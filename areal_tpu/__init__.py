@@ -1,0 +1,20 @@
+"""areal_tpu — a TPU-native asynchronous RL post-training framework for LLMs.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of AReaL
+(reference: Bruce-rl-hw/AReaL-vllm): fully-asynchronous GRPO/PPO with verifiable
+rewards, a pjit-sharded SPMD trainer, a JAX generation engine with continuous
+batching and interruptible decoding, and an async workflow executor with
+staleness control connecting the two.
+
+Layer map (mirrors reference areal/README.md:82-130, re-designed TPU-first):
+
+- ``areal_tpu.api``      — contracts: configs, allocation DSL, engine/workflow APIs
+- ``areal_tpu.models``   — functional transformer stacks (Qwen2/Llama family)
+- ``areal_tpu.ops``      — jnp + Pallas kernels (packed attention, GAE, ppo math)
+- ``areal_tpu.parallel`` — mesh construction, sharding rules, sequence parallelism
+- ``areal_tpu.engine``   — train engines (SFT, PPO actor) and inference clients
+- ``areal_tpu.inference``— the generation engine + HTTP server
+- ``areal_tpu.utils``    — name_resolve, stats, packing, recover, etc.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,292 @@
+"""Allocation-mode DSL: how devices are split between training and generation.
+
+Role of reference areal/api/alloc_mode.py (lark grammar, :253-320): a compact
+string names the parallel layout of each component, e.g.
+
+- ``d2t2p2``                     — colocated trainer, 5-D parallel factors
+- ``jaxgen.d4t2``                — generation servers only
+- ``jaxgen.d4t2+d8t1``           — decoupled: gen mesh + train mesh
+- ``jaxgen.d4t2+fsdp:d8``        — decoupled with an explicit train backend
+- ``jaxgen.d2+(attn:d2t2|ffn:d2e2)`` — MoE hybrid train spec (attn vs ffn)
+
+Factors (any order, default 1): ``d`` data, ``t`` tensor, ``p`` pipeline,
+``c`` context(sequence), ``e`` expert. TPU mapping: these become axis sizes of
+a `jax.sharding.Mesh` (areal_tpu/parallel/mesh.py); "generation servers" are
+JAX generation-engine processes on their own sub-slice.
+
+Implemented as a small recursive-descent parser rather than a lark grammar —
+the language is regular enough that a hand parser is clearer and dependency-free.
+"""
+
+import dataclasses
+import enum
+import re
+from typing import Dict, Optional
+
+GEN_BACKENDS = ("jaxgen", "sglang", "vllm")
+TRAIN_BACKENDS = ("spmd", "fsdp", "megatron")
+
+_FACTOR_RE = re.compile(r"([dtpce])(\d+)")
+_SPEC_RE = re.compile(r"^(?:[dtpce]\d+)+$")
+
+
+class AllocationType(enum.Enum):
+    COLOCATE = "colocate"
+    DECOUPLED_TRAIN = "decoupled_train"
+    LLM_SERVER_ONLY = "llm_server_only"
+    DECOUPLED_EVAL = "decoupled_eval"
+
+
+class AllocationValidationError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelStrategy:
+    """5-D parallel factors (reference alloc_mode.py:34 `ParallelStrategy`).
+
+    On TPU these are mesh-axis sizes: (data·fsdp, context, tensor) for dense
+    models, plus expert for MoE and pipeline for cross-slice stages.
+    """
+
+    data_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 1:
+                raise AllocationValidationError(f"{f.name} must be a positive int, got {v}")
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.tensor_parallel_size
+            * self.pipeline_parallel_size
+            * self.context_parallel_size
+        )
+
+    # expert data parallelism: experts are replicated over the remaining
+    # non-expert degrees (reference alloc_mode.py:119-124).
+    @property
+    def expert_data_parallel_size(self) -> int:
+        dcp = self.data_parallel_size * self.context_parallel_size
+        if dcp % self.expert_parallel_size != 0:
+            raise AllocationValidationError(
+                f"d*c={dcp} not divisible by e={self.expert_parallel_size}"
+            )
+        return dcp // self.expert_parallel_size
+
+    def to_str(self) -> str:
+        out = []
+        for ch, v in (
+            ("d", self.data_parallel_size),
+            ("t", self.tensor_parallel_size),
+            ("p", self.pipeline_parallel_size),
+            ("c", self.context_parallel_size),
+            ("e", self.expert_parallel_size),
+        ):
+            if v != 1:
+                out.append(f"{ch}{v}")
+        return "".join(out) or "d1"
+
+    @classmethod
+    def from_str(cls, s: str) -> "ParallelStrategy":
+        s = s.strip()
+        if not _SPEC_RE.match(s):
+            raise AllocationValidationError(f"bad parallel spec: {s!r}")
+        factors: Dict[str, int] = {}
+        for ch, num in _FACTOR_RE.findall(s):
+            if ch in factors:
+                raise AllocationValidationError(f"duplicate factor {ch!r} in {s!r}")
+            factors[ch] = int(num)
+        return cls(
+            data_parallel_size=factors.get("d", 1),
+            tensor_parallel_size=factors.get("t", 1),
+            pipeline_parallel_size=factors.get("p", 1),
+            context_parallel_size=factors.get("c", 1),
+            expert_parallel_size=factors.get("e", 1),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridTrainStrategy:
+    """MoE hybrid spec: distinct layouts for attention vs expert(ffn) blocks
+    (reference ``(attn:d2t2|ffn:d2e2)`` form, alloc_mode.py:81-124)."""
+
+    attn: ParallelStrategy
+    ffn: ParallelStrategy
+
+    def __post_init__(self):
+        attn_ws = self.attn.world_size
+        # on the ffn side `d` is expert-data parallelism, so experts occupy
+        # d × c × t × p × e devices (reference alloc_mode.py:81-124)
+        ffn_ws = self.ffn.world_size * self.ffn.expert_parallel_size
+        if attn_ws != ffn_ws:
+            raise AllocationValidationError(
+                f"attn world size {attn_ws} != ffn world size {ffn_ws}"
+            )
+
+    @property
+    def world_size(self) -> int:
+        return self.attn.world_size
+
+
+@dataclasses.dataclass(frozen=True)
+class AllocationMode:
+    """Parsed allocation string (reference alloc_mode.py:294 `from_str`)."""
+
+    type_: AllocationType
+    train: Optional[ParallelStrategy] = None
+    gen: Optional[ParallelStrategy] = None
+    gen_backend: Optional[str] = None
+    train_backend: Optional[str] = None
+    train_hybrid: Optional[HybridTrainStrategy] = None
+
+    @property
+    def train_world_size(self) -> int:
+        if self.train_hybrid is not None:
+            return self.train_hybrid.world_size
+        return self.train.world_size if self.train else 0
+
+    @property
+    def gen_world_size(self) -> int:
+        return self.gen.world_size if self.gen else 0
+
+    @property
+    def world_size(self) -> int:
+        return self.train_world_size + self.gen_world_size
+
+    @classmethod
+    def from_str(cls, s: str) -> "AllocationMode":
+        s = s.strip().replace(" ", "")
+        if not s:
+            raise AllocationValidationError("empty allocation string")
+        parts = _split_top(s, "+")
+        if len(parts) > 2:
+            raise AllocationValidationError(f"too many '+' components in {s!r}")
+        if len(parts) == 2:
+            gen_backend, gen = _parse_gen(parts[0])
+            train_backend, train, hybrid = _parse_train(parts[1])
+            return cls(
+                type_=AllocationType.DECOUPLED_TRAIN,
+                train=train,
+                gen=gen,
+                gen_backend=gen_backend,
+                train_backend=train_backend,
+                train_hybrid=hybrid,
+            )
+        part = parts[0]
+        # "backend.spec" → server only; bare spec → colocate
+        prefix = _backend_prefix(part)
+        if prefix in GEN_BACKENDS:
+            gen_backend, gen = _parse_gen(part)
+            return cls(type_=AllocationType.LLM_SERVER_ONLY, gen=gen, gen_backend=gen_backend)
+        train_backend, train, hybrid = _parse_train(part)
+        return cls(
+            type_=AllocationType.COLOCATE,
+            train=train,
+            gen=train,
+            train_backend=train_backend,
+            train_hybrid=hybrid,
+        )
+
+    def to_str(self) -> str:
+        if self.type_ == AllocationType.LLM_SERVER_ONLY:
+            return f"{self.gen_backend}.{self.gen.to_str()}"
+        if self.train_hybrid is not None:
+            train = f"(attn:{self.train_hybrid.attn.to_str()}|ffn:{self.train_hybrid.ffn.to_str()})"
+        else:
+            train = self.train.to_str() if self.train else ""
+        if self.train_backend:
+            train = f"{self.train_backend}:{train}"
+        if self.type_ == AllocationType.COLOCATE:
+            return train
+        return f"{self.gen_backend}.{self.gen.to_str()}+{train}"
+
+
+def _split_top(s: str, sep: str):
+    """Split on `sep` outside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth < 0:
+                raise AllocationValidationError(f"unbalanced parens in {s!r}")
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if depth != 0:
+        raise AllocationValidationError(f"unbalanced parens in {s!r}")
+    parts.append("".join(cur))
+    return parts
+
+
+def _backend_prefix(s: str) -> Optional[str]:
+    for sep in (".", ":"):
+        if sep in s:
+            head = s.split(sep, 1)[0]
+            if head.isalpha():
+                return head
+    return None
+
+
+def _parse_gen(s: str):
+    prefix = _backend_prefix(s)
+    if prefix is None:
+        raise AllocationValidationError(
+            f"generation spec {s!r} needs a backend prefix, e.g. 'jaxgen.{s}'"
+        )
+    if prefix not in GEN_BACKENDS:
+        raise AllocationValidationError(
+            f"unknown generation backend {prefix!r} (known: {GEN_BACKENDS})"
+        )
+    body = s[len(prefix) + 1 :]
+    strat = ParallelStrategy.from_str(body)
+    if strat.pipeline_parallel_size != 1 or strat.expert_parallel_size != 1:
+        # generation engine scales by server replicas (d) × tensor (t) × context (c)
+        raise AllocationValidationError(
+            f"generation spec {s!r}: p/e factors are not supported on the gen side"
+        )
+    return prefix, strat
+
+
+def _parse_train(s: str):
+    backend = None
+    prefix = _backend_prefix(s)
+    if prefix is not None and not s.startswith("("):
+        if prefix in TRAIN_BACKENDS:
+            backend = prefix
+            s = s[len(prefix) + 1 :]
+        elif prefix in GEN_BACKENDS:
+            raise AllocationValidationError(f"gen backend {prefix!r} in train position")
+        elif not _SPEC_RE.match(s):
+            raise AllocationValidationError(f"unknown train backend {prefix!r}")
+    if s.startswith("("):
+        if not s.endswith(")"):
+            raise AllocationValidationError(f"bad hybrid spec {s!r}")
+        inner = s[1:-1]
+        sides = _split_top(inner, "|")
+        if len(sides) != 2:
+            raise AllocationValidationError(f"hybrid spec needs attn|ffn: {s!r}")
+        spec = {}
+        for side in sides:
+            if ":" not in side:
+                raise AllocationValidationError(f"bad hybrid component {side!r}")
+            name, body = side.split(":", 1)
+            if name not in ("attn", "ffn"):
+                raise AllocationValidationError(f"hybrid component must be attn/ffn: {name!r}")
+            spec[name] = ParallelStrategy.from_str(body)
+        if set(spec) != {"attn", "ffn"}:
+            raise AllocationValidationError(f"hybrid spec needs both attn and ffn: {s!r}")
+        hybrid = HybridTrainStrategy(attn=spec["attn"], ffn=spec["ffn"])
+        return backend, spec["attn"], hybrid
+    return backend, ParallelStrategy.from_str(s), None

@@ -1,0 +1,442 @@
+"""Experiment configuration dataclasses + YAML/CLI loading.
+
+Role of reference areal/api/cli_args.py: every experiment is a nested
+dataclass tree, loaded from a YAML file (``--config path.yaml``) and
+overridden by dotted CLI args (``actor.optimizer.lr=1e-5``). The reference
+uses OmegaConf; here a small recursive merge over ``dataclasses.fields`` does
+the same job dependency-free.
+"""
+
+import argparse
+import dataclasses
+import enum
+import os
+import sys
+import typing
+from typing import Any, Dict, List, Optional, Tuple, Type, TypeVar
+
+import yaml
+
+T = TypeVar("T")
+
+
+# --------------------------------------------------------------------------
+# Generation
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class GenerationHyperparameters:
+    """Sampling options for rollout (reference cli_args.py:82)."""
+
+    n_samples: int = 1
+    max_new_tokens: int = 512
+    min_new_tokens: int = 0
+    greedy: bool = False
+    top_p: float = 1.0
+    top_k: int = 0  # 0 disables top-k
+    temperature: float = 1.0
+    stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+
+    def new(self, **kwargs) -> "GenerationHyperparameters":
+        return dataclasses.replace(self, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# Training
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class OptimizerConfig:
+    """optax optimizer spec (reference cli_args.py:140)."""
+
+    type: str = "adamw"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    gradient_clipping: float = 1.0
+    offload_optimizer_state: bool = False
+
+
+@dataclasses.dataclass
+class MicroBatchSpec:
+    """Token-budget micro-batching (reference api/cli_args MicroBatchSpec)."""
+
+    n_mbs: int = 1
+    max_tokens_per_mb: int = 32768
+
+
+@dataclasses.dataclass
+class ParallelismConfig:
+    """Trainer mesh axis sizes. On TPU these build one
+    jax.sharding.Mesh with axes (data, fsdp, seq, tensor); data×fsdp shards
+    the batch + optimizer state, seq is Ulysses-style sequence parallelism,
+    tensor shards weights within attention/MLP blocks."""
+
+    data_parallel_size: int = 1
+    fsdp_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    seq_parallel_size: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.fsdp_parallel_size
+            * self.tensor_parallel_size
+            * self.seq_parallel_size
+        )
+
+
+@dataclasses.dataclass
+class TrainEngineConfig:
+    """Train-engine spec (reference cli_args.py:223)."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    path: str = ""  # HF checkpoint path or model preset name
+    init_from_scratch: bool = False
+    dtype: str = "bfloat16"
+    param_dtype: str = "bfloat16"  # parameter storage dtype
+    grad_dtype: str = "float32"
+    disable_dropout: bool = True
+    gradient_checkpointing: bool = True
+    mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
+    optimizer: Optional[OptimizerConfig] = dataclasses.field(default_factory=OptimizerConfig)
+    parallel: ParallelismConfig = dataclasses.field(default_factory=ParallelismConfig)
+    backend: str = "spmd"
+
+
+@dataclasses.dataclass
+class AdvNormConfig:
+    """Advantage normalization (reference ppo/actor.py:370 `AdvNorm`)."""
+
+    mean_level: str = "batch"  # batch | group | none
+    std_level: str = "batch"  # batch | group | none
+    group_size: int = 1
+
+
+@dataclasses.dataclass
+class PPOActorConfig(TrainEngineConfig):
+    """GRPO/PPO algorithm options (reference cli_args.py:274)."""
+
+    group_size: int = 1  # answers per prompt (GRPO group)
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    eps_clip_higher: Optional[float] = None  # asymmetric upper clip (DAPO)
+    c_clip: Optional[float] = None  # dual clip
+    temperature: float = 1.0
+    gamma: float = 1.0
+    lam: float = 1.0
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    reward_clip: float = 20.0
+    group_reward_norm: bool = False
+    adv_norm: AdvNormConfig = dataclasses.field(default_factory=AdvNormConfig)
+    kl_ctl: float = 0.0
+    recompute_logprob: bool = True
+    use_decoupled_loss: bool = True
+    behav_imp_weight_cap: Optional[float] = None
+    dynamic_sampling: bool = False
+    # overlong reward penalty (DAPO; reference utils/functional.py:237)
+    overlong_reward_penalty: bool = False
+    overlong_tokens: int = 0
+    overlong_penalty_factor: float = 0.0
+    max_new_tokens: int = 512
+
+
+# --------------------------------------------------------------------------
+# Inference / rollout
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class InferenceEngineConfig:
+    """Async rollout control (reference cli_args.py:531)."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    max_concurrent_rollouts: Optional[int] = None
+    queue_size: Optional[int] = None
+    consumer_batch_size: int = 1
+    max_head_offpolicyness: int = 0  # staleness η: max model-version lead
+    enable_rollout_tracing: bool = False
+    schedule_policy: str = "round_robin"  # round_robin | least_requests
+    request_timeout: float = 3600.0
+    request_retries: int = 3
+    setup_timeout: float = 120.0
+    pause_grace_period: float = 0.0
+
+
+@dataclasses.dataclass
+class JaxGenConfig:
+    """Generation-engine/server spec — the analog of the reference's
+    SGLangConfig (cli_args.py:458), but describing the in-repo JAX engine."""
+
+    model_path: str = ""
+    dtype: str = "bfloat16"
+    seed: int = 1
+    max_num_seqs: int = 64  # decode slots
+    max_model_len: int = 4096
+    prefill_chunk: int = 512
+    page_size: int = 128
+    tensor_parallel_size: int = 1
+    mem_fraction: float = 0.85
+    enable_metrics: bool = True
+    log_level: str = "info"
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = auto
+
+    @staticmethod
+    def build_cmd(
+        config: "JaxGenConfig",
+        host: str,
+        port: int,
+        experiment_name: str = "",
+        trial_name: str = "",
+    ) -> List[str]:
+        """Command line for a standalone generation server process."""
+        args = [
+            sys.executable,
+            "-m",
+            "areal_tpu.inference.server",
+            f"--model-path={config.model_path}",
+            f"--host={host}",
+            f"--port={port}",
+            f"--max-num-seqs={config.max_num_seqs}",
+            f"--max-model-len={config.max_model_len}",
+            f"--dtype={config.dtype}",
+            f"--tensor-parallel-size={config.tensor_parallel_size}",
+            f"--seed={config.seed}",
+        ]
+        if experiment_name:
+            args.append(f"--experiment-name={experiment_name}")
+        if trial_name:
+            args.append(f"--trial-name={trial_name}")
+        return args
+
+
+# --------------------------------------------------------------------------
+# Aux subsystems
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class SaverConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu"
+    freq_epochs: Optional[int] = None
+    freq_steps: Optional[int] = None
+    freq_secs: Optional[int] = None
+
+
+@dataclasses.dataclass
+class EvaluatorConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = "/tmp/areal_tpu"
+    freq_epochs: Optional[int] = None
+    freq_steps: Optional[int] = None
+    freq_secs: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RecoverConfig:
+    mode: str = "disabled"  # disabled | auto | fault | resume
+    retries: int = 3
+    freq_epochs: Optional[int] = None
+    freq_steps: Optional[int] = None
+    freq_secs: Optional[int] = 600
+
+
+@dataclasses.dataclass
+class NameResolveConfig:
+    type: str = "nfs"  # memory | nfs
+    nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+
+
+@dataclasses.dataclass
+class ClusterSpecConfig:
+    name_resolve: NameResolveConfig = dataclasses.field(default_factory=NameResolveConfig)
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu"
+    n_devices_per_node: int = 8
+
+
+@dataclasses.dataclass
+class DatasetConfig:
+    path: str = ""
+    type: str = "gsm8k"
+    batch_size: int = 8
+    shuffle: bool = True
+    max_length: Optional[int] = None
+    drop_last: bool = True
+
+
+@dataclasses.dataclass
+class LauncherConfig:
+    inference_server_cpus_per_task: int = 4
+    inference_server_mem: int = 32768
+    trainer_cpus_per_task: int = 4
+    trainer_mem: int = 32768
+
+
+# --------------------------------------------------------------------------
+# Experiments
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class BaseExperimentConfig:
+    experiment_name: str = "experiment"
+    trial_name: str = "trial"
+    cluster: ClusterSpecConfig = dataclasses.field(default_factory=ClusterSpecConfig)
+    allocation_mode: str = ""
+    seed: int = 1
+    total_train_epochs: int = 1
+    total_train_steps: Optional[int] = None
+    tokenizer_path: str = ""
+    train_dataset: DatasetConfig = dataclasses.field(default_factory=DatasetConfig)
+    valid_dataset: Optional[DatasetConfig] = None
+    saver: SaverConfig = dataclasses.field(default_factory=SaverConfig)
+    checkpointer: SaverConfig = dataclasses.field(default_factory=SaverConfig)
+    evaluator: EvaluatorConfig = dataclasses.field(default_factory=EvaluatorConfig)
+    recover: RecoverConfig = dataclasses.field(default_factory=RecoverConfig)
+    launcher: LauncherConfig = dataclasses.field(default_factory=LauncherConfig)
+
+
+@dataclasses.dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = dataclasses.field(default_factory=TrainEngineConfig)
+
+
+@dataclasses.dataclass
+class GRPOConfig(BaseExperimentConfig):
+    async_training: bool = True
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    rollout: InferenceEngineConfig = dataclasses.field(default_factory=InferenceEngineConfig)
+    server: JaxGenConfig = dataclasses.field(default_factory=JaxGenConfig)
+    actor: PPOActorConfig = dataclasses.field(default_factory=PPOActorConfig)
+    ref: Optional[PPOActorConfig] = None
+
+
+# --------------------------------------------------------------------------
+# Loading / merging
+# --------------------------------------------------------------------------
+def _is_optional(tp) -> Tuple[bool, Any]:
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return True, args[0]
+    return False, tp
+
+
+def from_dict(cls: Type[T], data: Dict[str, Any]) -> T:
+    """Recursively build a dataclass from a nested dict."""
+    if data is None:
+        data = {}
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls} is not a dataclass")
+    kwargs = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        if key not in fields:
+            raise ValueError(f"unknown config key {key!r} for {cls.__name__}")
+        ftype = fields[key].type
+        if isinstance(ftype, str):
+            ftype = typing.get_type_hints(cls)[key]
+        _, inner = _is_optional(ftype)
+        if dataclasses.is_dataclass(inner) and isinstance(value, dict):
+            kwargs[key] = from_dict(inner, value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)
+
+
+def to_dict(obj) -> Dict[str, Any]:
+    return dataclasses.asdict(obj)
+
+
+def _coerce(existing: Any, raw: str) -> Any:
+    s = raw.strip()
+    low = s.lower()
+    if low in ("null", "none"):
+        return None
+    if low in ("true", "false"):
+        return low == "true"
+    if isinstance(existing, bool):
+        return low in ("true", "1", "yes")
+    for caster in (int, float):
+        try:
+            return caster(s)
+        except ValueError:
+            pass
+    if s.startswith("[") or s.startswith("{"):
+        return yaml.safe_load(s)
+    return s
+
+
+def apply_override(obj: Any, dotted: str, raw_value: str) -> None:
+    """Apply ``a.b.c=value`` onto a dataclass tree in place-ish (rebuilds
+    leaves as needed; dataclasses here are mutable so set directly)."""
+    parts = dotted.split(".")
+    target = obj
+    for p in parts[:-1]:
+        if not hasattr(target, p):
+            raise ValueError(f"unknown config key {dotted!r}")
+        nxt = getattr(target, p)
+        if nxt is None:
+            # instantiate Optional[dataclass] nodes on demand
+            hints = typing.get_type_hints(type(target))
+            _, inner = _is_optional(hints[p])
+            if dataclasses.is_dataclass(inner):
+                nxt = inner()
+                setattr(target, p, nxt)
+            else:
+                raise ValueError(f"cannot descend into None field {p!r}")
+        target = nxt
+    leaf = parts[-1]
+    if not hasattr(target, leaf):
+        raise ValueError(f"unknown config key {dotted!r}")
+    setattr(target, leaf, _coerce(getattr(target, leaf), raw_value))
+
+
+def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
+    """Parse ``--config file.yaml key=value ...`` into `config_cls`
+    (reference cli_args.py:922 `load_expr_config`). Returns (config, path)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default=None)
+    args, overrides = parser.parse_known_args(argv)
+    data = {}
+    if args.config:
+        with open(args.config) as f:
+            data = yaml.safe_load(f) or {}
+    cfg = from_dict(config_cls, data)
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} must look like key=value")
+        key, value = ov.split("=", 1)
+        apply_override(cfg, key, value)
+    _propagate_names(cfg)
+    return cfg, args.config or ""
+
+
+def _propagate_names(cfg) -> None:
+    """Copy experiment/trial names into sub-configs that carry them
+    (the reference does this in each entry point)."""
+    exp = getattr(cfg, "experiment_name", None)
+    trial = getattr(cfg, "trial_name", None)
+    fileroot = None
+    cluster = getattr(cfg, "cluster", None)
+    if cluster is not None:
+        fileroot = cluster.fileroot
+    if not exp:
+        return
+    for f in dataclasses.fields(cfg):
+        sub = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(sub) and not isinstance(sub, type):
+            if hasattr(sub, "experiment_name") and not sub.experiment_name:
+                sub.experiment_name = exp
+            if hasattr(sub, "trial_name") and not sub.trial_name:
+                sub.trial_name = trial
+            if fileroot and hasattr(sub, "fileroot"):
+                sub.fileroot = fileroot

@@ -1,0 +1,164 @@
+"""Request/response and metadata structs shared across engines.
+
+Role of reference areal/api/io_struct.py: the wire-level contracts between
+workflows, inference engines, and train engines.
+"""
+
+import dataclasses
+import enum
+import itertools
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+
+
+@dataclasses.dataclass
+class ModelRequest:
+    """One generation request (reference io_struct.py:22)."""
+
+    rid: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    input_ids: List[int] = dataclasses.field(default_factory=list)
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelResponse:
+    """Generation result (reference io_struct.py:38). Token-in/token-out;
+    logprobs are the behavior policy's sampled-token logprobs and `versions`
+    records the weight version that produced each output token (for
+    staleness-aware decoupled PPO)."""
+
+    input_tokens: List[int] = dataclasses.field(default_factory=list)
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    output_logprobs: List[float] = dataclasses.field(default_factory=list)
+    output_versions: List[int] = dataclasses.field(default_factory=list)
+    stop_reason: str = "stop"  # stop | length | abort
+    latency: float = 0.0
+    ttft: float = 0.0
+
+    @property
+    def input_len(self) -> int:
+        return len(self.input_tokens)
+
+    @property
+    def output_len(self) -> int:
+        return len(self.output_tokens)
+
+
+class WeightUpdateMethod(enum.Enum):
+    DISK = "disk"
+    DEVICE = "device"  # cross-mesh device transfer (ICI/DCN), NCCL-bcast analog
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Flat description of one parameter for chunked transfer
+    (reference io_struct.py ParamSpec)."""
+
+    name: str
+    shape: List[int]
+    dtype: str
+
+    @property
+    def size_bytes(self) -> int:
+        import numpy as np
+
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass
+class WeightUpdateMeta:
+    """How fresh weights travel trainer → generation engine
+    (reference io_struct.py:126)."""
+
+    type: WeightUpdateMethod = WeightUpdateMethod.DISK
+    path: Optional[str] = None  # disk: checkpoint dir
+    model_version: int = 0
+    chunk_bytes: int = 1 << 30  # device path: FFD chunking budget
+    param_specs: List[ParamSpec] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_disk(cls, experiment_name: str, trial_name: str, fileroot: str,
+                  model_version: int = 0) -> "WeightUpdateMeta":
+        import os
+
+        path = os.path.join(
+            fileroot, experiment_name, trial_name, "weight_update", f"v{model_version}"
+        )
+        return cls(type=WeightUpdateMethod.DISK, path=path, model_version=model_version)
+
+
+@dataclasses.dataclass
+class SaveLoadMeta:
+    """Checkpoint save/load request (reference io_struct.py:144)."""
+
+    path: str
+    weight_format: str = "orbax"  # orbax | hf
+    with_optim: bool = False
+    tokenizer_path: Optional[str] = None
+    base_model_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FinetuneSpec:
+    """Dataset-epoch accounting (reference io_struct.py FinetuneSpec)."""
+
+    total_train_epochs: int
+    dataset_size: int
+    train_batch_size: int
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(1, self.dataset_size // self.train_batch_size)
+
+    @property
+    def total_train_steps(self) -> int:
+        return self.total_train_epochs * self.steps_per_epoch
+
+
+@dataclasses.dataclass
+class StepInfo:
+    """Global/epoch step bookkeeping (reference io_struct.py:169)."""
+
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+    steps_per_epoch: int = 1
+
+    def next(self) -> "StepInfo":
+        ep_step = self.epoch_step + 1
+        epoch = self.epoch
+        if ep_step >= self.steps_per_epoch:
+            ep_step = 0
+            epoch += 1
+        return StepInfo(
+            epoch=epoch,
+            epoch_step=ep_step,
+            global_step=self.global_step + 1,
+            steps_per_epoch=self.steps_per_epoch,
+        )
+
+
+@dataclasses.dataclass
+class RolloutStat:
+    """Rollout lifecycle counters (reference io_struct.py RolloutStat)."""
+
+    submitted: int = 0
+    accepted: int = 0
+    running: int = 0
+    rejected: int = 0
+
+
+_COUNTER = itertools.count()
+
+
+def unique_rid(prefix: str = "req") -> str:
+    return f"{prefix}-{int(time.time()*1000)}-{next(_COUNTER)}"

@@ -1,0 +1,149 @@
+"""ctypes loader for the native helpers in interval_ops.cpp.
+
+Builds the shared library on first import (g++ is in the image; no pybind11
+needed — plain C ABI + ctypes). All entry points degrade gracefully: callers
+fall back to numpy implementations when the toolchain is unavailable.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "interval_ops.cpp")
+_LIB_PATH = os.path.join(_DIR, "libinterval_ops.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB_PATH]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+# Build eagerly so a missing toolchain surfaces as ImportError here and
+# callers (utils/datapack.py) fall back to their numpy paths, instead of
+# crashing at first call.
+def _ensure_available() -> None:
+    try:
+        _load()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        raise ImportError(f"areal_tpu.csrc native build unavailable: {e}") from e
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.merge_intervals.restype = ctypes.c_int64
+        lib.merge_intervals.argtypes = [i64p, i64p, ctypes.c_int64]
+        lib.slice_intervals_f32.restype = ctypes.c_int64
+        lib.slice_intervals_f32.argtypes = [f32p, i64p, i64p, ctypes.c_int64, f32p]
+        lib.set_intervals_f32.restype = ctypes.c_int64
+        lib.set_intervals_f32.argtypes = [f32p, i64p, i64p, ctypes.c_int64, f32p]
+        lib.slice_intervals_u16.restype = ctypes.c_int64
+        lib.slice_intervals_u16.argtypes = [u16p, i64p, i64p, ctypes.c_int64, u16p]
+        lib.set_intervals_u16.restype = ctypes.c_int64
+        lib.set_intervals_u16.argtypes = [u16p, i64p, i64p, ctypes.c_int64, u16p]
+        lib.ffd_allocate.restype = ctypes.c_int64
+        lib.ffd_allocate.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i64p]
+        _lib = lib
+        return lib
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def merge_intervals(intervals: Sequence) -> List:
+    """Coalesce sorted [start, end) pairs (reference csrc/interval_op.cpp:4-29)."""
+    arr = np.asarray(intervals, dtype=np.int64)
+    if arr.size == 0:
+        return []
+    starts = np.ascontiguousarray(arr[:, 0])
+    ends = np.ascontiguousarray(arr[:, 1])
+    lib = _load()
+    n = lib.merge_intervals(_i64(starts), _i64(ends), len(starts))
+    return list(zip(starts[:n].tolist(), ends[:n].tolist()))
+
+
+def slice_intervals(src: np.ndarray, intervals: Sequence) -> np.ndarray:
+    """Gather many (start, end) slices of a flat array into one contiguous
+    array (reference csrc/interval_op.cu slice_intervals)."""
+    arr = np.asarray(intervals, dtype=np.int64).reshape(-1, 2)
+    starts = np.ascontiguousarray(arr[:, 0])
+    ends = np.ascontiguousarray(arr[:, 1])
+    total = int((ends - starts).sum())
+    src = np.ascontiguousarray(src)
+    lib = _load()
+    if src.dtype == np.float32:
+        out = np.empty(total, np.float32)
+        lib.slice_intervals_f32(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _i64(starts), _i64(ends), len(starts),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+    if src.dtype.itemsize == 2:
+        view = src.view(np.uint16)
+        out = np.empty(total, np.uint16)
+        lib.slice_intervals_u16(
+            view.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            _i64(starts), _i64(ends), len(starts),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)))
+        return out.view(src.dtype)
+    return np.concatenate([src[s:e] for s, e in zip(starts, ends)])
+
+
+def set_intervals(src: np.ndarray, dst: np.ndarray, intervals: Sequence) -> None:
+    """Scatter a contiguous array into many (start, end) slices of `dst`
+    (reference csrc/interval_op.cu set_intervals)."""
+    arr = np.asarray(intervals, dtype=np.int64).reshape(-1, 2)
+    starts = np.ascontiguousarray(arr[:, 0])
+    ends = np.ascontiguousarray(arr[:, 1])
+    src = np.ascontiguousarray(src)
+    assert dst.flags["C_CONTIGUOUS"]
+    lib = _load()
+    if dst.dtype == np.float32:
+        lib.set_intervals_f32(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _i64(starts), _i64(ends), len(starts),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    elif dst.dtype.itemsize == 2:
+        lib.set_intervals_u16(
+            src.view(np.uint16).ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            _i64(starts), _i64(ends), len(starts),
+            dst.view(np.uint16).ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)))
+    else:
+        off = 0
+        for s, e in zip(starts, ends):
+            dst[s:e] = src[off : off + (e - s)]
+            off += e - s
+
+
+def ffd_allocate(sizes: Sequence[int], capacity: int, min_groups: int = 1) -> List[List[int]]:
+    """First-fit-decreasing bin packing; returns index groups."""
+    sizes_arr = np.ascontiguousarray(np.asarray(sizes, dtype=np.int64))
+    n = len(sizes_arr)
+    if n == 0:
+        return []
+    bin_of = np.empty(n, np.int64)
+    lib = _load()
+    n_bins = lib.ffd_allocate(_i64(sizes_arr), n, capacity, min_groups, _i64(bin_of))
+    groups: List[List[int]] = [[] for _ in range(n_bins)]
+    for idx, b in enumerate(bin_of.tolist()):
+        groups[b].append(idx)
+    return groups
+
+
+_ensure_available()

@@ -1,0 +1,120 @@
+// Native helpers for host-side batching and parameter repacking.
+//
+// TPU-native equivalent of the reference's csrc/ extensions
+// (csrc/interval_op/interval_op.cpp merge_intervals; interval_op.cu
+// slice/set_intervals; plus an FFD bin-packing fast path used by
+// areal_tpu/utils/datapack.py). On TPU the *device-side* scatter/gather of
+// param slices is obviated by jax.Array resharding, but the host staging
+// path (weight export to generation servers) still slices many
+// (offset, len) intervals out of flat buffers — done here in C++.
+//
+// C ABI only; loaded from Python via ctypes (see __init__.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Coalesce sorted [start, end) intervals in-place.
+// Returns the number of merged intervals written back to `starts`/`ends`.
+int64_t merge_intervals(int64_t* starts, int64_t* ends, int64_t n) {
+  if (n <= 0) return 0;
+  int64_t w = 0;
+  for (int64_t i = 1; i < n; ++i) {
+    if (starts[i] == ends[w]) {
+      ends[w] = ends[i];
+    } else {
+      ++w;
+      starts[w] = starts[i];
+      ends[w] = ends[i];
+    }
+  }
+  return w + 1;
+}
+
+// Gather many [start, end) intervals of a flat float32 buffer into `out`
+// (contiguous). Returns total elements copied.
+int64_t slice_intervals_f32(const float* src, const int64_t* starts,
+                            const int64_t* ends, int64_t n, float* out) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = ends[i] - starts[i];
+    std::memcpy(out + off, src + starts[i], sizeof(float) * len);
+    off += len;
+  }
+  return off;
+}
+
+// Scatter a contiguous float32 buffer back into many [start, end) intervals.
+int64_t set_intervals_f32(const float* src, const int64_t* starts,
+                          const int64_t* ends, int64_t n, float* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = ends[i] - starts[i];
+    std::memcpy(dst + starts[i], src + off, sizeof(float) * len);
+    off += len;
+  }
+  return off;
+}
+
+// 16-bit variants (bf16/fp16 move as opaque uint16).
+int64_t slice_intervals_u16(const uint16_t* src, const int64_t* starts,
+                            const int64_t* ends, int64_t n, uint16_t* out) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = ends[i] - starts[i];
+    std::memcpy(out + off, src + starts[i], sizeof(uint16_t) * len);
+    off += len;
+  }
+  return off;
+}
+
+int64_t set_intervals_u16(const uint16_t* src, const int64_t* starts,
+                          const int64_t* ends, int64_t n, uint16_t* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t len = ends[i] - starts[i];
+    std::memcpy(dst + starts[i], src + off, sizeof(uint16_t) * len);
+    off += len;
+  }
+  return off;
+}
+
+// First-fit-decreasing bin packing. Writes the bin id of each item into
+// `bin_of` and returns the number of bins used (>= min_groups).
+int64_t ffd_allocate(const int64_t* sizes, int64_t n, int64_t capacity,
+                     int64_t min_groups, int64_t* bin_of) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return sizes[a] > sizes[b]; });
+  std::vector<int64_t> loads;
+  std::vector<bool> empty_flag;
+  loads.assign(std::max<int64_t>(min_groups, 1), 0);
+  empty_flag.assign(loads.size(), true);
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t idx = order[k];
+    int64_t size = sizes[idx];
+    int64_t placed = -1;
+    for (size_t b = 0; b < loads.size(); ++b) {
+      if (loads[b] + size <= capacity || (empty_flag[b] && size > capacity)) {
+        placed = static_cast<int64_t>(b);
+        break;
+      }
+    }
+    if (placed < 0) {
+      loads.push_back(0);
+      empty_flag.push_back(true);
+      placed = static_cast<int64_t>(loads.size()) - 1;
+    }
+    loads[placed] += size;
+    empty_flag[placed] = false;
+    bin_of[idx] = placed;
+  }
+  return static_cast<int64_t>(loads.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,250 @@
+"""Batch containers and padded↔packed conversion.
+
+Role of reference areal/utils/data.py: RL data is ragged (prompt+completion
+lengths vary); the trainer wants it packed (one flat token stream with
+sequence boundaries) and micro-batched under a token budget. The reference
+uses TensorDict + flash-attn varlen cu_seqlens with fully dynamic shapes.
+
+TPU redesign: a *batch* is a plain ``dict[str, np.ndarray]`` in padded layout
+(`[B, L]` per-token keys + ``attention_mask``; `[B]` per-sequence keys). For
+the device we convert to a *packed* layout — flat `[T_pad]` token stream with
+``segment_ids`` (1-based; 0 marks padding) and ``positions`` — padded up to a
+static bucket size so XLA compiles one kernel per bucket instead of one per
+shape. Attention uses segment-id masking, the TPU analog of cu_seqlens varlen
+attention (reference areal/utils/data.py:245-300).
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.utils import datapack
+
+Batch = Dict[str, np.ndarray]
+
+# Default bucket ladder: multiples of 256 up to 8k then powers of two. Static
+# shapes are what lets XLA tile the MXU without recompiling per batch.
+_BUCKET_QUANTUM = 256
+
+
+def next_bucket_size(n: int, quantum: int = _BUCKET_QUANTUM) -> int:
+    """Smallest bucket >= n: quantized to `quantum` below 8192, else pow2."""
+    n = max(int(n), 1)
+    if n <= 8192:
+        return ((n + quantum - 1) // quantum) * quantum
+    out = 8192
+    while out < n:
+        out *= 2
+    return out
+
+
+def pad_sequences_to_tensors(
+    sequences: List[np.ndarray], pad_value: float = 0.0
+) -> Dict[str, np.ndarray]:
+    """Stack ragged 1-D arrays into [B, L_max] + attention_mask."""
+    if not sequences:
+        return dict(input_ids=np.zeros((0, 0), np.int32), attention_mask=np.zeros((0, 0), np.bool_))
+    max_len = max(len(s) for s in sequences)
+    out = np.full((len(sequences), max_len), pad_value, dtype=np.asarray(sequences[0]).dtype)
+    mask = np.zeros((len(sequences), max_len), dtype=np.bool_)
+    for i, s in enumerate(sequences):
+        out[i, : len(s)] = s
+        mask[i, : len(s)] = True
+    return dict(input_ids=out, attention_mask=mask)
+
+
+def concat_padded_tensors(
+    batches: List[Batch], pad_value: float = 0.0
+) -> Batch:
+    """Concatenate padded batches along B, re-padding to the common max length
+    (reference areal/utils/data.py:120)."""
+    batches = [b for b in batches if b]
+    if not batches:
+        return {}
+    keys = set(batches[0].keys())
+    for b in batches[1:]:
+        if set(b.keys()) != keys:
+            raise ValueError(f"key mismatch: {keys} vs {set(b.keys())}")
+    per_token_keys = {
+        k for k in keys if np.asarray(batches[0][k]).ndim >= 2
+    }
+    max_len = max(np.asarray(b["attention_mask"]).shape[1] for b in batches)
+    out: Batch = {}
+    for k in keys:
+        parts = []
+        for b in batches:
+            v = np.asarray(b[k])
+            if k in per_token_keys and v.shape[1] < max_len:
+                pad_width = [(0, 0), (0, max_len - v.shape[1])] + [(0, 0)] * (v.ndim - 2)
+                fill = False if v.dtype == np.bool_ else pad_value
+                v = np.pad(v, pad_width, constant_values=fill)
+            parts.append(v)
+        out[k] = np.concatenate(parts, axis=0)
+    return out
+
+
+def batch_select(batch: Batch, indices: Sequence[int]) -> Batch:
+    idx = np.asarray(indices, dtype=np.int64)
+    return {k: np.asarray(v)[idx] for k, v in batch.items()}
+
+
+def batch_size(batch: Batch) -> int:
+    return int(np.asarray(next(iter(batch.values()))).shape[0])
+
+
+def trim_batch(batch: Batch) -> Batch:
+    """Drop fully-padded tail columns (keeps padded layout minimal)."""
+    mask = np.asarray(batch["attention_mask"])
+    if mask.size == 0:
+        return batch
+    lens = mask.sum(1)
+    max_len = int(lens.max()) if len(lens) else 0
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        out[k] = v[:, :max_len] if v.ndim >= 2 and v.shape[1] >= max_len else v
+    return out
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """Flat packed device layout. All per-token arrays have shape [T_pad].
+
+    ``segment_ids`` is 1-based per sequence with 0 on padding; ``positions``
+    restart at 0 per sequence. ``seq_lens`` has shape [B_pad]; rows past
+    ``num_seqs`` are padding (a row within ``num_seqs`` may legitimately have
+    length 0). Extra per-token keys (loss_mask, logprobs, ...) live in
+    ``per_token``; per-sequence keys (rewards, ...) in ``per_seq``.
+    """
+
+    tokens: np.ndarray
+    segment_ids: np.ndarray
+    positions: np.ndarray
+    seq_lens: np.ndarray
+    num_seqs: int
+    per_token: Dict[str, np.ndarray]
+    per_seq: Dict[str, np.ndarray]
+
+    @property
+    def total_tokens(self) -> int:
+        return int((self.segment_ids > 0).sum())
+
+    @property
+    def n_seqs(self) -> int:
+        return self.num_seqs
+
+
+def pack_batch(
+    batch: Batch,
+    pad_to: Optional[int] = None,
+    pad_seqs_to: Optional[int] = None,
+) -> PackedBatch:
+    """Padded [B, L] batch → packed flat layout (reference data.py:245
+    `pack_tensor_dict`, re-shaped for static TPU buckets)."""
+    mask = np.asarray(batch["attention_mask"]).astype(bool)
+    bsz, _ = mask.shape
+    lens = mask.sum(1).astype(np.int32)
+    total = int(lens.sum())
+    t_pad = pad_to if pad_to is not None else next_bucket_size(total)
+    if t_pad < total:
+        raise ValueError(f"pad_to={t_pad} < total tokens {total}")
+    b_pad = pad_seqs_to if pad_seqs_to is not None else bsz
+    flat_idx = np.nonzero(mask.reshape(-1))[0]
+
+    def _pack_tok(v: np.ndarray) -> np.ndarray:
+        flat = v.reshape((-1,) + v.shape[2:])[flat_idx]
+        out_shape = (t_pad,) + flat.shape[1:]
+        out = np.zeros(out_shape, dtype=flat.dtype)
+        out[:total] = flat
+        return out
+
+    tokens = _pack_tok(np.asarray(batch["input_ids"]))
+    seg = np.zeros(t_pad, dtype=np.int32)
+    pos = np.zeros(t_pad, dtype=np.int32)
+    off = 0
+    for i, L in enumerate(lens):
+        seg[off : off + L] = i + 1
+        pos[off : off + L] = np.arange(L)
+        off += int(L)
+    seq_lens = np.zeros(b_pad, dtype=np.int32)
+    seq_lens[:bsz] = lens
+    per_token, per_seq = {}, {}
+    for k, v in batch.items():
+        if k in ("input_ids", "attention_mask"):
+            continue
+        v = np.asarray(v)
+        if v.ndim >= 2 and v.shape[:2] == mask.shape:
+            per_token[k] = _pack_tok(v)
+        else:
+            padded = np.zeros((b_pad,) + v.shape[1:], dtype=v.dtype)
+            padded[:bsz] = v
+            per_seq[k] = padded
+    return PackedBatch(
+        tokens=tokens, segment_ids=seg, positions=pos, seq_lens=seq_lens,
+        num_seqs=bsz, per_token=per_token, per_seq=per_seq,
+    )
+
+
+def unpack_batch(packed: PackedBatch) -> Batch:
+    """Packed → padded (inverse of `pack_batch`, dropping only the padding
+    rows past num_seqs — genuine zero-length rows are preserved so per-seq
+    values stay aligned)."""
+    bsz = packed.num_seqs
+    lens = packed.seq_lens[:bsz]
+    max_len = int(lens.max()) if bsz else 0
+    out_mask = np.zeros((bsz, max_len), np.bool_)
+    cu = np.concatenate([[0], np.cumsum(lens)])
+
+    def _unpack(flat: np.ndarray) -> np.ndarray:
+        out = np.zeros((bsz, max_len) + flat.shape[1:], dtype=flat.dtype)
+        for i, L in enumerate(lens):
+            out[i, :L] = flat[cu[i] : cu[i + 1]]
+        return out
+
+    batch: Batch = dict(input_ids=_unpack(packed.tokens))
+    for i, L in enumerate(lens):
+        out_mask[i, :L] = True
+    batch["attention_mask"] = out_mask
+    for k, v in packed.per_token.items():
+        batch[k] = _unpack(v)
+    for k, v in packed.per_seq.items():
+        batch[k] = v[: bsz]
+    return batch
+
+
+@dataclasses.dataclass
+class MicroBatchList:
+    """Result of splitting a batch under a token budget (reference
+    data.py:339): padded micro-batches plus the index groups, so results can
+    be scattered back into original order."""
+
+    mbs: List[Batch]
+    groups: List[List[int]]
+    forward_indices: List[int]
+
+    def __len__(self):
+        return len(self.mbs)
+
+
+def split_padded_batch_into_mb_list(
+    batch: Batch, max_tokens_per_mb: int, min_n_mbs: int = 1
+) -> MicroBatchList:
+    """FFD-pack sequences into micro-batches of <= max_tokens_per_mb tokens
+    (reference data.py:401 `split_padded_tensor_dict_into_mb_list`)."""
+    mask = np.asarray(batch["attention_mask"])
+    lens = mask.sum(1).astype(np.int64)
+    groups = datapack.ffd_allocate(lens, max_tokens_per_mb, min_groups=min_n_mbs)
+    # keep deterministic order: sort groups by smallest original index
+    groups = sorted([sorted(g) for g in groups], key=lambda g: g[0])
+    mbs = [trim_batch(batch_select(batch, g)) for g in groups]
+    forward_indices = datapack.flat2d(groups)
+    return MicroBatchList(mbs=mbs, groups=groups, forward_indices=forward_indices)
+
+
+def reorder_back(values: np.ndarray, forward_indices: List[int]) -> np.ndarray:
+    """Scatter per-sequence results of concatenated micro-batches back into
+    the original batch order."""
+    out = np.empty_like(values)
+    out[np.asarray(forward_indices)] = values
+    return out

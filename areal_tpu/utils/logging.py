@@ -1,0 +1,50 @@
+"""Hierarchical colored logging (role of reference areal/utils/logging.py)."""
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            if color:
+                return f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+    root = logging.getLogger("areal_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("AREAL_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: str = "") -> logging.Logger:
+    _configure_root()
+    if not name:
+        return logging.getLogger("areal_tpu")
+    return logging.getLogger(f"areal_tpu.{name}")

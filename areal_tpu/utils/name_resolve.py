@@ -1,0 +1,246 @@
+"""Distributed KV / service discovery.
+
+Role of reference areal/utils/name_resolve.py: processes rendezvous by
+publishing small values (server addresses, model versions, experiment status)
+under string keys. Two backends here:
+
+- ``MemoryNameRecordRepository`` — in-process dict, for unit tests and
+  single-process runs.
+- ``NfsNameRecordRepository`` — one file per key under a shared directory
+  (works on any shared filesystem; on a TPU pod slice this is typically a
+  GCS-fuse or NFS mount reachable from every host).
+
+The module-level functions (`add`, `get`, `wait`, ...) operate on a global
+repository configured by `reconfigure()` (reference name_resolve.py:1239).
+"""
+
+import dataclasses
+import os
+import random
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameRecordRepository:
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: Optional[float] = None,
+        replace: bool = False,
+    ):
+        raise NotImplementedError()
+
+    def get(self, name: str) -> str:
+        raise NotImplementedError()
+
+    def delete(self, name: str):
+        raise NotImplementedError()
+
+    def clear_subtree(self, name_root: str):
+        raise NotImplementedError()
+
+    def find_subtree(self, name_root: str) -> List[str]:
+        raise NotImplementedError()
+
+    def get_subtree(self, name_root: str) -> List[str]:
+        return [self.get(name) for name in self.find_subtree(name_root)]
+
+    def add_subentry(self, name: str, value: str, **kwargs):
+        """Register one of many values under a key prefix (unique suffix)."""
+        sub = f"{name}/{random.getrandbits(48):012x}"
+        self.add(sub, value, **kwargs)
+        return sub
+
+    def wait(self, name: str, timeout: Optional[float] = None, poll_frequency: float = 0.1) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"timed out waiting for key: {name}")
+                time.sleep(poll_frequency)
+
+    def reset(self):
+        pass
+
+    def __del__(self):
+        try:
+            self.reset()
+        except Exception:
+            pass
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    def __init__(self):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def delete(self, name):
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+
+    def clear_subtree(self, name_root):
+        root = name_root.rstrip("/")
+        with self._lock:
+            for k in [k for k in self._store if k == root or k.startswith(root + "/")]:
+                del self._store[k]
+
+    def find_subtree(self, name_root):
+        root = name_root.rstrip("/")
+        with self._lock:
+            return sorted(
+                k for k in self._store if k == root or k.startswith(root + "/")
+            )
+
+    def reset(self):
+        self._store = {}
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """File-per-key repository on a shared filesystem."""
+
+    def __init__(self, record_root: str = "/tmp/areal_tpu/name_resolve"):
+        self.record_root = record_root
+        self._to_delete = set()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.record_root, name.strip("/"), "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if replace:
+            tmp = path + f".tmp.{os.getpid()}.{random.getrandbits(32)}"
+            with open(tmp, "w") as f:
+                f.write(str(value))
+            os.replace(tmp, path)  # atomic on POSIX
+        else:
+            # O_EXCL makes the *claim* atomic: two racing adds of the same
+            # key must resolve to exactly one winner.
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                raise NameEntryExistsError(name) from None
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
+        if delete_on_exit:
+            self._to_delete.add(name)
+
+    def get(self, name):
+        path = self._path(name)
+        try:
+            with open(path) as f:
+                return f.read()
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
+
+    def delete(self, name):
+        path = self._path(name)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
+        self._to_delete.discard(name)
+
+    def clear_subtree(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        shutil.rmtree(root, ignore_errors=True)
+
+    def find_subtree(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        out = []
+        for dirpath, _, filenames in os.walk(root):
+            if "ENTRY" in filenames:
+                out.append(os.path.relpath(dirpath, self.record_root))
+        return sorted(out)
+
+    def reset(self):
+        for name in list(self._to_delete):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+
+
+DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
+
+
+def reconfigure(backend: str = "memory", **kwargs) -> NameRecordRepository:
+    """Swap the global repository ('memory' or 'nfs')."""
+    global DEFAULT_REPOSITORY
+    if backend == "memory":
+        DEFAULT_REPOSITORY = MemoryNameRecordRepository()
+    elif backend == "nfs":
+        DEFAULT_REPOSITORY = NfsNameRecordRepository(**kwargs)
+    else:
+        raise ValueError(f"unknown name_resolve backend: {backend}")
+    return DEFAULT_REPOSITORY
+
+
+def add(name, value, **kwargs):
+    return DEFAULT_REPOSITORY.add(name, value, **kwargs)
+
+
+def add_subentry(name, value, **kwargs):
+    return DEFAULT_REPOSITORY.add_subentry(name, value, **kwargs)
+
+
+def get(name):
+    return DEFAULT_REPOSITORY.get(name)
+
+
+def delete(name):
+    return DEFAULT_REPOSITORY.delete(name)
+
+
+def clear_subtree(name_root):
+    return DEFAULT_REPOSITORY.clear_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return DEFAULT_REPOSITORY.find_subtree(name_root)
+
+
+def get_subtree(name_root):
+    return DEFAULT_REPOSITORY.get_subtree(name_root)
+
+
+def wait(name, timeout=None, poll_frequency=0.1):
+    return DEFAULT_REPOSITORY.wait(name, timeout, poll_frequency)
+
+
+def reset():
+    return DEFAULT_REPOSITORY.reset()

@@ -1,0 +1,40 @@
+"""Key schema for name_resolve entries (role of reference areal/utils/names.py)."""
+
+USER_NAMESPACE = "areal_tpu"
+
+
+def _root(experiment_name: str, trial_name: str) -> str:
+    return f"{USER_NAMESPACE}/{experiment_name}/{trial_name}"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return _root(experiment_name, trial_name)
+
+
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which each generation server registers its address."""
+    return f"{_root(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server_manager(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/gen_server_manager"
+
+
+def update_weights_from_disk(experiment_name: str, trial_name: str, model_version: int) -> str:
+    return f"{_root(experiment_name, trial_name)}/update_weights_from_disk/{model_version}"
+
+
+def model_version(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/status"
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/worker_status/{worker_name}"
+
+
+def distributed_peer(experiment_name: str, trial_name: str, peer_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/distributed_peer/{peer_name}"

@@ -1,0 +1,91 @@
+"""Free-port discovery and host addressing (role of reference areal/utils/network.py)."""
+
+import os
+import socket
+import time
+from typing import List
+
+_LOCK_DIR = "/tmp/areal_tpu_ports"
+
+
+def gethostname() -> str:
+    return socket.gethostname()
+
+
+def gethostip() -> str:
+    """Best-effort routable IP of this host (falls back to 127.0.0.1)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def find_free_ports(count: int, low: int = 10000, high: int = 60000) -> List[int]:
+    """Find `count` distinct free TCP ports, guarding against double-grants
+    within this host via lockfiles (reference areal/utils/network.py behavior).
+    """
+    os.makedirs(_LOCK_DIR, exist_ok=True)
+    ports: List[int] = []
+    for _ in range(count * 64):
+        if len(ports) == count:
+            break
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        finally:
+            s.close()
+        if not (low <= port <= high) or port in ports:
+            continue
+        lock = os.path.join(_LOCK_DIR, str(port))
+        if not _claim_lock(lock):
+            continue
+        ports.append(port)
+    if len(ports) < count:
+        raise RuntimeError(f"could not find {count} free ports")
+    return ports
+
+
+def _claim_lock(lock: str) -> bool:
+    """Atomically claim a port lockfile. A lock whose owner PID is dead (or
+    whose file is older than an hour) is stale and gets reclaimed — crashed
+    runs must not permanently retire their ports."""
+    for _ in range(2):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            try:
+                with open(lock) as f:
+                    owner = int(f.read().strip() or "0")
+                stale_age = time.time() - os.path.getmtime(lock) > 3600
+                owner_dead = False
+                if owner > 0:
+                    try:
+                        os.kill(owner, 0)
+                    except ProcessLookupError:
+                        owner_dead = True
+                    except PermissionError:
+                        pass
+                if owner_dead or stale_age:
+                    os.unlink(lock)
+                    continue
+            except (OSError, ValueError):
+                pass
+            return False
+    return False
+
+
+def release_ports(ports) -> None:
+    for p in ports:
+        try:
+            os.unlink(os.path.join(_LOCK_DIR, str(p)))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,30 @@
+"""Deterministic per-key seed derivation (role of reference areal/utils/seeding.py).
+
+Every consumer (dataloader shuffling, sampling, model init) derives its own
+stream from (base_seed, key) so adding a consumer never perturbs the others.
+"""
+
+import hashlib
+
+import numpy as np
+
+_BASE_SEED = 0
+_SEED_FROM = ""
+
+
+def set_random_seed(base_seed: int, key: str) -> None:
+    """Set the process-global base seed; `key` identifies the process role."""
+    global _BASE_SEED, _SEED_FROM
+    _BASE_SEED = int(base_seed)
+    _SEED_FROM = key
+    np.random.seed(_derive(base_seed, key) % (2**32))
+
+
+def _derive(base_seed: int, key: str) -> int:
+    digest = hashlib.sha256(f"{base_seed}/{key}".encode()).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def get_seed(key: str) -> int:
+    """A stable 63-bit seed derived from the global base seed and `key`."""
+    return _derive(_BASE_SEED, f"{_SEED_FROM}/{key}") % (2**63)

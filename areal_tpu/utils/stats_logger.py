@@ -1,0 +1,50 @@
+"""Step-stat sink: stdout + JSONL + optional tensorboard.
+
+Role of reference areal/utils/stats_logger.py: the DP-head rank commits the
+exported stats of every train step to the experiment loggers. wandb/swanlab
+are not available in this environment, so the durable sink is a JSONL file
+(one line per step) plus tensorboard when installed.
+"""
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("stats")
+
+
+class StatsLogger:
+    def __init__(self, experiment_name: str, trial_name: str, fileroot: str = "/tmp/areal_tpu"):
+        self.path = os.path.join(fileroot, experiment_name, trial_name)
+        os.makedirs(self.path, exist_ok=True)
+        self._jsonl = open(os.path.join(self.path, "stats.jsonl"), "a")
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=os.path.join(self.path, "tb"))
+        except Exception:
+            pass
+        self._start = time.time()
+
+    def commit(self, epoch: int, step: int, global_step: int, data: Dict[str, float]):
+        record = dict(epoch=epoch, step=step, global_step=global_step, time=time.time() - self._start)
+        record.update({k: float(v) for k, v in data.items()})
+        self._jsonl.write(json.dumps(record) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in data.items():
+                self._tb.add_scalar(k, v, global_step)
+        headline = {
+            k: round(float(v), 4)
+            for k, v in list(data.items())[:12]
+        }
+        logger.info(f"step {global_step} (epoch {epoch} local {step}): {headline}")
+
+    def close(self):
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

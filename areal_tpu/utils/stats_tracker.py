@@ -1,0 +1,184 @@
+"""Hierarchical scoped metric accumulator with denominators.
+
+Role of reference areal/utils/stats_tracker.py (`DistributedStatsTracker`):
+training code records masked tensor stats under scoped keys
+(``with tracker.scope("actor"): tracker.stat(denominator=..., **values)``) and
+the trainer exports reduced scalars once per step. Reduce types: AVG (of masked
+means), SUM, MIN, MAX, SCALAR (python floats), MOE-style denominators
+(a bool mask tensor names the elements a stat averages over).
+
+TPU adaptation: values are jax/numpy arrays on host export; cross-host
+reduction (the reference's dist.all_reduce) happens via
+`jax.experimental.multihost_utils` only when running multi-process — in the
+common single-controller SPMD case every host computes identical stats so no
+reduction is needed.
+"""
+
+import contextlib
+import enum
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+
+class ReduceType(enum.Enum):
+    AVG = "avg"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    SCALAR = "scalar"
+
+
+def _to_np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+class DistributedStatsTracker:
+    def __init__(self, name: str = ""):
+        self._name = name
+        self._lock = threading.Lock()
+        self._scope: List[str] = []
+        self._denominators: Dict[str, List[np.ndarray]] = defaultdict(list)
+        self._denom_of: Dict[str, str] = {}
+        self._stats: Dict[str, List[np.ndarray]] = defaultdict(list)
+        self._reduce_types: Dict[str, ReduceType] = {}
+        self._scalars: Dict[str, List[float]] = defaultdict(list)
+
+    def _key(self, key: str) -> str:
+        parts = [p for p in ([self._name] + self._scope + [key]) if p]
+        return "/".join(parts)
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        self._scope.append(name)
+        try:
+            yield
+        finally:
+            self._scope.pop()
+
+    @contextlib.contextmanager
+    def record_timing(self, key: str):
+        """Wall-clock scope exported as ``timeperf/<key>`` (reference :70-80)."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.scalar(**{f"timeperf/{key}": time.perf_counter() - start})
+
+    def denominator(self, **kwargs):
+        """Register boolean mask tensors that later stats average over."""
+        with self._lock:
+            for key, mask in kwargs.items():
+                full = self._key(key)
+                m = _to_np(mask)
+                if m.dtype != np.bool_:
+                    raise ValueError(f"denominator {full} must be boolean")
+                self._denominators[full].append(m)
+
+    def scalar(self, **kwargs):
+        with self._lock:
+            for key, value in kwargs.items():
+                full = self._key(key)
+                self._reduce_types[full] = ReduceType.SCALAR
+                self._scalars[full].append(float(value))
+
+    def stat(
+        self,
+        denominator: str,
+        reduce_type: Optional[ReduceType] = None,
+        **kwargs,
+    ):
+        """Record masked tensors; each reduces against `denominator`'s mask."""
+        with self._lock:
+            denom_key = self._key(denominator)
+            if denom_key not in self._denominators:
+                raise ValueError(f"unknown denominator: {denom_key}")
+            masks = self._denominators[denom_key]
+            if not masks:
+                raise ValueError(f"denominator {denom_key} has no recorded mask")
+            mask_idx = len(masks) - 1
+            for key, value in kwargs.items():
+                full = self._key(key)
+                v = _to_np(value).astype(np.float32)
+                # bind to the denominator mask current at record time, so a
+                # stat recorded on only some minibatches still reduces with
+                # its own mask
+                self._stats[full].append((mask_idx, v))
+                self._denom_of[full] = denom_key
+                if reduce_type is not None:
+                    self._reduce_types[full] = reduce_type
+                elif full not in self._reduce_types:
+                    self._reduce_types[full] = ReduceType.AVG
+
+    def export(self, key: Optional[str] = None, reset: bool = True) -> Dict[str, float]:
+        """Reduce everything recorded so far into scalars."""
+        with self._lock:
+            result: Dict[str, float] = {}
+            for full, vals in self._scalars.items():
+                if key is not None and not full.startswith(key):
+                    continue
+                result[full] = float(np.mean(vals)) if vals else 0.0
+            for full, vals in self._stats.items():
+                if key is not None and not full.startswith(key):
+                    continue
+                denom_key = self._denom_of[full]
+                masks = self._denominators.get(denom_key, [])
+                rt = self._reduce_types.get(full, ReduceType.AVG)
+                selected = []
+                for mask_idx, x in vals:
+                    x = x.reshape(-1)
+                    m = (
+                        masks[mask_idx].reshape(-1)
+                        if mask_idx < len(masks)
+                        else np.ones_like(x, dtype=bool)
+                    )
+                    if m.shape != x.shape:
+                        m = np.ones_like(x, dtype=bool)
+                    selected.append(x[m])
+                sel = (
+                    np.concatenate(selected)
+                    if selected
+                    else np.zeros((0,), np.float32)
+                )
+                if rt == ReduceType.AVG:
+                    result[full] = float(sel.mean()) if sel.size else 0.0
+                elif rt == ReduceType.SUM:
+                    result[full] = float(sel.sum())
+                elif rt == ReduceType.MIN:
+                    result[full] = float(sel.min()) if sel.size else 0.0
+                elif rt == ReduceType.MAX:
+                    result[full] = float(sel.max()) if sel.size else 0.0
+            # denominator counts are themselves useful (e.g. n_tokens)
+            for denom_key, masks in self._denominators.items():
+                if key is not None and not denom_key.startswith(key):
+                    continue
+                result.setdefault(
+                    denom_key, float(sum(int(m.sum()) for m in masks))
+                )
+            if reset:
+                if key is None:
+                    self._denominators.clear()
+                    self._denom_of.clear()
+                    self._stats.clear()
+                    self._scalars.clear()
+                else:
+                    for d in (self._denominators, self._stats, self._scalars):
+                        for k in [k for k in d if k.startswith(key)]:
+                            del d[k]
+            return result
+
+
+DEFAULT_TRACKER = DistributedStatsTracker()
+
+scope = DEFAULT_TRACKER.scope
+record_timing = DEFAULT_TRACKER.record_timing
+denominator = DEFAULT_TRACKER.denominator
+scalar = DEFAULT_TRACKER.scalar
+stat = DEFAULT_TRACKER.stat
+
+
+def export_all(reset: bool = True) -> Dict[str, float]:
+    return DEFAULT_TRACKER.export(reset=reset)

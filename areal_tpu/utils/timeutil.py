@@ -1,0 +1,68 @@
+"""Frequency controllers for save/eval/ckpt triggers.
+
+Role of reference areal/utils/timeutil.py (`EpochStepTimeFreqCtl`): an action
+fires when any of the configured epoch / step / wall-clock-second frequencies
+elapses since the last fire.
+"""
+
+import dataclasses
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FreqSpec:
+    freq_epochs: Optional[int] = None
+    freq_steps: Optional[int] = None
+    freq_secs: Optional[int] = None
+
+
+class EpochStepTimeFreqCtl:
+    """Fires on epoch/step/second boundaries; state is (de)serializable so a
+    recovered run resumes the same cadence (reference areal/utils/timeutil.py)."""
+
+    def __init__(
+        self,
+        freq_epoch: Optional[int] = None,
+        freq_step: Optional[int] = None,
+        freq_sec: Optional[int] = None,
+    ):
+        self.freq_epoch = freq_epoch
+        self.freq_step = freq_step
+        self.freq_sec = freq_sec
+        self._last_epoch = 0
+        self._last_step = 0
+        self._last_time = time.monotonic()
+        self._interval_start = time.monotonic()
+
+    def check(self, epochs: int, steps: int) -> bool:
+        """`epochs`/`steps` are *deltas* accumulated since the last call."""
+        self._last_epoch += epochs
+        self._last_step += steps
+        fire = False
+        if self.freq_epoch is not None and self._last_epoch >= self.freq_epoch:
+            fire = True
+        if self.freq_step is not None and self._last_step >= self.freq_step:
+            fire = True
+        if (
+            self.freq_sec is not None
+            and time.monotonic() - self._last_time >= self.freq_sec
+        ):
+            fire = True
+        if fire:
+            self._last_epoch = 0
+            self._last_step = 0
+            self._last_time = time.monotonic()
+        return fire
+
+    def state_dict(self):
+        return dict(
+            last_epoch=self._last_epoch,
+            last_step=self._last_step,
+            elapsed=time.monotonic() - self._last_time,
+        )
+
+    def load_state_dict(self, state):
+        self._last_epoch = state["last_epoch"]
+        self._last_step = state["last_step"]
+        self._last_time = time.monotonic() - state["elapsed"]

@@ -1,0 +1,26 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding logic is exercised on
+XLA's host platform with 8 virtual devices (the driver separately dry-runs
+the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def memory_name_resolve():
+    from areal_tpu.utils import name_resolve
+
+    repo = name_resolve.reconfigure("memory")
+    yield repo
+    repo.reset()

@@ -1,0 +1,105 @@
+"""Allocation-mode DSL round trips (mirrors reference
+areal/tests/test_allocation_mode.py)."""
+
+import pytest
+
+from areal_tpu.api.alloc_mode import (
+    AllocationMode,
+    AllocationType,
+    AllocationValidationError,
+    ParallelStrategy,
+)
+
+
+def test_parallel_strategy_basic():
+    ps = ParallelStrategy.from_str("d4t2p2")
+    assert ps.data_parallel_size == 4
+    assert ps.tensor_parallel_size == 2
+    assert ps.pipeline_parallel_size == 2
+    assert ps.context_parallel_size == 1
+    assert ps.world_size == 16
+
+
+def test_parallel_strategy_order_free():
+    assert ParallelStrategy.from_str("t2d4") == ParallelStrategy.from_str("d4t2")
+
+
+def test_parallel_strategy_all_dims():
+    ps = ParallelStrategy.from_str("d2t2p2c2e2")
+    assert ps.world_size == 16  # e is not a device-multiplying factor
+    assert ps.expert_parallel_size == 2
+    assert ps.expert_data_parallel_size == 2
+
+
+def test_parallel_strategy_roundtrip():
+    for s in ["d4t2", "d8", "t4p2", "d2t2p2c2"]:
+        assert ParallelStrategy.from_str(s).to_str() == s
+
+
+@pytest.mark.parametrize("bad", ["", "x4", "d0", "d-1", "d2d4", "4d"])
+def test_parallel_strategy_rejects(bad):
+    with pytest.raises(AllocationValidationError):
+        ParallelStrategy.from_str(bad)
+
+
+def test_colocate():
+    am = AllocationMode.from_str("d2t2p2")
+    assert am.type_ == AllocationType.COLOCATE
+    assert am.train.world_size == 8
+    assert am.gen == am.train
+
+
+def test_server_only():
+    am = AllocationMode.from_str("jaxgen.d4t2")
+    assert am.type_ == AllocationType.LLM_SERVER_ONLY
+    assert am.gen_backend == "jaxgen"
+    assert am.gen.data_parallel_size == 4
+    assert am.gen.tensor_parallel_size == 2
+    assert am.train is None
+
+
+def test_decoupled():
+    am = AllocationMode.from_str("jaxgen.d4t2+d8")
+    assert am.type_ == AllocationType.DECOUPLED_TRAIN
+    assert am.gen_world_size == 8
+    assert am.train_world_size == 8
+    assert am.world_size == 16
+
+
+def test_decoupled_with_train_backend():
+    am = AllocationMode.from_str("jaxgen.d4+fsdp:d2t4")
+    assert am.train_backend == "fsdp"
+    assert am.train.tensor_parallel_size == 4
+
+
+def test_sglang_compat_backend_name():
+    am = AllocationMode.from_str("sglang.d4t2+d8")
+    assert am.gen_backend == "sglang"
+
+
+def test_moe_hybrid():
+    am = AllocationMode.from_str("jaxgen.d2+(attn:d2t2|ffn:d2e2)")
+    assert am.train_hybrid is not None
+    assert am.train_hybrid.attn.tensor_parallel_size == 2
+    assert am.train_hybrid.ffn.expert_parallel_size == 2
+    assert am.train_world_size == 4
+
+
+def test_moe_hybrid_mismatch_rejected():
+    with pytest.raises(AllocationValidationError):
+        AllocationMode.from_str("jaxgen.d2+(attn:d2t2|ffn:d8e2)")
+
+
+def test_roundtrip_alloc():
+    for s in ["d2t2p2", "jaxgen.d4t2", "jaxgen.d4t2+d8", "jaxgen.d2+(attn:d2t2|ffn:d2e2)"]:
+        am = AllocationMode.from_str(s)
+        assert AllocationMode.from_str(am.to_str()) == am
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["jaxgen.d4t2+d8+d8", "unknown.d4", "jaxgen.d4p2", "jaxgen.d2+(attn:d2)"],
+)
+def test_alloc_rejects(bad):
+    with pytest.raises(AllocationValidationError):
+        AllocationMode.from_str(bad)

@@ -1,0 +1,123 @@
+"""Packing/micro-batching invariants (mirrors reference
+areal/tests/test_packed_vs_padded_consistency.py at the data layer)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.utils import data as du
+from areal_tpu.utils import datapack
+
+
+def _ragged_batch(lens, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs = [rng.integers(1, 1000, size=L).astype(np.int32) for L in lens]
+    batch = du.pad_sequences_to_tensors(seqs)
+    batch["loss_mask"] = batch["attention_mask"].astype(np.int32)
+    batch["rewards"] = rng.normal(size=len(lens)).astype(np.float32)
+    return batch, seqs
+
+
+def test_pad_sequences():
+    batch, seqs = _ragged_batch([3, 5, 2])
+    assert batch["input_ids"].shape == (3, 5)
+    assert batch["attention_mask"].sum() == 10
+    np.testing.assert_array_equal(batch["input_ids"][1], seqs[1])
+
+
+def test_pack_unpack_roundtrip():
+    batch, _ = _ragged_batch([7, 3, 11, 1])
+    packed = du.pack_batch(batch)
+    assert packed.total_tokens == 22
+    assert packed.tokens.shape[0] == du.next_bucket_size(22)
+    # segment ids are 1-based contiguous, padding is 0
+    assert packed.segment_ids.max() == 4
+    assert (packed.segment_ids[packed.total_tokens:] == 0).all()
+    restored = du.unpack_batch(packed)
+    restored = du.trim_batch(restored)
+    np.testing.assert_array_equal(restored["input_ids"], du.trim_batch(batch)["input_ids"])
+    np.testing.assert_array_equal(restored["loss_mask"], batch["loss_mask"])
+    np.testing.assert_array_equal(restored["rewards"], batch["rewards"])
+
+
+def test_pack_static_bucket():
+    batch, _ = _ragged_batch([5, 5])
+    p = du.pack_batch(batch, pad_to=512, pad_seqs_to=8)
+    assert p.tokens.shape == (512,)
+    assert p.seq_lens.shape == (8,)
+    assert p.n_seqs == 2
+
+
+def test_concat_padded():
+    b1, _ = _ragged_batch([3, 4], seed=1)
+    b2, _ = _ragged_batch([6], seed=2)
+    out = du.concat_padded_tensors([b1, b2])
+    assert out["input_ids"].shape == (3, 6)
+    assert out["attention_mask"].sum() == 13
+    assert out["rewards"].shape == (3,)
+
+
+def test_mb_split_respects_budget():
+    lens = [100, 200, 300, 50, 250, 120, 80]
+    batch, _ = _ragged_batch(lens)
+    mbl = du.split_padded_batch_into_mb_list(batch, max_tokens_per_mb=400)
+    assert sum(int(np.asarray(m["attention_mask"]).sum()) for m in mbl.mbs) == sum(lens)
+    for mb in mbl.mbs:
+        assert int(np.asarray(mb["attention_mask"]).sum()) <= 400
+    # every index appears exactly once
+    assert sorted(mbl.forward_indices) == list(range(len(lens)))
+
+
+def test_reorder_back():
+    vals = np.array([10.0, 20.0, 30.0, 40.0])
+    fwd = [2, 0, 3, 1]
+    # vals are in forward (mb) order; reorder to original
+    out = du.reorder_back(vals, fwd)
+    np.testing.assert_array_equal(out, [20.0, 40.0, 10.0, 30.0])
+
+
+def test_ffd_allocate():
+    sizes = [5, 9, 3, 7, 2, 8]
+    groups = datapack.ffd_allocate(sizes, capacity=10)
+    seen = sorted(x for g in groups for x in g)
+    assert seen == list(range(6))
+    for g in groups:
+        assert sum(sizes[i] for i in g) <= 10
+
+
+def test_ffd_oversize_item():
+    groups = datapack.ffd_allocate([100, 2, 3], capacity=10)
+    seen = sorted(x for g in groups for x in g)
+    assert seen == [0, 1, 2]
+
+
+def test_ffd_min_groups():
+    groups = datapack.ffd_allocate([1, 1, 1, 1], capacity=100, min_groups=2)
+    assert len(groups) >= 2
+
+
+def test_partition_balanced():
+    sizes = [10, 1, 1, 1, 9, 8]
+    groups = datapack.partition_balanced(sizes, k=3)
+    assert len(groups) == 3
+    loads = [sum(sizes[i] for i in g) for g in groups]
+    assert max(loads) <= 12
+
+
+def test_bucket_sizes():
+    assert du.next_bucket_size(1) == 256
+    assert du.next_bucket_size(256) == 256
+    assert du.next_bucket_size(257) == 512
+    assert du.next_bucket_size(9000) == 16384
+
+
+def test_pack_unpack_zero_length_rows():
+    # zero-length sequences must keep per-seq alignment (regression)
+    batch = du.pad_sequences_to_tensors(
+        [np.array([1, 2, 3], np.int32), np.array([], np.int32), np.array([7, 8], np.int32)]
+    )
+    batch["rewards"] = np.array([10.0, 20.0, 30.0], np.float32)
+    p = du.pack_batch(batch)
+    assert p.n_seqs == 3
+    restored = du.unpack_batch(p)
+    np.testing.assert_array_equal(restored["rewards"], [10.0, 20.0, 30.0])
+    assert restored["attention_mask"].sum(1).tolist() == [3, 0, 2]
