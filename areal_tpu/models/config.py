@@ -1,0 +1,96 @@
+"""Model architecture config for the Llama/Qwen2/Qwen3 decoder family.
+
+Role of reference realhf/api/core/model_api.py `ReaLModelConfig` + the HF
+config conversion in realhf/api/from_hf/: one dataclass describes every
+supported dense decoder-only family; per-family differences (QKV bias, tied
+embeddings, head_dim override, q/k norm) are fields, not subclasses.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_position_embeddings: int = 32768
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # qwen2: QKV bias, no O bias
+    use_qk_norm: bool = False  # qwen3: per-head RMSNorm on q and k
+    family: str = "llama"
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+# Supported HF `model_type`s (all share the llama-style decoder block:
+# RMSNorm + SiLU-gated MLP + rotary GQA attention). gemma/gpt2 need
+# architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for now.
+_HF_FAMILIES = ("llama", "qwen2", "qwen3", "mistral")
+
+
+def from_hf_config(d: dict) -> ModelConfig:
+    """Build from a parsed HF config.json dict (families mirror the
+    reference's from_hf registry: realhf/api/from_hf/)."""
+    model_type = d.get("model_type", "llama")
+    if model_type not in _HF_FAMILIES:
+        raise ValueError(f"unsupported model family {model_type!r}")
+    num_heads = d["num_attention_heads"]
+    hidden = d["hidden_size"]
+    head_dim = d.get("head_dim") or hidden // num_heads
+    return ModelConfig(
+        vocab_size=d["vocab_size"],
+        hidden_size=hidden,
+        intermediate_size=d["intermediate_size"],
+        num_layers=d["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=d.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        max_position_embeddings=d.get("max_position_embeddings", 32768),
+        rope_theta=d.get("rope_theta", 10000.0),
+        rms_norm_eps=d.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=d.get("tie_word_embeddings", False),
+        attention_bias=d.get("attention_bias", model_type == "qwen2"),
+        use_qk_norm=(model_type == "qwen3"),
+        family=model_type,
+    )
+
+
+def load_hf_config(path: str) -> ModelConfig:
+    with open(os.path.join(path, "config.json")) as f:
+        return from_hf_config(json.load(f))
+
+
+def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
+    """Small config for tests."""
+    return ModelConfig(
+        vocab_size=vocab_size,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_position_embeddings=512,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=(family == "qwen2"),
+        use_qk_norm=(family == "qwen3"),
+        family=family,
+    )

@@ -1,0 +1,169 @@
+"""HF checkpoint ↔ param-pytree conversion.
+
+Role of reference realhf/api/from_hf/ (per-family `from_/to_{family}`
+converters) and areal's HF save/load (fsdp_engine.py save/load): the
+framework speaks HF safetensors on disk so checkpoints interoperate with the
+rest of the ecosystem (tokenizers, eval harnesses, serving).
+
+Torch linear weights are [out, in]; our kernels keep [in, out] so the matmul
+is `x @ W` with no transpose at run time.
+"""
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+from areal_tpu.models.config import ModelConfig, load_hf_config
+from areal_tpu.models.transformer import Params
+
+_LAYER_MAP = {
+    # our key -> (hf suffix, transpose?)
+    "input_norm": ("input_layernorm.weight", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+    "q_norm": ("self_attn.q_norm.weight", False),
+    "k_norm": ("self_attn.k_norm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+
+def _open_shards(path: str) -> Dict[str, str]:
+    """tensor name -> shard file path."""
+    index_file = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            index = json.load(f)
+        return {
+            k: os.path.join(path, v) for k, v in index["weight_map"].items()
+        }
+    single = os.path.join(path, "model.safetensors")
+    names = {}
+    with safe_open(single, framework="numpy") as f:
+        for k in f.keys():
+            names[k] = single
+    return names
+
+
+class _ShardReader:
+    def __init__(self, name_to_file: Dict[str, str]):
+        self.name_to_file = name_to_file
+        self._handles: Dict[str, object] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.name_to_file
+
+    def get(self, name: str) -> np.ndarray:
+        file = self.name_to_file[name]
+        if file not in self._handles:
+            self._handles[file] = safe_open(file, framework="numpy")
+        return self._handles[file].get_tensor(name)
+
+
+def load_params(
+    path: str, cfg: Optional[ModelConfig] = None, dtype=jnp.bfloat16
+) -> Params:
+    """Load an HF checkpoint directory into the stacked-layer pytree."""
+    if cfg is None:
+        cfg = load_hf_config(path)
+    reader = _ShardReader(_open_shards(path))
+
+    def g(name: str) -> np.ndarray:
+        arr = reader.get(name)
+        if arr.dtype == np.dtype("V2"):  # raw bf16 from safetensors/numpy
+            import ml_dtypes
+
+            arr = arr.view(ml_dtypes.bfloat16)
+        return arr
+
+    layers: Dict[str, np.ndarray] = {}
+    for our_key, (suffix, transpose) in _LAYER_MAP.items():
+        name0 = f"model.layers.0.{suffix}"
+        if name0 not in reader:
+            continue
+        per_layer = []
+        for i in range(cfg.num_layers):
+            w = g(f"model.layers.{i}.{suffix}")
+            per_layer.append(w.T if transpose else w)
+        layers[our_key] = jnp.asarray(np.stack(per_layer), dtype=dtype)
+    params: Params = {
+        "embedding": jnp.asarray(g("model.embed_tokens.weight"), dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(g("model.norm.weight"), dtype=dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(g("lm_head.weight").T, dtype=dtype)
+    return params
+
+
+def save_params(
+    params: Params,
+    cfg: ModelConfig,
+    path: str,
+    hf_config_dict: Optional[dict] = None,
+) -> None:
+    """Write the pytree back out as a single-file HF safetensors checkpoint
+    (reference: fsdp_engine HF save path; used by disk weight updates)."""
+    os.makedirs(path, exist_ok=True)
+    tensors: Dict[str, np.ndarray] = {}
+
+    # store in fp32 for portability (loader re-casts); safetensors/numpy
+    # cannot serialize ml_dtypes.bfloat16 directly
+    def as_np32(x) -> np.ndarray:
+        return np.asarray(x, dtype=np.float32)
+
+    tensors["model.embed_tokens.weight"] = as_np32(params["embedding"])
+    tensors["model.norm.weight"] = as_np32(params["final_norm"])
+    if not cfg.tie_word_embeddings:
+        tensors["lm_head.weight"] = as_np32(params["lm_head"]).T.copy()
+    for our_key, (suffix, transpose) in _LAYER_MAP.items():
+        if our_key not in params["layers"]:
+            continue
+        stacked = as_np32(params["layers"][our_key])
+        for i in range(cfg.num_layers):
+            w = stacked[i]
+            tensors[f"model.layers.{i}.{suffix}"] = (
+                w.T.copy() if transpose else w.copy()
+            )
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    if hf_config_dict is None:
+        hf_config_dict = default_hf_config_dict(cfg)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(hf_config_dict, f, indent=2)
+
+
+def default_hf_config_dict(cfg: ModelConfig) -> dict:
+    return {
+        "model_type": cfg.family,
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "attention_bias": cfg.attention_bias,
+        "torch_dtype": "float32",
+        "architectures": {
+            "llama": ["LlamaForCausalLM"],
+            "qwen2": ["Qwen2ForCausalLM"],
+            "qwen3": ["Qwen3ForCausalLM"],
+            "mistral": ["MistralForCausalLM"],
+        }.get(cfg.family, ["LlamaForCausalLM"]),
+    }

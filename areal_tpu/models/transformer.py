@@ -1,0 +1,186 @@
+"""Functional decoder-only transformer over packed token streams.
+
+Role of reference realhf/impl/model/nn/real_llm_api.py (`ReaLModel`) — the
+from-scratch parallel causal LM — re-designed TPU-first:
+
+- Params are a plain pytree; per-layer weights are **stacked along a leading
+  layer axis** and the stack is traversed with `jax.lax.scan`, so XLA
+  compiles one layer body regardless of depth (compile time O(1) in layers).
+- Parallelism is declarative: `param_logical_axes` returns a same-structure
+  tree of logical axis names; `areal_tpu.parallel.sharding` maps those to
+  mesh axes (fsdp/tensor). No parallel modules, no explicit collectives —
+  pjit inserts them.
+- Inputs are packed streams (`[B, T]` tokens + segment_ids + positions),
+  the TPU analog of the reference's cu_seqlens varlen batches.
+- `jax.checkpoint` (remat) on the scanned layer body trades FLOPs for HBM,
+  replacing torch gradient checkpointing (reference base_hf_engine.py).
+"""
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.ops.basic import (
+    apply_rope,
+    rms_norm,
+    rope_frequencies,
+    segment_attention,
+)
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# Init
+# --------------------------------------------------------------------------
+def init_params(
+    cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random init (scaled normal), HF-compatible structure."""
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    Qd, KVd = cfg.q_dim, cfg.kv_dim
+    keys = jax.random.split(rng, 8)
+
+    def nrm(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    std = 0.02
+    layers = {
+        "input_norm": jnp.ones((L, D), dtype),
+        "post_attn_norm": jnp.ones((L, D), dtype),
+        "wq": nrm(keys[0], (L, D, Qd), std),
+        "wk": nrm(keys[1], (L, D, KVd), std),
+        "wv": nrm(keys[2], (L, D, KVd), std),
+        "wo": nrm(keys[3], (L, Qd, D), std),
+        "w_gate": nrm(keys[4], (L, D, F), std),
+        "w_up": nrm(keys[5], (L, D, F), std),
+        "w_down": nrm(keys[6], (L, F, D), std),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, Qd), dtype)
+        layers["bk"] = jnp.zeros((L, KVd), dtype)
+        layers["bv"] = jnp.zeros((L, KVd), dtype)
+    if cfg.use_qk_norm:
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+    params: Params = {
+        "embedding": nrm(keys[7], (cfg.vocab_size, D), std),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = nrm(
+            jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std
+        )
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Same-structure tree of logical axis name tuples.
+
+    Logical names: "vocab" (vocab-parallel), "embed" (fsdp-sharded model
+    dim), "heads" (tensor-parallel attention dim), "mlp" (tensor-parallel
+    ffn dim), "layer" (scanned, never sharded), None (replicated).
+    """
+    layers = {
+        "input_norm": ("layer", None),
+        "post_attn_norm": ("layer", None),
+        "wq": ("layer", "embed", "heads"),
+        "wk": ("layer", "embed", "heads"),
+        "wv": ("layer", "embed", "heads"),
+        "wo": ("layer", "heads", "embed"),
+        "w_gate": ("layer", "embed", "mlp"),
+        "w_up": ("layer", "embed", "mlp"),
+        "w_down": ("layer", "mlp", "embed"),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = ("layer", "heads")
+        layers["bk"] = ("layer", "heads")
+        layers["bv"] = ("layer", "heads")
+    if cfg.use_qk_norm:
+        layers["q_norm"] = ("layer", None)
+        layers["k_norm"] = ("layer", None)
+    axes: Params = {
+        "embedding": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": (None,),
+    }
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+def _layer_body(
+    cfg: ModelConfig,
+    x: jnp.ndarray,  # [B, T, D]
+    lp: Params,  # one layer's params (leading layer axis removed)
+    segment_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> jnp.ndarray:
+    b, t, d = x.shape
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.use_qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, cos, sin)
+    k = apply_rope(k, positions, cos, sin)
+    attn = segment_attention(q, k, v, segment_ids, causal=True)
+    x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
+    h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    return x + ffn
+
+
+def apply(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32
+    segment_ids: jnp.ndarray,  # [B, T] int32; 0 = padding
+    positions: jnp.ndarray,  # [B, T] int32; restart per sequence
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Forward to logits [B, T, vocab] (fp32)."""
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    x = params["embedding"][tokens]
+
+    def body(carry, lp):
+        out = _layer_body(cfg, carry, lp, segment_ids, positions, cos, sin)
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (
+        params["embedding"].T
+        if cfg.tie_word_embeddings
+        else params["lm_head"]
+    )
+    return (x.astype(jnp.float32)) @ head.astype(jnp.float32)
+
+
+def count_params(params: Params) -> int:
+    return int(
+        sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+    )

@@ -1,0 +1,104 @@
+"""Core numeric ops for the transformer stack.
+
+TPU notes: everything here is shape-static and fusible by XLA. Attention is
+the segment-ids formulation of packed varlen attention — the TPU analog of
+the reference's flash-attn cu_seqlens path (reference
+areal/utils/data.py:245-300, realhf/impl/model/modules/attn.py). A Pallas
+flash kernel can replace `segment_attention` without touching callers.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation (reference impl/model/modules/rms.py)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(
+    head_dim: int, max_len: int, theta: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute cos/sin tables [max_len, head_dim//2] in fp32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate [..., T, H, D] by per-token positions [..., T].
+
+    Uses the HF "rotate_half" layout (first/second half pairing) so weights
+    loaded from HF checkpoints produce identical outputs
+    (reference impl/model/modules/rotary.py).
+    """
+    dtype = x.dtype
+    c = cos[positions].astype(jnp.float32)[..., None, :]  # [..., T, 1, D/2]
+    s = sin[positions].astype(jnp.float32)[..., None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def make_segment_mask(
+    q_seg: jnp.ndarray, kv_seg: jnp.ndarray, causal: bool = True
+) -> jnp.ndarray:
+    """Boolean attention mask [..., Tq, Tk] for packed streams.
+
+    Token i may attend to token j iff both are real (segment id > 0), they
+    belong to the same sequence, and j <= i (causal).
+    """
+    same = (q_seg[..., :, None] == kv_seg[..., None, :]) & (
+        q_seg[..., :, None] > 0
+    )
+    if causal:
+        tq, tk = q_seg.shape[-1], kv_seg.shape[-1]
+        qi = jnp.arange(tq)[:, None]
+        kj = jnp.arange(tk)[None, :]
+        same = same & (kj <= qi + (tk - tq))
+    return same
+
+
+def segment_attention(
+    q: jnp.ndarray,  # [B, T, Hq, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+    segment_ids: jnp.ndarray,  # [B, T]
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Packed-varlen causal attention with GQA; fp32 softmax.
+
+    XLA-native formulation; the hot path can be swapped for a Pallas splash
+    kernel (ops/pallas) with the same signature.
+    """
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = make_segment_mask(segment_ids, segment_ids, causal=causal)
+    logits = jnp.where(mask[:, None, :, :], logits, -2.3819763e38)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked (padding) rows: softmax of all -inf → near-uniform garbage;
+    # zero them so padding tokens contribute exactly nothing downstream.
+    valid_q = (segment_ids > 0)[:, None, :, None]
+    probs = jnp.where(valid_q, probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,54 @@
+"""Device-mesh construction from a ParallelismConfig.
+
+Role of reference fsdp_engine.py:114-165 (torch DeviceMesh (dp, sp, tp)) and
+realhf/base/topology.py (ProcessTopology/ParallelGrid) — on TPU a single
+`jax.sharding.Mesh` plus NamedSharding replaces all explicit process-group
+plumbing: XLA derives the collectives from shardings, and they ride ICI.
+
+Mesh axes, outermost → innermost (innermost = fastest-varying device index =
+closest ICI neighbors; tensor needs the tightest coupling, then seq):
+
+    ("data", "fsdp", "seq", "tensor")
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from areal_tpu.api.cli_args import ParallelismConfig
+
+MESH_AXES = ("data", "fsdp", "seq", "tensor")
+
+
+def make_mesh(
+    parallel: ParallelismConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    shape = (
+        parallel.data_parallel_size,
+        parallel.fsdp_parallel_size,
+        parallel.seq_parallel_size,
+        parallel.tensor_parallel_size,
+    )
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices, only {len(devices)} available"
+        )
+    arr = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_parallel() -> ParallelismConfig:
+    return ParallelismConfig(1, 1, 1, 1)
+
+
+def fsdp_parallel(n: Optional[int] = None) -> ParallelismConfig:
+    """All devices on the fsdp axis — the default single-slice strategy."""
+    if n is None:
+        n = jax.device_count()
+    return ParallelismConfig(fsdp_parallel_size=n)

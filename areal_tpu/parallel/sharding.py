@@ -1,0 +1,70 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+Role of reference fsdp_engine.py:167-263 (DTensor TP module plan) +
+apply_fsdp2 — replaced by declarative rules in the t5x/MaxText style: each
+param carries logical axis names (models.transformer.param_logical_axes);
+one rules table maps them onto mesh axes; pjit does the rest.
+
+Default rules:
+- "embed"  → "fsdp"    (ZeRO-3-style param sharding on the model dim)
+- "heads"  → "tensor"  (megatron-style column/row parallel attention)
+- "mlp"    → "tensor"  (column/row parallel FFN)
+- "vocab"  → None      (replicated; vocab-parallel loss is a later opt)
+- "layer"  → None      (scanned axis, never sharded)
+
+Activations: batch → ("data", "fsdp"), sequence → "seq" (Ulysses-style SP
+handled inside attention via all-to-alls XLA derives from shardings).
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "embed": "fsdp",
+    "heads": "tensor",
+    "mlp": "tensor",
+    "vocab": None,
+    "layer": None,
+}
+
+
+def logical_to_spec(
+    axes: Tuple[Optional[str], ...],
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> P:
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(a) if a is not None else None for a in axes))
+
+
+def tree_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Optional[Dict[str, Optional[str]]] = None,
+):
+    """Map a tree of logical-axis tuples to a tree of NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def batch_spec() -> P:
+    """Packed batch arrays [B, T]: rows over (data, fsdp), tokens over seq."""
+    return P(("data", "fsdp"), "seq")
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(mesh: Mesh, params: Any, logical_tree: Any, rules=None):
+    """Device-put a host pytree onto the mesh under the rules table."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(params, shardings)

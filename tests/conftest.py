@@ -7,12 +7,20 @@ the multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the environment pins the real-TPU tunnel backend ("axon")
+# and its sitecustomize imports jax and sets jax_platforms="axon,cpu" at
+# interpreter start, so the env var alone is ignored. Tests must run on the
+# virtual CPU mesh: set the flag env vars AND update the live jax config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
