@@ -98,9 +98,8 @@ class TrainEngineConfig:
     trial_name: str = ""
     path: str = ""  # HF checkpoint path or model preset name
     init_from_scratch: bool = False
-    dtype: str = "bfloat16"
-    param_dtype: str = "bfloat16"  # parameter storage dtype
-    grad_dtype: str = "float32"
+    dtype: str = "bfloat16"  # compute dtype (MXU-friendly)
+    param_dtype: str = "float32"  # parameter/optimizer storage (master weights)
     disable_dropout: bool = True
     gradient_checkpointing: bool = True
     mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)

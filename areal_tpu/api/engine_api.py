@@ -79,9 +79,7 @@ class TrainEngine(abc.ABC):
     def forward(
         self,
         input_: Dict[str, Any],
-        output_seqlens: Optional[List[int]] = None,
         post_hook: Optional[Callable] = None,
-        aggregate_fn: Callable = None,
     ):
         raise NotImplementedError()
 

@@ -1,0 +1,239 @@
+"""PPO/GRPO actor: the algorithm layer over any TrainEngine.
+
+Role of reference areal/engine/ppo/actor.py (`PPOActor`, `FSDPPPOActor`,
+`grpo_loss_fn`): reward shaping → advantage estimation → minibatched
+decoupled-PPO updates. Device math (GAE, whitening, the loss) is jnp and
+jit-traced inside the engine; host-side orchestration (minibatch splitting,
+dynamic sampling) is numpy on padded batches.
+
+Data layout (padded Batch, all aligned to TARGET token position t =
+"token t given prefix < t"):
+- input_ids [B, L], attention_mask [B, L]
+- loss_mask [B, L]: 1 on completion tokens (positions to train)
+- logprobs  [B, L]: behavior-policy logprobs of token t (0 on prompt)
+- versions  [B, L]: weight version that generated token t (-1 prompt)
+- rewards   [B]: scalar episode rewards
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import PPOActorConfig
+from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.engine.spmd_engine import target_aligned_logprobs
+from areal_tpu.ops import functional as F
+from areal_tpu.utils import stats_tracker
+from areal_tpu.utils.data import Batch, batch_select, batch_size
+
+
+class PPOActor:
+    """Algorithm wrapper (reference ppo/actor.py:24)."""
+
+    def __init__(self, config: PPOActorConfig, engine: TrainEngine):
+        self.config = config
+        self.engine = engine
+        self.reward_bias = config.reward_bias
+        self.reward_scaling = config.reward_scaling
+        self.reward_clip = config.reward_clip
+        self.group_size = config.group_size
+        self.kl_ctl = config.kl_ctl
+
+    # ------------------------------------------------------------------
+    def compute_logp(self, data: Batch, temperature: Optional[float] = None) -> np.ndarray:
+        """Recompute logprobs of the batch tokens under current weights
+        (reference ppo/actor.py:48 `compute_logp`)."""
+        temp = temperature if temperature is not None else self.config.temperature
+
+        def hook(logits, arrays):
+            return target_aligned_logprobs(logits, arrays, temperature=temp)
+
+        return self.engine.forward(data, post_hook=hook)
+
+    # ------------------------------------------------------------------
+    def compute_advantages(self, data: Batch) -> Batch:
+        """Reward shaping + GAE + advantage normalization (reference
+        ppo/actor.py:67-159). Returns `data` with added keys: advantages,
+        kl_rewards, tot_rewards (all [B, L] target-aligned)."""
+        cfg = self.config
+        mask = np.asarray(data["attention_mask"]).astype(bool)
+        loss_mask = np.asarray(data["loss_mask"]).astype(np.float32)
+        bsz, L = mask.shape
+        reward_score = np.asarray(data["rewards"]).astype(np.float32)
+        reward_score = (reward_score + self.reward_bias) * self.reward_scaling
+        reward_score = np.clip(
+            reward_score, -self.reward_clip, self.reward_clip
+        )
+        if cfg.group_reward_norm and self.group_size > 1:
+            reward_score = np.asarray(
+                F.grpo_group_norm_rewards(
+                    jnp.asarray(reward_score), self.group_size
+                )
+            )
+        if cfg.overlong_reward_penalty:
+            gen_lens = loss_mask.sum(1)
+            reward_score = np.asarray(
+                F.reward_overlong_penalty(
+                    jnp.asarray(gen_lens), jnp.asarray(reward_score),
+                    cfg.overlong_tokens, cfg.overlong_penalty_factor,
+                    cfg.max_new_tokens,
+                )
+            )
+
+        logprobs = np.asarray(
+            data.get("prox_logp", data["logprobs"])
+        ).astype(np.float32)
+        ref_logp = data.get("ref_logp")
+        # dense KL reward on completion positions
+        if ref_logp is not None and self.kl_ctl != 0.0:
+            kl_rewards = (
+                -self.kl_ctl
+                * (logprobs - np.asarray(ref_logp, np.float32))
+                * loss_mask
+            )
+        else:
+            kl_rewards = np.zeros_like(loss_mask)
+        tok_rewards = kl_rewards.copy()
+        # terminal scalar reward at the last completion token
+        lens = mask.sum(1).astype(np.int64)
+        last_idx = np.maximum(lens - 1, 0)
+        tok_rewards[np.arange(bsz), last_idx] += reward_score
+
+        values = np.asarray(
+            data.get("values", np.zeros_like(loss_mask))
+        ).astype(np.float32)
+        adv, returns = _gae_jit(
+            jnp.asarray(tok_rewards), jnp.asarray(values),
+            jnp.asarray(mask.astype(np.float32)), cfg.gamma, cfg.lam,
+        )
+        adv = np.asarray(adv)
+        an = cfg.adv_norm
+        if an is not None and (an.mean_level != "none" or an.std_level != "none"):
+            adv = _adv_normalize(adv, loss_mask, an, self.group_size)
+        data["advantages"] = adv
+        data["kl_rewards"] = kl_rewards
+        data["tot_rewards"] = tok_rewards
+        stats_tracker.scalar(
+            task_reward=float(reward_score.mean()),
+            kl_reward=float(kl_rewards.sum(1).mean()),
+            advantage=float((adv * loss_mask).sum() / max(loss_mask.sum(), 1)),
+        )
+        return data
+
+    # ------------------------------------------------------------------
+    def ppo_update(self, data: Batch) -> List[Dict[str, float]]:
+        """Minibatched decoupled-PPO update (reference ppo/actor.py:161)."""
+        cfg = self.config
+        if cfg.recompute_logprob and "prox_logp" not in data:
+            # proximal policy = current weights before this update
+            data["prox_logp"] = self.compute_logp(data) * np.asarray(
+                data["loss_mask"], np.float32
+            )
+        if cfg.dynamic_sampling and self.group_size > 1:
+            keep = np.asarray(
+                F.dynamic_sampling_mask(
+                    jnp.asarray(np.asarray(data["rewards"], np.float32)),
+                    self.group_size,
+                )
+            )
+            if keep.any() and not keep.all():
+                data = batch_select(data, np.nonzero(keep)[0])
+        bsz = batch_size(data)
+        n_mbs = min(cfg.ppo_n_minibatches, max(bsz, 1))
+        perm = np.random.permutation(bsz)
+        groups = np.array_split(perm, n_mbs)
+        all_stats = []
+        for g in groups:
+            if len(g) == 0:
+                continue
+            mb = batch_select(data, g)
+            stats = self.engine.train_batch(
+                mb, self._loss_fn, _ppo_loss_weight_fn
+            )
+            all_stats.append(stats)
+        return all_stats
+
+    @property
+    def _loss_fn(self):
+        if not hasattr(self, "_cached_loss_fn"):
+            cfg = self.config
+
+            def grpo_loss_fn(logits, arrays):
+                """reference ppo/actor.py:292 `grpo_loss_fn`."""
+                newlogp = target_aligned_logprobs(
+                    logits, arrays, temperature=cfg.temperature
+                )
+                old_logp = arrays["t_logprobs"].astype(jnp.float32)
+                prox_logp = (
+                    arrays["t_prox_logp"].astype(jnp.float32)
+                    if "t_prox_logp" in arrays
+                    else None
+                )
+                if not cfg.use_decoupled_loss and prox_logp is not None:
+                    # plain PPO against recomputed logp
+                    old_logp, prox_logp = prox_logp, None
+                loss_mask = arrays["t_loss_mask"] > 0
+                loss, stats = F.ppo_actor_loss_fn(
+                    logprobs=newlogp,
+                    old_logprobs=old_logp,
+                    advantages=arrays["t_advantages"].astype(jnp.float32),
+                    eps_clip=cfg.eps_clip,
+                    loss_mask=loss_mask,
+                    c_clip=cfg.c_clip,
+                    proximal_logprobs=prox_logp,
+                    behav_imp_weight_cap=cfg.behav_imp_weight_cap,
+                    eps_clip_higher=cfg.eps_clip_higher,
+                )
+                return loss, stats
+
+            self._cached_loss_fn = grpo_loss_fn
+        return self._cached_loss_fn
+
+
+def _ppo_loss_weight_fn(arrays) -> jnp.ndarray:
+    return jnp.maximum(
+        (arrays["t_loss_mask"] > 0).astype(jnp.float32).sum(), 1.0
+    )
+
+
+_gae_jit = jax.jit(F.gae_padded, static_argnums=(3, 4))
+
+
+def _adv_normalize(adv, loss_mask, an, group_size: int) -> np.ndarray:
+    """Batch/group-level advantage whitening (reference ppo/actor.py:370
+    `AdvNorm`)."""
+    m = loss_mask.astype(np.float64)
+    x = adv.astype(np.float64)
+
+    def _mean(vals, msk, axis=None):
+        return (vals * msk).sum(axis) / np.maximum(msk.sum(axis), 1.0)
+
+    if an.mean_level == "batch":
+        mean = _mean(x, m)
+    elif an.mean_level == "group":
+        g = group_size
+        xm = _mean(
+            x.reshape(-1, g, x.shape[1]), m.reshape(-1, g, x.shape[1]),
+            axis=(1, 2),
+        )[:, None, None]
+        mean = np.broadcast_to(xm, (x.shape[0] // g, g, x.shape[1])).reshape(x.shape)
+    else:
+        mean = 0.0
+    centered = x - mean
+    if an.std_level == "batch":
+        std = np.sqrt(_mean(centered**2, m)) + 1e-5
+    elif an.std_level == "group":
+        g = group_size
+        sm = np.sqrt(
+            _mean(
+                (centered**2).reshape(-1, g, x.shape[1]),
+                m.reshape(-1, g, x.shape[1]), axis=(1, 2),
+            )
+        )[:, None, None] + 1e-5
+        std = np.broadcast_to(sm, (x.shape[0] // g, g, x.shape[1])).reshape(x.shape)
+    else:
+        std = 1.0
+    return ((centered / std) * m).astype(np.float32)

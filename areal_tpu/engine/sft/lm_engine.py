@@ -1,0 +1,67 @@
+"""SFT language-model engine (reference areal/engine/sft/lm_engine.py).
+
+Wraps a TrainEngine with the causal-LM loss over packed streams. The loss
+mask convention matches the reference: ``loss_mask[t] == 1`` marks tokens
+whose *prediction* should be trained (completion tokens), so the logit at
+position t-1 is scored against token t.
+"""
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.ops.functional import gather_logprobs
+from areal_tpu.utils.data import Batch
+
+
+def _shifted_targets(arrays: Dict) -> tuple:
+    """(next_tokens, trainable-position mask) for packed [R, T] arrays."""
+    tokens = arrays["tokens"]
+    seg = arrays["segment_ids"]
+    nxt_tok = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    same = jnp.concatenate(
+        [seg[:, 1:] == seg[:, :-1], jnp.zeros_like(seg[:, :1], bool)], axis=1
+    ) & (seg > 0)
+    if "t_loss_mask" in arrays:
+        nxt_lm = jnp.concatenate(
+            [
+                arrays["t_loss_mask"][:, 1:],
+                jnp.zeros_like(arrays["t_loss_mask"][:, :1]),
+            ],
+            axis=1,
+        )
+        mask = same & (nxt_lm > 0)
+    else:
+        mask = same
+    return nxt_tok, mask
+
+
+def sft_loss_fn(logits: jnp.ndarray, arrays: Dict):
+    nxt_tok, mask = _shifted_targets(arrays)
+    logp = gather_logprobs(logits, nxt_tok)
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    loss = -jnp.sum(logp * m) / denom
+    # perplexity proxy stat (masked mean logp)
+    return loss, {"nll": loss}
+
+
+def sft_loss_weight_fn(arrays: Dict) -> jnp.ndarray:
+    _, mask = _shifted_targets(arrays)
+    return jnp.maximum(mask.astype(jnp.float32).sum(), 1.0)
+
+
+class LMEngine:
+    """Thin algorithm wrapper: train_lm/evaluate_lm over any TrainEngine."""
+
+    def __init__(self, engine: TrainEngine):
+        self.engine = engine
+
+    def train_lm(self, data: Batch) -> Dict[str, float]:
+        return self.engine.train_batch(data, sft_loss_fn, sft_loss_weight_fn)
+
+    def evaluate_lm(self, data: Batch) -> Dict[str, float]:
+        return self.engine.eval_batch(data, sft_loss_fn, sft_loss_weight_fn)

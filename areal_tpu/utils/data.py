@@ -242,6 +242,143 @@ def split_padded_batch_into_mb_list(
     return MicroBatchList(mbs=mbs, groups=groups, forward_indices=forward_indices)
 
 
+@dataclasses.dataclass
+class PackedRows:
+    """Mesh-ready packed layout: R independent packed streams.
+
+    Rows are sharded over the (data, fsdp) mesh axes and the token dim over
+    seq; each row is one packed multi-sequence stream. `row_seqs[r]` lists
+    the original batch indices of the sequences packed into row r, in packing
+    order (segment id = slot index + 1).
+    """
+
+    tokens: np.ndarray  # [R, T] int32
+    segment_ids: np.ndarray  # [R, T] int32 (1-based per row; 0 = padding)
+    positions: np.ndarray  # [R, T] int32
+    per_token: Dict[str, np.ndarray]  # each [R, T, ...]
+    per_seq: Dict[str, np.ndarray]  # each [R, S, ...]
+    seq_lens: np.ndarray  # [R, S] int32 (0 on empty slots)
+    row_seqs: List[List[int]]  # original indices per row
+
+    @property
+    def n_rows(self) -> int:
+        return self.tokens.shape[0]
+
+    @property
+    def bucket(self) -> int:
+        return self.tokens.shape[1]
+
+    @property
+    def total_tokens(self) -> int:
+        return int((self.segment_ids > 0).sum())
+
+
+def pack_batch_rows(
+    batch: Batch,
+    n_rows: int,
+    pad_to: Optional[int] = None,
+    pad_seqs_to: Optional[int] = None,
+    quantum: int = _BUCKET_QUANTUM,
+) -> PackedRows:
+    """Pack a padded [B, L] batch into R balanced packed streams.
+
+    The device-facing layout for SPMD training: rows shard over data
+    parallelism, tokens over sequence parallelism, every shape static.
+    `quantum` sets the bucket granularity (callers pass 256×seq_parallel so
+    the token axis splits evenly across the seq mesh axis).
+    """
+    mask = np.asarray(batch["attention_mask"]).astype(bool)
+    bsz = mask.shape[0]
+    lens = mask.sum(1).astype(np.int32)
+    row_groups = datapack.partition_balanced(lens, n_rows)
+    row_groups = [sorted(g) for g in row_groups]
+    row_tokens = [int(lens[g].sum()) for g in row_groups]
+    t_pad = (
+        pad_to
+        if pad_to is not None
+        else next_bucket_size(max(row_tokens + [1]), quantum)
+    )
+    if t_pad < max(row_tokens + [0]):
+        raise ValueError(f"pad_to={t_pad} < max row tokens {max(row_tokens)}")
+    s_pad = pad_seqs_to if pad_seqs_to is not None else max(
+        1, max(len(g) for g in row_groups)
+    )
+
+    per_token_keys = [
+        k
+        for k, v in batch.items()
+        if k not in ("input_ids", "attention_mask")
+        and np.asarray(v).ndim >= 2
+        and np.asarray(v).shape[:2] == mask.shape
+    ]
+    per_seq_keys = [
+        k
+        for k, v in batch.items()
+        if k not in ("input_ids", "attention_mask") and k not in per_token_keys
+    ]
+
+    ids = np.asarray(batch["input_ids"])
+    tokens = np.zeros((n_rows, t_pad), np.int32)
+    seg = np.zeros((n_rows, t_pad), np.int32)
+    pos = np.zeros((n_rows, t_pad), np.int32)
+    seq_lens = np.zeros((n_rows, s_pad), np.int32)
+    per_token = {
+        k: np.zeros(
+            (n_rows, t_pad) + np.asarray(batch[k]).shape[2:],
+            np.asarray(batch[k]).dtype,
+        )
+        for k in per_token_keys
+    }
+    per_seq = {
+        k: np.zeros(
+            (n_rows, s_pad) + np.asarray(batch[k]).shape[1:],
+            np.asarray(batch[k]).dtype,
+        )
+        for k in per_seq_keys
+    }
+    for r, group in enumerate(row_groups):
+        off = 0
+        for slot, b in enumerate(group):
+            L = int(lens[b])
+            tokens[r, off : off + L] = ids[b, :L]
+            seg[r, off : off + L] = slot + 1
+            pos[r, off : off + L] = np.arange(L)
+            seq_lens[r, slot] = L
+            for k in per_token_keys:
+                per_token[k][r, off : off + L] = np.asarray(batch[k])[b, :L]
+            for k in per_seq_keys:
+                per_seq[k][r, slot] = np.asarray(batch[k])[b]
+            off += L
+    return PackedRows(
+        tokens=tokens, segment_ids=seg, positions=pos,
+        per_token=per_token, per_seq=per_seq, seq_lens=seq_lens,
+        row_seqs=row_groups,
+    )
+
+
+def unpack_rows_per_token(
+    packed: PackedRows, values: np.ndarray, pad_value: float = 0.0
+) -> np.ndarray:
+    """[R, T, ...] per-token device output → padded [B, L, ...] in original
+    batch order."""
+    lens_flat: Dict[int, int] = {}
+    for r, group in enumerate(packed.row_seqs):
+        for slot, b in enumerate(group):
+            lens_flat[b] = int(packed.seq_lens[r, slot])
+    bsz = len(lens_flat)
+    max_len = max(lens_flat.values()) if bsz else 0
+    out = np.full(
+        (bsz, max_len) + values.shape[2:], pad_value, dtype=values.dtype
+    )
+    for r, group in enumerate(packed.row_seqs):
+        off = 0
+        for slot, b in enumerate(group):
+            L = int(packed.seq_lens[r, slot])
+            out[b, :L] = values[r, off : off + L]
+            off += L
+    return out
+
+
 def reorder_back(values: np.ndarray, forward_indices: List[int]) -> np.ndarray:
     """Scatter per-sequence results of concatenated micro-batches back into
     the original batch order."""

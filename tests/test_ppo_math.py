@@ -1,0 +1,159 @@
+"""RL math: GAE vs pure-python reference, PPO loss semantics, normalization.
+
+Mirrors reference realhf/tests/cpp_extensions/test_cugae.py (kernel vs pygae)
+and PPO loss unit behavior.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.ops import functional as F
+
+
+def _pygae(rewards, values, gamma, lam):
+    """Textbook per-sequence GAE (bootstrap 0 at episode end)."""
+    T = len(rewards)
+    adv = np.zeros(T, np.float64)
+    nxt = 0.0
+    nxt_v = 0.0
+    for t in reversed(range(T)):
+        delta = rewards[t] + gamma * nxt_v - values[t]
+        adv[t] = delta + gamma * lam * nxt
+        nxt = adv[t]
+        nxt_v = values[t]
+    return adv
+
+
+@pytest.mark.parametrize("gamma,lam", [(1.0, 1.0), (0.99, 0.95)])
+def test_gae_packed_matches_python(gamma, lam):
+    rng = np.random.default_rng(0)
+    lens = [5, 1, 8, 3]
+    rewards = [rng.standard_normal(L).astype(np.float32) for L in lens]
+    values = [rng.standard_normal(L).astype(np.float32) for L in lens]
+    total = sum(lens)
+    pad = 24
+    r = np.zeros(pad, np.float32)
+    v = np.zeros(pad, np.float32)
+    seg = np.zeros(pad, np.int32)
+    off = 0
+    for i, L in enumerate(lens):
+        r[off : off + L] = rewards[i]
+        v[off : off + L] = values[i]
+        seg[off : off + L] = i + 1
+        off += L
+    adv, ret = F.gae_packed(
+        jnp.asarray(r), jnp.asarray(v), jnp.asarray(seg), gamma, lam
+    )
+    adv = np.asarray(adv)
+    off = 0
+    for i, L in enumerate(lens):
+        expected = _pygae(rewards[i], values[i], gamma, lam)
+        np.testing.assert_allclose(
+            adv[off : off + L], expected, rtol=1e-5, atol=1e-5
+        )
+        off += L
+    assert (np.asarray(adv)[total:] == 0).all()
+
+
+def test_ppo_loss_clip_and_decoupled():
+    T = 6
+    adv = jnp.asarray([1.0, -1.0, 2.0, -2.0, 0.5, 0.0])
+    old = jnp.zeros(T)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 0], jnp.float32)
+    # identical policies → loss = -mean(adv over mask)
+    loss, stats = F.ppo_actor_loss_fn(old, old, adv, 0.2, mask)
+    np.testing.assert_allclose(float(loss), -float((adv[:5]).mean()), rtol=1e-6)
+    np.testing.assert_allclose(float(stats["importance_weight"]), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(stats["clip_ratio"]), 0.0, atol=1e-6)
+
+    # big positive ratio on positive advantage → clipped at 1+eps
+    new = jnp.asarray([1.0, 0, 0, 0, 0, 0])  # ratio e at t=0
+    loss2, stats2 = F.ppo_actor_loss_fn(new, old, adv, 0.2, mask)
+    assert float(stats2["clip_ratio"]) > 0.0
+
+    # decoupled: prox == new → ratio 1, behav weight = exp(prox-old)
+    prox = new
+    loss3, stats3 = F.ppo_actor_loss_fn(
+        new, old, adv, 0.2, mask, proximal_logprobs=prox
+    )
+    assert float(stats3["behave_imp_weight"]) > 1.0
+    # cap excludes the t=0 token entirely
+    loss4, stats4 = F.ppo_actor_loss_fn(
+        new, old, adv, 0.2, mask, proximal_logprobs=prox,
+        behav_imp_weight_cap=1.5,
+    )
+    np.testing.assert_allclose(float(stats4["behave_imp_weight"]), 1.0, rtol=1e-6)
+
+    # dual clip engages on very negative advantage with large ratio
+    new5 = jnp.asarray([0, 3.0, 0, 0, 0, 0])
+    loss5, stats5 = F.ppo_actor_loss_fn(
+        new5, old, adv, 0.2, mask, c_clip=3.0
+    )
+    assert float(stats5["dual_clip_ratio"]) > 0.0
+
+
+def test_gae_padded_propagates_across_loss_mask_gaps():
+    """A terminal reward must reach tokens before a loss-masked gap
+    (multi-turn rollouts: user/tool tokens are valid episode steps but are
+    excluded from the loss)."""
+    B, L = 1, 6
+    rewards = np.zeros((B, L), np.float32)
+    rewards[0, 5] = 1.0  # terminal reward at the last token
+    values = np.zeros((B, L), np.float32)
+    attn = np.ones((B, L), np.float32)  # all tokens valid
+    adv, ret = F.gae_padded(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(attn), 1.0, 1.0
+    )
+    # with gamma=lam=1 and zero values, every position sees the terminal reward
+    np.testing.assert_allclose(np.asarray(adv)[0], np.ones(L), rtol=1e-6)
+    # padding (invalid tokens) stays zero and blocks the recursion
+    attn2 = attn.copy()
+    attn2[0, 4:] = 0
+    adv2, _ = F.gae_padded(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(attn2), 1.0, 1.0
+    )
+    np.testing.assert_allclose(np.asarray(adv2)[0], np.zeros(L), atol=1e-6)
+
+
+def test_masked_normalization_dim():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
+    mask = jnp.ones((4, 8), jnp.float32)
+    out = np.asarray(F.masked_normalization(x, mask, dim=1))
+    np.testing.assert_allclose(out.mean(axis=1), np.zeros(4), atol=1e-5)
+
+
+def test_masked_normalization():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
+    mask = jnp.asarray(rng.integers(0, 2, size=(4, 8)).astype(np.float32))
+    out = np.asarray(F.masked_normalization(x, mask))
+    m = np.asarray(mask) > 0
+    np.testing.assert_allclose(out[m].mean(), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out[m].std(), 1.0, atol=1e-2)
+    assert (out[~m] == 0).all()
+
+
+def test_grpo_group_norm_and_dynamic_sampling():
+    rewards = jnp.asarray([1.0, 0.0, 1.0, 1.0], jnp.float32)  # 2 groups of 2
+    out = np.asarray(F.grpo_group_norm_rewards(rewards, 2))
+    np.testing.assert_allclose(out[:2], [1.0, -1.0], rtol=1e-4)
+    np.testing.assert_allclose(out[2:], [0.0, 0.0], atol=1e-6)
+    keep = np.asarray(F.dynamic_sampling_mask(rewards, 2))
+    assert keep[:2].all() and not keep[2:].any()
+
+
+def test_overlong_penalty():
+    lens = jnp.asarray([10.0, 90.0, 100.0])
+    rewards = jnp.ones(3)
+    out = np.asarray(
+        F.reward_overlong_penalty(lens, rewards, overlong_tokens=20,
+                                  overlong_penalty_factor=1.0,
+                                  max_new_tokens=100)
+    )
+    np.testing.assert_allclose(out[0], 1.0)  # well under the window
+    np.testing.assert_allclose(out[1], 0.5)  # halfway into the window
+    np.testing.assert_allclose(out[2], 0.0)  # at the cap
