@@ -1,0 +1,38 @@
+"""Reward API: sync verifier functions made awaitable.
+
+Role of reference areal/api/reward_api.py (`AsyncRewardWrapper`): reward
+functions (math verification, code execution) are blocking CPU work; the
+async rollout loop must not stall on them, so they run in a thread pool.
+"""
+
+import asyncio
+import concurrent.futures
+import functools
+from typing import Callable, Optional
+
+_DEFAULT_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _DEFAULT_POOL
+    if _DEFAULT_POOL is None:
+        _DEFAULT_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="reward"
+        )
+    return _DEFAULT_POOL
+
+
+class AsyncRewardWrapper:
+    """Wrap a sync ``reward_fn(prompt, completion, prompt_ids,
+    completion_ids, **data) -> float`` for use inside ``arun_episode``."""
+
+    def __init__(self, reward_fn: Callable[..., float]):
+        self.reward_fn = reward_fn
+
+    async def __call__(self, *args, **kwargs) -> float:
+        loop = asyncio.get_running_loop()
+        return float(
+            await loop.run_in_executor(
+                _pool(), functools.partial(self.reward_fn, *args, **kwargs)
+            )
+        )

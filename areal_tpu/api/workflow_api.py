@@ -1,0 +1,264 @@
+"""Async rollout runtime: RolloutWorkflow + WorkflowExecutor.
+
+Role of reference areal/api/workflow_api.py:31-323 — the heart of async RL.
+A background thread runs an asyncio loop that drains an input queue into
+``workflow.arun_episode`` tasks against the inference engine. Capacity
+control enforces both a concurrency cap and the staleness gate
+
+    capacity = min(max_concurrent_rollouts,
+                   (max_head_offpolicyness + trainer_version + 1) ·
+                   consumer_batch_size − (accepted + running))
+
+so rollouts never run more than ``max_head_offpolicyness`` weight versions
+ahead of what the trainer has consumed (reference workflow_api.py:101-113).
+
+TPU adaptation: batches are plain dict[str, np.ndarray] (padded layout)
+instead of TensorDicts; the asyncio loop is stock (uvloop is CUDA-image
+baggage the reference carries — not needed here).
+"""
+
+import abc
+import asyncio
+import queue
+import random
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.io_struct import RolloutStat
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("WorkflowExecutor")
+
+
+class RolloutWorkflow(abc.ABC):
+    """One episode of data collection (reference workflow_api.py:31)."""
+
+    @abc.abstractmethod
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Run one episode and return a padded batch (or None to reject)."""
+        raise NotImplementedError()
+
+
+class _WorkItem:
+    __slots__ = ("data", "workflow", "create_time")
+
+    def __init__(self, data, workflow):
+        self.data = data
+        self.workflow = workflow
+        self.create_time = time.monotonic_ns()
+
+
+class _ResultItem:
+    __slots__ = ("batch", "create_time")
+
+    def __init__(self, batch, create_time):
+        self.batch = batch
+        self.create_time = create_time
+
+
+class WorkflowExecutor:
+    """Background async rollout driver (reference workflow_api.py:51)."""
+
+    def __init__(self, config: InferenceEngineConfig, inference_engine):
+        self.config = config
+        self.engine = inference_engine
+        qsize = config.queue_size or (config.consumer_batch_size * 16 or 128)
+        self.input_queue: "queue.Queue[_WorkItem]" = queue.Queue(maxsize=qsize)
+        # unbounded: total outstanding results are already bounded by the
+        # staleness gate (accepted counts feed get_capacity), and a bounded
+        # queue would let put() block the asyncio loop thread
+        self.output_queue: "queue.Queue[_ResultItem]" = queue.Queue()
+        self.rollout_stat = RolloutStat()
+        self._lock = threading.Lock()
+        self._exiting = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def initialize(self):
+        self._thread = threading.Thread(
+            target=self._thread_main, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def destroy(self):
+        self._exiting.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def pause(self):
+        """Stop launching new episodes (weight-update window; reference
+        workflow_api pause/resume gate)."""
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+    # ------------------------------------------------------------------
+    def get_capacity(self) -> int:
+        """Staleness-aware admission budget (reference workflow_api.py:101)."""
+        cfg = self.config
+        with self._lock:
+            version = self.engine.get_version()
+            consumer_bs = max(cfg.consumer_batch_size, 1)
+            max_concurrent = cfg.max_concurrent_rollouts or consumer_bs
+            capacity = max_concurrent - self.rollout_stat.running
+            if cfg.max_head_offpolicyness is not None:
+                ofp = cfg.max_head_offpolicyness
+                sample_cnt = self.rollout_stat.accepted + self.rollout_stat.running
+                budget = (ofp + version + 1) * consumer_bs - sample_cnt
+                capacity = min(capacity, budget)
+            return capacity
+
+    # ------------------------------------------------------------------
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
+        self.input_queue.put_nowait(_WorkItem(data, workflow))
+        with self._lock:
+            self.rollout_stat.submitted += 1
+
+    def wait(
+        self, count: int, timeout: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        """Block until `count` accepted results; returns one concatenated
+        padded batch sorted by creation time then shuffled (reference
+        workflow_api.py:225-274)."""
+        start = time.monotonic()
+        timeout = timeout or self.config.request_timeout
+        results: List[_ResultItem] = []
+        while len(results) < count:
+            if self._exiting.is_set():
+                raise RuntimeError("executor is shutting down")
+            remain = timeout - (time.monotonic() - start)
+            if remain <= 0:
+                # put back what we took so nothing is lost
+                for r in results:
+                    self.output_queue.put_nowait(r)
+                raise TimeoutError(
+                    f"rollout wait timed out: {len(results)}/{count}"
+                )
+            try:
+                item = self.output_queue.get(timeout=min(0.05, remain))
+            except queue.Empty:
+                continue
+            results.append(item)
+        results.sort(key=lambda r: r.create_time)
+        random.shuffle(results)
+        return data_utils.concat_padded_tensors([r.batch for r in results])
+
+    def rollout_batch(
+        self, data: List[Dict[str, Any]], workflow: RolloutWorkflow
+    ) -> Dict[str, np.ndarray]:
+        """Synchronous batch rollout: submit all, wait all."""
+        for item in data:
+            self.submit(item, workflow)
+        return self.wait(count=len(data))
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow: RolloutWorkflow,
+    ) -> Dict[str, np.ndarray]:
+        """Overlap submission with waiting: keep the pipeline full under the
+        capacity gate, return as soon as one consumer batch is ready
+        (reference workflow_api.py:288-317)."""
+        if not hasattr(self, "_data_generator"):
+            self._data_generator = _cycle(dataloader)
+        bs = getattr(dataloader, "batch_size", 1) or 1
+        assert self.config.consumer_batch_size % bs == 0
+        while True:
+            # top the pipeline up whenever the staleness gate has room for
+            # at least one more dataloader batch (reference :300-308)
+            if (
+                self.get_capacity() + bs > 0
+                and not self.input_queue.full()
+            ):
+                items = next(self._data_generator)
+                for item in items:
+                    self.submit(item, workflow)
+            try:
+                return self.wait(
+                    count=self.config.consumer_batch_size, timeout=1
+                )
+            except TimeoutError:
+                continue
+
+    # ------------------------------------------------------------------
+    def _thread_main(self):
+        try:
+            asyncio.run(self._run_async())
+        except Exception:
+            logger.error(
+                "rollout thread crashed:\n" + traceback.format_exc()
+            )
+            raise
+
+    async def _run_async(self):
+        pending: set = set()
+        trace = self.config.enable_rollout_tracing
+        while not self._exiting.is_set():
+            # launch as many episodes as capacity allows
+            capacity = self.get_capacity()
+            launched = 0
+            while capacity > 0 and not self._paused.is_set():
+                try:
+                    item = self.input_queue.get_nowait()
+                except queue.Empty:
+                    break
+                task = asyncio.create_task(
+                    self._run_episode(item)
+                )
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+                capacity -= 1
+                launched += 1
+                with self._lock:
+                    self.rollout_stat.running += 1
+                if trace:
+                    logger.info(
+                        f"launched episode (running={self.rollout_stat.running})"
+                    )
+            if pending:
+                await asyncio.wait(
+                    pending, timeout=0.02,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            else:
+                await asyncio.sleep(0.005)
+        for t in pending:
+            t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    async def _run_episode(self, item: _WorkItem):
+        try:
+            batch = await item.workflow.arun_episode(self.engine, item.data)
+        except Exception:
+            logger.error("episode failed:\n" + traceback.format_exc())
+            batch = None
+        with self._lock:
+            self.rollout_stat.running -= 1
+            if batch is None:
+                self.rollout_stat.rejected += 1
+                return
+            self.rollout_stat.accepted += 1
+        self.output_queue.put_nowait(_ResultItem(batch, item.create_time))
+        if self.config.enable_rollout_tracing:
+            logger.info(
+                f"episode done (accepted={self.rollout_stat.accepted})"
+            )
+
+
+def _cycle(dataloader):
+    while True:
+        for batch in dataloader:
+            yield batch

@@ -47,11 +47,17 @@ class PPOActor:
         """Recompute logprobs of the batch tokens under current weights
         (reference ppo/actor.py:48 `compute_logp`)."""
         temp = temperature if temperature is not None else self.config.temperature
+        # cache the hook per temperature: the engine keys its jitted program
+        # on hook identity, so a fresh closure per call would recompile
+        if not hasattr(self, "_logp_hooks"):
+            self._logp_hooks = {}
+        if temp not in self._logp_hooks:
 
-        def hook(logits, arrays):
-            return target_aligned_logprobs(logits, arrays, temperature=temp)
+            def hook(logits, arrays, _temp=temp):
+                return target_aligned_logprobs(logits, arrays, temperature=_temp)
 
-        return self.engine.forward(data, post_hook=hook)
+            self._logp_hooks[temp] = hook
+        return self.engine.forward(data, post_hook=self._logp_hooks[temp])
 
     # ------------------------------------------------------------------
     def compute_advantages(self, data: Batch) -> Batch:

@@ -1,0 +1,289 @@
+"""Remote inference client: InferenceEngine over HTTP generation servers.
+
+Role of reference areal/engine/sglang_remote.py (`RemoteSGLangEngine`):
+- server discovery (env ``AREAL_LLM_SERVER_ADDRS`` or name_resolve subtree)
+  with health checks;
+- round-robin server choice with rid-affinity (a resumed/interrupted request
+  returns to the server holding its KV: sglang_remote.py:114-168);
+- the **interruptible generation loop** — on ``abort`` (weight-update
+  window) re-issue ``/generate`` with accumulated output tokens appended to
+  the prompt, so long generations span weight versions
+  (sglang_remote.py:186-234);
+- non-blocking disk weight updates: pause all servers → wait for the
+  trainer's name_resolve signal → reload → continue (sglang_remote.py:
+  251-309, 368-409);
+- rollout orchestration delegated to WorkflowExecutor.
+"""
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+import requests as _requests
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import (
+    ModelRequest,
+    ModelResponse,
+    WeightUpdateMeta,
+    WeightUpdateMethod,
+)
+from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
+from areal_tpu.utils import logging as logging_util, name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry
+
+logger = logging_util.getLogger("RemoteInferenceEngine")
+
+SERVER_ADDRS_ENV = "AREAL_LLM_SERVER_ADDRS"
+
+
+class RemoteInferenceEngine(InferenceEngine):
+    def __init__(self, config: InferenceEngineConfig):
+        self.config = config
+        self.addresses: List[str] = []
+        self._server_idx = 0
+        self._rid_to_address: Dict[str, str] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        self.workflow_executor: Optional[WorkflowExecutor] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # ------------------------------------------------------------------
+    def initialize(self, addrs: Optional[List[str]] = None):
+        if addrs is None:
+            env = os.environ.get(SERVER_ADDRS_ENV, "")
+            if env:
+                addrs = [a.strip() for a in env.split(",") if a.strip()]
+        if not addrs:
+            key = names.gen_servers(
+                self.config.experiment_name, self.config.trial_name
+            )
+            deadline = time.monotonic() + self.config.setup_timeout
+            while time.monotonic() < deadline:
+                addrs = name_resolve.get_subtree(key)
+                if addrs:
+                    break
+                time.sleep(0.5)
+        if not addrs:
+            raise RuntimeError("no generation servers found")
+        self.addresses = list(addrs)
+        self._health_check_all()
+        self.workflow_executor = WorkflowExecutor(self.config, self)
+        self.workflow_executor.initialize()
+        return self
+
+    def destroy(self):
+        if self.workflow_executor is not None:
+            self.workflow_executor.destroy()
+        self.executor.shutdown(wait=False)
+        if self._session is not None and not self._session.closed:
+            try:  # best-effort: the owning loop is already gone
+                asyncio.run(self._session.close())
+            except RuntimeError:
+                pass
+            self._session = None
+
+    def _health_check_all(self):
+        deadline = time.monotonic() + self.config.setup_timeout
+        pending = set(self.addresses)
+        while pending and time.monotonic() < deadline:
+            for addr in list(pending):
+                try:
+                    r = _requests.get(f"http://{addr}/health", timeout=5)
+                    if r.status_code == 200:
+                        pending.discard(addr)
+                except _requests.RequestException:
+                    pass
+            if pending:
+                time.sleep(0.5)
+        if pending:
+            raise RuntimeError(f"servers failed health check: {sorted(pending)}")
+        logger.info(f"{len(self.addresses)} generation server(s) healthy")
+
+    # ------------------------------------------------------------------
+    def get_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def set_version(self, version: int):
+        with self._lock:
+            self._version = version
+
+    # ------------------------------------------------------------------
+    def choose_server(self, rid: Optional[str] = None) -> str:
+        """rid-affinity first (KV locality on resume), else scheduling
+        policy (reference sglang_remote.py:158-168)."""
+        with self._lock:
+            if rid is not None and rid in self._rid_to_address:
+                return self._rid_to_address[rid]
+            if self.config.schedule_policy == "least_requests":
+                addr = min(
+                    self.addresses,
+                    key=lambda a: sum(
+                        1 for v in self._rid_to_address.values() if v == a
+                    ),
+                )
+            else:  # round_robin
+                addr = self.addresses[self._server_idx % len(self.addresses)]
+                self._server_idx += 1
+            if rid is not None:
+                self._rid_to_address[rid] = addr
+                if len(self._rid_to_address) > 16384:
+                    self._rid_to_address.pop(
+                        next(iter(self._rid_to_address))
+                    )
+            return addr
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)
+            )
+        return self._session
+
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        """Interruptible generation loop (reference sglang_remote.py:121-249)."""
+        gconfig = req.gconfig
+        assert gconfig.n_samples == 1, (
+            "agenerate expects n_samples=1; workflows fan out samples"
+        )
+        session = await self._get_session()
+        start = time.monotonic()
+        accumulated: List[int] = []
+        logprobs: List[float] = []
+        versions: List[int] = []
+        stop_reason = None
+        ttft = None
+        while stop_reason not in ("stop", "length") and len(accumulated) < gconfig.max_new_tokens:
+            server = self.choose_server(req.rid)
+            payload = {
+                "rid": req.rid,
+                "input_ids": list(req.input_ids) + accumulated,
+                "sampling_params": {
+                    "max_new_tokens": gconfig.max_new_tokens - len(accumulated),
+                    "min_new_tokens": max(
+                        0, gconfig.min_new_tokens - len(accumulated)
+                    ),
+                    "temperature": gconfig.temperature,
+                    "top_p": gconfig.top_p,
+                    "top_k": gconfig.top_k,
+                    "greedy": gconfig.greedy,
+                    "stop_token_ids": gconfig.stop_token_ids,
+                },
+            }
+            result = await arequest_with_retry(
+                session,
+                f"http://{server}/generate",
+                payload,
+                max_retries=self.config.request_retries,
+                timeout=self.config.request_timeout,
+            )
+            if ttft is None and result["output_ids"]:
+                ttft = time.monotonic() - start
+            accumulated.extend(result["output_ids"])
+            logprobs.extend(result["output_logprobs"])
+            versions.extend(result["output_versions"])
+            stop_reason = result["meta_info"]["finish_reason"]["type"]
+            if stop_reason == "abort":
+                # server is in a weight-update window; brief backoff then
+                # resume with accumulated tokens
+                await asyncio.sleep(self.config.pause_grace_period or 0.1)
+        with self._lock:
+            self._rid_to_address.pop(req.rid, None)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=accumulated,
+            output_logprobs=logprobs,
+            output_versions=versions,
+            stop_reason=stop_reason or "length",
+            latency=time.monotonic() - start,
+            ttft=ttft or (time.monotonic() - start),
+        )
+
+    # ------------------------------------------------------------------
+    # Weight updates (disk path)
+    # ------------------------------------------------------------------
+    def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
+        """Non-blocking: pause servers, reload weights when the trainer's
+        signal lands, resume (reference sglang_remote.py:251-309)."""
+        if meta.type != WeightUpdateMethod.DISK:
+            raise NotImplementedError(
+                "device-path weight update requires colocated engines; "
+                "use LocalSyncInferenceEngine"
+            )
+        for addr in self.addresses:
+            r = _requests.post(
+                f"http://{addr}/pause_generation", timeout=30
+            )
+            r.raise_for_status()
+
+        def _do_update():
+            try:
+                # the trainer signals checkpoint readiness via name_resolve
+                # (reference fsdp_engine.py:384-395); flows that save before
+                # calling us are detected by the checkpoint on disk
+                key = names.update_weights_from_disk(
+                    self.config.experiment_name,
+                    self.config.trial_name,
+                    meta.model_version,
+                )
+                deadline = time.monotonic() + self.config.request_timeout
+                while True:
+                    if os.path.exists(os.path.join(meta.path, "config.json")):
+                        break
+                    try:
+                        name_resolve.get(key)
+                        break
+                    except Exception:
+                        pass
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"weight checkpoint never appeared at {meta.path}"
+                        )
+                    time.sleep(0.2)
+                for addr in self.addresses:
+                    r = _requests.post(
+                        f"http://{addr}/update_weights_from_disk",
+                        json={
+                            "model_path": meta.path,
+                            "version": meta.model_version,
+                        },
+                        timeout=600,
+                    )
+                    r.raise_for_status()
+                    assert r.json().get("success"), r.json()
+                self.set_version(meta.model_version)
+            finally:
+                for addr in self.addresses:
+                    _requests.post(
+                        f"http://{addr}/continue_generation", timeout=30
+                    )
+
+        return self.executor.submit(_do_update)
+
+    # ------------------------------------------------------------------
+    # Rollout orchestration (delegated; reference sglang_remote.py:311-365)
+    # ------------------------------------------------------------------
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
+        self.workflow_executor.submit(data, workflow)
+
+    def wait(self, count: int, timeout: Optional[float] = None):
+        return self.workflow_executor.wait(count, timeout=timeout)
+
+    def rollout_batch(self, data: List[Dict[str, Any]], workflow):
+        return self.workflow_executor.rollout_batch(data, workflow)
+
+    def prepare_batch(self, dataloader, workflow):
+        return self.workflow_executor.prepare_batch(dataloader, workflow)
+
+    def pause(self):
+        self.workflow_executor.pause()
+
+    def resume(self):
+        self.workflow_executor.resume()

@@ -1,0 +1,69 @@
+"""Slot-based KV cache for continuous-batching decode.
+
+Role of the reference's serving-engine KV pool (SGLang radix/paged cache,
+used via HTTP in areal/engine/sglang_remote.py): on TPU a fixed-geometry
+cache is the XLA-friendly design — one array per K/V of shape
+[L, S, M, Hkv, D] (layers × slots × max_model_len × kv heads × head dim),
+updated with static-shape dynamic slices inside jit. Slot allocation is
+host-side bookkeeping; the device never sees dynamic shapes.
+
+Prefix reuse (the radix-cache analog) is a planned optimization; the
+interruptible-generation protocol (resubmit with accumulated tokens) does a
+full re-prefill, matching the reference's post-abort behavior
+(sglang_remote.py:186-234).
+"""
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import ModelConfig
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    num_slots: int
+    max_model_len: int
+
+    def hbm_bytes(self, cfg: ModelConfig, dtype_bytes: int = 2) -> int:
+        per_tok = 2 * cfg.num_kv_heads * cfg.head_dim * dtype_bytes
+        return cfg.num_layers * self.num_slots * self.max_model_len * per_tok
+
+
+def init_kv_cache(
+    cfg: ModelConfig, ccfg: CacheConfig, dtype=jnp.bfloat16
+) -> dict:
+    shape = (
+        cfg.num_layers,
+        ccfg.num_slots,
+        ccfg.max_model_len,
+        cfg.num_kv_heads,
+        cfg.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        # per-slot current length (tokens already cached)
+        "lens": jnp.zeros((ccfg.num_slots,), jnp.int32),
+    }
+
+
+class SlotAllocator:
+    """Host-side free-list of decode slots."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self._free: List[int] = list(range(num_slots))
+
+    def alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        assert 0 <= slot < self.num_slots and slot not in self._free
+        self._free.append(slot)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)

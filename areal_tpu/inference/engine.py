@@ -1,0 +1,366 @@
+"""Generation engine: continuous batching over the slot KV cache.
+
+Role of the SGLang server the reference drives over HTTP (areal/engine/
+sglang_remote.py + realhf/system/generation_server.py), rebuilt TPU-native:
+a single background loop thread owns the device state (params, KV cache) and
+interleaves admissions (prefill) with batched decode steps. Everything the
+device executes is one of two compiled programs (model_runner.prefill /
+decode_step), so continuous batching never recompiles.
+
+Interruption protocol (matches reference semantics sglang_remote.py:186-234):
+``pause()`` aborts all in-flight requests — they resolve with
+``stop_reason="abort"`` and whatever tokens they have; the client re-submits
+with accumulated tokens after ``continue_generation``. Weight updates happen
+between decode steps, so a paused engine swaps weights atomically.
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import JaxGenConfig
+from areal_tpu.inference import model_runner
+from areal_tpu.inference.cache import CacheConfig, SlotAllocator, init_kv_cache
+from areal_tpu.models import hf_io
+from areal_tpu.models.config import ModelConfig, load_hf_config
+from areal_tpu.models.transformer import Params
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("GenerationEngine")
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: str
+    input_ids: List[int]
+    max_new_tokens: int
+    min_new_tokens: int
+    temperature: float
+    top_p: float
+    top_k: int
+    greedy: bool
+    stop_token_ids: List[int]
+    future: Future
+    slot: Optional[int] = None
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    output_logprobs: List[float] = dataclasses.field(default_factory=list)
+    output_versions: List[int] = dataclasses.field(default_factory=list)
+    submit_time: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+
+
+def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
+    sp = payload.get("sampling_params", {})
+    return _Request(
+        rid=payload.get("rid", f"req-{time.time_ns()}"),
+        input_ids=list(payload["input_ids"]),
+        max_new_tokens=int(sp.get("max_new_tokens", 128)),
+        min_new_tokens=int(sp.get("min_new_tokens", 0)),
+        temperature=float(sp.get("temperature", 1.0)),
+        top_p=float(sp.get("top_p", 1.0)),
+        top_k=int(sp.get("top_k", 0)),
+        greedy=bool(sp.get("greedy", False)),
+        stop_token_ids=list(sp.get("stop_token_ids", [])),
+        future=fut,
+    )
+
+
+class GenerationEngine:
+    """In-process generation engine; the HTTP server is a thin shell."""
+
+    def __init__(
+        self,
+        config: JaxGenConfig,
+        model_config: Optional[ModelConfig] = None,
+        params: Optional[Params] = None,
+    ):
+        self.config = config
+        self.dtype = _DTYPES[config.dtype]
+        if model_config is None:
+            model_config = load_hf_config(config.model_path)
+        self.model_config = model_config
+        if params is None:
+            params = hf_io.load_params(
+                config.model_path, model_config, dtype=self.dtype
+            )
+        self.params = jax.device_put(params)
+        self.cache_config = CacheConfig(
+            num_slots=config.max_num_seqs, max_model_len=config.max_model_len
+        )
+        self.cache = init_kv_cache(model_config, self.cache_config, self.dtype)
+        self.allocator = SlotAllocator(config.max_num_seqs)
+        self.model_version = 0
+        self._rng_key = jax.random.PRNGKey(config.seed)
+
+        self._admit_queue: "queue.Queue[_Request]" = queue.Queue()
+        self._command_queue: "queue.Queue" = queue.Queue()
+        self._active: Dict[int, _Request] = {}  # slot -> request
+        self._paused = threading.Event()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        # metrics
+        self.total_generated_tokens = 0
+        self.total_prompt_tokens = 0
+        self.total_requests = 0
+        self.total_aborted = 0
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def start(self):
+        assert not self._running
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+    # Public API (thread-safe)
+    # ------------------------------------------------------------------
+    def submit(self, payload: Dict[str, Any]) -> Future:
+        fut: Future = Future()
+        req = _parse_request(payload, fut)
+        if len(req.input_ids) >= self.config.max_model_len:
+            fut.set_exception(
+                ValueError(
+                    f"prompt length {len(req.input_ids)} >= max_model_len "
+                    f"{self.config.max_model_len}"
+                )
+            )
+            return fut
+        self._admit_queue.put(req)
+        return fut
+
+    def generate(self, payload: Dict[str, Any], timeout: float = 3600.0) -> Dict:
+        return self.submit(payload).result(timeout=timeout)
+
+    def pause(self):
+        """Abort in-flight requests; stop admitting until continue."""
+        done = Future()
+        self._paused.set()
+        self._command_queue.put(("abort_all", None, done))
+        done.result(timeout=60)
+
+    def continue_generation(self):
+        self._paused.clear()
+
+    def update_weights_from_disk(self, path: str, version: Optional[int] = None):
+        done = Future()
+        self._command_queue.put(("update_weights", (path, version), done))
+        return done.result(timeout=600)
+
+    def update_weights_from_tensors(
+        self, params: Params, version: Optional[int] = None
+    ):
+        """Colocated path: swap in an already-materialized param pytree
+        (role of the reference's NCCL broadcast receive path)."""
+        done = Future()
+        self._command_queue.put(("update_weights_tensors", (params, version), done))
+        return done.result(timeout=600)
+
+    def metrics(self) -> Dict[str, float]:
+        return dict(
+            running_requests=len(self._active),
+            queued_requests=self._admit_queue.qsize(),
+            free_slots=self.allocator.n_free,
+            total_generated_tokens=self.total_generated_tokens,
+            total_prompt_tokens=self.total_prompt_tokens,
+            total_requests=self.total_requests,
+            total_aborted=self.total_aborted,
+            model_version=self.model_version,
+            paused=float(self._paused.is_set()),
+        )
+
+    # ------------------------------------------------------------------
+    # Engine loop (single owner of device state)
+    # ------------------------------------------------------------------
+    def _loop(self):
+        while self._running:
+            did_work = self._drain_commands()
+            if not self._paused.is_set():
+                did_work |= self._admit()
+                did_work |= self._decode()
+            if not did_work:
+                time.sleep(0.001)
+
+    def _drain_commands(self) -> bool:
+        did = False
+        while True:
+            try:
+                cmd, arg, done = self._command_queue.get_nowait()
+            except queue.Empty:
+                return did
+            did = True
+            try:
+                if cmd == "abort_all":
+                    for slot in list(self._active):
+                        self._finish(slot, "abort")
+                    done.set_result(True)
+                elif cmd == "update_weights":
+                    path, version = arg
+                    host = hf_io.load_params(
+                        path, self.model_config, dtype=self.dtype
+                    )
+                    self.params = jax.device_put(host)
+                    self.model_version = (
+                        version
+                        if version is not None
+                        else self.model_version + 1
+                    )
+                    logger.info(
+                        f"weights updated from {path} → v{self.model_version}"
+                    )
+                    done.set_result(self.model_version)
+                elif cmd == "update_weights_tensors":
+                    params, version = arg
+                    self.params = jax.device_put(
+                        jax.tree_util.tree_map(
+                            lambda p: p.astype(self.dtype), params
+                        )
+                    )
+                    self.model_version = (
+                        version
+                        if version is not None
+                        else self.model_version + 1
+                    )
+                    done.set_result(self.model_version)
+                else:  # pragma: no cover
+                    done.set_exception(ValueError(f"unknown command {cmd}"))
+            except Exception as e:  # surface errors to the caller
+                done.set_exception(e)
+
+    def _prefill_bucket(self, n: int) -> int:
+        quantum = min(self.config.prefill_chunk, self.config.max_model_len)
+        b = data_utils.next_bucket_size(n, quantum)
+        return min(b, self.config.max_model_len)
+
+    def _admit(self) -> bool:
+        did = False
+        while self.allocator.n_free > 0:
+            try:
+                req = self._admit_queue.get_nowait()
+            except queue.Empty:
+                break
+            slot = self.allocator.alloc()
+            plen = len(req.input_ids)
+            bucket = self._prefill_bucket(plen)
+            padded = np.zeros(bucket, np.int32)
+            padded[:plen] = req.input_ids
+            self.cache, logits = model_runner.prefill(
+                self.params, self.model_config, self.cache,
+                jnp.asarray(padded), jnp.asarray(plen, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+            )
+            req.slot = slot
+            self._active[slot] = req
+            self.total_prompt_tokens += plen
+            self.total_requests += 1
+            # sample the first token from prefill logits: embed the row into
+            # a full [S, V] stack so sampling keeps one static shape
+            full = jnp.zeros(
+                (self.cache_config.num_slots,) + logits.shape, logits.dtype
+            ).at[slot].set(logits)
+            self._sample_and_append(full, only_slots=[slot])
+            did = True
+        return did
+
+    def _decode(self) -> bool:
+        if not self._active:
+            return False
+        s = self.cache_config.num_slots
+        tokens = np.zeros(s, np.int32)
+        active = np.zeros(s, bool)
+        for slot, req in self._active.items():
+            tokens[slot] = req.output_ids[-1]
+            active[slot] = True
+        self.cache, logits = model_runner.decode_step(
+            self.params, self.model_config, self.cache,
+            jnp.asarray(tokens), jnp.asarray(active),
+        )
+        self._sample_and_append(logits, only_slots=list(self._active))
+        return True
+
+    def _sample_and_append(
+        self, logits: jnp.ndarray, only_slots: List[int]
+    ):
+        """Sample one token per slot from a full [S, V] stack (one static
+        shape for every admission/decode step) and handle stops for
+        `only_slots`."""
+        s = self.cache_config.num_slots
+        temp = np.ones(s, np.float32)
+        top_p = np.ones(s, np.float32)
+        top_k = np.zeros(s, np.int32)
+        greedy = np.zeros(s, bool)
+        for slot in only_slots:
+            req = self._active[slot]
+            temp[slot] = req.temperature
+            top_p[slot] = req.top_p
+            top_k[slot] = req.top_k
+            greedy[slot] = req.greedy
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        toks, logps = model_runner.sample_tokens(
+            logits, sub, jnp.asarray(temp), jnp.asarray(top_p),
+            jnp.asarray(top_k), jnp.asarray(greedy),
+        )
+        toks = np.asarray(toks)
+        logps = np.asarray(logps)
+        for slot in sorted(only_slots):
+            i = slot
+            req = self._active[slot]
+            if req.first_token_time is None:
+                req.first_token_time = time.monotonic()
+            req.output_ids.append(int(toks[i]))
+            req.output_logprobs.append(float(logps[i]))
+            req.output_versions.append(self.model_version)
+            self.total_generated_tokens += 1
+            out_len = len(req.output_ids)
+            total_len = len(req.input_ids) + out_len
+            stop_hit = (
+                int(toks[i]) in req.stop_token_ids
+                and out_len >= req.min_new_tokens
+            )
+            if stop_hit:
+                self._finish(slot, "stop")
+            elif (
+                out_len >= req.max_new_tokens
+                or total_len >= self.config.max_model_len
+            ):
+                self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str):
+        req = self._active.pop(slot)
+        self.allocator.free(slot)
+        if reason == "abort":
+            self.total_aborted += 1
+        now = time.monotonic()
+        result = {
+            "output_ids": req.output_ids,
+            "output_logprobs": req.output_logprobs,
+            "output_versions": req.output_versions,
+            "meta_info": {
+                "finish_reason": {"type": reason},
+                "prompt_tokens": len(req.input_ids),
+                "completion_tokens": len(req.output_ids),
+                "latency": now - req.submit_time,
+                "ttft": (req.first_token_time or now) - req.submit_time,
+                "model_version": self.model_version,
+            },
+        }
+        if not req.future.done():
+            req.future.set_result(result)

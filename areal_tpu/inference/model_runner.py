@@ -1,0 +1,241 @@
+"""Jitted prefill / decode-step programs over the slot KV cache.
+
+The TPU-native core of the generation engine (role of SGLang's model runner
+behind the reference's HTTP API). Two compiled programs:
+
+- ``prefill``: one request's prompt at a bucketed static length → writes
+  K/V for every position into the request's cache slot, returns the logits
+  of the last real token.
+- ``decode_step``: ALL active slots advance one token in a single batched
+  program — continuous batching is "the batch dim is the slot dim". K/V for
+  the new token scatter into each slot's line; attention reads the full
+  static cache line under a length mask.
+
+Both scan over the stacked layer params (compile once per bucket, O(1) in
+depth) and keep fp32 softmax/logits. Sampling (temperature / top-k / top-p /
+greedy, per-slot) runs on device; stop handling is host-side.
+"""
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.models.transformer import Params
+from areal_tpu.ops.basic import apply_rope, rms_norm, rope_frequencies
+
+NEG_INF = -2.3819763e38
+
+
+def _project_qkv(cfg: ModelConfig, lp: Params, h: jnp.ndarray):
+    """h [..., D] → q [..., Hq, Dh], k/v [..., Hkv, Dh] (pre-rope)."""
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(h.shape[:-1] + (cfg.num_heads, cfg.head_dim))
+    k = k.reshape(h.shape[:-1] + (cfg.num_kv_heads, cfg.head_dim))
+    v = v.reshape(h.shape[:-1] + (cfg.num_kv_heads, cfg.head_dim))
+    if cfg.use_qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
+
+
+def _mlp(lp: Params, h: jnp.ndarray) -> jnp.ndarray:
+    return (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+
+
+def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (
+        params["embedding"].T if cfg.tie_word_embeddings else params["lm_head"]
+    )
+    return x.astype(jnp.float32) @ head.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [Tp] int32, padded to bucket
+    true_len: jnp.ndarray,  # scalar int32
+    slot: jnp.ndarray,  # scalar int32
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Run the prompt through the stack, cache K/V, return last-token logits."""
+    tp = tokens.shape[0]
+    pos = jnp.arange(tp, dtype=jnp.int32)
+    valid = pos < true_len
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    x = params["embedding"][tokens][None]  # [1, Tp, D]
+    causal = (pos[None, :] <= pos[:, None]) & valid[None, :] & valid[:, None]
+
+    def layer(carry, xs):
+        x = carry
+        lp, _ = xs
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h)
+        q = apply_rope(q, pos[None], cos, sin)
+        k = apply_rope(k, pos[None], cos, sin)
+        # attention [1, Tp, Hq, Dh]
+        rep = cfg.num_heads // cfg.num_kv_heads
+        kk = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+        vv = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32)
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(causal[None, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32))
+        attn = attn.astype(x.dtype).reshape(1, tp, cfg.q_dim)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        return x, (k[0], v[0])  # [Tp, Hkv, Dh]
+
+    n_layers = cfg.num_layers
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(n_layers))
+    )
+    # write K/V into the slot: [L, Tp, Hkv, D] → cache [L, S, M, Hkv, D]
+    zero = jnp.zeros((), jnp.int32)
+    mask = valid[None, :, None, None]
+    ks = jnp.where(mask, ks, 0.0).astype(cache["k"].dtype)
+    vs = jnp.where(mask, vs, 0.0).astype(cache["v"].dtype)
+    cache_k = jax.lax.dynamic_update_slice(
+        cache["k"], ks[:, None], (zero, slot, zero, zero, zero)
+    )
+    cache_v = jax.lax.dynamic_update_slice(
+        cache["v"], vs[:, None], (zero, slot, zero, zero, zero)
+    )
+    lens = cache["lens"].at[slot].set(true_len)
+    last = x[0, jnp.maximum(true_len - 1, 0)]
+    logits = _final_logits(params, cfg, last[None])[0]
+    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [S] int32: current input token per slot
+    active: jnp.ndarray,  # [S] bool
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """All slots advance one position; returns logits [S, V] (fp32)."""
+    s, m = cache["k"].shape[1], cache["k"].shape[2]
+    positions = cache["lens"]  # [S] next position per slot
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    x = params["embedding"][tokens]  # [S, D]
+    arange_m = jnp.arange(m)
+    att_mask = arange_m[None, :] <= positions[:, None]  # [S, M] incl. new tok
+
+    def layer(carry, xs):
+        x = carry  # [S, D]
+        lp, k_l, v_l = xs  # cache line [S, M, Hkv, D]
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h)  # q [S, Hq, Dh], k/v [S, Hkv, Dh]
+        q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
+        # scatter new k/v at each slot's position
+        k_l = _scatter_token(k_l, k, positions)
+        v_l = _scatter_token(v_l, v, positions)
+        rep = cfg.num_heads // cfg.num_kv_heads
+        kk = jnp.repeat(k_l, rep, axis=2) if rep > 1 else k_l
+        vv = jnp.repeat(v_l, rep, axis=2) if rep > 1 else v_l
+        scores = jnp.einsum(
+            "shd,smhd->shm", q.astype(jnp.float32), kk.astype(jnp.float32)
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(att_mask[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("shm,smhd->shd", probs, vv.astype(jnp.float32))
+        attn = attn.astype(x.dtype).reshape(s, cfg.q_dim)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _final_logits(params, cfg, x)  # [S, V]
+    lens = jnp.where(active, positions + 1, positions)
+    return {"k": new_k, "v": new_v, "lens": lens}, logits
+
+
+def _scatter_token(
+    cache_line: jnp.ndarray,  # [S, M, Hkv, D]
+    new: jnp.ndarray,  # [S, Hkv, D]
+    positions: jnp.ndarray,  # [S]
+) -> jnp.ndarray:
+    new = new.astype(cache_line.dtype)
+
+    def one(line, tok, pos):
+        return jax.lax.dynamic_update_slice(
+            line, tok[None], (pos, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        )
+
+    return jax.vmap(one)(cache_line, new, positions)
+
+
+# ---------------------------------------------------------------------------
+# Sampling
+# ---------------------------------------------------------------------------
+@jax.jit
+def sample_tokens(
+    logits: jnp.ndarray,  # [S, V] fp32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S] int32 (0 = disabled)
+    greedy: jnp.ndarray,  # [S] bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-slot sampling; returns (tokens [S], logprobs [S]).
+
+    The returned logprob is under the temperature-scaled (untruncated)
+    distribution — the behavior-policy logprob the trainer consumes
+    (reference ModelResponse.output_logprobs semantics).
+    """
+    s, v = logits.shape
+    temp = jnp.maximum(temperature, 1e-5)[:, None]
+    scaled = logits / temp
+    logp_full = jax.nn.log_softmax(scaled, axis=-1)
+
+    # top-k / top-p truncation for the *sampling* distribution
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+    rank = jnp.arange(v)[None, :]
+    keep = jnp.ones((s, v), bool)
+    keep &= jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
+    # keep tokens while cumulative prob (exclusive) < top_p
+    keep &= (cumprev := cumprobs - sorted_probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)  # always keep the argmax token
+    trunc_sorted = jnp.where(keep, sorted_logits, NEG_INF)
+    trunc = jnp.full_like(scaled, NEG_INF).at[
+        jnp.arange(s)[:, None], sort_idx
+    ].set(trunc_sorted)
+    sampled = jax.random.categorical(key, trunc, axis=-1)
+    argmax = jnp.argmax(logits, axis=-1)
+    tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
+    logprobs = jnp.take_along_axis(
+        logp_full, tokens[:, None], axis=-1
+    ).squeeze(-1)
+    return tokens, logprobs

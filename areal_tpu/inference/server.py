@@ -1,0 +1,165 @@
+"""HTTP server shell around GenerationEngine.
+
+Role of the SGLang HTTP server the reference talks to (endpoints mirrored
+from areal/engine/sglang_remote.py + realhf/system/gserver_manager.py usage):
+``/generate``, ``/health``, ``/pause_generation``, ``/continue_generation``,
+``/update_weights_from_disk``, ``/metrics``, ``/get_model_info``.
+
+Stdlib ThreadingHTTPServer (fastapi is intentionally not a dependency): one
+thread per in-flight request, each blocking on its engine Future; the device
+work all happens on the engine's single loop thread.
+"""
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from areal_tpu.api.cli_args import JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import logging as logging_util, names, network
+from areal_tpu.utils import name_resolve
+
+logger = logging_util.getLogger("GenServer")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: GenerationEngine = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet default access logs
+        pass
+
+    def _send_json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def do_GET(self):
+        eng = self.engine
+        if self.path == "/health":
+            self._send_json({"status": "ok"})
+        elif self.path == "/get_model_info":
+            self._send_json(
+                {
+                    "model_version": eng.model_version,
+                    "model_path": eng.config.model_path,
+                    "max_model_len": eng.config.max_model_len,
+                }
+            )
+        elif self.path == "/metrics":
+            m = eng.metrics()
+            lines = [
+                f"areal_tpu_gen_{k} {v}" for k, v in sorted(m.items())
+            ]
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_POST(self):
+        eng = self.engine
+        try:
+            if self.path == "/generate":
+                payload = self._read_json()
+                result = eng.generate(payload)
+                self._send_json(result)
+            elif self.path == "/pause_generation":
+                eng.pause()
+                self._send_json({"status": "paused"})
+            elif self.path == "/continue_generation":
+                eng.continue_generation()
+                self._send_json({"status": "resumed"})
+            elif self.path == "/update_weights_from_disk":
+                payload = self._read_json()
+                version = eng.update_weights_from_disk(
+                    payload["model_path"], payload.get("version")
+                )
+                self._send_json({"success": True, "model_version": version})
+            else:
+                self._send_json({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:  # surface engine errors as 500s
+            logger.error(f"{self.path} failed: {e}")
+            self._send_json({"error": str(e)}, 500)
+
+
+def serve(
+    engine: GenerationEngine,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    experiment_name: str = "",
+    trial_name: str = "",
+    server_index: int = 0,
+    background: bool = False,
+) -> ThreadingHTTPServer:
+    if port == 0:
+        port = network.find_free_ports(1)[0]
+    handler = type("Handler", (_Handler,), {"engine": engine})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    if experiment_name and trial_name:
+        # register for discovery (reference generation_server.py:159-170)
+        name_resolve.add_subentry(
+            names.gen_servers(experiment_name, trial_name),
+            f"{host}:{port}",
+        )
+    logger.info(f"generation server listening on {host}:{port}")
+    if background:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+    else:
+        httpd.serve_forever()
+    return httpd
+
+
+def main(argv: Optional[list] = None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--server-index", type=int, default=0)
+    args = p.parse_args(argv)
+    cfg = JaxGenConfig(
+        model_path=args.model_path,
+        dtype=args.dtype,
+        seed=args.seed,
+        max_num_seqs=args.max_num_seqs,
+        max_model_len=args.max_model_len,
+        tensor_parallel_size=args.tensor_parallel_size,
+        host=args.host,
+        port=args.port,
+    )
+    engine = GenerationEngine(cfg).start()
+    serve(
+        engine,
+        host=args.host,
+        port=args.port,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        server_index=args.server_index,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -114,11 +114,11 @@ def ppo_actor_loss_fn(
     if c_clip is not None:
         assert c_clip > 1.0, c_clip
         pg_loss3 = jnp.sign(advantages) * c_clip * advantages
-        dual_clip_mask = pg_loss3 > pg_loss
+        # mask marks tokens where the min() actually replaced the value
+        dual_clip_mask = (pg_loss3 < pg_loss) & (advantages < 0)
         pg_loss = jnp.minimum(pg_loss, pg_loss3) * (advantages < 0) + pg_loss * (
             advantages >= 0
         )
-        dual_clip_mask = dual_clip_mask & (advantages < 0)
     else:
         dual_clip_mask = jnp.zeros_like(clip_mask)
     if proximal_logprobs is not None:

@@ -1,0 +1,46 @@
+"""Async HTTP helpers (role of reference areal/utils/http.py)."""
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import aiohttp
+
+
+class HttpRequestError(Exception):
+    pass
+
+
+async def arequest_with_retry(
+    session: aiohttp.ClientSession,
+    url: str,
+    payload: Optional[Dict[str, Any]] = None,
+    method: str = "POST",
+    max_retries: int = 3,
+    timeout: float = 3600.0,
+    retry_delay: float = 0.5,
+) -> Dict[str, Any]:
+    last_exc: Optional[Exception] = None
+    for attempt in range(max_retries):
+        try:
+            t = aiohttp.ClientTimeout(total=timeout)
+            if method.upper() == "POST":
+                async with session.post(url, json=payload, timeout=t) as resp:
+                    if resp.status != 200:
+                        body = await resp.text()
+                        raise HttpRequestError(
+                            f"POST {url} -> {resp.status}: {body[:500]}"
+                        )
+                    return await resp.json()
+            else:
+                async with session.get(url, timeout=t) as resp:
+                    if resp.status != 200:
+                        body = await resp.text()
+                        raise HttpRequestError(
+                            f"GET {url} -> {resp.status}: {body[:500]}"
+                        )
+                    return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, HttpRequestError) as e:
+            last_exc = e
+            if attempt + 1 < max_retries:
+                await asyncio.sleep(retry_delay * (2**attempt))
+    raise HttpRequestError(f"request to {url} failed after {max_retries} tries") from last_exc

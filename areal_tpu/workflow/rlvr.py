@@ -1,0 +1,138 @@
+"""RLVR workflow: n sampled completions per prompt + verifiable reward.
+
+Role of reference areal/workflow/rlvr.py:23-129 (`RLVRWorkflow`): the GRPO
+data-collection unit. For each prompt it launches ``n_samples`` independent
+generations, scores each with the (async-wrapped) reward function, and
+assembles the padded training batch with target-aligned behavior logprobs,
+loss mask, per-token weight versions, and the scalar reward.
+
+Input ``data`` dict must have either ``input_ids`` (token list) or
+``messages`` (chat template applied via the tokenizer); extra keys are
+passed through to the reward function (e.g. the ground-truth answer).
+"""
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import asyncio
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest, unique_rid
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("RLVRWorkflow")
+
+
+class RLVRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        enable_thinking: bool = False,
+        dump_dir: Optional[str] = None,
+    ):
+        self.reward_fn = AsyncRewardWrapper(reward_fn)
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.enable_thinking = enable_thinking
+        self.dump_dir = dump_dir
+
+    def _tokenize_prompt(self, data: Dict[str, Any]) -> List[int]:
+        if "input_ids" in data:
+            return list(data["input_ids"])
+        if self.tokenizer is None:
+            raise ValueError("need a tokenizer for message-format data")
+        return self.tokenizer.apply_chat_template(
+            data["messages"],
+            tokenize=True,
+            add_generation_prompt=True,
+            enable_thinking=self.enable_thinking,
+        )
+
+    def _detokenize(self, ids: List[int]) -> str:
+        if self.tokenizer is None:
+            return ""
+        return self.tokenizer.decode(ids)
+
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        prompt_ids = self._tokenize_prompt(data)
+        n = self.gconfig.n_samples
+        req_template = ModelRequest(
+            input_ids=prompt_ids, gconfig=self.gconfig.new(n_samples=1)
+        )
+        resps = await asyncio.gather(
+            *[
+                engine.agenerate(
+                    dataclasses.replace(req_template, rid=unique_rid())
+                )
+                for _ in range(n)
+            ]
+        )
+        extra = {
+            k: v
+            for k, v in data.items()
+            if k not in ("input_ids", "messages")
+        }
+        prompt_str = self._detokenize(prompt_ids)
+        rewards = await asyncio.gather(
+            *[
+                self.reward_fn(
+                    prompt_str,
+                    self._detokenize(r.output_tokens),
+                    prompt_ids,
+                    r.output_tokens,
+                    **extra,
+                )
+                for r in resps
+            ]
+        )
+        rows = []
+        plen = len(prompt_ids)
+        for r, reward in zip(resps, rewards):
+            seq = prompt_ids + r.output_tokens
+            L = len(seq)
+            row = {
+                "input_ids": np.asarray([seq], np.int32),
+                "attention_mask": np.ones((1, L), np.bool_),
+                "loss_mask": np.asarray(
+                    [[0] * plen + [1] * r.output_len], np.int32
+                ),
+                "logprobs": np.asarray(
+                    [[0.0] * plen + list(r.output_logprobs)], np.float32
+                ),
+                "versions": np.asarray(
+                    [[-1] * plen + list(r.output_versions)], np.int32
+                ),
+                "rewards": np.asarray([reward], np.float32),
+            }
+            rows.append(row)
+        if self.dump_dir is not None:
+            self._dump(engine, prompt_str, resps, rewards)
+        return data_utils.concat_padded_tensors(rows)
+
+    def _dump(self, engine, prompt_str, resps, rewards):
+        """Append generations to a per-version text file (reference
+        workflow/rlvr.py dump path)."""
+        try:
+            version = engine.get_version()
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(
+                os.path.join(self.dump_dir, f"v{version}.txt"), "a"
+            ) as f:
+                for r, rew in zip(resps, rewards):
+                    f.write(
+                        f"PROMPT: {prompt_str!r}\nOUTPUT: "
+                        f"{self._detokenize(r.output_tokens)!r}\n"
+                        f"REWARD: {rew}\n---\n"
+                    )
+        except Exception:  # dumping must never kill an episode
+            logger.warning("rollout dump failed", exc_info=True)

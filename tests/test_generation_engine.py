@@ -1,0 +1,130 @@
+"""Continuous-batching generation engine: concurrency, stops, interruption,
+weight updates."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gcfg = JaxGenConfig(
+        dtype="float32", max_num_seqs=4, max_model_len=64, prefill_chunk=16
+    )
+    eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+    yield eng
+    eng.stop()
+
+
+def test_single_generation(engine):
+    out = engine.generate(
+        {
+            "input_ids": [1, 2, 3, 4],
+            "sampling_params": {"max_new_tokens": 8, "greedy": True},
+        }
+    )
+    assert len(out["output_ids"]) == 8
+    assert out["meta_info"]["finish_reason"]["type"] == "length"
+    assert len(out["output_logprobs"]) == 8
+    assert all(v == 0 for v in out["output_versions"])
+    # greedy determinism
+    out2 = engine.generate(
+        {
+            "input_ids": [1, 2, 3, 4],
+            "sampling_params": {"max_new_tokens": 8, "greedy": True},
+        }
+    )
+    assert out2["output_ids"] == out["output_ids"]
+
+
+def test_concurrent_requests_exceeding_slots(engine):
+    futs = [
+        engine.submit(
+            {
+                "input_ids": [i + 1, i + 2, i + 3],
+                "sampling_params": {"max_new_tokens": 6, "temperature": 0.7},
+            }
+        )
+        for i in range(10)  # > 4 slots
+    ]
+    outs = [f.result(timeout=60) for f in futs]
+    for o in outs:
+        assert len(o["output_ids"]) == 6
+
+
+def test_stop_tokens(engine):
+    # greedy decode to find which token appears, then use it as a stop token
+    probe = engine.generate(
+        {
+            "input_ids": [5, 6, 7],
+            "sampling_params": {"max_new_tokens": 4, "greedy": True},
+        }
+    )
+    stop_tok = probe["output_ids"][1]
+    out = engine.generate(
+        {
+            "input_ids": [5, 6, 7],
+            "sampling_params": {
+                "max_new_tokens": 16,
+                "greedy": True,
+                "stop_token_ids": [stop_tok],
+            },
+        }
+    )
+    assert out["output_ids"][-1] == stop_tok
+    assert len(out["output_ids"]) == 2
+    assert out["meta_info"]["finish_reason"]["type"] == "stop"
+
+
+def test_pause_aborts_and_resume(engine):
+    fut = engine.submit(
+        {
+            "input_ids": [1, 2],
+            "sampling_params": {"max_new_tokens": 10_000, "temperature": 1.0},
+        }
+    )
+    # wait for it to start producing
+    deadline = time.monotonic() + 30
+    while engine.metrics()["running_requests"] == 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    engine.pause()
+    out = fut.result(timeout=30)
+    assert out["meta_info"]["finish_reason"]["type"] == "abort"
+    assert len(out["output_ids"]) >= 1
+    engine.continue_generation()
+    out2 = engine.generate(
+        {"input_ids": [1, 2], "sampling_params": {"max_new_tokens": 4}}
+    )
+    assert len(out2["output_ids"]) == 4
+
+
+def test_weight_update_bumps_version(engine):
+    cfg = engine.model_config
+    new_params = init_params(cfg, jax.random.PRNGKey(42), dtype=jnp.float32)
+    v = engine.update_weights_from_tensors(new_params)
+    assert v == engine.model_version == 1
+    out = engine.generate(
+        {"input_ids": [1, 2, 3], "sampling_params": {"max_new_tokens": 3}}
+    )
+    assert out["output_versions"] == [1, 1, 1]
+    # reset for other tests (module-scoped fixture ordering safety)
+    engine.model_version = 0
+
+
+def test_prompt_too_long_rejected(engine):
+    fut = engine.submit({"input_ids": list(range(64))})
+    with pytest.raises(ValueError):
+        fut.result(timeout=10)
