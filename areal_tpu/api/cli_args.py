@@ -102,6 +102,9 @@ class TrainEngineConfig:
     param_dtype: str = "float32"  # parameter/optimizer storage (master weights)
     disable_dropout: bool = True
     gradient_checkpointing: bool = True
+    # attention kernel when seq_parallel_size > 1: "auto" lets GSPMD shard
+    # the XLA kernel; "ring"/"ulysses" use the explicit shard_map kernels
+    attn_impl: str = "auto"
     mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
     optimizer: Optional[OptimizerConfig] = dataclasses.field(default_factory=OptimizerConfig)
     parallel: ParallelismConfig = dataclasses.field(default_factory=ParallelismConfig)
@@ -178,6 +181,10 @@ class JaxGenConfig:
     max_num_seqs: int = 64  # decode slots
     max_model_len: int = 4096
     prefill_chunk: int = 512
+    # decode steps fused into one device dispatch (amortizes the host
+    # round-trip; stop handling happens on device so at most one dispatch
+    # of latency is added to a finished request)
+    decode_chunk: int = 8
     page_size: int = 128
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85

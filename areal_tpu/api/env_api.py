@@ -1,0 +1,21 @@
+"""Gym-like async environment contract (reference areal/api/env_api.py)."""
+
+import abc
+from typing import Any, Dict, Tuple
+
+
+class Env(abc.ABC):
+    """Async environment for agentic workflows."""
+
+    async def areset(self, **kwargs) -> Any:
+        """Start an episode; returns the initial observation."""
+        raise NotImplementedError()
+
+    async def astep(
+        self, action: Any
+    ) -> Tuple[Any, float, bool, Dict[str, Any]]:
+        """Apply an action; returns (observation, reward, done, info)."""
+        raise NotImplementedError()
+
+    async def aclose(self):
+        pass

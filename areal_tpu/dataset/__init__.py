@@ -1,0 +1,136 @@
+"""Dataset loading + a stateful dataloader for RL/SFT training.
+
+Role of reference areal/dataset/__init__.py (`get_custom_dataset`) and the
+torchdata StatefulDataLoader the trainer checkpoints: datasets load from
+local jsonl files (the training environment is egress-free; the reference
+pulls from the HF hub) and the dataloader exposes state_dict/
+load_state_dict so recover resumes mid-epoch without repeating samples.
+"""
+
+import json
+import os
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from areal_tpu.api.cli_args import DatasetConfig
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def _gsm8k_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
+    """GSM8K schema {question, answer} → workflow item. The answer keeps its
+    '#### <final>' tail; math_parser extracts it for the reward."""
+    out = {"answer": row["answer"]}
+    if tokenizer is not None:
+        out["messages"] = [{"role": "user", "content": row["question"]}]
+    else:
+        out["question"] = row["question"]
+    return out
+
+
+_PROCESSORS: Dict[str, Callable] = {
+    "gsm8k": _gsm8k_to_rl,
+    "raw": lambda row, tokenizer=None: row,
+}
+
+
+def get_custom_dataset(
+    config: DatasetConfig,
+    tokenizer=None,
+    split: str = "train",
+) -> List[Dict[str, Any]]:
+    """Load + convert a dataset (reference areal/dataset/__init__.py:1-99).
+
+    ``config.path`` may be a .jsonl file or a directory containing
+    ``{split}.jsonl``.
+    """
+    path = config.path
+    if os.path.isdir(path):
+        path = os.path.join(path, f"{split}.jsonl")
+    rows = load_jsonl(path)
+    proc = _PROCESSORS.get(config.type, _PROCESSORS["raw"])
+    out = [proc(r, tokenizer=tokenizer) for r in rows]
+    if config.max_length is not None and tokenizer is not None:
+        out = [
+            r
+            for r in out
+            if "messages" not in r
+            or len(tokenizer.apply_chat_template(r["messages"], tokenize=True))
+            <= config.max_length
+        ]
+    return out
+
+
+class StatefulDataLoader:
+    """Shuffling epoch dataloader with resumable state (role of torchdata's
+    StatefulDataLoader in the reference recover path).
+
+    One ``__iter__`` pass yields the REMAINDER of the current epoch (so a
+    resumed run continues where it left off); callers loop epochs.
+    """
+
+    def __init__(
+        self,
+        dataset: List[Any],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+    ):
+        assert batch_size >= 1
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or (lambda x: x)
+        self._epoch = 0
+        self._batch_idx = 0  # batches already yielded in the current epoch
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self)
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def _order(self) -> List[int]:
+        order = list(range(len(self.dataset)))
+        if self.shuffle:
+            random.Random(self.seed + self._epoch).shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[Any]:
+        order = self._order()
+        nb = len(self)
+        for b in range(self._batch_idx, nb):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if not idx:
+                continue
+            self._batch_idx = b + 1
+            yield self.collate_fn([self.dataset[i] for i in idx])
+        self._epoch += 1
+        self._batch_idx = 0
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"epoch": self._epoch, "batch_idx": self._batch_idx}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        self._epoch = int(state["epoch"])
+        self._batch_idx = int(state["batch_idx"])

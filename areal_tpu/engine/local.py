@@ -1,0 +1,176 @@
+"""Colocated inference engine: trainer + generator in one process/runtime.
+
+Role of reference areal/experimental/sglang_engine.py (in-process
+`SGLangEngine` for colocated mode) — but on TPU this is the PRIMARY
+single-slice deployment, not an experiment: a TPU chip is owned by exactly
+one process, so trainer and generator colocate by sharing the jax runtime.
+The payoff is the fast weight path — ``update_weights`` hands the trainer's
+device params straight to the generation engine (an HBM-to-HBM cast/copy,
+role of the reference's custom NCCL broadcast group fsdp_engine.py:399-433)
+with no disk or network hop.
+"""
+
+import concurrent.futures
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.cli_args import InferenceEngineConfig, JaxGenConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import (
+    ModelRequest,
+    ModelResponse,
+    WeightUpdateMeta,
+    WeightUpdateMethod,
+)
+from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("LocalSyncInferenceEngine")
+
+
+class LocalSyncInferenceEngine(InferenceEngine):
+    """InferenceEngine over an in-process GenerationEngine."""
+
+    def __init__(
+        self,
+        config: InferenceEngineConfig,
+        gen_config: JaxGenConfig,
+        model_config=None,
+        params=None,
+    ):
+        self.config = config
+        self.engine = GenerationEngine(
+            gen_config, model_config=model_config, params=params
+        )
+        self._version = 0
+        self._lock = threading.Lock()
+        self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self.workflow_executor: Optional[WorkflowExecutor] = None
+        self._train_engine = None  # set for the device weight path
+
+    # ------------------------------------------------------------------
+    def initialize(self, train_engine=None):
+        self._train_engine = train_engine
+        self.engine.start()
+        self.workflow_executor = WorkflowExecutor(self.config, self)
+        self.workflow_executor.initialize()
+        return self
+
+    def destroy(self):
+        if self.workflow_executor is not None:
+            self.workflow_executor.destroy()
+        self.engine.stop()
+        self.executor.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    def get_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def set_version(self, version: int):
+        with self._lock:
+            self._version = version
+        self.engine.model_version = version
+
+    # ------------------------------------------------------------------
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        """Submit to the in-process engine; the abort/resume loop still
+        applies (pause aborts in-flight slots exactly like the server)."""
+        import asyncio
+
+        gconfig = req.gconfig
+        assert gconfig.n_samples == 1
+        start = time.monotonic()
+        accumulated: List[int] = []
+        logprobs: List[float] = []
+        versions: List[int] = []
+        stop_reason = None
+        ttft = None
+        while (
+            stop_reason not in ("stop", "length")
+            and len(accumulated) < gconfig.max_new_tokens
+        ):
+            fut = self.engine.submit(
+                {
+                    "rid": req.rid,
+                    "input_ids": list(req.input_ids) + accumulated,
+                    "sampling_params": {
+                        "max_new_tokens": gconfig.max_new_tokens
+                        - len(accumulated),
+                        "min_new_tokens": max(
+                            0, gconfig.min_new_tokens - len(accumulated)
+                        ),
+                        "temperature": gconfig.temperature,
+                        "top_p": gconfig.top_p,
+                        "top_k": gconfig.top_k,
+                        "greedy": gconfig.greedy,
+                        "stop_token_ids": gconfig.stop_token_ids,
+                    },
+                }
+            )
+            result = await asyncio.wrap_future(fut)
+            if ttft is None and result["output_ids"]:
+                # engine-side ttft, re-based onto this call's clock
+                meta = result["meta_info"]
+                ttft = (time.monotonic() - start) - meta["latency"] + meta["ttft"]
+            accumulated.extend(result["output_ids"])
+            logprobs.extend(result["output_logprobs"])
+            versions.extend(result["output_versions"])
+            stop_reason = result["meta_info"]["finish_reason"]["type"]
+            if stop_reason == "abort":
+                await asyncio.sleep(self.config.pause_grace_period or 0.05)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=accumulated,
+            output_logprobs=logprobs,
+            output_versions=versions,
+            stop_reason=stop_reason or "length",
+            latency=time.monotonic() - start,
+            ttft=ttft if ttft is not None else time.monotonic() - start,
+        )
+
+    # ------------------------------------------------------------------
+    def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
+        """DEVICE path: hand the trainer's live params to the generator —
+        the ICI/HBM analog of the reference's NCCL broadcast."""
+        self.engine.pause()
+
+        def _do():
+            try:
+                if meta.type == WeightUpdateMethod.DEVICE:
+                    assert self._train_engine is not None, (
+                        "device weight path needs initialize(train_engine=...)"
+                    )
+                    self.engine.update_weights_from_tensors(
+                        self._train_engine.params, version=meta.model_version
+                    )
+                else:
+                    self.engine.update_weights_from_disk(
+                        meta.path, version=meta.model_version
+                    )
+                self.set_version(meta.model_version)
+            finally:
+                self.engine.continue_generation()
+
+        return self.executor.submit(_do)
+
+    # ------------------------------------------------------------------
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
+        self.workflow_executor.submit(data, workflow)
+
+    def wait(self, count: int, timeout: Optional[float] = None):
+        return self.workflow_executor.wait(count, timeout=timeout)
+
+    def rollout_batch(self, data: List[Dict[str, Any]], workflow):
+        return self.workflow_executor.rollout_batch(data, workflow)
+
+    def prepare_batch(self, dataloader, workflow):
+        return self.workflow_executor.prepare_batch(dataloader, workflow)
+
+    def pause(self):
+        self.workflow_executor.pause()
+
+    def resume(self):
+        self.workflow_executor.resume()

@@ -211,12 +211,24 @@ class SPMDTrainEngine(TrainEngine):
     # ------------------------------------------------------------------
     # Train
     # ------------------------------------------------------------------
+    def _attend_fn(self):
+        """Explicit SP attention kernel, or None for GSPMD auto-sharding."""
+        impl = self.config.attn_impl
+        if impl == "auto" or self.config.parallel.seq_parallel_size == 1:
+            return None
+        if not hasattr(self, "_cached_attend"):
+            from areal_tpu.ops.ring_attention import make_sharded_attention
+
+            self._cached_attend = make_sharded_attention(self.mesh, impl=impl)
+        return self._cached_attend
+
     def _get_grad_fn(self, loss_fn: Callable, loss_weight_fn: Callable):
         key = ("grad", loss_fn, loss_weight_fn)
         if key not in self._jit_cache:
             mc = self.model_config
             remat = self.config.gradient_checkpointing
             compute_dtype = self.compute_dtype
+            attend = self._attend_fn()
 
             def fwd_loss(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -224,7 +236,7 @@ class SPMDTrainEngine(TrainEngine):
                 )
                 logits = model_apply(
                     cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=remat,
+                    arrays["positions"], remat=remat, attend_fn=attend,
                 )
                 loss, stats = loss_fn(logits, arrays)
                 w = loss_weight_fn(arrays).astype(jnp.float32)
@@ -334,6 +346,7 @@ class SPMDTrainEngine(TrainEngine):
         if key not in self._jit_cache:
             mc = self.model_config
             compute_dtype = self.compute_dtype
+            attend = self._attend_fn()
 
             def eval_step(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -341,7 +354,7 @@ class SPMDTrainEngine(TrainEngine):
                 )
                 logits = model_apply(
                     cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=False,
+                    arrays["positions"], remat=False, attend_fn=attend,
                 )
                 loss, stats = loss_fn(logits, arrays)
                 return loss, stats, loss_weight_fn(arrays).astype(jnp.float32)
@@ -379,6 +392,7 @@ class SPMDTrainEngine(TrainEngine):
         if key not in self._jit_cache:
             mc = self.model_config
             compute_dtype = self.compute_dtype
+            attend = self._attend_fn()
 
             def fwd(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -386,7 +400,7 @@ class SPMDTrainEngine(TrainEngine):
                 )
                 logits = model_apply(
                     cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=False,
+                    arrays["positions"], remat=False, attend_fn=attend,
                 )
                 return hook(logits, arrays)
 

@@ -108,6 +108,19 @@ class GenerationEngine:
         self._paused = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # device-resident decode state: the generation loop's only host
+        # traffic per step is ONE result fetch (tokens+logprobs)
+        s = config.max_num_seqs
+        self._cur_tokens = jnp.zeros(s, jnp.int32)
+        self._active_dev = jnp.zeros(s, bool)
+        self._temp_dev = jnp.ones(s, jnp.float32)
+        self._top_p_dev = jnp.ones(s, jnp.float32)
+        self._top_k_dev = jnp.zeros(s, jnp.int32)
+        self._greedy_dev = jnp.zeros(s, bool)
+        self._remaining = jnp.zeros(s, jnp.int32)
+        self._no_stop = jnp.zeros(s, jnp.int32)
+        self._stop_tokens = jnp.full((s, 8), -1, jnp.int32)
+        self._step_counter = 0
         # metrics
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
@@ -229,10 +242,12 @@ class GenerationEngine:
                     done.set_result(self.model_version)
                 elif cmd == "update_weights_tensors":
                     params, version = arg
-                    self.params = jax.device_put(
-                        jax.tree_util.tree_map(
-                            lambda p: p.astype(self.dtype), params
-                        )
+                    # copy=True: the caller may later DONATE these buffers
+                    # (the trainer's update step); aliasing them would leave
+                    # us holding deleted arrays
+                    self.params = jax.tree_util.tree_map(
+                        lambda p: jnp.array(p, dtype=self.dtype, copy=True),
+                        params,
                     )
                     self.model_version = (
                         version
@@ -271,6 +286,27 @@ class GenerationEngine:
             self._active[slot] = req
             self.total_prompt_tokens += plen
             self.total_requests += 1
+            # update device-resident sampling + stop state for this slot
+            self._temp_dev = self._temp_dev.at[slot].set(req.temperature)
+            self._top_p_dev = self._top_p_dev.at[slot].set(req.top_p)
+            self._top_k_dev = self._top_k_dev.at[slot].set(req.top_k)
+            self._greedy_dev = self._greedy_dev.at[slot].set(req.greedy)
+            self._active_dev = self._active_dev.at[slot].set(True)
+            allowed = min(
+                req.max_new_tokens, self.config.max_model_len - plen
+            )
+            # the first token is sampled at admission (below), so the
+            # device-side budget starts at allowed − 1
+            self._remaining = self._remaining.at[slot].set(allowed - 1)
+            self._no_stop = self._no_stop.at[slot].set(
+                req.min_new_tokens - 1
+            )
+            stops = np.full(8, -1, np.int32)
+            ids = np.asarray(req.stop_token_ids[:8], np.int32)
+            stops[: len(ids)] = ids
+            self._stop_tokens = self._stop_tokens.at[slot].set(
+                jnp.asarray(stops)
+            )
             # sample the first token from prefill logits: embed the row into
             # a full [S, V] stack so sampling keeps one static shape
             full = jnp.zeros(
@@ -283,17 +319,51 @@ class GenerationEngine:
     def _decode(self) -> bool:
         if not self._active:
             return False
-        s = self.cache_config.num_slots
-        tokens = np.zeros(s, np.int32)
-        active = np.zeros(s, bool)
-        for slot, req in self._active.items():
-            tokens[slot] = req.output_ids[-1]
-            active[slot] = True
-        self.cache, logits = model_runner.decode_step(
+        steps = max(1, self.config.decode_chunk)
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng_key, self._step_counter)
+        (
+            self.cache, toks, logps, emitted, active_after,
+            self._remaining, self._no_stop,
+        ) = model_runner.decode_multi(
             self.params, self.model_config, self.cache,
-            jnp.asarray(tokens), jnp.asarray(active),
+            self._cur_tokens, self._active_dev, self._remaining,
+            self._no_stop, self._stop_tokens, key,
+            self._temp_dev, self._top_p_dev, self._top_k_dev,
+            self._greedy_dev, steps=steps,
         )
-        self._sample_and_append(logits, only_slots=list(self._active))
+        self._cur_tokens = toks[-1]
+        self._active_dev = active_after
+        # the ONE host fetch per `steps` generated tokens
+        h_toks, h_logps, h_emitted, h_active = jax.device_get(
+            (toks, logps, emitted, active_after)
+        )
+        now = time.monotonic()
+        for slot in list(self._active):
+            req = self._active[slot]
+            stopped_host = False
+            for s in range(steps):
+                if not h_emitted[s, slot]:
+                    break
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                tok = int(h_toks[s, slot])
+                req.output_ids.append(tok)
+                req.output_logprobs.append(float(h_logps[s, slot]))
+                req.output_versions.append(self.model_version)
+                self.total_generated_tokens += 1
+                # host backstop over the FULL stop list (the device buffer
+                # only holds the first 8 stop ids)
+                if (
+                    tok in req.stop_token_ids
+                    and len(req.output_ids) >= req.min_new_tokens
+                ):
+                    stopped_host = True
+                    break
+            if stopped_host:
+                self._finish(slot, "stop")
+            elif not h_active[slot]:
+                self._finish(slot, "length")
         return True
 
     def _sample_and_append(
@@ -302,24 +372,21 @@ class GenerationEngine:
         """Sample one token per slot from a full [S, V] stack (one static
         shape for every admission/decode step) and handle stops for
         `only_slots`."""
-        s = self.cache_config.num_slots
-        temp = np.ones(s, np.float32)
-        top_p = np.ones(s, np.float32)
-        top_k = np.zeros(s, np.int32)
-        greedy = np.zeros(s, bool)
-        for slot in only_slots:
-            req = self._active[slot]
-            temp[slot] = req.temperature
-            top_p[slot] = req.top_p
-            top_k[slot] = req.top_k
-            greedy[slot] = req.greedy
-        self._rng_key, sub = jax.random.split(self._rng_key)
+        self._step_counter += 1
+        key = jax.random.fold_in(self._rng_key, self._step_counter)
         toks, logps = model_runner.sample_tokens(
-            logits, sub, jnp.asarray(temp), jnp.asarray(top_p),
-            jnp.asarray(top_k), jnp.asarray(greedy),
+            logits, key, self._temp_dev, self._top_p_dev, self._top_k_dev,
+            self._greedy_dev,
         )
-        toks = np.asarray(toks)
-        logps = np.asarray(logps)
+        # record sampled tokens as the next decode inputs for these slots
+        for slot in only_slots:
+            self._cur_tokens = self._cur_tokens.at[slot].set(toks[slot])
+        host_toks, host_logps = jax.device_get((toks, logps))
+        self._append_sampled(host_toks, host_logps, only_slots)
+
+    def _append_sampled(
+        self, toks: np.ndarray, logps: np.ndarray, only_slots: List[int]
+    ):
         for slot in sorted(only_slots):
             i = slot
             req = self._active[slot]
@@ -346,6 +413,7 @@ class GenerationEngine:
     def _finish(self, slot: int, reason: str):
         req = self._active.pop(slot)
         self.allocator.free(slot)
+        self._active_dev = self._active_dev.at[slot].set(False)
         if reason == "abort":
             self.total_aborted += 1
         now = time.monotonic()

@@ -179,6 +179,88 @@ def decode_step(
     return {"k": new_k, "v": new_v, "lens": lens}, logits
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps"), donate_argnames=("cache",)
+)
+def decode_multi(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [S] current input token per slot
+    active: jnp.ndarray,  # [S] bool
+    remaining: jnp.ndarray,  # [S] int32 tokens still allowed per slot
+    no_stop_before: jnp.ndarray,  # [S] int32 (min_new_tokens countdown)
+    stop_tokens: jnp.ndarray,  # [S, K] int32, -1 padded
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    greedy: jnp.ndarray,
+    steps: int,
+):
+    """`steps` fused decode+sample iterations in ONE dispatch, with stop
+    handling on device — the host round-trip (which dominates serving
+    latency, especially over a driver link) is amortized over `steps`
+    tokens. A slot deactivates in-device when it emits a stop token (past
+    its min_new_tokens window) or exhausts its budget; inactive slots stop
+    advancing their cache line.
+
+    Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S] bool,
+    active_after [S], remaining_after, no_stop_after).
+    """
+
+    def step(carry, step_key):
+        cache, tokens, active, remaining, no_stop = carry
+        cache, toks, logps = decode_and_sample(
+            params, cfg, cache, tokens, active, step_key,
+            temperature, top_p, top_k, greedy,
+        )
+        emitted = active
+        # a stop token may end the slot once it would have emitted
+        # >= min_new_tokens INCLUDING this one (no_stop holds min - emitted)
+        hit_stop = jnp.any(
+            toks[:, None] == stop_tokens, axis=1
+        ) & (no_stop <= 1)
+        remaining = jnp.where(active, remaining - 1, remaining)
+        no_stop = jnp.where(active, no_stop - 1, no_stop)
+        active = active & ~hit_stop & (remaining > 0)
+        tokens = toks
+        return (cache, tokens, active, remaining, no_stop), (
+            toks, logps, emitted,
+        )
+
+    keys = jax.random.split(key, steps)
+    (cache, tokens, active, remaining, no_stop), (toks, logps, emitted) = (
+        jax.lax.scan(
+            step, (cache, tokens, active, remaining, no_stop_before), keys
+        )
+    )
+    return cache, toks, logps, emitted, active, remaining, no_stop
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_and_sample(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [S]
+    active: jnp.ndarray,  # [S] bool
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S]
+    greedy: jnp.ndarray,  # [S] bool
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Fused decode step + sampling: ONE dispatch and one host fetch per
+    generation step (the per-step host round-trip is the latency floor of the
+    serving loop, so everything between two steps stays on device)."""
+    cache, logits = decode_step(params, cfg, cache, tokens, active)
+    toks, logps = sample_tokens(
+        logits, key, temperature, top_p, top_k, greedy
+    )
+    return cache, toks, logps
+
+
 def _scatter_token(
     cache_line: jnp.ndarray,  # [S, M, Hkv, D]
     new: jnp.ndarray,  # [S, Hkv, D]

@@ -1,0 +1,193 @@
+"""Local launcher: spawn generation servers + trainer on one host.
+
+Role of reference areal/launcher/local.py (`LocalLauncher`, `local_main`):
+parse the allocation mode, start one generation-server subprocess per gen
+replica, pass their addresses to the trainer via ``AREAL_LLM_SERVER_ADDRS``,
+run the trainer, watch liveness, and auto-restart the whole constellation on
+failure up to ``recover.retries`` when recover mode allows
+(local.py:332-359).
+
+TPU notes: device assignment works by sub-slice environment
+(``TPU_VISIBLE_CHIPS``/``JAX_PLATFORMS``) rather than CUDA_VISIBLE_DEVICES;
+on a single-chip host the colocated mode (no server subprocesses, trainer
+owns the chip) is the default and this launcher simply execs the trainer.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
+from areal_tpu.api.cli_args import BaseExperimentConfig, JaxGenConfig
+from areal_tpu.utils import logging as logging_util, network
+from areal_tpu.utils.recover import RECOVER_ENV
+
+logger = logging_util.getLogger("LocalLauncher")
+
+
+class JobException(Exception):
+    def __init__(self, name: str, code: int):
+        super().__init__(f"job {name} exited with code {code}")
+        self.name = name
+        self.code = code
+
+
+class LocalLauncher:
+    def __init__(self, experiment_name: str, trial_name: str, fileroot: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.fileroot = fileroot
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    @property
+    def log_dir(self) -> str:
+        d = os.path.join(
+            self.fileroot, self.experiment_name, self.trial_name, "logs"
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def submit(
+        self,
+        name: str,
+        cmd: List[str],
+        env: Optional[Dict[str, str]] = None,
+    ) -> subprocess.Popen:
+        log_path = os.path.join(self.log_dir, f"{name}.log")
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        with open(log_path, "a") as logf:
+            proc = subprocess.Popen(
+                cmd,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=full_env,
+                start_new_session=True,
+            )
+        self._procs[name] = proc
+        logger.info(f"started {name} (pid {proc.pid}) → {log_path}")
+        return proc
+
+    def poll(self) -> Optional[JobException]:
+        for name, proc in self._procs.items():
+            code = proc.poll()
+            if code is not None and code != 0:
+                return JobException(name, code)
+        return None
+
+    def finished(self, name: str) -> bool:
+        proc = self._procs.get(name)
+        return proc is not None and proc.poll() == 0
+
+    def stop_all(self):
+        for name, proc in self._procs.items():
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + 10
+        for proc in self._procs.values():
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        self._procs.clear()
+
+
+def launch_servers(
+    launcher: LocalLauncher,
+    gen_config: JaxGenConfig,
+    n_servers: int,
+    base_env: Optional[Dict[str, str]] = None,
+) -> List[str]:
+    """Start n generation-server subprocesses; returns host:port addrs."""
+    ports = network.find_free_ports(n_servers)
+    addrs = []
+    for i in range(n_servers):
+        host = gen_config.host or "127.0.0.1"
+        cmd = JaxGenConfig.build_cmd(
+            gen_config, host, ports[i],
+            experiment_name=launcher.experiment_name,
+            trial_name=launcher.trial_name,
+        )
+        cmd.append(f"--server-index={i}")
+        launcher.submit(f"gen_server_{i}", cmd, env=base_env)
+        addrs.append(f"{host}:{ports[i]}")
+    return addrs
+
+
+def local_main(
+    config: BaseExperimentConfig,
+    trainer_entry: str,
+    trainer_argv: List[str],
+    recover_retries: Optional[int] = None,
+    _attempt: int = 0,
+):
+    """Launch the experiment constellation; auto-restart on failure
+    (reference local.py:252-359)."""
+    alloc = (
+        AllocationMode.from_str(config.allocation_mode)
+        if config.allocation_mode
+        else None
+    )
+    launcher = LocalLauncher(
+        config.experiment_name, config.trial_name, config.cluster.fileroot
+    )
+    retries = (
+        recover_retries
+        if recover_retries is not None
+        else getattr(config.recover, "retries", 0)
+    )
+    recover_enabled = getattr(config.recover, "mode", "disabled") in (
+        "auto",
+        "fault",
+    )
+    try:
+        env = {}
+        if _attempt > 0 and recover_enabled:
+            env[RECOVER_ENV] = "1"
+        if alloc is not None and alloc.type_ in (
+            AllocationType.DECOUPLED_TRAIN,
+            AllocationType.LLM_SERVER_ONLY,
+        ):
+            server_cfg = getattr(config, "server", None) or JaxGenConfig()
+            n_servers = alloc.gen.data_parallel_size
+            addrs = launch_servers(launcher, server_cfg, n_servers, env)
+            env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
+        if alloc is None or alloc.type_ != AllocationType.LLM_SERVER_ONLY:
+            launcher.submit(
+                "trainer",
+                [sys.executable, trainer_entry] + trainer_argv,
+                env=env,
+            )
+        # watch loop
+        while True:
+            exc = launcher.poll()
+            if exc is not None:
+                raise exc
+            if launcher.finished("trainer"):
+                logger.info("trainer finished")
+                return
+            time.sleep(1)
+    except JobException as e:
+        launcher.stop_all()
+        if recover_enabled and _attempt < retries:
+            logger.warning(
+                f"{e}; restarting (attempt {_attempt + 1}/{retries})"
+            )
+            local_main(
+                config, trainer_entry, trainer_argv, recover_retries,
+                _attempt + 1,
+            )
+        else:
+            raise
+    finally:
+        launcher.stop_all()

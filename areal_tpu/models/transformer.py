@@ -125,6 +125,7 @@ def _layer_body(
     positions: jnp.ndarray,
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    attend_fn: Optional[Any] = None,
 ) -> jnp.ndarray:
     b, t, d = x.shape
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -143,7 +144,10 @@ def _layer_body(
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
-    attn = segment_attention(q, k, v, segment_ids, causal=True)
+    if attend_fn is None:
+        attn = segment_attention(q, k, v, segment_ids, causal=True)
+    else:  # explicit SP kernel (ring / ulysses shard_map)
+        attn = attend_fn(q, k, v, segment_ids)
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
@@ -157,15 +161,23 @@ def apply(
     segment_ids: jnp.ndarray,  # [B, T] int32; 0 = padding
     positions: jnp.ndarray,  # [B, T] int32; restart per sequence
     remat: bool = True,
+    attend_fn: Optional[Any] = None,
 ) -> jnp.ndarray:
-    """Forward to logits [B, T, vocab] (fp32)."""
+    """Forward to logits [B, T, vocab] (fp32).
+
+    `attend_fn(q, k, v, segment_ids)` overrides the attention kernel (e.g.
+    ring / Ulysses shard_map from ops/ring_attention.py); default is the
+    XLA segment-masked kernel with GSPMD-propagated sharding.
+    """
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     x = params["embedding"][tokens]
 
     def body(carry, lp):
-        out = _layer_body(cfg, carry, lp, segment_ids, positions, cos, sin)
+        out = _layer_body(
+            cfg, carry, lp, segment_ids, positions, cos, sin, attend_fn
+        )
         return out, None
 
     if remat:

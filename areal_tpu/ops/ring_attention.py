@@ -1,0 +1,176 @@
+"""Ring attention + Ulysses all-to-all attention over the "seq" mesh axis.
+
+Long-context sequence parallelism, first-class (the reference reaches long
+context via Ulysses all-to-all — areal/utils/ulysses.py,
+models/transformers/ulyssess_patch.py — and has NO ring attention;
+SURVEY.md §2.5 marks it absent. Here both are native):
+
+- **Ulysses** (`ulysses_segment_attention`): all-to-all converts the local
+  [B, T/sp, H, D] layout to [B, T, H/sp, D], runs full-sequence attention on
+  a head shard, and converts back. Communication: 2 all-to-alls per
+  attention; heads must divide by sp.
+- **Ring** (`ring_segment_attention`): K/V blocks rotate around the seq axis
+  via `ppermute` while queries stay put; a streaming (online-softmax)
+  accumulator merges each block's contribution. Communication overlaps with
+  compute; no head-divisibility constraint and activation memory stays
+  O(T/sp) — the long-context scaling path.
+
+Both operate on PACKED streams (segment_ids carry sequence boundaries) and
+are written as per-shard functions to be wrapped in `shard_map` (see
+`make_sharded_attention`), composing with the (data, fsdp, seq, tensor)
+mesh: XLA still shards heads over "tensor" inside the shard_map body.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from areal_tpu.ops.basic import segment_attention
+
+NEG_INF = -2.3819763e38
+
+
+def _block_attend(q, k, v, mask):
+    """Unnormalized block attention: returns (scores_max, exp-sum, weighted
+    values) for online-softmax merging. q [B,tq,H,D]; k/v [B,tk,Hkv,D]."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    s = jnp.where(mask[:, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, H, tq]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask[:, None, :, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_safe, l, o
+
+
+def ring_segment_attention(
+    q: jnp.ndarray,  # [B, t_local, Hq, D]
+    k: jnp.ndarray,  # [B, t_local, Hkv, D]
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,  # [B, t_local]
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Per-shard ring attention body (call inside shard_map)."""
+    sp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t, hq, d = q.shape
+    q_pos = idx * t + jnp.arange(t)  # global packed positions
+
+    # accumulators (online softmax over ring steps)
+    m_acc = jnp.full((b, hq, t), -1e30, jnp.float32)
+    l_acc = jnp.zeros((b, hq, t), jnp.float32)
+    o_acc = jnp.zeros((b, t, hq, d), jnp.float32)
+
+    perm = [(i, (i - 1) % sp) for i in range(sp)]  # rotate blocks leftward
+
+    def merge(carry, block):
+        m_acc, l_acc, o_acc = carry
+        m_blk, l_blk, o_blk = block
+        m_new = jnp.maximum(m_acc, m_blk)
+        a = jnp.exp(m_acc - m_new)
+        bfac = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a + l_blk * bfac
+        o_new = (
+            o_acc * a.transpose(0, 2, 1)[..., None]
+            + o_blk * bfac.transpose(0, 2, 1)[..., None]
+        )
+        return m_new, l_new, o_new
+
+    k_cur, v_cur, seg_cur = k, v, segment_ids
+    src = idx
+    for step in range(sp):
+        kv_pos = src * t + jnp.arange(t)
+        mask = (segment_ids[:, :, None] == seg_cur[:, None, :]) & (
+            segment_ids[:, :, None] > 0
+        )
+        if causal:
+            mask = mask & (kv_pos[None, None, :] <= q_pos[None, :, None])
+        blk = _block_attend(q, k_cur, v_cur, mask)
+        m_acc, l_acc, o_acc = merge((m_acc, l_acc, o_acc), blk)
+        if step + 1 < sp:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            seg_cur = jax.lax.ppermute(seg_cur, axis_name, perm)
+            src = (src + 1) % sp
+    out = o_acc / jnp.maximum(l_acc, 1e-30).transpose(0, 2, 1)[..., None]
+    valid_q = (segment_ids > 0)[:, :, None, None]
+    return jnp.where(valid_q, out, 0.0).astype(q.dtype)
+
+
+def ulysses_segment_attention(
+    q: jnp.ndarray,  # [B, t_local, Hq, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,  # [B, t_local]
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Per-shard Ulysses body: all-to-all seq→heads, attend, all-to-all back
+    (reference areal/utils/ulysses.py:45-214 `SeqAllToAll`/gather-scatter,
+    expressed as native lax.all_to_all instead of torch autograd functions)."""
+    sp = jax.lax.psum(1, axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hkv < sp:  # repeat KV heads so each shard owns >= 1 (reference
+        rep = sp // hkv  # ulyssess_patch.py:43-45)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # [B, t, H, D] → gather seq, scatter heads → [B, T, H/sp, D]
+    def a2a_fwd(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def a2a_bwd(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+    seg_full = jax.lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+    out = segment_attention(qg, kg, vg, seg_full, causal=causal)
+    return a2a_bwd(out)
+
+
+def make_sharded_attention(
+    mesh: Mesh,
+    impl: str = "ring",
+    causal: bool = True,
+):
+    """Wrap a per-shard attention body in shard_map for the training stack.
+
+    Returns ``attend(q, k, v, segment_ids) -> out`` taking GLOBAL arrays
+    laid out [B, T, H, D] with B over (data, fsdp), T over seq, H over
+    tensor — the transformer's activation sharding.
+    """
+    body = (
+        ring_segment_attention if impl == "ring" else ulysses_segment_attention
+    )
+    fn = functools.partial(body, axis_name="seq", causal=causal)
+    qkv_spec = P(("data", "fsdp"), "seq", "tensor", None)
+    seg_spec = P(("data", "fsdp"), "seq")
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    def attend(q, k, v, segment_ids):
+        return fn(q, k, v, segment_ids)
+
+    return attend

@@ -1,0 +1,161 @@
+"""Math answer extraction + equivalence checking for verifiable rewards.
+
+Role of reference areal/reward/math_parser.py (sympy-based answer
+equivalence, used for GSM8K/MATH GRPO): extract the final answer from a
+model completion (``\\boxed{...}``, ``#### <ans>`` GSM8K style, or the last
+number) and decide equivalence against the ground truth — numerically first,
+then sympy symbolic equivalence as a fallback.
+
+Written fresh for this framework: a compact, timeout-guarded checker rather
+than a port of the reference's 867-line grammar.
+"""
+
+import re
+from typing import Optional
+
+_BOXED_RE = re.compile(r"\\boxed\s*\{")
+_GSM8K_RE = re.compile(r"####\s*([^\n]+)")
+_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:[eE][+-]?\d+)?")
+_FRAC_RE = re.compile(r"\\[d]?frac\{([^{}]+)\}\{([^{}]+)\}")
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} contents, brace-balanced."""
+    out = None
+    for m in _BOXED_RE.finditer(text):
+        start = m.end()
+        depth = 1
+        for i in range(start, len(text)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    out = text[start:i]
+                    break
+    return out
+
+
+def extract_answer(text: str) -> Optional[str]:
+    """Final answer string from a completion (boxed > #### > last number)."""
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    m = _GSM8K_RE.findall(text)
+    if m:
+        return m[-1].strip()
+    nums = _NUMBER_RE.findall(text)
+    if nums:
+        return nums[-1]
+    return None
+
+
+def normalize_answer(ans: str) -> str:
+    ans = ans.strip()
+    ans = ans.replace("$", "").replace("%", "").replace(",", "")
+    ans = ans.replace("\\!", "").replace("\\,", "").replace("\\ ", " ")
+    ans = _FRAC_RE.sub(r"(\1)/(\2)", ans)
+    ans = ans.replace("\\left", "").replace("\\right", "")
+    ans = ans.replace("^{", "**(").replace("^", "**")
+    # close any braces opened by ** conversion
+    if "**(" in ans:
+        ans = ans.replace("}", ")")
+    ans = ans.replace("{", "(").replace("}", ")")
+    ans = re.sub(r"\\text\s*\(([^)]*)\)", r"\1", ans)
+    ans = ans.replace("\\pi", "pi").replace("\\sqrt", "sqrt")
+    ans = ans.strip(". ")
+    return ans.strip()
+
+
+def _to_float(s: str) -> Optional[float]:
+    try:
+        return float(s)
+    except (ValueError, TypeError):
+        return None
+
+
+# sympy can blow up on pathological model outputs (e.g. 9**9**9**9); all
+# sympy work runs through this bounded pool with a wall-clock timeout. A
+# worker stuck on a hostile expression is abandoned (the thread leaks until
+# it finishes, bounded by the pool size); once the pool saturates further
+# symbolic checks fail fast to False rather than stalling the reward path.
+import concurrent.futures as _futures
+
+_SYMPY_POOL = _futures.ThreadPoolExecutor(
+    max_workers=4, thread_name_prefix="sympy"
+)
+_SYMPY_TIMEOUT_S = 3.0
+
+
+def _with_timeout(fn, *args):
+    try:
+        return _SYMPY_POOL.submit(fn, *args).result(timeout=_SYMPY_TIMEOUT_S)
+    except Exception:
+        return None
+
+
+def _sympy_equal(a: str, b: str) -> bool:
+    def work():
+        import sympy
+        from sympy.parsing.sympy_parser import parse_expr
+
+        ea = parse_expr(a, evaluate=True)
+        eb = parse_expr(b, evaluate=True)
+        return sympy.simplify(ea - eb) == 0
+
+    return bool(_with_timeout(work))
+
+
+def _numeric_value(s: str) -> Optional[float]:
+    """Float value of a possibly-symbolic expression (sympy fallback)."""
+    f = _to_float(s)
+    if f is not None:
+        return f
+
+    def work():
+        import sympy
+        from sympy.parsing.sympy_parser import parse_expr
+
+        v = parse_expr(s, evaluate=True)
+        if v.is_number:
+            return float(sympy.N(v))
+        return None
+
+    return _with_timeout(work)
+
+
+def answers_equal(pred: str, truth: str, rel_tol: float = 1e-4) -> bool:
+    """Equivalence: exact normalized string, numeric (with symbolic
+    evaluation fallback), then sympy symbolic difference."""
+    if pred is None or truth is None:
+        return False
+    p, t = normalize_answer(pred), normalize_answer(truth)
+    if not p or not t:
+        return False
+    if p == t:
+        return True
+    fp, ft = _numeric_value(p), _numeric_value(t)
+    if fp is not None and ft is not None:
+        if ft == 0:
+            return abs(fp) < rel_tol
+        return abs(fp - ft) / max(abs(ft), 1e-12) < rel_tol
+    if fp is None and ft is None:
+        return _sympy_equal(p, t)
+    return False
+
+
+def process_results(completion: str, truth: str) -> float:
+    """1.0 if the completion's final answer matches the ground truth
+    (reference math_parser.process_results contract)."""
+    pred = extract_answer(completion)
+    # ground truth may itself be GSM8K-formatted ("... #### 42")
+    t = extract_answer(truth) if ("####" in truth or "\\boxed" in truth) else truth
+    return float(answers_equal(pred, t))
+
+
+def gsm8k_reward_fn(
+    prompt: str, completion: str, prompt_ids, completion_ids, answer: str = "", **kwargs
+) -> float:
+    """Reward function signature the RLVR workflow expects
+    (reference examples/math/gsm8k_grpo.py gsm8k_reward_fn)."""
+    return process_results(completion, answer)

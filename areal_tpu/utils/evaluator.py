@@ -1,0 +1,41 @@
+"""Frequency-controlled evaluation trigger (reference areal/utils/evaluator.py)."""
+
+from typing import Callable, Optional
+
+from areal_tpu.api.cli_args import EvaluatorConfig
+from areal_tpu.api.io_struct import StepInfo
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.timeutil import EpochStepTimeFreqCtl
+
+logger = logging_util.getLogger("Evaluator")
+
+
+class Evaluator:
+    def __init__(self, config: EvaluatorConfig, ft_spec):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.freq_ctl = EpochStepTimeFreqCtl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    def evaluate(
+        self,
+        evaluate_fn: Callable[[], Optional[dict]],
+        step: StepInfo,
+        force: bool = False,
+    ) -> Optional[dict]:
+        if not force and not self.freq_ctl.check(
+            epochs=int(step.epoch_step == step.steps_per_epoch - 1), steps=1
+        ):
+            return None
+        result = evaluate_fn()
+        logger.info(f"eval @ step {step.global_step}: {result}")
+        return result
+
+    def state_dict(self):
+        return {"freq_ctl": self.freq_ctl.state_dict()}
+
+    def load_state_dict(self, state):
+        self.freq_ctl.load_state_dict(state["freq_ctl"])

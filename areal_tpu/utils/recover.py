@@ -1,0 +1,153 @@
+"""Full-state checkpoint/resume (reference areal/utils/recover.py).
+
+`RecoverHandler.dump` persists StepInfo + saver/evaluator/stats-logger
+freq-controller states + dataloader state + engine weights+optimizer;
+`RecoverHandler.load` restores all of it and (for RL) re-uploads weights to
+the inference servers. Recover detection is env-driven
+(``AREAL_TPU_RECOVER_RUN=1`` set by the launcher on restart, analog of the
+reference's ``AREAL_RECOVER_RUN``).
+"""
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+from areal_tpu.api.cli_args import RecoverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, WeightUpdateMeta
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.timeutil import EpochStepTimeFreqCtl
+
+logger = logging_util.getLogger("Recover")
+
+RECOVER_ENV = "AREAL_TPU_RECOVER_RUN"
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    last_step_info: StepInfo
+    saver_state: Dict[str, Any]
+    evaluator_state: Dict[str, Any]
+    dataloader_state: Dict[str, Any]
+    model_version: int = 0
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def check_if_recover(config: RecoverConfig, recover_root: str) -> bool:
+    """Should this run resume from a recover checkpoint?"""
+    if config.mode == "disabled":
+        return False
+    has_ckpt = os.path.exists(os.path.join(recover_root, "recover_info.pkl"))
+    if config.mode == "resume":
+        return has_ckpt
+    if config.mode in ("auto", "fault"):
+        return has_ckpt and os.environ.get(RECOVER_ENV) == "1"
+    return False
+
+
+class RecoverHandler:
+    def __init__(self, config: RecoverConfig, fileroot: str,
+                 experiment_name: str, trial_name: str):
+        self.config = config
+        self.recover_root = os.path.join(
+            fileroot, experiment_name, trial_name, "recover"
+        )
+        self.freq_ctl = EpochStepTimeFreqCtl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    @property
+    def info_path(self) -> str:
+        return os.path.join(self.recover_root, "recover_info.pkl")
+
+    @property
+    def weights_path(self) -> str:
+        return os.path.join(self.recover_root, "weights")
+
+    def dump(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        inference_engine=None,
+        force: bool = False,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Persist everything needed to resume after `step_info` completed."""
+        if self.config.mode == "disabled":
+            return False
+        if not force and not self.freq_ctl.check(epochs=0, steps=1):
+            return False
+        os.makedirs(self.recover_root, exist_ok=True)
+        info = RecoverInfo(
+            last_step_info=step_info,
+            saver_state=saver.state_dict() if saver else {},
+            evaluator_state=evaluator.state_dict() if evaluator else {},
+            dataloader_state=dataloader.state_dict() if dataloader else {},
+            model_version=(
+                inference_engine.get_version() if inference_engine else 0
+            ),
+            extra=extra or {},
+        )
+        engine.save(
+            SaveLoadMeta(
+                path=self.weights_path, weight_format="hf", with_optim=True
+            )
+        )
+        tmp = self.info_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(info, f)
+        os.replace(tmp, self.info_path)  # atomic: readers never see partial
+        logger.info(
+            f"recover checkpoint dumped @ global step "
+            f"{step_info.global_step}"
+        )
+        return True
+
+    def load(
+        self,
+        engine,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        inference_engine=None,
+        weight_update_meta: Optional[WeightUpdateMeta] = None,
+    ) -> Optional[RecoverInfo]:
+        """Restore state; returns RecoverInfo or None when no checkpoint."""
+        if not os.path.exists(self.info_path):
+            return None
+        with open(self.info_path, "rb") as f:
+            info: RecoverInfo = pickle.load(f)
+        engine.load(
+            SaveLoadMeta(
+                path=self.weights_path, weight_format="hf", with_optim=True
+            )
+        )
+        if saver is not None:
+            saver.load_state_dict(info.saver_state)
+        if evaluator is not None:
+            evaluator.load_state_dict(info.evaluator_state)
+        if dataloader is not None and info.dataloader_state:
+            dataloader.load_state_dict(info.dataloader_state)
+        if inference_engine is not None:
+            inference_engine.set_version(info.model_version)
+            if weight_update_meta is not None:
+                # push restored weights to generation servers so rollout
+                # resumes from the recovered policy
+                meta = dataclasses.replace(
+                    weight_update_meta,
+                    path=self.weights_path,
+                    model_version=info.model_version,
+                )
+                engine.set_version(info.model_version)
+                fut = inference_engine.update_weights(meta)
+                fut.result(timeout=600)
+        logger.info(
+            f"recovered from global step {info.last_step_info.global_step}"
+        )
+        return info

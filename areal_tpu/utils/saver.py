@@ -1,0 +1,82 @@
+"""Frequency-controlled checkpoint saver (reference areal/utils/saver.py).
+
+Path schema: <fileroot>/<experiment>/<trial>/checkpoints/<name>/
+epoch<e>epochstep<s>globalstep<g>/ — same layout idea as the reference so
+eval/inference tooling can watch the directory.
+"""
+
+import os
+from typing import Optional
+
+from areal_tpu.api.cli_args import SaverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.timeutil import EpochStepTimeFreqCtl
+
+logger = logging_util.getLogger("Saver")
+
+
+class Saver:
+    def __init__(self, config: SaverConfig, ft_spec, for_recover: bool = False):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.for_recover = for_recover
+        self.freq_ctl = EpochStepTimeFreqCtl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    @staticmethod
+    def get_save_root(config: SaverConfig, name: str = "default") -> str:
+        return os.path.join(
+            config.fileroot,
+            config.experiment_name,
+            config.trial_name,
+            "checkpoints",
+            name,
+        )
+
+    def get_save_path(self, step: StepInfo, name: str = "default") -> str:
+        return os.path.join(
+            self.get_save_root(self.config, name),
+            f"epoch{step.epoch}epochstep{step.epoch_step}"
+            f"globalstep{step.global_step}",
+        )
+
+    def save(
+        self,
+        engine,
+        step: StepInfo,
+        name: str = "default",
+        force: bool = False,
+        weight_format: str = "hf",
+        with_optim: Optional[bool] = None,
+        tokenizer=None,
+    ) -> Optional[str]:
+        """Save if a frequency fires (or force=True); returns the path."""
+        if not force and not self.freq_ctl.check(
+            epochs=int(step.epoch_step == step.steps_per_epoch - 1), steps=1
+        ):
+            return None
+        path = self.get_save_path(step, name)
+        os.makedirs(path, exist_ok=True)
+        engine.save(
+            SaveLoadMeta(
+                path=path,
+                weight_format=weight_format,
+                with_optim=(
+                    with_optim if with_optim is not None else self.for_recover
+                ),
+            )
+        )
+        if tokenizer is not None:
+            tokenizer.save_pretrained(path)
+        logger.info(f"saved checkpoint to {path}")
+        return path
+
+    def state_dict(self):
+        return {"freq_ctl": self.freq_ctl.state_dict()}
+
+    def load_state_dict(self, state):
+        self.freq_ctl.load_state_dict(state["freq_ctl"])

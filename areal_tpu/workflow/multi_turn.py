@@ -1,0 +1,109 @@
+"""Multi-turn workflow: retry-until-correct with discounted reward.
+
+Role of reference areal/workflow/multi_turn.py:23-173 (`MultiTurnWorkflow`):
+the model answers; if wrong, an amendment prompt is appended and it retries,
+up to ``max_turns``. The final reward is discounted by the number of turns
+taken; feedback/user tokens are loss-masked (trained only on its own
+completions), and the whole conversation becomes ONE training sequence.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest, unique_rid
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("MultiTurnWorkflow")
+
+
+class MultiTurnWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        max_turns: int = 3,
+        turn_discount: float = 0.9,
+        feedback_text: str = (
+            "Your answer is either wrong or not parsable. Please try again."
+        ),
+    ):
+        assert gconfig.n_samples == 1, (
+            "multi-turn episodes are single-trajectory; group sampling "
+            "happens at the prompt level"
+        )
+        self.reward_fn = AsyncRewardWrapper(reward_fn)
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.max_turns = max_turns
+        self.turn_discount = turn_discount
+        self.feedback_text = feedback_text
+
+    def _tokenize_prompt(self, data: Dict[str, Any]) -> List[int]:
+        if "input_ids" in data:
+            return list(data["input_ids"])
+        return self.tokenizer.apply_chat_template(
+            data["messages"], tokenize=True, add_generation_prompt=True
+        )
+
+    def _feedback_tokens(self, data: Dict[str, Any]) -> List[int]:
+        if self.tokenizer is None:
+            return list(data.get("feedback_ids", []))
+        return self.tokenizer.encode(self.feedback_text)
+
+    def _detok(self, ids: List[int]) -> str:
+        return self.tokenizer.decode(ids) if self.tokenizer else ""
+
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        extra = {
+            k: v for k, v in data.items() if k not in ("input_ids", "messages")
+        }
+        prompt_ids = self._tokenize_prompt(data)
+        tokens: List[int] = list(prompt_ids)
+        loss_mask: List[int] = [0] * len(prompt_ids)
+        logprobs: List[float] = [0.0] * len(prompt_ids)
+        versions: List[int] = [-1] * len(prompt_ids)
+        discount = 1.0
+        reward = 0.0
+        for turn in range(self.max_turns):
+            req = ModelRequest(
+                rid=unique_rid(),
+                input_ids=tokens,
+                gconfig=self.gconfig.new(n_samples=1),
+            )
+            resp = await engine.agenerate(req)
+            tokens.extend(resp.output_tokens)
+            loss_mask.extend([1] * resp.output_len)
+            logprobs.extend(resp.output_logprobs)
+            versions.extend(resp.output_versions)
+            reward = await self.reward_fn(
+                self._detok(prompt_ids),
+                self._detok(resp.output_tokens),
+                prompt_ids,
+                resp.output_tokens,
+                **extra,
+            )
+            if reward > 0:
+                break
+            if turn + 1 < self.max_turns:
+                fb = self._feedback_tokens(data)
+                tokens.extend(fb)
+                loss_mask.extend([0] * len(fb))  # not our tokens
+                logprobs.extend([0.0] * len(fb))
+                versions.extend([-1] * len(fb))
+                discount *= self.turn_discount
+        L = len(tokens)
+        return {
+            "input_ids": np.asarray([tokens], np.int32),
+            "attention_mask": np.ones((1, L), np.bool_),
+            "loss_mask": np.asarray([loss_mask], np.int32),
+            "logprobs": np.asarray([logprobs], np.float32),
+            "versions": np.asarray([versions], np.int32),
+            "rewards": np.asarray([reward * discount], np.float32),
+        }

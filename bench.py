@@ -1,0 +1,195 @@
+"""End-of-round benchmark: effective GRPO throughput on one TPU chip.
+
+Measures the reference's headline quantity — *effective training throughput*:
+tokens consumed by the trainer divided by end-to-end step time, where a step
+is rollout (in-process generation engine, continuous batching) → behavior
+logp → advantage computation → decoupled-PPO update
+(benchmark/verl_v0_3_0_post1_76084d3/README.md conventions: only
+trainer-consumed tokens count).
+
+Model: Qwen2-0.5B geometry, random init, bf16. Workload: 32 samples
+(8 prompts × 4), 64-token prompts, 128 new tokens.
+
+``vs_baseline`` derivation: AReaL v0.3 reports 1000 async GRPO steps of
+512 prompts × 16 samples in 14.8 h on 128 H800s for the 1.5B model
+(blog/AReaL_v0_3.md:176-181) → 8192 samples / 53.3 s / 128 ≈ 1.2 effective
+samples/s per device. GSM8K-style samples average ≈700 tokens, and a 0.5B
+model is ≈3× cheaper per token than 1.5B, so the comparable per-device
+baseline for this workload is ≈ 1.2 × (700/192) × 3 ≈ 13 samples/s/device
+→ in tokens: ≈ 2520 effective tokens/s/device. This anchors vs_baseline
+until multi-chip runs use the reference workload directly.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE = 2520.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        JaxGenConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        ParallelismConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.ppo.actor import PPOActor
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.utils import data as data_utils
+
+    model_cfg = ModelConfig(
+        vocab_size=32768,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_layers=24,
+        num_heads=14,
+        num_kv_heads=2,
+        head_dim=64,
+        max_position_embeddings=4096,
+        rope_theta=1e6,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        attention_bias=True,
+        family="qwen2",
+    )
+    n_prompts, group_size = 8, 4
+    prompt_len, max_new = 64, 128
+    n_samples = n_prompts * group_size
+
+    params = init_params(model_cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    gen = GenerationEngine(
+        JaxGenConfig(
+            dtype="bfloat16",
+            max_num_seqs=n_samples,
+            max_model_len=512,
+            prefill_chunk=128,
+            decode_chunk=32,
+        ),
+        model_config=model_cfg,
+        params=params,
+    ).start()
+
+    pcfg = PPOActorConfig(
+        dtype="bfloat16",
+        param_dtype="bfloat16",
+        gradient_checkpointing=True,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=8192),
+        optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
+        parallel=ParallelismConfig(),
+        group_size=group_size,
+        ppo_n_minibatches=1,
+        group_reward_norm=True,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+    )
+    trainer = SPMDTrainEngine(pcfg)
+    trainer.initialize(
+        ft_spec=FinetuneSpec(1, 1024, n_samples), model_config=model_cfg
+    )
+    # the trainer trains the same weights the generator serves — as a COPY,
+    # since the trainer's update step donates its param buffers
+    trainer.params = jax.device_put(
+        jax.tree_util.tree_map(lambda p: jnp.array(p, copy=True), params),
+        trainer._param_shardings,
+    )
+    actor = PPOActor(pcfg, trainer)
+
+    rng = np.random.default_rng(0)
+
+    def one_step():
+        t0 = time.perf_counter()
+        prompts, futs = [], []
+        for _ in range(n_prompts):
+            prompt = rng.integers(
+                1, model_cfg.vocab_size, size=prompt_len
+            ).tolist()
+            for _ in range(group_size):
+                prompts.append(prompt)
+                futs.append(
+                    gen.submit(
+                        {
+                            "input_ids": prompt,
+                            "sampling_params": {
+                                "max_new_tokens": max_new,
+                                "temperature": 1.0,
+                            },
+                        }
+                    )
+                )
+        results = [f.result(timeout=1800) for f in futs]
+        rollout_done = time.perf_counter()
+        batches = []
+        for prompt, r in zip(prompts, results):
+            full = prompt + r["output_ids"]
+            L = len(full)
+            olen = len(r["output_ids"])
+            batches.append(
+                {
+                    "input_ids": np.asarray([full], np.int32),
+                    "attention_mask": np.ones((1, L), np.bool_),
+                    "loss_mask": np.asarray(
+                        [[0] * prompt_len + [1] * olen], np.int32
+                    ),
+                    "logprobs": np.asarray(
+                        [[0.0] * prompt_len + r["output_logprobs"]],
+                        np.float32,
+                    ),
+                    "versions": np.asarray(
+                        [[-1] * prompt_len + r["output_versions"]], np.int32
+                    ),
+                    "rewards": np.asarray([float(olen % 2)], np.float32),
+                }
+            )
+        batch = data_utils.concat_padded_tensors(batches)
+        out = actor.compute_advantages(dict(batch))
+        stats = actor.ppo_update(out)
+        step_time = time.perf_counter() - t0
+        tokens = int(batch["attention_mask"].sum())
+        return step_time, rollout_done - t0, tokens, stats
+
+    # warmup (compiles prefill/decode/sample/grad/apply/forward programs)
+    one_step()
+    # measured steps
+    times, toks = [], []
+    for _ in range(2):
+        step_time, rollout_time, tokens, stats = one_step()
+        times.append(step_time)
+        toks.append(tokens)
+    eff_tokens_per_sec = sum(toks) / sum(times)
+    samples_per_sec = (2 * n_samples) / sum(times)
+    result = {
+        "metric": "grpo_effective_tokens_per_sec_per_device",
+        "value": round(eff_tokens_per_sec, 2),
+        "unit": "tokens/s (Qwen2-0.5B shape, rollout+logp+update, 1 chip)",
+        "vs_baseline": round(
+            eff_tokens_per_sec / BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE,
+            4,
+        ),
+        "extra": {
+            "samples_per_sec": round(samples_per_sec, 3),
+            "step_time_s": round(sum(times) / len(times), 3),
+            "tokens_per_step": int(sum(toks) / len(toks)),
+        },
+    }
+    gen.stop()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

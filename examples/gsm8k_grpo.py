@@ -1,0 +1,207 @@
+"""GSM8K GRPO — the north-star workload (reference examples/math/gsm8k_grpo.py).
+
+Run (colocated single-slice, trainer + generator share the TPU runtime):
+
+    python examples/gsm8k_grpo.py --config examples/gsm8k_grpo.yaml
+
+or against disaggregated generation servers:
+
+    AREAL_LLM_SERVER_ADDRS=host:port,... python examples/gsm8k_grpo.py --config ...
+
+The step loop mirrors the reference main (gsm8k_grpo.py:168-288):
+rollout → [ref logp] → advantages → ppo_update → pause → weight update →
+version bump → save/eval/recover-dump → stats commit → resume.
+"""
+
+import itertools
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+from areal_tpu.api.io_struct import (
+    FinetuneSpec,
+    StepInfo,
+    WeightUpdateMeta,
+    WeightUpdateMethod,
+)
+from areal_tpu.dataset import StatefulDataLoader, get_custom_dataset
+from areal_tpu.engine.local import LocalSyncInferenceEngine
+from areal_tpu.engine.ppo.actor import PPOActor
+from areal_tpu.engine.remote import SERVER_ADDRS_ENV, RemoteInferenceEngine
+from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+from areal_tpu.reward.math_parser import gsm8k_reward_fn
+from areal_tpu.utils import logging as logging_util, stats_tracker
+from areal_tpu.utils.evaluator import Evaluator
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+logger = logging_util.getLogger("gsm8k_grpo")
+
+
+def load_tokenizer(path: str):
+    if not path:
+        return None
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(path)
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, GRPOConfig)
+    tokenizer = load_tokenizer(config.tokenizer_path)
+
+    train_dataset = get_custom_dataset(
+        config.train_dataset, tokenizer=tokenizer, split="train"
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        seed=config.seed,
+        drop_last=config.train_dataset.drop_last,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    # trainer
+    engine = SPMDTrainEngine(config.actor)
+    engine.initialize(ft_spec=ft_spec, seed=config.seed)
+    actor = PPOActor(config.actor, engine)
+    ref_engine = None
+    if config.ref is not None:
+        ref_engine = SPMDTrainEngine(config.ref)
+        ref_engine.initialize(ft_spec=ft_spec, seed=config.seed)
+    ref_actor = (
+        PPOActor(config.ref, ref_engine) if ref_engine is not None else None
+    )
+
+    # rollout: remote servers if announced, else colocated in-process
+    colocated = not os.environ.get(SERVER_ADDRS_ENV)
+    if colocated:
+        gen_cfg = config.server
+        if not gen_cfg.model_path:
+            gen_cfg.model_path = config.actor.path
+        rollout = LocalSyncInferenceEngine(
+            config.rollout, gen_cfg, model_config=engine.model_config
+        )
+        rollout.initialize(train_engine=engine)
+    else:
+        rollout = RemoteInferenceEngine(config.rollout).initialize()
+
+    workflow = RLVRWorkflow(
+        gsm8k_reward_fn,
+        config.gconfig,
+        tokenizer=tokenizer,
+        dump_dir=os.path.join(
+            config.cluster.fileroot, config.experiment_name,
+            config.trial_name, "generated",
+        ),
+    )
+
+    saver = Saver(config.saver, ft_spec)
+    evaluator = Evaluator(config.evaluator, ft_spec)
+    recover_handler = RecoverHandler(
+        config.recover, config.cluster.fileroot,
+        config.experiment_name, config.trial_name,
+    )
+    stats_logger = StatsLogger(
+        config.experiment_name, config.trial_name, config.cluster.fileroot
+    )
+
+    def weight_update_meta(version: int) -> WeightUpdateMeta:
+        if colocated:
+            return WeightUpdateMeta(
+                type=WeightUpdateMethod.DEVICE, model_version=version
+            )
+        return WeightUpdateMeta.from_disk(
+            config.experiment_name, config.trial_name,
+            config.cluster.fileroot, model_version=version,
+        )
+
+    start_step = StepInfo(steps_per_epoch=ft_spec.steps_per_epoch)
+    if check_if_recover(config.recover, recover_handler.recover_root):
+        info = recover_handler.load(
+            engine, saver=saver, evaluator=evaluator, dataloader=dataloader,
+            inference_engine=rollout,
+            weight_update_meta=(
+                None if colocated else weight_update_meta(0)
+            ),
+        )
+        if info is not None:
+            start_step = info.last_step_info.next()
+            if colocated:
+                rollout.update_weights(
+                    weight_update_meta(info.model_version)
+                ).result(timeout=600)
+
+    total_steps = config.total_train_steps or (
+        ft_spec.total_train_epochs * ft_spec.steps_per_epoch
+    )
+    step = start_step
+    logger.info(
+        f"starting GRPO: {total_steps} steps, "
+        f"{ft_spec.steps_per_epoch} steps/epoch, "
+        f"{'colocated' if colocated else 'remote'} generation"
+    )
+    while step.global_step < total_steps:
+        with stats_tracker.record_timing("e2e"):
+            with stats_tracker.record_timing("rollout"):
+                if config.async_training:
+                    batch = rollout.prepare_batch(dataloader, workflow)
+                else:
+                    items = next(iter(dataloader))
+                    batch = rollout.rollout_batch(items, workflow)
+
+            if ref_actor is not None:
+                with stats_tracker.record_timing("ref_logp"):
+                    batch["ref_logp"] = ref_actor.compute_logp(batch) * batch[
+                        "loss_mask"
+                    ].astype(np.float32)
+
+            with stats_tracker.record_timing("compute_advantages"):
+                batch = actor.compute_advantages(batch)
+
+            with stats_tracker.record_timing("ppo_update"):
+                train_stats = actor.ppo_update(batch)
+
+            with stats_tracker.record_timing("weight_update"):
+                rollout.pause()
+                new_version = rollout.get_version() + 1
+                meta = weight_update_meta(new_version)
+                if not colocated:
+                    engine.upload_weights(meta)
+                rollout.update_weights(meta).result(timeout=600)
+                engine.set_version(new_version)
+                rollout.resume()
+
+            with stats_tracker.record_timing("save_eval_recover"):
+                saver.save(engine, step, tokenizer=tokenizer)
+                evaluator.evaluate(lambda: None, step)
+                recover_handler.dump(
+                    engine, step, saver=saver, evaluator=evaluator,
+                    dataloader=dataloader, inference_engine=rollout,
+                )
+
+        stats = stats_tracker.export_all()
+        for s in train_stats:
+            for k, v in s.items():
+                stats[f"ppo_actor/{k}"] = v
+        stats["ppo_actor/n_tokens"] = float(batch["attention_mask"].sum())
+        stats["reward/mean"] = float(np.mean(batch["rewards"]))
+        stats_logger.commit(step.epoch, step.epoch_step, step.global_step, stats)
+        step = step.next()
+
+    stats_logger.close()
+    rollout.destroy()
+    logger.info("training complete")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

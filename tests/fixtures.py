@@ -1,0 +1,76 @@
+"""Shared test fixtures: tiny tokenizer + tiny checkpoints + synthetic data.
+
+Role of reference realhf/tests/fixtures.py:22-153 (random word-piece
+tokenizer + synthetic jsonl datasets built on the fly).
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def make_tiny_tokenizer(path: str, vocab_size: int = 128):
+    """A word-level tokenizer over digits/operators, saved HF-style."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    words = (
+        ["<pad>", "<eos>", "<user>", "<assistant>"]
+        + [str(i) for i in range(10)]
+        + list("+-*/=()?.")
+        + [
+            "what", "is", "the", "answer", "sum", "of", "and", "compute",
+            "####", "a", "b", "c", "x", "y",
+        ]
+    )
+    vocab = {w: i for i, w in enumerate(words)}
+    i = len(vocab)
+    while len(vocab) < vocab_size:
+        vocab[f"<extra{i}>"] = i
+        i += 1
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<pad>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        pad_token="<pad>",
+        eos_token="<eos>",
+    )
+    fast.chat_template = (
+        "{% for m in messages %}{{ '<' + m['role'] + '> ' + m['content'] + ' ' }}"
+        "{% endfor %}{% if add_generation_prompt %}{{ '<assistant>' }}{% endif %}"
+    )
+    os.makedirs(path, exist_ok=True)
+    fast.save_pretrained(path)
+    return fast
+
+
+def make_tiny_checkpoint(path: str, family: str = "qwen2", seed: int = 0):
+    """Random tiny model in HF format (vocab matches the tiny tokenizer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import hf_io
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+
+    cfg = tiny_config(family)
+    params = init_params(cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
+    hf_io.save_params(params, cfg, path)
+    return cfg
+
+
+def make_gsm8k_jsonl(path: str, n: int = 32, seed: int = 0):
+    """Synthetic GSM8K-style rows: 'what is the sum of a and b ?' → a+b."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for _ in range(n):
+            a, b = int(rng.integers(0, 5)), int(rng.integers(0, 5))
+            digits_a = " ".join(str(a))
+            digits_b = " ".join(str(b))
+            q = f"what is the sum of {digits_a} and {digits_b} ?"
+            ans_digits = " ".join(str(a + b))
+            ansline = f"the answer is #### {ans_digits}"
+            f.write(json.dumps({"question": q, "answer": ansline}) + "\n")
+    return path

@@ -1,0 +1,73 @@
+"""Multi-turn workflow: retries, discounting, loss-masked feedback tokens."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+
+class _ScriptedEngine:
+    """Engine double returning scripted completions."""
+
+    def __init__(self, outputs):
+        self.outputs = list(outputs)
+        self.calls = []
+
+    def get_version(self):
+        return 0
+
+    async def agenerate(self, req):
+        self.calls.append(list(req.input_ids))
+        out = self.outputs.pop(0)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.5] * len(out),
+            output_versions=[0] * len(out),
+            stop_reason="stop",
+        )
+
+
+def test_multi_turn_retries_and_discount():
+    # first answer wrong (reward 0), second right
+    eng = _ScriptedEngine([[7, 8], [9]])
+    rewards = iter([0.0, 1.0])
+
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return next(rewards)
+
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        tokenizer=None,
+        max_turns=3,
+        turn_discount=0.5,
+    )
+    data = {"input_ids": [1, 2, 3], "feedback_ids": [5, 5]}
+    batch = asyncio.run(wf.arun_episode(eng, data))
+    ids = batch["input_ids"][0].tolist()
+    lm = batch["loss_mask"][0].tolist()
+    # prompt + turn1 + feedback + turn2
+    assert ids == [1, 2, 3, 7, 8, 5, 5, 9]
+    assert lm == [0, 0, 0, 1, 1, 0, 0, 1]
+    assert batch["rewards"][0] == pytest.approx(0.5)  # discounted once
+    # second call saw the amended context
+    assert eng.calls[1] == [1, 2, 3, 7, 8, 5, 5]
+    assert (batch["versions"][0] == np.asarray([-1, -1, -1, 0, 0, -1, -1, 0])).all()
+
+
+def test_multi_turn_first_try_correct():
+    eng = _ScriptedEngine([[4]])
+    wf = MultiTurnWorkflow(
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        max_turns=3,
+        turn_discount=0.5,
+    )
+    batch = asyncio.run(wf.arun_episode(eng, {"input_ids": [1], "feedback_ids": [5]}))
+    assert batch["rewards"][0] == pytest.approx(1.0)
+    assert len(eng.calls) == 1

@@ -99,6 +99,45 @@ def test_sharded_matches_single_device():
     )
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sharded_attn_impl_matches_single_device(impl):
+    """Training with the explicit ring/Ulysses SP kernels must produce the
+    same update as the plain single-device path."""
+    batch = _toy_batch(n=8)
+    eng1 = _engine()
+    r1 = eng1.train_batch(batch, sft_loss_fn, sft_loss_weight_fn)
+    cfg = TrainEngineConfig(
+        dtype="float32", param_dtype="float32",
+        gradient_checkpointing=False,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=32768),
+        optimizer=OptimizerConfig(
+            type="adamw", lr=1e-2, weight_decay=0.0,
+            warmup_steps_proportion=0.0, lr_scheduler_type="constant",
+            gradient_clipping=100.0,
+        ),
+        parallel=ParallelismConfig(
+            1, 2, tensor_parallel_size=2, seq_parallel_size=2
+        ),
+        attn_impl=impl,
+    )
+    eng2 = SPMDTrainEngine(cfg)
+    eng2.initialize(
+        ft_spec=FinetuneSpec(1, 64, 8),
+        model_config=__import__(
+            "areal_tpu.models.config", fromlist=["tiny_config"]
+        ).tiny_config("qwen2"),
+        seed=0,
+    )
+    r2 = eng2.train_batch(batch, sft_loss_fn, sft_loss_weight_fn)
+    np.testing.assert_allclose(r1["loss"], r2["loss"], rtol=1e-4)
+    p1 = jax.device_get(eng1.params)
+    p2 = jax.device_get(eng2.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        p1, p2,
+    )
+
+
 def test_forward_logprobs_match_manual():
     eng = _engine()
     batch = _toy_batch(n=4)
