@@ -185,6 +185,9 @@ class JaxGenConfig:
     # round-trip; stop handling happens on device so at most one dispatch
     # of latency is added to a finished request)
     decode_chunk: int = 8
+    # admissions prefetched into one batched prefill dispatch (rows are
+    # padded to this wave size so the program shape is static per bucket)
+    admit_wave: int = 8
     page_size: int = 128
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85

@@ -212,8 +212,14 @@ class SPMDTrainEngine(TrainEngine):
     # Train
     # ------------------------------------------------------------------
     def _attend_fn(self):
-        """Explicit SP attention kernel, or None for GSPMD auto-sharding."""
+        """Attention kernel override: "flash" (Pallas splash, TPU-only),
+        "ring"/"ulysses" (explicit SP shard_map), or None for the XLA kernel
+        with GSPMD auto-sharding."""
         impl = self.config.attn_impl
+        if impl == "flash":
+            from areal_tpu.ops.flash import flash_segment_attention
+
+            return flash_segment_attention
         if impl == "auto" or self.config.parallel.seq_parallel_size == 1:
             return None
         if not hasattr(self, "_cached_attend"):

@@ -266,27 +266,32 @@ class GenerationEngine:
         return min(b, self.config.max_model_len)
 
     def _admit(self) -> bool:
-        did = False
-        while self.allocator.n_free > 0:
+        """Admit up to `admit_wave` queued requests in ONE prefill dispatch
+        (rows padded to a fixed wave size so shapes stay static)."""
+        wave = max(1, self.config.admit_wave)
+        reqs: List[_Request] = []
+        while self.allocator.n_free > len(reqs) and len(reqs) < wave:
             try:
-                req = self._admit_queue.get_nowait()
+                reqs.append(self._admit_queue.get_nowait())
             except queue.Empty:
                 break
-            slot = self.allocator.alloc()
+        if not reqs:
+            return False
+        bucket = self._prefill_bucket(max(len(r.input_ids) for r in reqs))
+        tokens = np.zeros((wave, bucket), np.int32)
+        true_lens = np.zeros(wave, np.int32)
+        slots = np.zeros(wave, np.int32)
+        for i, req in enumerate(reqs):
             plen = len(req.input_ids)
-            bucket = self._prefill_bucket(plen)
-            padded = np.zeros(bucket, np.int32)
-            padded[:plen] = req.input_ids
-            self.cache, logits = model_runner.prefill(
-                self.params, self.model_config, self.cache,
-                jnp.asarray(padded), jnp.asarray(plen, jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-            )
+            slot = self.allocator.alloc()
+            tokens[i, :plen] = req.input_ids
+            true_lens[i] = plen
+            slots[i] = slot
             req.slot = slot
             self._active[slot] = req
             self.total_prompt_tokens += plen
             self.total_requests += 1
-            # update device-resident sampling + stop state for this slot
+            # device-resident sampling + stop state for this slot
             self._temp_dev = self._temp_dev.at[slot].set(req.temperature)
             self._top_p_dev = self._top_p_dev.at[slot].set(req.top_p)
             self._top_k_dev = self._top_k_dev.at[slot].set(req.top_k)
@@ -307,14 +312,20 @@ class GenerationEngine:
             self._stop_tokens = self._stop_tokens.at[slot].set(
                 jnp.asarray(stops)
             )
-            # sample the first token from prefill logits: embed the row into
-            # a full [S, V] stack so sampling keeps one static shape
-            full = jnp.zeros(
-                (self.cache_config.num_slots,) + logits.shape, logits.dtype
-            ).at[slot].set(logits)
-            self._sample_and_append(full, only_slots=[slot])
-            did = True
-        return did
+        self.cache, wave_logits = model_runner.prefill_batch(
+            self.params, self.model_config, self.cache,
+            jnp.asarray(tokens), jnp.asarray(true_lens), jnp.asarray(slots),
+        )
+        # first token for every admitted slot: scatter wave rows into a full
+        # [S, V] stack so sampling keeps one static shape
+        full = jnp.zeros(
+            (self.cache_config.num_slots, wave_logits.shape[-1]),
+            wave_logits.dtype,
+        ).at[jnp.asarray(slots[: len(reqs)])].set(wave_logits[: len(reqs)])
+        self._sample_and_append(
+            full, only_slots=[int(s) for s in slots[: len(reqs)]]
+        )
+        return True
 
     def _decode(self) -> bool:
         if not self._active:

@@ -179,6 +179,39 @@ def decode_step(
     return {"k": new_k, "v": new_v, "lens": lens}, logits
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_batch(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [N, Tp] int32 (N admissions, same bucket)
+    true_lens: jnp.ndarray,  # [N] int32 (0 = empty row, skipped)
+    slots: jnp.ndarray,  # [N] int32 (duplicate slot 0 for empty rows ok:
+    # they write 0 tokens because their mask is empty)
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Prefill N requests in ONE dispatch via lax.scan over rows.
+
+    Rows run sequentially on device (each is itself a big batched matmul
+    program) but the host pays a single dispatch+fetch round-trip for the
+    whole admission wave instead of one per request.
+    """
+
+    def row(cache, xs):
+        toks, tl, slot = xs
+
+        def do(c):
+            return prefill(params, cfg, c, toks, tl, slot)
+
+        def skip(c):
+            # padding row of a partial admission wave: touch nothing
+            return c, jnp.zeros((cfg.vocab_size,), jnp.float32)
+
+        return jax.lax.cond(tl > 0, do, skip, cache)
+
+    cache, logits = jax.lax.scan(row, cache, (tokens, true_lens, slots))
+    return cache, logits  # logits [N, V]
+
+
 @functools.partial(
     jax.jit, static_argnames=("cfg", "steps"), donate_argnames=("cache",)
 )

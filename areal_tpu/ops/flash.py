@@ -1,0 +1,65 @@
+"""Flash (splash) attention wrapper for packed segment streams on TPU.
+
+Role of the reference's flash-attn varlen path (realhf/impl/model/modules/
+attn.py wraps flash_attn_varlen_func; areal relies on HF flash-attention-2):
+on TPU the analog is the Pallas splash-attention kernel family shipped with
+JAX (jax.experimental.pallas.ops.tpu.splash_attention) — fused streaming
+softmax, O(T) activation memory, differentiable (custom VJP), with native
+segment-id support that matches our packed layout exactly.
+
+This wrapper adapts splash's [H, T, D] MQA-grouped convention to the
+framework's [B, T, H, D] packed-stream convention and masks padding
+(segment id 0) on the way out. TPU-only: callers gate on backend (the
+engine's attn_impl="flash" config) — CPU tests use the XLA kernel.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental.pallas.ops.tpu.splash_attention import (
+    splash_attention_kernel as _sk,
+    splash_attention_mask as _sm,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_kernel(t: int, rep: int):
+    # ensure_compile_time_eval: this may be reached inside a jit trace, but
+    # the kernel object (and the mask arrays it processes) must be concrete —
+    # it is cached across traces, and a tracer captured here would escape.
+    with jax.ensure_compile_time_eval():
+        mask = _sm.MultiHeadMask(
+            [_sm.CausalMask((t, t)) for _ in range(rep)]
+        )
+        return _sk.make_splash_mqa_single_device(mask)
+
+
+def flash_segment_attention(
+    q: jnp.ndarray,  # [B, T, Hq, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,  # [B, T]
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops.basic.segment_attention on TPU."""
+    assert causal, "splash path is causal-only (decoder models)"
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    rep = hq // hkv
+    kernel = _make_kernel(t, rep)
+    scale = d**-0.5
+    qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qg = qg.transpose(0, 2, 1, 3).reshape(b, hkv, rep, t, d)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    def per_batch(q_, k_, v_, seg_row):
+        ids = _sk.SegmentIds(q=seg_row, kv=seg_row)
+        return jax.vmap(kernel, in_axes=(0, 0, 0, None))(q_, k_, v_, ids)
+
+    out = jax.vmap(per_batch)(qg, kt, vt, segment_ids)
+    out = out.reshape(b, hq, t, d).transpose(0, 2, 1, 3)
+    valid = (segment_ids > 0)[:, :, None, None]
+    return jnp.where(valid, out, 0).astype(q.dtype)

@@ -89,6 +89,7 @@ def main():
         dtype="bfloat16",
         param_dtype="bfloat16",
         gradient_checkpointing=True,
+        attn_impl="flash",
         mb_spec=MicroBatchSpec(max_tokens_per_mb=8192),
         optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
         parallel=ParallelismConfig(),
