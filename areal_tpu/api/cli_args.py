@@ -160,6 +160,9 @@ class InferenceEngineConfig:
     trial_name: str = ""
     max_concurrent_rollouts: Optional[int] = None
     queue_size: Optional[int] = None
+    # Unit = episodes (prompts), NOT sequences: wait()/get_capacity() count
+    # one per submitted workflow item, and each RLVR episode carries
+    # gconfig.n_samples sequences. Set this to the dataloader batch size.
     consumer_batch_size: int = 1
     max_head_offpolicyness: int = 0  # staleness η: max model-version lead
     enable_rollout_tracing: bool = False

@@ -172,7 +172,7 @@ class WorkflowExecutor:
         capacity gate, return as soon as one consumer batch is ready
         (reference workflow_api.py:288-317)."""
         if not hasattr(self, "_data_generator"):
-            self._data_generator = _cycle(dataloader)
+            self._data_generator = cycle_dataloader(dataloader)
         bs = getattr(dataloader, "batch_size", 1) or 1
         assert self.config.consumer_batch_size % bs == 0
         while True:
@@ -258,7 +258,8 @@ class WorkflowExecutor:
             )
 
 
-def _cycle(dataloader):
+def cycle_dataloader(dataloader):
+    """Endless epoch-wrapping iterator over a dataloader."""
     while True:
         for batch in dataloader:
             yield batch

@@ -325,7 +325,10 @@ def sample_tokens(
 
     The returned logprob is under the temperature-scaled (untruncated)
     distribution — the behavior-policy logprob the trainer consumes
-    (reference ModelResponse.output_logprobs semantics).
+    (reference ModelResponse.output_logprobs semantics). Greedy slots are
+    the exception: they pick argmax over the raw logits, so their logprob
+    is reported under the *unscaled* distribution (temperature never enters
+    their behavior policy).
     """
     s, v = logits.shape
     temp = jnp.maximum(temperature, 1e-5)[:, None]
@@ -350,7 +353,15 @@ def sample_tokens(
     sampled = jax.random.categorical(key, trunc, axis=-1)
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
-    logprobs = jnp.take_along_axis(
+    # Greedy slots ignore temperature when picking the token, so report the
+    # logprob under the *unscaled* distribution — mixing argmax(logits) with
+    # the temperature-scaled softmax would hand the trainer importance
+    # ratios from a distribution that was never sampled.
+    lp_sampled = jnp.take_along_axis(
         logp_full, tokens[:, None], axis=-1
     ).squeeze(-1)
+    lp_greedy = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), tokens[:, None], axis=-1
+    ).squeeze(-1)
+    logprobs = jnp.where(greedy, lp_greedy, lp_sampled)
     return tokens, logprobs

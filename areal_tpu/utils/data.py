@@ -54,6 +54,13 @@ def pad_sequences_to_tensors(
     return dict(input_ids=out, attention_mask=mask)
 
 
+# Per-key pad values for keys where the generic pad_value would be a *valid*
+# data value: 'versions' uses -1 as the "padding / not generated" sentinel —
+# padding with 0 would masquerade as weight-version-0 tokens under any
+# staleness filter.
+_KEY_PAD_VALUES = {"versions": -1}
+
+
 def concat_padded_tensors(
     batches: List[Batch], pad_value: float = 0.0
 ) -> Batch:
@@ -77,7 +84,9 @@ def concat_padded_tensors(
             v = np.asarray(b[k])
             if k in per_token_keys and v.shape[1] < max_len:
                 pad_width = [(0, 0), (0, max_len - v.shape[1])] + [(0, 0)] * (v.ndim - 2)
-                fill = False if v.dtype == np.bool_ else pad_value
+                fill = _KEY_PAD_VALUES.get(
+                    k, False if v.dtype == np.bool_ else pad_value
+                )
                 v = np.pad(v, pad_width, constant_values=fill)
             parts.append(v)
         out[k] = np.concatenate(parts, axis=0)
@@ -152,10 +161,10 @@ def pack_batch(
     b_pad = pad_seqs_to if pad_seqs_to is not None else bsz
     flat_idx = np.nonzero(mask.reshape(-1))[0]
 
-    def _pack_tok(v: np.ndarray) -> np.ndarray:
+    def _pack_tok(v: np.ndarray, fill=0) -> np.ndarray:
         flat = v.reshape((-1,) + v.shape[2:])[flat_idx]
         out_shape = (t_pad,) + flat.shape[1:]
-        out = np.zeros(out_shape, dtype=flat.dtype)
+        out = np.full(out_shape, fill, dtype=flat.dtype)
         out[:total] = flat
         return out
 
@@ -175,7 +184,7 @@ def pack_batch(
             continue
         v = np.asarray(v)
         if v.ndim >= 2 and v.shape[:2] == mask.shape:
-            per_token[k] = _pack_tok(v)
+            per_token[k] = _pack_tok(v, fill=_KEY_PAD_VALUES.get(k, 0))
         else:
             padded = np.zeros((b_pad,) + v.shape[1:], dtype=v.dtype)
             padded[:bsz] = v
@@ -323,8 +332,9 @@ def pack_batch_rows(
     pos = np.zeros((n_rows, t_pad), np.int32)
     seq_lens = np.zeros((n_rows, s_pad), np.int32)
     per_token = {
-        k: np.zeros(
+        k: np.full(
             (n_rows, t_pad) + np.asarray(batch[k]).shape[2:],
+            _KEY_PAD_VALUES.get(k, 0),
             np.asarray(batch[k]).dtype,
         )
         for k in per_token_keys

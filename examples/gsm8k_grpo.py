@@ -20,6 +20,7 @@ import sys
 import numpy as np
 
 from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+from areal_tpu.api.workflow_api import cycle_dataloader
 from areal_tpu.api.io_struct import (
     FinetuneSpec,
     StepInfo,
@@ -145,6 +146,7 @@ def main(argv):
         ft_spec.total_train_epochs * ft_spec.steps_per_epoch
     )
     step = start_step
+    data_generator = None
     logger.info(
         f"starting GRPO: {total_steps} steps, "
         f"{ft_spec.steps_per_epoch} steps/epoch, "
@@ -156,7 +158,12 @@ def main(argv):
                 if config.async_training:
                     batch = rollout.prepare_batch(dataloader, workflow)
                 else:
-                    items = next(iter(dataloader))
+                    # one persistent iterator: StatefulDataLoader tracks its
+                    # epoch position on the instance, so a fresh iter() at an
+                    # epoch boundary would raise StopIteration immediately
+                    if data_generator is None:
+                        data_generator = cycle_dataloader(dataloader)
+                    items = next(data_generator)
                     batch = rollout.rollout_batch(items, workflow)
 
             if ref_actor is not None:

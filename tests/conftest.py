@@ -1,26 +1,22 @@
 """Test config: run everything on a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is unavailable in CI; sharding logic is exercised on
-XLA's host platform with 8 virtual devices (the driver separately dry-runs
-the multi-chip path via __graft_entry__.dryrun_multichip).
+XLA's host platform with 8 virtual devices. The provisioning recipe lives in
+``__graft_entry__._ensure_virtual_devices`` (the driver's multi-chip dry run
+uses the same helper) — it hard-overrides the real-TPU tunnel backend pin:
+the environment's sitecustomize imports jax and sets jax_platforms at
+interpreter start, so env vars alone are ignored and the live jax config
+must be updated too.
 """
 
 import os
+import sys
 
-# Hard override: the environment pins the real-TPU tunnel backend ("axon")
-# and its sitecustomize imports jax and sets jax_platforms="axon,cpu" at
-# interpreter start, so the env var alone is ignored. Tests must run on the
-# virtual CPU mesh: set the flag env vars AND update the live jax config.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax  # noqa: E402
+from __graft_entry__ import _ensure_virtual_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_ensure_virtual_devices(8)
 
 import pytest  # noqa: E402
 
