@@ -188,9 +188,19 @@ class JaxGenConfig:
     # round-trip; stop handling happens on device so at most one dispatch
     # of latency is added to a finished request)
     decode_chunk: int = 8
-    # admissions prefetched into one batched prefill dispatch (rows are
-    # padded to this wave size so the program shape is static per bucket)
+    # unique prompts prefilled in one batched dispatch (rows are padded to
+    # this wave size so the program shape is static per bucket); identical
+    # prompts (GRPO siblings) share one row + a KV line copy
     admit_wave: int = 8
+    # decode attention reads cache lines bucketed to this quantum above the
+    # longest active sequence (instead of always max_model_len)
+    kv_bucket: int = 256
+    # lax.top_k candidate count for truncated sampling (raised to the max
+    # requested per-slot top_k); 0 would force the exact full-vocab sort
+    sample_topk_bound: int = 64
+    # reuse a freed slot's cached KV when >= this many prompt tokens match
+    # (0 disables prefix reuse)
+    prefix_reuse_min: int = 16
     page_size: int = 128
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85

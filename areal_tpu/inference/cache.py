@@ -7,10 +7,12 @@ cache is the XLA-friendly design — one array per K/V of shape
 updated with static-shape dynamic slices inside jit. Slot allocation is
 host-side bookkeeping; the device never sees dynamic shapes.
 
-Prefix reuse (the radix-cache analog) is a planned optimization; the
-interruptible-generation protocol (resubmit with accumulated tokens) does a
-full re-prefill, matching the reference's post-abort behavior
-(sglang_remote.py:186-234).
+Prefix reuse (the radix-cache analog, reference
+areal/engine/sglang_remote.py:158-168) is host-side bookkeeping over this
+fixed geometry: the engine remembers what tokens a freed slot still caches
+and re-claims the slot (``alloc_specific``) when a new request shares the
+prefix — the interruptible-generation resubmit (prompt + accumulated
+tokens) then re-prefills only the suffix.
 """
 
 import dataclasses
@@ -59,6 +61,13 @@ class SlotAllocator:
 
     def alloc(self) -> Optional[int]:
         return self._free.pop() if self._free else None
+
+    def alloc_specific(self, slot: int) -> bool:
+        """Claim a particular free slot (prefix-cache reuse)."""
+        if slot in self._free:
+            self._free.remove(slot)
+            return True
+        return False
 
     def free(self, slot: int) -> None:
         assert 0 <= slot < self.num_slots and slot not in self._free

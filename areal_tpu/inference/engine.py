@@ -105,6 +105,10 @@ class GenerationEngine:
         self._admit_queue: "queue.Queue[_Request]" = queue.Queue()
         self._command_queue: "queue.Queue" = queue.Queue()
         self._active: Dict[int, _Request] = {}  # slot -> request
+        self._pending: List[_Request] = []  # drained but not yet admitted
+        # freed slot -> tokens its cache line still holds (prefix reuse);
+        # flushed on weight update (stale-KV guard)
+        self._freed_prefix: Dict[int, np.ndarray] = {}
         self._paused = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -124,6 +128,7 @@ class GenerationEngine:
         # metrics
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
+        self.total_cached_prompt_tokens = 0  # prompt tokens served from KV reuse
         self.total_requests = 0
         self.total_aborted = 0
 
@@ -190,10 +195,11 @@ class GenerationEngine:
     def metrics(self) -> Dict[str, float]:
         return dict(
             running_requests=len(self._active),
-            queued_requests=self._admit_queue.qsize(),
+            queued_requests=self._admit_queue.qsize() + len(self._pending),
             free_slots=self.allocator.n_free,
             total_generated_tokens=self.total_generated_tokens,
             total_prompt_tokens=self.total_prompt_tokens,
+            total_cached_prompt_tokens=self.total_cached_prompt_tokens,
             total_requests=self.total_requests,
             total_aborted=self.total_aborted,
             model_version=self.model_version,
@@ -231,6 +237,8 @@ class GenerationEngine:
                         path, self.model_config, dtype=self.dtype
                     )
                     self.params = jax.device_put(host)
+                    # cached KV is from the old policy — never reuse it
+                    self._freed_prefix.clear()
                     self.model_version = (
                         version
                         if version is not None
@@ -249,6 +257,7 @@ class GenerationEngine:
                         lambda p: jnp.array(p, dtype=self.dtype, copy=True),
                         params,
                     )
+                    self._freed_prefix.clear()
                     self.model_version = (
                         version
                         if version is not None
@@ -265,67 +274,221 @@ class GenerationEngine:
         b = data_utils.next_bucket_size(n, quantum)
         return min(b, self.config.max_model_len)
 
+    def _alloc_slot(self) -> int:
+        slot = self.allocator.alloc()
+        assert slot is not None  # selection is capped by n_free
+        self._freed_prefix.pop(slot, None)  # line is being overwritten
+        return slot
+
+    def _try_prefix_reuse(self, input_ids: List[int]):
+        """Find a free slot whose cached tokens share the longest prefix
+        with `input_ids`; claim it. Returns (slot, cached_len) or (None, 0).
+
+        The radix-cache analog (reference sglang_remote.py:158-168): the
+        interruptible-generation resubmit (prompt + accumulated tokens) and
+        repeated system prompts hit this path.
+        """
+        if self.config.prefix_reuse_min <= 0 or not self._freed_prefix:
+            return None, 0
+        prompt = np.asarray(input_ids, np.int32)
+        # at least one suffix token must remain to produce next-token logits
+        limit = len(prompt) - 1
+        best_slot, best_len = None, 0
+        for slot, cached in self._freed_prefix.items():
+            n = min(len(cached), limit)
+            if n <= best_len:
+                continue
+            eq = cached[:n] == prompt[:n]
+            match = n if eq.all() else int(np.argmin(eq))
+            if match > best_len:
+                best_len, best_slot = match, slot
+        if best_slot is None or best_len < self.config.prefix_reuse_min:
+            return None, 0
+        claimed = self.allocator.alloc_specific(best_slot)
+        assert claimed  # _freed_prefix only tracks free slots
+        del self._freed_prefix[best_slot]
+        return best_slot, best_len
+
     def _admit(self) -> bool:
-        """Admit up to `admit_wave` queued requests in ONE prefill dispatch
-        (rows padded to a fixed wave size so shapes stay static)."""
-        wave = max(1, self.config.admit_wave)
-        reqs: List[_Request] = []
-        while self.allocator.n_free > len(reqs) and len(reqs) < wave:
+        """Admit queued requests: identical prompts (GRPO siblings) group
+        behind ONE prefill row + KV line copies; unique prompts prefill as
+        one batched [N, Tp] dispatch, each row resuming from its slot's
+        reusable cached prefix (offset)."""
+        while True:
             try:
-                reqs.append(self._admit_queue.get_nowait())
+                self._pending.append(self._admit_queue.get_nowait())
             except queue.Empty:
                 break
-        if not reqs:
+        if not self._pending or self.allocator.n_free == 0:
             return False
-        bucket = self._prefill_bucket(max(len(r.input_ids) for r in reqs))
-        tokens = np.zeros((wave, bucket), np.int32)
-        true_lens = np.zeros(wave, np.int32)
-        slots = np.zeros(wave, np.int32)
-        for i, req in enumerate(reqs):
+        wave = max(1, self.config.admit_wave)
+        # --- select: group identical prompts; <= wave unique prompts,
+        # total admitted <= free slots ---
+        groups: Dict[tuple, List[_Request]] = {}
+        rest: List[_Request] = []
+        budget = self.allocator.n_free
+        for req in self._pending:
+            key = tuple(req.input_ids)
+            if budget > 0 and key in groups:
+                groups[key].append(req)
+                budget -= 1
+            elif budget > 0 and len(groups) < wave:
+                groups[key] = [req]
+                budget -= 1
+            else:
+                rest.append(req)
+        self._pending = rest
+        if not groups:
+            return False
+
+        m = self.config.max_model_len
+        reps = [g[0] for g in groups.values()]
+        # --- prefix reuse + suffix planning per representative ---
+        rep_slots, offsets = [], []
+        for rep in reps:
+            slot, off = self._try_prefix_reuse(rep.input_ids)
+            if slot is None:
+                slot, off = self._alloc_slot(), 0
+            rep_slots.append(slot)
+            offsets.append(off)
+        # suffix bucket; clamp offsets so every row fits (off + tp <= m)
+        while True:
+            tp = self._prefill_bucket(
+                max(
+                    len(rep.input_ids) - off
+                    for rep, off in zip(reps, offsets)
+                )
+            )
+            bad = [i for i, off in enumerate(offsets) if off + tp > m]
+            if not bad:
+                break
+            for i in bad:
+                offsets[i] = max(0, m - tp)
+        # count reuse from the post-clamp offsets (what was actually served
+        # from cache)
+        self.total_cached_prompt_tokens += sum(offsets)
+        pf_bound = min(
+            m,
+            data_utils.next_bucket_size(
+                max(offsets) + tp, self.config.kv_bucket
+            ),
+        )
+        # pow2 row bucket: a lone unique prompt (a GRPO group) doesn't pay
+        # for wave-1 padding rows of compute
+        n_rows = 1 << (len(reps) - 1).bit_length() if len(reps) > 1 else 1
+        tokens = np.zeros((n_rows, tp), np.int32)
+        true_lens = np.zeros(n_rows, np.int32)
+        row_slots = np.zeros(n_rows, np.int32)
+        row_offsets = np.zeros(n_rows, np.int32)
+        for i, (rep, slot, off) in enumerate(zip(reps, rep_slots, offsets)):
+            suffix = rep.input_ids[off:]
+            tokens[i, : len(suffix)] = suffix
+            true_lens[i] = len(suffix)
+            row_slots[i] = slot
+            row_offsets[i] = off
+        self.cache, wave_logits = model_runner.prefill_batch(
+            self.params, self.model_config, self.cache,
+            jnp.asarray(tokens), jnp.asarray(row_offsets),
+            jnp.asarray(true_lens), jnp.asarray(row_slots),
+            kv_bound=pf_bound,
+        )
+
+        # --- sibling fan-out: copy the representative's KV line ---
+        copy_src, copy_dst = [], []
+        admitted: List[tuple] = []  # (req, slot, logits_row)
+        for i, group in enumerate(groups.values()):
+            admitted.append((group[0], rep_slots[i], i))
+            for sib in group[1:]:
+                slot = self._alloc_slot()
+                copy_src.append(rep_slots[i])
+                copy_dst.append(slot)
+                admitted.append((sib, slot, i))
+                self.total_cached_prompt_tokens += len(sib.input_ids)
+        if copy_src:
+            pad = data_utils.next_bucket_size(len(copy_src), 8)
+            src = np.zeros(pad, np.int32)
+            dst = np.full(pad, self.cache_config.num_slots, np.int32)
+            src[: len(copy_src)] = copy_src
+            dst[: len(copy_dst)] = copy_dst
+            self.cache = model_runner.copy_slots(
+                self.cache, jnp.asarray(src), jnp.asarray(dst)
+            )
+
+        # --- batched per-slot state update (one scatter per state array) ---
+        n = len(admitted)
+        slots_np = np.zeros(n, np.int32)
+        temps = np.zeros(n, np.float32)
+        top_ps = np.zeros(n, np.float32)
+        top_ks = np.zeros(n, np.int32)
+        greedys = np.zeros(n, bool)
+        remainings = np.zeros(n, np.int32)
+        no_stops = np.zeros(n, np.int32)
+        stops = np.full((n, 8), -1, np.int32)
+        for j, (req, slot, _) in enumerate(admitted):
             plen = len(req.input_ids)
-            slot = self.allocator.alloc()
-            tokens[i, :plen] = req.input_ids
-            true_lens[i] = plen
-            slots[i] = slot
             req.slot = slot
             self._active[slot] = req
             self.total_prompt_tokens += plen
             self.total_requests += 1
-            # device-resident sampling + stop state for this slot
-            self._temp_dev = self._temp_dev.at[slot].set(req.temperature)
-            self._top_p_dev = self._top_p_dev.at[slot].set(req.top_p)
-            self._top_k_dev = self._top_k_dev.at[slot].set(req.top_k)
-            self._greedy_dev = self._greedy_dev.at[slot].set(req.greedy)
-            self._active_dev = self._active_dev.at[slot].set(True)
-            allowed = min(
-                req.max_new_tokens, self.config.max_model_len - plen
-            )
+            slots_np[j] = slot
+            temps[j] = req.temperature
+            top_ps[j] = req.top_p
+            top_ks[j] = req.top_k
+            greedys[j] = req.greedy
             # the first token is sampled at admission (below), so the
             # device-side budget starts at allowed − 1
-            self._remaining = self._remaining.at[slot].set(allowed - 1)
-            self._no_stop = self._no_stop.at[slot].set(
-                req.min_new_tokens - 1
-            )
-            stops = np.full(8, -1, np.int32)
+            remainings[j] = min(req.max_new_tokens, m - plen) - 1
+            no_stops[j] = req.min_new_tokens - 1
             ids = np.asarray(req.stop_token_ids[:8], np.int32)
-            stops[: len(ids)] = ids
-            self._stop_tokens = self._stop_tokens.at[slot].set(
-                jnp.asarray(stops)
-            )
-        self.cache, wave_logits = model_runner.prefill_batch(
-            self.params, self.model_config, self.cache,
-            jnp.asarray(tokens), jnp.asarray(true_lens), jnp.asarray(slots),
-        )
-        # first token for every admitted slot: scatter wave rows into a full
-        # [S, V] stack so sampling keeps one static shape
+            stops[j, : len(ids)] = ids
+        sl = jnp.asarray(slots_np)
+        self._temp_dev = self._temp_dev.at[sl].set(jnp.asarray(temps))
+        self._top_p_dev = self._top_p_dev.at[sl].set(jnp.asarray(top_ps))
+        self._top_k_dev = self._top_k_dev.at[sl].set(jnp.asarray(top_ks))
+        self._greedy_dev = self._greedy_dev.at[sl].set(jnp.asarray(greedys))
+        self._active_dev = self._active_dev.at[sl].set(True)
+        self._remaining = self._remaining.at[sl].set(jnp.asarray(remainings))
+        self._no_stop = self._no_stop.at[sl].set(jnp.asarray(no_stops))
+        self._stop_tokens = self._stop_tokens.at[sl].set(jnp.asarray(stops))
+
+        # --- first token for every admitted slot: siblings share the
+        # representative's last-token logits row ---
+        rows = jnp.asarray([r for (_, _, r) in admitted])
         full = jnp.zeros(
             (self.cache_config.num_slots, wave_logits.shape[-1]),
             wave_logits.dtype,
-        ).at[jnp.asarray(slots[: len(reqs)])].set(wave_logits[: len(reqs)])
-        self._sample_and_append(
-            full, only_slots=[int(s) for s in slots[: len(reqs)]]
-        )
+        ).at[sl].set(wave_logits[rows])
+        self._sample_and_append(full, only_slots=[int(s) for s in slots_np])
         return True
+
+    def _kv_bound(self, steps: int) -> int:
+        """Static decode-attention bound: bucketed longest active length
+        + the steps this dispatch will add."""
+        max_len = max(
+            len(r.input_ids) + len(r.output_ids)
+            for r in self._active.values()
+        )
+        return min(
+            self.config.max_model_len,
+            data_utils.next_bucket_size(
+                max_len + steps + 1, self.config.kv_bucket
+            ),
+        )
+
+    def _sampling_mode(self) -> int:
+        """Static topk_bound for the sampling kernel, from the live mix of
+        requests: -1 (pure categorical) when nothing truncates, else a
+        lax.top_k bound covering every slot's top_k."""
+        reqs = self._active.values()
+        if all(r.top_p >= 1.0 and r.top_k <= 0 for r in reqs):
+            return -1
+        mx = max((r.top_k for r in reqs), default=0)
+        # bucketed so varying client top_k values don't each force a fresh
+        # XLA compile of the fused decode program
+        return data_utils.next_bucket_size(
+            max(self.config.sample_topk_bound, mx),
+            max(1, self.config.sample_topk_bound),
+        )
 
     def _decode(self) -> bool:
         if not self._active:
@@ -342,6 +505,8 @@ class GenerationEngine:
             self._no_stop, self._stop_tokens, key,
             self._temp_dev, self._top_p_dev, self._top_k_dev,
             self._greedy_dev, steps=steps,
+            kv_bound=self._kv_bound(steps),
+            topk_bound=self._sampling_mode(),
         )
         self._cur_tokens = toks[-1]
         self._active_dev = active_after
@@ -387,7 +552,7 @@ class GenerationEngine:
         key = jax.random.fold_in(self._rng_key, self._step_counter)
         toks, logps = model_runner.sample_tokens(
             logits, key, self._temp_dev, self._top_p_dev, self._top_k_dev,
-            self._greedy_dev,
+            self._greedy_dev, topk_bound=self._sampling_mode(),
         )
         # record sampled tokens as the next decode inputs for these slots
         for slot in only_slots:
@@ -427,6 +592,13 @@ class GenerationEngine:
         self._active_dev = self._active_dev.at[slot].set(False)
         if reason == "abort":
             self.total_aborted += 1
+        if self.config.prefix_reuse_min > 0:
+            # the slot's line holds the prompt plus all generated tokens
+            # except the last sampled one (it was never fed back)
+            cached = len(req.input_ids) + max(0, len(req.output_ids) - 1)
+            self._freed_prefix[slot] = np.asarray(
+                (req.input_ids + req.output_ids)[:cached], np.int32
+            )
         now = time.monotonic()
         result = {
             "output_ids": req.output_ids,

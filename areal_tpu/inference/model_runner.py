@@ -1,23 +1,33 @@
 """Jitted prefill / decode-step programs over the slot KV cache.
 
 The TPU-native core of the generation engine (role of SGLang's model runner
-behind the reference's HTTP API). Two compiled programs:
+behind the reference's HTTP API). Compiled programs:
 
-- ``prefill``: one request's prompt at a bucketed static length → writes
-  K/V for every position into the request's cache slot, returns the logits
-  of the last real token.
+- ``prefill_batch``: N requests' prompt suffixes as ONE batched [N, Tp]
+  forward — the whole admission wave is a single large matmul program
+  instead of N serial prompt passes. Each row carries a per-row ``offset``:
+  the number of tokens already cached in its slot (prefix reuse — the
+  radix-cache analog, reference areal/engine/sglang_remote.py:158-168).
+  K/V for the suffix land at [offset, offset+len) in the slot's line.
 - ``decode_step``: ALL active slots advance one token in a single batched
   program — continuous batching is "the batch dim is the slot dim". K/V for
-  the new token scatter into each slot's line; attention reads the full
-  static cache line under a length mask.
+  the new token scatter into each slot's line; attention reads the cache
+  line up to a static ``kv_bound`` (host-bucketed to the longest active
+  sequence) under a length mask, so short sequences don't pay
+  max_model_len HBM traffic.
+- ``copy_slots``: duplicate cache lines across slots — GRPO's group_size
+  identical prompts prefill once and fan out by an HBM copy.
 
-Both scan over the stacked layer params (compile once per bucket, O(1) in
-depth) and keep fp32 softmax/logits. Sampling (temperature / top-k / top-p /
-greedy, per-slot) runs on device; stop handling is host-side.
+All programs scan over the stacked layer params (compile once per bucket,
+O(1) in depth), keep fp32 softmax/logits, and use
+``preferred_element_type=f32`` einsums so bf16 stays on the MXU. Sampling
+(temperature / top-k / top-p / greedy, per-slot) runs on device with a
+static ``topk_bound`` (lax.top_k instead of a full-vocab sort); stop
+handling is on device in ``decode_multi``, host backstopped.
 """
 
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,8 +70,111 @@ def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
 
 
 # ---------------------------------------------------------------------------
-# Prefill
+# Prefill (batched, prefix-aware)
 # ---------------------------------------------------------------------------
+def _prefill_impl(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [N, Tp] int32 suffix tokens, padded to bucket
+    offsets: jnp.ndarray,  # [N] int32 tokens already cached (prefix reuse)
+    true_lens: jnp.ndarray,  # [N] int32 suffix lengths (0 = padding row)
+    slots: jnp.ndarray,  # [N] int32 target slot per row
+    kv_bound: Optional[int],
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """One batched forward over N prompt suffixes; writes K/V into each
+    row's slot at its offset; returns last-real-token logits [N, V].
+
+    Host contract: ``offsets[i] + Tp <= kv_bound <= max_model_len`` for
+    every real row, so the dynamic_update_slice never clamps.
+    """
+    n, tp = tokens.shape
+    num_slots, m = cache["k"].shape[1], cache["k"].shape[2]
+    mb = m if kv_bound is None else min(kv_bound, m)
+    # padding rows scatter out-of-range → dropped
+    slots = jnp.where(true_lens > 0, slots, num_slots)
+    sidx = jnp.arange(tp, dtype=jnp.int32)[None, :]
+    pos = offsets[:, None] + sidx  # [N, Tp] absolute positions
+    valid_q = sidx < true_lens[:, None]
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    x = params["embedding"][tokens]  # [N, Tp, D]
+    # key j visible to row-i query at suffix index s iff j <= offset_i + s
+    att_mask = jnp.arange(mb)[None, None, :] <= pos[:, :, None]  # [N, Tp, mb]
+    scale = cfg.head_dim**-0.5
+    rep = cfg.num_heads // cfg.num_kv_heads
+
+    k_all = cache["k"][:, :, :mb]
+    v_all = cache["v"][:, :, :mb]
+
+    def upd(line, new, off):
+        zero = jnp.zeros((), jnp.int32)
+        return jax.lax.dynamic_update_slice(line, new, (off, zero, zero))
+
+    def layer(x, xs):
+        lp, k_lines, v_lines = xs  # lines [S, mb, Hkv, Dh]
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(cfg, lp, h)
+        q = apply_rope(q, pos, cos, sin)
+        k = apply_rope(k, pos, cos, sin)
+        kz = jnp.where(valid_q[..., None, None], k, 0).astype(k_lines.dtype)
+        vz = jnp.where(valid_q[..., None, None], v, 0).astype(v_lines.dtype)
+        rows_k = jax.vmap(upd)(k_lines[slots], kz, offsets)  # [N, mb, Hkv, Dh]
+        rows_v = jax.vmap(upd)(v_lines[slots], vz, offsets)
+        kk = jnp.repeat(rows_k, rep, axis=2) if rep > 1 else rows_k
+        vv = jnp.repeat(rows_v, rep, axis=2) if rep > 1 else rows_v
+        scores = (
+            jnp.einsum(
+                "nqhd,nkhd->nhqk", q, kk,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        scores = jnp.where(att_mask[:, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "nhqk,nkhd->nqhd", probs, vv.astype(jnp.float32)
+        )
+        attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        k_lines = k_lines.at[slots].set(rows_k, mode="drop")
+        v_lines = v_lines.at[slots].set(rows_v, mode="drop")
+        return x, (k_lines, v_lines)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], k_all, v_all))
+    if mb < m:
+        cache_k = cache["k"].at[:, :, :mb].set(new_k)
+        cache_v = cache["v"].at[:, :, :mb].set(new_v)
+    else:
+        cache_k, cache_v = new_k, new_v
+    lens = cache["lens"].at[slots].set(offsets + true_lens, mode="drop")
+    last = x[jnp.arange(n), jnp.maximum(true_lens - 1, 0)]  # [N, D]
+    logits = _final_logits(params, cfg, last)  # [N, V] fp32
+    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "kv_bound"), donate_argnames=("cache",)
+)
+def prefill_batch(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [N, Tp]
+    offsets: jnp.ndarray,  # [N]
+    true_lens: jnp.ndarray,  # [N]
+    slots: jnp.ndarray,  # [N]
+    kv_bound: Optional[int] = None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Prefill N prompt suffixes in ONE batched dispatch (see module doc)."""
+    return _prefill_impl(
+        params, cfg, cache, tokens, offsets, true_lens, slots, kv_bound
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill(
     params: Params,
@@ -71,97 +184,82 @@ def prefill(
     true_len: jnp.ndarray,  # scalar int32
     slot: jnp.ndarray,  # scalar int32
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Run the prompt through the stack, cache K/V, return last-token logits."""
-    tp = tokens.shape[0]
-    pos = jnp.arange(tp, dtype=jnp.int32)
-    valid = pos < true_len
-    cos, sin = rope_frequencies(
-        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    """Single-request prefill (batch of one; kept for tests/simple callers)."""
+    cache, logits = _prefill_impl(
+        params,
+        cfg,
+        cache,
+        tokens[None],
+        jnp.zeros((1,), jnp.int32),
+        true_len[None],
+        slot[None],
+        None,
     )
-    x = params["embedding"][tokens][None]  # [1, Tp, D]
-    causal = (pos[None, :] <= pos[:, None]) & valid[None, :] & valid[:, None]
+    return cache, logits[0]
 
-    def layer(carry, xs):
-        x = carry
-        lp, _ = xs
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q, k, v = _project_qkv(cfg, lp, h)
-        q = apply_rope(q, pos[None], cos, sin)
-        k = apply_rope(k, pos[None], cos, sin)
-        # attention [1, Tp, Hq, Dh]
-        rep = cfg.num_heads // cfg.num_kv_heads
-        kk = jnp.repeat(k, rep, axis=2) if rep > 1 else k
-        vv = jnp.repeat(v, rep, axis=2) if rep > 1 else v
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32)
-        ) * (cfg.head_dim**-0.5)
-        scores = jnp.where(causal[None, None], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32))
-        attn = attn.astype(x.dtype).reshape(1, tp, cfg.q_dim)
-        x = x + attn @ lp["wo"]
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
-        return x, (k[0], v[0])  # [Tp, Hkv, Dh]
 
-    n_layers = cfg.num_layers
-    x, (ks, vs) = jax.lax.scan(
-        layer, x, (params["layers"], jnp.arange(n_layers))
-    )
-    # write K/V into the slot: [L, Tp, Hkv, D] → cache [L, S, M, Hkv, D]
-    zero = jnp.zeros((), jnp.int32)
-    mask = valid[None, :, None, None]
-    ks = jnp.where(mask, ks, 0.0).astype(cache["k"].dtype)
-    vs = jnp.where(mask, vs, 0.0).astype(cache["v"].dtype)
-    cache_k = jax.lax.dynamic_update_slice(
-        cache["k"], ks[:, None], (zero, slot, zero, zero, zero)
-    )
-    cache_v = jax.lax.dynamic_update_slice(
-        cache["v"], vs[:, None], (zero, slot, zero, zero, zero)
-    )
-    lens = cache["lens"].at[slot].set(true_len)
-    last = x[0, jnp.maximum(true_len - 1, 0)]
-    logits = _final_logits(params, cfg, last[None])[0]
-    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def copy_slots(
+    cache: Dict[str, jnp.ndarray],
+    src: jnp.ndarray,  # [P] int32 source slot per copy
+    dst: jnp.ndarray,  # [P] int32 destination (>= num_slots rows are dropped)
+) -> Dict[str, jnp.ndarray]:
+    """Duplicate cache lines src→dst (GRPO sibling fan-out after one
+    shared prompt prefill). Padding rows use dst >= num_slots."""
+    k = cache["k"].at[:, dst].set(cache["k"][:, src], mode="drop")
+    v = cache["v"].at[:, dst].set(cache["v"][:, src], mode="drop")
+    lens = cache["lens"].at[dst].set(cache["lens"][src], mode="drop")
+    return {"k": k, "v": v, "lens": lens}
 
 
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def decode_step(
+def _decode_impl(
     params: Params,
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
     tokens: jnp.ndarray,  # [S] int32: current input token per slot
     active: jnp.ndarray,  # [S] bool
+    kv_bound: Optional[int],
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """All slots advance one position; returns logits [S, V] (fp32)."""
+    """All slots advance one position; returns logits [S, V] (fp32).
+
+    Attention reads only the first ``kv_bound`` cache positions (host
+    guarantees every active length + 1 fits inside it).
+    """
     s, m = cache["k"].shape[1], cache["k"].shape[2]
+    mb = m if kv_bound is None else min(kv_bound, m)
     positions = cache["lens"]  # [S] next position per slot
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     x = params["embedding"][tokens]  # [S, D]
-    arange_m = jnp.arange(m)
-    att_mask = arange_m[None, :] <= positions[:, None]  # [S, M] incl. new tok
+    att_mask = jnp.arange(mb)[None, :] <= positions[:, None]  # [S, mb]
+    scale = cfg.head_dim**-0.5
+    rep = cfg.num_heads // cfg.num_kv_heads
 
     def layer(carry, xs):
         x = carry  # [S, D]
-        lp, k_l, v_l = xs  # cache line [S, M, Hkv, D]
+        lp, k_l, v_l = xs  # cache line [S, mb, Hkv, Dh]
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _project_qkv(cfg, lp, h)  # q [S, Hq, Dh], k/v [S, Hkv, Dh]
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
-        # scatter new k/v at each slot's position
-        k_l = _scatter_token(k_l, k, positions)
-        v_l = _scatter_token(v_l, v, positions)
-        rep = cfg.num_heads // cfg.num_kv_heads
+        # scatter new k/v at each ACTIVE slot's position; inactive slots'
+        # lines (possibly freed-but-reusable prefixes longer than this
+        # dispatch's kv_bound) must not be touched — dynamic_update_slice
+        # clamps out-of-range starts, which would corrupt position mb-1
+        k_l = _scatter_token(k_l, k, positions, active)
+        v_l = _scatter_token(v_l, v, positions, active)
         kk = jnp.repeat(k_l, rep, axis=2) if rep > 1 else k_l
         vv = jnp.repeat(v_l, rep, axis=2) if rep > 1 else v_l
-        scores = jnp.einsum(
-            "shd,smhd->shm", q.astype(jnp.float32), kk.astype(jnp.float32)
-        ) * (cfg.head_dim**-0.5)
+        scores = (
+            jnp.einsum(
+                "shd,smhd->shm", q, kk, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
         scores = jnp.where(att_mask[:, None, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("shm,smhd->shd", probs, vv.astype(jnp.float32))
@@ -172,48 +270,36 @@ def decode_step(
         return x, (k_l, v_l)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+        layer, x, (params["layers"], cache["k"][:, :, :mb], cache["v"][:, :, :mb])
     )
     logits = _final_logits(params, cfg, x)  # [S, V]
     lens = jnp.where(active, positions + 1, positions)
-    return {"k": new_k, "v": new_v, "lens": lens}, logits
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def prefill_batch(
-    params: Params,
-    cfg: ModelConfig,
-    cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [N, Tp] int32 (N admissions, same bucket)
-    true_lens: jnp.ndarray,  # [N] int32 (0 = empty row, skipped)
-    slots: jnp.ndarray,  # [N] int32 (duplicate slot 0 for empty rows ok:
-    # they write 0 tokens because their mask is empty)
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Prefill N requests in ONE dispatch via lax.scan over rows.
-
-    Rows run sequentially on device (each is itself a big batched matmul
-    program) but the host pays a single dispatch+fetch round-trip for the
-    whole admission wave instead of one per request.
-    """
-
-    def row(cache, xs):
-        toks, tl, slot = xs
-
-        def do(c):
-            return prefill(params, cfg, c, toks, tl, slot)
-
-        def skip(c):
-            # padding row of a partial admission wave: touch nothing
-            return c, jnp.zeros((cfg.vocab_size,), jnp.float32)
-
-        return jax.lax.cond(tl > 0, do, skip, cache)
-
-    cache, logits = jax.lax.scan(row, cache, (tokens, true_lens, slots))
-    return cache, logits  # logits [N, V]
+    if mb < m:
+        cache_k = cache["k"].at[:, :, :mb].set(new_k)
+        cache_v = cache["v"].at[:, :, :mb].set(new_v)
+    else:
+        cache_k, cache_v = new_k, new_v
+    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps"), donate_argnames=("cache",)
+    jax.jit, static_argnames=("cfg", "kv_bound"), donate_argnames=("cache",)
+)
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [S] int32
+    active: jnp.ndarray,  # [S] bool
+    kv_bound: Optional[int] = None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    return _decode_impl(params, cfg, cache, tokens, active, kv_bound)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "kv_bound", "topk_bound"),
+    donate_argnames=("cache",),
 )
 def decode_multi(
     params: Params,
@@ -230,6 +316,8 @@ def decode_multi(
     top_k: jnp.ndarray,
     greedy: jnp.ndarray,
     steps: int,
+    kv_bound: Optional[int] = None,
+    topk_bound: int = 0,
 ):
     """`steps` fused decode+sample iterations in ONE dispatch, with stop
     handling on device — the host round-trip (which dominates serving
@@ -238,15 +326,19 @@ def decode_multi(
     its min_new_tokens window) or exhausts its budget; inactive slots stop
     advancing their cache line.
 
+    Host contract: ``max(lens) + steps <= kv_bound``.
+
     Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S] bool,
     active_after [S], remaining_after, no_stop_after).
     """
 
     def step(carry, step_key):
         cache, tokens, active, remaining, no_stop = carry
-        cache, toks, logps = decode_and_sample(
-            params, cfg, cache, tokens, active, step_key,
-            temperature, top_p, top_k, greedy,
+        cache, logits = _decode_impl(
+            params, cfg, cache, tokens, active, kv_bound
+        )
+        toks, logps = _sample_impl(
+            logits, step_key, temperature, top_p, top_k, greedy, topk_bound
         )
         emitted = active
         # a stop token may end the slot once it would have emitted
@@ -271,7 +363,11 @@ def decode_multi(
     return cache, toks, logps, emitted, active, remaining, no_stop
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "kv_bound", "topk_bound"),
+    donate_argnames=("cache",),
+)
 def decode_and_sample(
     params: Params,
     cfg: ModelConfig,
@@ -283,13 +379,15 @@ def decode_and_sample(
     top_p: jnp.ndarray,  # [S]
     top_k: jnp.ndarray,  # [S]
     greedy: jnp.ndarray,  # [S] bool
+    kv_bound: Optional[int] = None,
+    topk_bound: int = 0,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """Fused decode step + sampling: ONE dispatch and one host fetch per
     generation step (the per-step host round-trip is the latency floor of the
     serving loop, so everything between two steps stays on device)."""
-    cache, logits = decode_step(params, cfg, cache, tokens, active)
-    toks, logps = sample_tokens(
-        logits, key, temperature, top_p, top_k, greedy
+    cache, logits = _decode_impl(params, cfg, cache, tokens, active, kv_bound)
+    toks, logps = _sample_impl(
+        logits, key, temperature, top_p, top_k, greedy, topk_bound
     )
     return cache, toks, logps
 
@@ -298,30 +396,43 @@ def _scatter_token(
     cache_line: jnp.ndarray,  # [S, M, Hkv, D]
     new: jnp.ndarray,  # [S, Hkv, D]
     positions: jnp.ndarray,  # [S]
+    active: jnp.ndarray,  # [S] bool — inactive rows are left untouched
 ) -> jnp.ndarray:
     new = new.astype(cache_line.dtype)
 
-    def one(line, tok, pos):
-        return jax.lax.dynamic_update_slice(
-            line, tok[None], (pos, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    def one(line, tok, pos, act):
+        zero = jnp.zeros((), jnp.int32)
+        cur = jax.lax.dynamic_slice(
+            line, (pos, zero, zero), (1,) + line.shape[1:]
         )
+        tok = jnp.where(act, tok[None], cur)
+        return jax.lax.dynamic_update_slice(line, tok, (pos, zero, zero))
 
-    return jax.vmap(one)(cache_line, new, positions)
+    return jax.vmap(one)(cache_line, new, positions, active)
 
 
 # ---------------------------------------------------------------------------
 # Sampling
 # ---------------------------------------------------------------------------
-@jax.jit
-def sample_tokens(
+def _sample_impl(
     logits: jnp.ndarray,  # [S, V] fp32
     key: jax.Array,
     temperature: jnp.ndarray,  # [S]
     top_p: jnp.ndarray,  # [S]
     top_k: jnp.ndarray,  # [S] int32 (0 = disabled)
     greedy: jnp.ndarray,  # [S] bool
+    topk_bound: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-slot sampling; returns (tokens [S], logprobs [S]).
+
+    ``topk_bound`` picks the truncation strategy (static):
+      -1  no truncation anywhere (all slots top_p>=1, top_k=0) — a single
+          ``categorical`` over the scaled logits; no sort at all.
+       0  exact full-vocab sort (argsort) — the always-correct fallback.
+      K>0 ``lax.top_k(K)`` candidates, top-k/top-p masks applied within
+          them — the fast serving path (host picks K >= every slot's
+          top_k; top_p truncation beyond K candidates is approximated,
+          standard practice on accelerator serving stacks).
 
     The returned logprob is under the temperature-scaled (untruncated)
     distribution — the behavior-policy logprob the trainer consumes
@@ -335,22 +446,40 @@ def sample_tokens(
     scaled = logits / temp
     logp_full = jax.nn.log_softmax(scaled, axis=-1)
 
-    # top-k / top-p truncation for the *sampling* distribution
-    sort_idx = jnp.argsort(-scaled, axis=-1)
-    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumprobs = jnp.cumsum(sorted_probs, axis=-1)
-    rank = jnp.arange(v)[None, :]
-    keep = jnp.ones((s, v), bool)
-    keep &= jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
-    # keep tokens while cumulative prob (exclusive) < top_p
-    keep &= (cumprev := cumprobs - sorted_probs) < top_p[:, None]
-    keep = keep.at[:, 0].set(True)  # always keep the argmax token
-    trunc_sorted = jnp.where(keep, sorted_logits, NEG_INF)
-    trunc = jnp.full_like(scaled, NEG_INF).at[
-        jnp.arange(s)[:, None], sort_idx
-    ].set(trunc_sorted)
-    sampled = jax.random.categorical(key, trunc, axis=-1)
+    if topk_bound < 0:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    elif topk_bound > 0:
+        kb = min(topk_bound, v)
+        vals, idx = jax.lax.top_k(scaled, kb)  # [S, kb]
+        # top_p cutoffs are defined against the FULL-vocab distribution, not
+        # renormalized over the kb candidates (matching the exact path)
+        cand_probs = jnp.exp(jnp.take_along_axis(logp_full, idx, axis=-1))
+        cumprev = jnp.cumsum(cand_probs, axis=-1) - cand_probs
+        rank = jnp.arange(kb)[None, :]
+        keep = jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
+        keep &= cumprev < top_p[:, None]
+        keep = keep.at[:, 0].set(True)  # always keep the argmax token
+        trunc = jnp.where(keep, vals, NEG_INF)
+        choice = jax.random.categorical(key, trunc, axis=-1)
+        sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    else:
+        # exact path: full sort (slow; tests / host-side calls)
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumprobs = jnp.cumsum(sorted_probs, axis=-1)
+        rank = jnp.arange(v)[None, :]
+        keep = jnp.ones((s, v), bool)
+        keep &= jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
+        # keep tokens while cumulative prob (exclusive) < top_p
+        keep &= (cumprobs - sorted_probs) < top_p[:, None]
+        keep = keep.at[:, 0].set(True)  # always keep the argmax token
+        trunc_sorted = jnp.where(keep, sorted_logits, NEG_INF)
+        trunc = jnp.full_like(scaled, NEG_INF).at[
+            jnp.arange(s)[:, None], sort_idx
+        ].set(trunc_sorted)
+        sampled = jax.random.categorical(key, trunc, axis=-1)
+
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
     # Greedy slots ignore temperature when picking the token, so report the
@@ -365,3 +494,18 @@ def sample_tokens(
     ).squeeze(-1)
     logprobs = jnp.where(greedy, lp_greedy, lp_sampled)
     return tokens, logprobs
+
+
+@functools.partial(jax.jit, static_argnames=("topk_bound",))
+def sample_tokens(
+    logits: jnp.ndarray,  # [S, V] fp32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [S]
+    top_p: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S] int32 (0 = disabled)
+    greedy: jnp.ndarray,  # [S] bool
+    topk_bound: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return _sample_impl(
+        logits, key, temperature, top_p, top_k, greedy, topk_bound
+    )
