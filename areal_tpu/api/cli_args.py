@@ -192,6 +192,10 @@ class JaxGenConfig:
     # this wave size so the program shape is static per bucket); identical
     # prompts (GRPO siblings) share one row + a KV line copy
     admit_wave: int = 8
+    # newly queued requests are held up to this long (while decode has work
+    # or the queue is still filling) so admission waves arrive full — every
+    # distinct wave shape is a separate XLA compile
+    admit_hold_s: float = 0.05
     # decode attention reads cache lines bucketed to this quantum above the
     # longest active sequence (instead of always max_model_len)
     kv_bucket: int = 256

@@ -106,6 +106,7 @@ class GenerationEngine:
         self._command_queue: "queue.Queue" = queue.Queue()
         self._active: Dict[int, _Request] = {}  # slot -> request
         self._pending: List[_Request] = []  # drained but not yet admitted
+        self._pending_since: Optional[float] = None
         # freed slot -> tokens its cache line still holds (prefix reuse);
         # flushed on weight update (stale-KV guard)
         self._freed_prefix: Dict[int, np.ndarray] = {}
@@ -314,13 +315,24 @@ class GenerationEngine:
         behind ONE prefill row + KV line copies; unique prompts prefill as
         one batched [N, Tp] dispatch, each row resuming from its slot's
         reusable cached prefix (offset)."""
+        got_new = 0
         while True:
             try:
                 self._pending.append(self._admit_queue.get_nowait())
+                got_new += 1
             except queue.Empty:
                 break
         if not self._pending or self.allocator.n_free == 0:
             return False
+        if self._pending_since is None:
+            self._pending_since = time.monotonic()
+        # hold while the queue is still filling (or decode has work) so
+        # admission waves arrive full — every distinct wave shape compiles
+        # its own XLA program
+        age = time.monotonic() - self._pending_since
+        if age < self.config.admit_hold_s and (got_new or self._active):
+            return False
+        self._pending_since = None
         wave = max(1, self.config.admit_wave)
         # --- select: group identical prompts; <= wave unique prompts,
         # total admitted <= free slots ---
@@ -462,17 +474,17 @@ class GenerationEngine:
         return True
 
     def _kv_bound(self, steps: int) -> int:
-        """Static decode-attention bound: bucketed longest active length
-        + the steps this dispatch will add."""
+        """Static decode-attention bound: bucketed longest CACHED length.
+        decode_multi's chunk buffer carries the in-flight tokens, so the
+        bound only needs to cover what's already in the cache."""
+        del steps
         max_len = max(
             len(r.input_ids) + len(r.output_ids)
             for r in self._active.values()
         )
         return min(
             self.config.max_model_len,
-            data_utils.next_bucket_size(
-                max_len + steps + 1, self.config.kv_bucket
-            ),
+            data_utils.next_bucket_size(max_len, self.config.kv_bucket),
         )
 
     def _sampling_mode(self) -> int:
@@ -482,12 +494,14 @@ class GenerationEngine:
         reqs = self._active.values()
         if all(r.top_p >= 1.0 and r.top_k <= 0 for r in reqs):
             return -1
+        if self.config.sample_topk_bound <= 0:
+            return 0  # exact full-vocab sort requested
         mx = max((r.top_k for r in reqs), default=0)
         # bucketed so varying client top_k values don't each force a fresh
         # XLA compile of the fused decode program
         return data_utils.next_bucket_size(
             max(self.config.sample_topk_bound, mx),
-            max(1, self.config.sample_topk_bound),
+            self.config.sample_topk_bound,
         )
 
     def _decode(self) -> bool:
@@ -510,22 +524,29 @@ class GenerationEngine:
         )
         self._cur_tokens = toks[-1]
         self._active_dev = active_after
-        # the ONE host fetch per `steps` generated tokens
-        h_toks, h_logps, h_emitted, h_active = jax.device_get(
-            (toks, logps, emitted, active_after)
+        # the ONE host fetch per `steps` generated tokens (packed: each
+        # separate array fetch is a full round-trip over a driver tunnel)
+        s = self.cache_config.num_slots
+        packed = np.asarray(
+            model_runner.pack_host(toks, logps, emitted, active_after)
         )
+        n = steps * s
+        h_toks = packed[:n].reshape(steps, s).astype(np.int64)
+        h_logps = packed[n : 2 * n].reshape(steps, s)
+        h_emitted = packed[2 * n : 3 * n].reshape(steps, s) > 0.5
+        h_active = packed[3 * n : 3 * n + s] > 0.5
         now = time.monotonic()
         for slot in list(self._active):
             req = self._active[slot]
             stopped_host = False
-            for s in range(steps):
-                if not h_emitted[s, slot]:
+            for t in range(steps):
+                if not h_emitted[t, slot]:
                     break
                 if req.first_token_time is None:
                     req.first_token_time = now
-                tok = int(h_toks[s, slot])
+                tok = int(h_toks[t, slot])
                 req.output_ids.append(tok)
-                req.output_logprobs.append(float(h_logps[s, slot]))
+                req.output_logprobs.append(float(h_logps[t, slot]))
                 req.output_versions.append(self.model_version)
                 self.total_generated_tokens += 1
                 # host backstop over the FULL stop list (the device buffer
@@ -555,9 +576,13 @@ class GenerationEngine:
             self._greedy_dev, topk_bound=self._sampling_mode(),
         )
         # record sampled tokens as the next decode inputs for these slots
-        for slot in only_slots:
-            self._cur_tokens = self._cur_tokens.at[slot].set(toks[slot])
-        host_toks, host_logps = jax.device_get((toks, logps))
+        # (one batched scatter, one packed host fetch)
+        sl = jnp.asarray(np.asarray(only_slots, np.int32))
+        self._cur_tokens = self._cur_tokens.at[sl].set(toks[sl])
+        s = self.cache_config.num_slots
+        packed = np.asarray(model_runner.pack_host(toks, logps))
+        host_toks = packed[:s].astype(np.int64)
+        host_logps = packed[s:]
         self._append_sampled(host_toks, host_logps, only_slots)
 
     def _append_sampled(

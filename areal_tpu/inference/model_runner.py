@@ -103,7 +103,7 @@ def _prefill_impl(
     # key j visible to row-i query at suffix index s iff j <= offset_i + s
     att_mask = jnp.arange(mb)[None, None, :] <= pos[:, :, None]  # [N, Tp, mb]
     scale = cfg.head_dim**-0.5
-    rep = cfg.num_heads // cfg.num_kv_heads
+    g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
     k_all = cache["k"][:, :, :mb]
     v_all = cache["v"][:, :, :mb]
@@ -122,19 +122,22 @@ def _prefill_impl(
         vz = jnp.where(valid_q[..., None, None], v, 0).astype(v_lines.dtype)
         rows_k = jax.vmap(upd)(k_lines[slots], kz, offsets)  # [N, mb, Hkv, Dh]
         rows_v = jax.vmap(upd)(v_lines[slots], vz, offsets)
-        kk = jnp.repeat(rows_k, rep, axis=2) if rep > 1 else rows_k
-        vv = jnp.repeat(rows_v, rep, axis=2) if rep > 1 else rows_v
+        # GQA without materializing repeated KV: queries grouped by their
+        # shared kv head (head h uses group h // rep — HF layout); bf16
+        # stays on the MXU, accumulation fp32
+        qg = q.reshape(n, tp, g, rep, cfg.head_dim)
         scores = (
             jnp.einsum(
-                "nqhd,nkhd->nhqk", q, kk,
+                "nqgrd,nkgd->ngrqk", qg, rows_k,
                 preferred_element_type=jnp.float32,
             )
             * scale
         )
-        scores = jnp.where(att_mask[:, None], scores, NEG_INF)
+        scores = jnp.where(att_mask[:, None, None], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
-            "nhqk,nkhd->nqhd", probs, vv.astype(jnp.float32)
+            "ngrqk,nkgd->nqgrd", probs.astype(rows_v.dtype), rows_v,
+            preferred_element_type=jnp.float32,
         )
         attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
         x = x + attn @ lp["wo"]
@@ -237,7 +240,7 @@ def _decode_impl(
     x = params["embedding"][tokens]  # [S, D]
     att_mask = jnp.arange(mb)[None, :] <= positions[:, None]  # [S, mb]
     scale = cfg.head_dim**-0.5
-    rep = cfg.num_heads // cfg.num_kv_heads
+    g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
     def layer(carry, xs):
         x = carry  # [S, D]
@@ -252,17 +255,22 @@ def _decode_impl(
         # clamps out-of-range starts, which would corrupt position mb-1
         k_l = _scatter_token(k_l, k, positions, active)
         v_l = _scatter_token(v_l, v, positions, active)
-        kk = jnp.repeat(k_l, rep, axis=2) if rep > 1 else k_l
-        vv = jnp.repeat(v_l, rep, axis=2) if rep > 1 else v_l
+        # GQA without materializing repeated KV (the decode step is HBM
+        # bound on exactly these cache-line reads)
+        qg = q.reshape(s, g, rep, cfg.head_dim)
         scores = (
             jnp.einsum(
-                "shd,smhd->shm", q, kk, preferred_element_type=jnp.float32
+                "sgrd,smgd->sgrm", qg, k_l,
+                preferred_element_type=jnp.float32,
             )
             * scale
         )
-        scores = jnp.where(att_mask[:, None, :], scores, NEG_INF)
+        scores = jnp.where(att_mask[:, None, None, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("shm,smhd->shd", probs, vv.astype(jnp.float32))
+        attn = jnp.einsum(
+            "sgrm,smgd->sgrd", probs.astype(v_l.dtype), v_l,
+            preferred_element_type=jnp.float32,
+        )
         attn = attn.astype(x.dtype).reshape(s, cfg.q_dim)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
@@ -326,17 +334,95 @@ def decode_multi(
     its min_new_tokens window) or exhausts its budget; inactive slots stop
     advancing their cache line.
 
-    Host contract: ``max(lens) + steps <= kv_bound``.
+    The big KV cache is READ-ONLY inside the step loop — mutating a
+    multi-hundred-MB loop carry costs a full copy per step on TPU. New
+    tokens' K/V accumulate in a small ``[L, S, steps]`` chunk buffer;
+    attention covers the (bounded) cached window plus the chunk window;
+    one bulk scatter merges the chunk into the cache at the end.
+
+    Host contract: ``max(lens) <= kv_bound`` (the chunk window carries the
+    in-flight tokens, so the bound needn't cover ``+ steps``).
 
     Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S] bool,
     active_after [S], remaining_after, no_stop_after).
     """
+    s, m = cache["k"].shape[1], cache["k"].shape[2]
+    mb = m if kv_bound is None else min(kv_bound, m)
+    g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
+    nl = cfg.num_layers
+    pos0 = cache["lens"]  # [S] cached tokens per slot (fixed this chunk)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    srange = jnp.arange(s)
+    cache_mask = jnp.arange(mb)[None, :] < pos0[:, None]  # [S, mb] static
+    k_ro = cache["k"][:, :, :mb]  # read-only views
+    v_ro = cache["v"][:, :, :mb]
+    scale = cfg.head_dim**-0.5
 
     def step(carry, step_key):
-        cache, tokens, active, remaining, no_stop = carry
-        cache, logits = _decode_impl(
-            params, cfg, cache, tokens, active, kv_bound
+        kbuf, vbuf, tokens, clen, active, remaining, no_stop = carry
+        x = params["embedding"][tokens]  # [S, D]
+        pos = pos0 + clen
+
+        def layer(xc, xs):
+            x, kbuf, vbuf = xc
+            lp, li = xs
+            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+            q, k, v = _project_qkv(cfg, lp, h)
+            q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
+            k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
+            # new token K/V → chunk buffer (inactive slots drop)
+            ci = jnp.where(active, clen, steps)
+            kbuf = kbuf.at[li, srange, ci].set(
+                k.astype(kbuf.dtype), mode="drop"
+            )
+            vbuf = vbuf.at[li, srange, ci].set(
+                v.astype(vbuf.dtype), mode="drop"
+            )
+            k_l = jax.lax.dynamic_index_in_dim(k_ro, li, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(v_ro, li, 0, keepdims=False)
+            kb = jax.lax.dynamic_index_in_dim(kbuf, li, 0, keepdims=False)
+            vb = jax.lax.dynamic_index_in_dim(vbuf, li, 0, keepdims=False)
+            # GQA grouped attention over cached ++ chunk windows
+            qg = q.reshape(s, g, rep, cfg.head_dim)
+            sc = (
+                jnp.einsum(
+                    "sgrd,smgd->sgrm", qg, k_l,
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            sc = jnp.where(cache_mask[:, None, None, :], sc, NEG_INF)
+            sb = (
+                jnp.einsum(
+                    "sgrd,stgd->sgrt", qg, kb,
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            chunk_mask = jnp.arange(steps)[None, :] <= clen[:, None]
+            sb = jnp.where(chunk_mask[:, None, None, :], sb, NEG_INF)
+            probs = jax.nn.softmax(
+                jnp.concatenate([sc, sb], axis=-1), axis=-1
+            )
+            pc, pb = probs[..., :mb], probs[..., mb:]
+            attn = jnp.einsum(
+                "sgrm,smgd->sgrd", pc.astype(v_l.dtype), v_l,
+                preferred_element_type=jnp.float32,
+            ) + jnp.einsum(
+                "sgrt,stgd->sgrd", pb.astype(vb.dtype), vb,
+                preferred_element_type=jnp.float32,
+            )
+            x = x + attn.astype(x.dtype).reshape(s, cfg.q_dim) @ lp["wo"]
+            h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(lp, h2)
+            return (x, kbuf, vbuf), None
+
+        (x, kbuf, vbuf), _ = jax.lax.scan(
+            layer, (x, kbuf, vbuf), (params["layers"], jnp.arange(nl))
         )
+        logits = _final_logits(params, cfg, x)
         toks, logps = _sample_impl(
             logits, step_key, temperature, top_p, top_k, greedy, topk_bound
         )
@@ -346,20 +432,34 @@ def decode_multi(
         hit_stop = jnp.any(
             toks[:, None] == stop_tokens, axis=1
         ) & (no_stop <= 1)
+        clen = clen + active
         remaining = jnp.where(active, remaining - 1, remaining)
         no_stop = jnp.where(active, no_stop - 1, no_stop)
         active = active & ~hit_stop & (remaining > 0)
-        tokens = toks
-        return (cache, tokens, active, remaining, no_stop), (
+        return (kbuf, vbuf, toks, clen, active, remaining, no_stop), (
             toks, logps, emitted,
         )
 
-    keys = jax.random.split(key, steps)
-    (cache, tokens, active, remaining, no_stop), (toks, logps, emitted) = (
-        jax.lax.scan(
-            step, (cache, tokens, active, remaining, no_stop_before), keys
-        )
+    kbuf0 = jnp.zeros(
+        (nl, s, steps, g, cfg.head_dim), cache["k"].dtype
     )
+    vbuf0 = jnp.zeros_like(kbuf0)
+    keys = jax.random.split(key, steps)
+    (kbuf, vbuf, tokens, clen, active, remaining, no_stop), (
+        toks, logps, emitted,
+    ) = jax.lax.scan(
+        step,
+        (kbuf0, vbuf0, tokens, jnp.zeros(s, jnp.int32), active,
+         remaining, no_stop_before),
+        keys,
+    )
+    # bulk merge: chunk buffer → cache at absolute positions (one scatter)
+    tgrid = jnp.arange(steps)[None, :]
+    tgt = jnp.where(tgrid < clen[:, None], pos0[:, None] + tgrid, m)  # [S, T]
+    cache_k = cache["k"].at[:, srange[:, None], tgt].set(kbuf, mode="drop")
+    cache_v = cache["v"].at[:, srange[:, None], tgt].set(vbuf, mode="drop")
+    lens = pos0 + clen
+    cache = {"k": cache_k, "v": cache_v, "lens": lens}
     return cache, toks, logps, emitted, active, remaining, no_stop
 
 
@@ -494,6 +594,16 @@ def _sample_impl(
     ).squeeze(-1)
     logprobs = jnp.where(greedy, lp_greedy, lp_sampled)
     return tokens, logprobs
+
+
+@jax.jit
+def pack_host(*arrays) -> jnp.ndarray:
+    """Flatten+concat device arrays into ONE float32 blob so the host pays
+    a single fetch round-trip (over a driver tunnel each array fetch is a
+    full RPC; int32 token ids are exact in f32 below 2^24)."""
+    return jnp.concatenate(
+        [a.reshape(-1).astype(jnp.float32) for a in arrays]
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("topk_bound",))

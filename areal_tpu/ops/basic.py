@@ -85,20 +85,24 @@ def segment_attention(
     """
     b, t, hq, d = q.shape
     hkv = k.shape[2]
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = hq // hkv
     scale = d ** -0.5
+    # GQA via grouped einsum — no materialized KV repeat (head h reads kv
+    # group h // rep, HF layout); bf16 inputs stay on the MXU with fp32
+    # accumulation
+    qg = q.reshape(b, t, hkv, rep, d)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
     mask = make_segment_mask(segment_ids, segment_ids, causal=causal)
-    logits = jnp.where(mask[:, None, :, :], logits, -2.3819763e38)
+    logits = jnp.where(mask[:, None, None, :, :], logits, -2.3819763e38)
     probs = jax.nn.softmax(logits, axis=-1)
     # fully-masked (padding) rows: softmax of all -inf → near-uniform garbage;
     # zero them so padding tokens contribute exactly nothing downstream.
-    valid_q = (segment_ids > 0)[:, None, :, None]
+    valid_q = (segment_ids > 0)[:, None, None, :, None]
     probs = jnp.where(valid_q, probs, 0.0)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", probs, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, hq, d).astype(q.dtype)

@@ -1,0 +1,101 @@
+"""Analytic FLOPs model for the llama-family decoder + TPU peak tables.
+
+Role of the reference's FLOPs counter feeding TFLOP/s logs
+(realhf/base/monitor.py:288-402, realhf/system/master_worker.py:497-536),
+re-derived for this repo's model geometry. Counts MATMUL flops only
+(norms/elementwise are bandwidth, not MXU work):
+
+- per-token projection flops: 2 * (weights touched per token)
+- causal self-attention: QK^T and PV are each ``2 * len^2/2 * Hq * Dh``
+  per layer per sequence → ``2 * len^2 * Hq * Dh * L`` total
+- decode (one token over a ctx-long cache): 2 * W per token +
+  ``4 * ctx * Hq * Dh`` per layer
+
+MFU = executed matmul flops / elapsed / device peak. Backward counts 2×
+forward; rematerialized forward (gradient checkpointing) is NOT counted as
+useful work (standard MFU convention).
+"""
+
+from typing import Iterable, Optional
+
+from areal_tpu.models.config import ModelConfig
+
+# bf16 peak matmul FLOP/s per chip by device_kind substring (first match
+# wins). Sources: public TPU spec sheets.
+_PEAK_FLOPS = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),  # Trillium
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def device_peak_flops(device_kind: str) -> Optional[float]:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def matmul_weights(cfg: ModelConfig, with_head: bool = True) -> int:
+    """Total matmul-weight elements touched by one token's forward pass."""
+    d = cfg.hidden_size
+    per_layer = (
+        d * cfg.q_dim  # wq
+        + 2 * d * cfg.kv_dim  # wk, wv
+        + cfg.q_dim * d  # wo
+        + 3 * d * cfg.intermediate_size  # gate, up, down
+    )
+    total = cfg.num_layers * per_layer
+    if with_head:
+        total += d * cfg.vocab_size  # lm_head (tied or not, same matmul)
+    return total
+
+
+def attn_flops(cfg: ModelConfig, seq_lens: Iterable[int]) -> float:
+    """Causal self-attention matmul flops for full forward over sequences."""
+    hd = cfg.num_heads * cfg.head_dim
+    return float(
+        sum(2.0 * (n * n) * hd * cfg.num_layers for n in seq_lens)
+    )
+
+
+def forward_flops(cfg: ModelConfig, seq_lens: Iterable[int]) -> float:
+    """One forward pass over packed sequences (projection + attention)."""
+    seq_lens = list(seq_lens)
+    tokens = sum(seq_lens)
+    return 2.0 * tokens * matmul_weights(cfg) + attn_flops(cfg, seq_lens)
+
+
+def train_step_flops(
+    cfg: ModelConfig,
+    seq_lens: Iterable[int],
+    n_forward_only: int = 0,
+) -> float:
+    """fwd + bwd (2x fwd) over `seq_lens`, plus `n_forward_only` extra pure
+    forward passes over the same data (logprob recomputes: behavior +
+    reference policies)."""
+    f = forward_flops(cfg, list(seq_lens))
+    return (3.0 + n_forward_only) * f
+
+
+def prefill_flops(cfg: ModelConfig, prompt_lens: Iterable[int]) -> float:
+    return forward_flops(cfg, prompt_lens)
+
+
+def decode_flops(
+    cfg: ModelConfig, n_tokens: int, avg_ctx: float
+) -> float:
+    """`n_tokens` single-token decode steps at average cache length
+    `avg_ctx` (per-token: full projection stack + 2 ctx-long matmuls per
+    layer)."""
+    hd = cfg.num_heads * cfg.head_dim
+    per_tok = 2.0 * matmul_weights(cfg) + (
+        4.0 * avg_ctx * hd * cfg.num_layers
+    )
+    return n_tokens * per_tok

@@ -7,25 +7,24 @@ logp → advantage computation → decoupled-PPO update
 (benchmark/verl_v0_3_0_post1_76084d3/README.md conventions: only
 trainer-consumed tokens count).
 
-Model: Qwen2-0.5B geometry, random init, bf16. Workload: 32 samples
-(8 prompts × 4), 64-token prompts, 128 new tokens.
+Model: Qwen2-0.5B geometry, random init, bf16. Workload: 128 samples
+(16 prompts × 8 — GRPO grouping exercises the sibling KV dedup),
+128-token prompts, 256 new tokens, 1024 max context.
 
 ``vs_baseline`` derivation: AReaL v0.3 reports 1000 async GRPO steps of
 512 prompts × 16 samples in 14.8 h on 128 H800s for the 1.5B model
 (blog/AReaL_v0_3.md:176-181) → 8192 samples / 53.3 s / 128 ≈ 1.2 effective
 samples/s per device. GSM8K-style samples average ≈700 tokens, and a 0.5B
 model is ≈3× cheaper per token than 1.5B, so the comparable per-device
-baseline for this workload is ≈ 1.2 × (700/192) × 3 ≈ 13 samples/s/device
-→ in tokens: ≈ 2520 effective tokens/s/device. This anchors vs_baseline
-until multi-chip runs use the reference workload directly.
+baseline for this workload is ≈ 1.2 × (700/384) × 3 ≈ 6.6 samples/s/device
+→ in tokens: ≈ 2520 effective tokens/s/device. The measured MFU numbers in
+``extra`` anchor this guess-chain to hardware truth.
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 """
 
 import json
-import os
-import sys
 import time
 
 import numpy as np
@@ -38,7 +37,6 @@ def main():
     import jax.numpy as jnp
 
     from areal_tpu.api.cli_args import (
-        GenerationHyperparameters,
         JaxGenConfig,
         MicroBatchSpec,
         OptimizerConfig,
@@ -52,6 +50,7 @@ def main():
     from areal_tpu.models.config import ModelConfig
     from areal_tpu.models.transformer import init_params
     from areal_tpu.utils import data as data_utils
+    from areal_tpu.utils import flops as flops_util
 
     model_cfg = ModelConfig(
         vocab_size=32768,
@@ -68,8 +67,8 @@ def main():
         attention_bias=True,
         family="qwen2",
     )
-    n_prompts, group_size = 8, 4
-    prompt_len, max_new = 64, 128
+    n_prompts, group_size = 16, 8
+    prompt_len, max_new = 128, 256
     n_samples = n_prompts * group_size
 
     params = init_params(model_cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
@@ -77,9 +76,11 @@ def main():
         JaxGenConfig(
             dtype="bfloat16",
             max_num_seqs=n_samples,
-            max_model_len=512,
+            max_model_len=1024,
             prefill_chunk=128,
             decode_chunk=32,
+            admit_wave=16,
+            kv_bucket=128,
         ),
         model_config=model_cfg,
         params=params,
@@ -90,7 +91,7 @@ def main():
         param_dtype="bfloat16",
         gradient_checkpointing=True,
         attn_impl="flash",
-        mb_spec=MicroBatchSpec(max_tokens_per_mb=8192),
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=16384),
         optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
         parallel=ParallelismConfig(),
         group_size=group_size,
@@ -162,18 +163,66 @@ def main():
         stats = actor.ppo_update(out)
         step_time = time.perf_counter() - t0
         tokens = int(batch["attention_mask"].sum())
-        return step_time, rollout_done - t0, tokens, stats
+        seq_lens = [len(p) + len(r["output_ids"]) for p, r in zip(prompts, results)]
+        return step_time, rollout_done - t0, tokens, seq_lens, stats
 
     # warmup (compiles prefill/decode/sample/grad/apply/forward programs)
     one_step()
+    gen_before = gen.metrics()
     # measured steps
-    times, toks = [], []
-    for _ in range(2):
-        step_time, rollout_time, tokens, stats = one_step()
+    n_steps = 2
+    times, rtimes, toks, all_lens = [], [], [], []
+    for _ in range(n_steps):
+        step_time, rollout_time, tokens, seq_lens, stats = one_step()
         times.append(step_time)
+        rtimes.append(rollout_time)
         toks.append(tokens)
+        all_lens.extend(seq_lens)
+    gen_after = gen.metrics()
     eff_tokens_per_sec = sum(toks) / sum(times)
-    samples_per_sec = (2 * n_samples) / sum(times)
+    samples_per_sec = (n_steps * n_samples) / sum(times)
+
+    # --- measured MFU (executed matmul flops / elapsed / chip peak) ---
+    prompt_toks = (
+        gen_after["total_prompt_tokens"] - gen_before["total_prompt_tokens"]
+    )
+    cached_toks = (
+        gen_after["total_cached_prompt_tokens"]
+        - gen_before["total_cached_prompt_tokens"]
+    )
+    gen_toks = (
+        gen_after["total_generated_tokens"]
+        - gen_before["total_generated_tokens"]
+    )
+    prefilled = max(0, prompt_toks - cached_toks)
+    avg_ctx = float(np.mean(all_lens)) * 0.75  # decode ctx grows linearly
+    rollout_flops = flops_util.prefill_flops(
+        model_cfg, [prompt_len] * max(1, prefilled // prompt_len)
+    ) + flops_util.decode_flops(model_cfg, gen_toks, avg_ctx)
+    # ppo path: 1 train fwd+bwd + 2 forward-only logp passes (behavior
+    # recompute + proximal) over the packed batch
+    train_flops = flops_util.train_step_flops(
+        model_cfg, all_lens, n_forward_only=2
+    )
+    train_time = sum(times) - sum(rtimes)
+    peak = flops_util.device_peak_flops(jax.devices()[0].device_kind)
+    extra = {
+        "samples_per_sec": round(samples_per_sec, 3),
+        "step_time_s": round(sum(times) / n_steps, 3),
+        "rollout_time_s": round(sum(rtimes) / n_steps, 3),
+        "train_time_s": round(train_time / n_steps, 3),
+        "rollout_frac": round(sum(rtimes) / sum(times), 3),
+        "tokens_per_step": int(sum(toks) / n_steps),
+        "gen_tokens_per_sec": round(gen_toks / sum(rtimes), 1),
+        "cached_prompt_tokens": int(cached_toks),
+        "device": jax.devices()[0].device_kind,
+    }
+    if peak:
+        extra["mfu_rollout"] = round(rollout_flops / sum(rtimes) / peak, 4)
+        extra["mfu_train"] = round(train_flops / max(train_time, 1e-9) / peak, 4)
+        extra["mfu_e2e"] = round(
+            (rollout_flops + train_flops) / sum(times) / peak, 4
+        )
     result = {
         "metric": "grpo_effective_tokens_per_sec_per_device",
         "value": round(eff_tokens_per_sec, 2),
@@ -182,11 +231,7 @@ def main():
             eff_tokens_per_sec / BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE,
             4,
         ),
-        "extra": {
-            "samples_per_sec": round(samples_per_sec, 3),
-            "step_time_s": round(sum(times) / len(times), 3),
-            "tokens_per_step": int(sum(toks) / len(toks)),
-        },
+        "extra": extra,
     }
     gen.stop()
     print(json.dumps(result))

@@ -98,6 +98,93 @@ def test_two_slots_decode_independently(setup):
     assert int(jnp.argmax(logits[1])) == ref1
 
 
+def test_decode_multi_matches_stepwise(setup):
+    """Fused multi-step decode (chunk-buffer attention) == repeated
+    decode_step + greedy sampling, including cache state and early stop."""
+    cfg, params, ccfg = setup
+    s = ccfg.num_slots
+    rng = np.random.default_rng(7)
+    p0 = rng.integers(0, cfg.vocab_size, size=6).tolist()
+    p1 = rng.integers(0, cfg.vocab_size, size=9).tolist()
+
+    def prefill_two(cache):
+        for i, p in enumerate((p0, p1)):
+            pad = np.zeros(16, np.int32)
+            pad[: len(p)] = p
+            cache, lg = model_runner.prefill(
+                params, cfg, cache, jnp.asarray(pad),
+                jnp.asarray(len(p), jnp.int32), jnp.asarray(i, jnp.int32),
+            )
+            yield cache, lg
+
+    cache_a = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    gen_a = prefill_two(cache_a)
+    (cache_a, l0), (cache_a, l1) = gen_a
+    cache_b = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    gen_b = prefill_two(cache_b)
+    (cache_b, _), (cache_b, _) = gen_b
+
+    t0, t1 = int(jnp.argmax(l0)), int(jnp.argmax(l1))
+    tokens = jnp.zeros((s,), jnp.int32).at[0].set(t0).at[1].set(t1)
+    active = jnp.zeros((s,), bool).at[0].set(True).at[1].set(True)
+    steps = 5
+    greedy = jnp.ones(s, bool)
+    ones = jnp.ones(s)
+    zk = jnp.zeros(s, jnp.int32)
+
+    # A: fused decode_multi
+    cache_a, toks_a, logps_a, emitted_a, active_a, _, _ = (
+        model_runner.decode_multi(
+            params, cfg, cache_a, tokens, active,
+            jnp.full((s,), 100, jnp.int32), jnp.zeros(s, jnp.int32),
+            jnp.full((s, 4), -1, jnp.int32), jax.random.PRNGKey(0),
+            ones, ones, zk, greedy, steps=steps, kv_bound=32,
+        )
+    )
+    # B: stepwise decode_step + argmax
+    cur = tokens
+    toks_b = []
+    for _ in range(steps):
+        cache_b, logits = model_runner.decode_step(
+            params, cfg, cache_b, cur, active
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks_b.append(np.asarray(nxt))
+        cur = nxt
+    toks_b = np.stack(toks_b)
+    np.testing.assert_array_equal(
+        np.asarray(toks_a)[:, :2], toks_b[:, :2]
+    )
+    assert bool(np.all(np.asarray(emitted_a)[:, :2]))
+    # cache state converged identically (active slots' lines + lens)
+    assert int(cache_a["lens"][0]) == int(cache_b["lens"][0]) == 6 + steps
+    np.testing.assert_allclose(
+        np.asarray(cache_a["k"][:, :2, : 9 + steps]),
+        np.asarray(cache_b["k"][:, :2, : 9 + steps]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    # early stop inside the chunk: use the 3rd emitted token as a stop id
+    stop_id = int(toks_b[2, 0])
+    cache_c = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    gen_c = prefill_two(cache_c)
+    (cache_c, _), (cache_c, _) = gen_c
+    stops = jnp.full((s, 4), -1, jnp.int32).at[0, 0].set(stop_id)
+    cache_c, toks_c, _, emitted_c, active_c, _, _ = (
+        model_runner.decode_multi(
+            params, cfg, cache_c, tokens, active,
+            jnp.full((s,), 100, jnp.int32), jnp.zeros(s, jnp.int32),
+            stops, jax.random.PRNGKey(0),
+            ones, ones, zk, greedy, steps=steps, kv_bound=32,
+        )
+    )
+    em = np.asarray(emitted_c)[:, 0]
+    # slot 0 emitted exactly 3 tokens (stop token is the 3rd)
+    assert em.sum() == 3 and not bool(active_c[0])
+    # slot 1 unaffected
+    np.testing.assert_array_equal(np.asarray(toks_c)[:, 1], toks_b[:, 1])
+
+
 def test_sampling_modes():
     key = jax.random.PRNGKey(0)
     logits = jnp.asarray(
