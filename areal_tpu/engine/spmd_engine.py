@@ -178,15 +178,39 @@ class SPMDTrainEngine(TrainEngine):
     def _batch_sharding(self):
         return sharding_lib.batch_sharding(self.mesh)
 
+    def _mb_pad_to(self, mbs: List[Batch]) -> Optional[int]:
+        """Static per-row token pad for multi-microbatch steps: every
+        microbatch pads to ONE shared bucket (sized from the largest
+        microbatch, not the cap — min_n_mbs-forced splits of small batches
+        must not pay near-cap compute), so the expensive grad program
+        compiles once instead of per FFD-packed size."""
+        if len(mbs) <= 1:
+            return None
+        rows = self._dp_rows()
+        seq_mult = self.config.parallel.seq_parallel_size
+        biggest = max(
+            int(np.asarray(mb["attention_mask"]).sum()) for mb in mbs
+        )
+        return data_utils.next_bucket_size(
+            -(-biggest // rows), 256 * seq_mult
+        )
+
     def _pack_for_device(
-        self, mb: Batch
+        self, mb: Batch, pad_to: Optional[int] = None
     ) -> Tuple[data_utils.PackedRows, Dict[str, jnp.ndarray]]:
         rows = self._dp_rows()
         seq_mult = self.config.parallel.seq_parallel_size
         # bucket quantum must divide evenly across the seq axis
-        packed = data_utils.pack_batch_rows(
-            mb, n_rows=rows, quantum=256 * seq_mult
-        )
+        try:
+            packed = data_utils.pack_batch_rows(
+                mb, n_rows=rows, quantum=256 * seq_mult, pad_to=pad_to
+            )
+        except ValueError:
+            # a row outgrew the static pad (one very long sequence);
+            # fall back to the dynamic bucket for this microbatch
+            packed = data_utils.pack_batch_rows(
+                mb, n_rows=rows, quantum=256 * seq_mult
+            )
         arrays: Dict[str, Any] = dict(
             tokens=packed.tokens,
             segment_ids=packed.segment_ids,
@@ -211,7 +235,20 @@ class SPMDTrainEngine(TrainEngine):
     # ------------------------------------------------------------------
     # Train
     # ------------------------------------------------------------------
-    def _attend_fn(self):
+    def _flash_window(self, input_: Batch) -> int:
+        """Pow2-bucketed max sequence length: the splash kernel's
+        block-sparse local window (full causal over a long packed stream is
+        T² block iteration; sequences only need their own length)."""
+        if self.config.attn_impl != "flash":
+            return 0
+        lens = np.asarray(input_["attention_mask"]).sum(1)
+        m = max(1, int(lens.max()))
+        w = 256
+        while w < m:
+            w *= 2
+        return w
+
+    def _attend_fn(self, window: int = 0):
         """Attention kernel override: "flash" (Pallas splash, TPU-only),
         "ring"/"ulysses" (explicit SP shard_map), or None for the XLA kernel
         with GSPMD auto-sharding."""
@@ -219,7 +256,7 @@ class SPMDTrainEngine(TrainEngine):
         if impl == "flash":
             from areal_tpu.ops.flash import flash_segment_attention
 
-            return flash_segment_attention
+            return functools.partial(flash_segment_attention, window=window)
         if impl == "auto" or self.config.parallel.seq_parallel_size == 1:
             return None
         if not hasattr(self, "_cached_attend"):
@@ -228,13 +265,15 @@ class SPMDTrainEngine(TrainEngine):
             self._cached_attend = make_sharded_attention(self.mesh, impl=impl)
         return self._cached_attend
 
-    def _get_grad_fn(self, loss_fn: Callable, loss_weight_fn: Callable):
-        key = ("grad", loss_fn, loss_weight_fn)
+    def _get_grad_fn(
+        self, loss_fn: Callable, loss_weight_fn: Callable, window: int = 0
+    ):
+        key = ("grad", loss_fn, loss_weight_fn, window)
         if key not in self._jit_cache:
             mc = self.model_config
             remat = self.config.gradient_checkpointing
             compute_dtype = self.compute_dtype
-            attend = self._attend_fn()
+            attend = self._attend_fn(window)
 
             def fwd_loss(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -273,6 +312,11 @@ class SPMDTrainEngine(TrainEngine):
                     grads, opt_state, params
                 )
                 new_params = optax.apply_updates(params, updates)
+                # keep the declared param dtype: f32 updates would silently
+                # promote bf16 params (breaking donation every step)
+                new_params = jax.tree_util.tree_map(
+                    lambda n, o: n.astype(o.dtype), new_params, params
+                )
                 # skip non-finite updates (reference base_hf_engine.py:474)
                 ok = jnp.isfinite(grad_norm)
                 new_params = jax.tree_util.tree_map(
@@ -308,11 +352,14 @@ class SPMDTrainEngine(TrainEngine):
             input_, self.config.mb_spec.max_tokens_per_mb,
             min_n_mbs=self.config.mb_spec.n_mbs,
         )
-        grad_fn = self._get_grad_fn(loss_fn, loss_weight_fn)
+        grad_fn = self._get_grad_fn(
+            loss_fn, loss_weight_fn, self._flash_window(input_)
+        )
         grad_accum = self._zero_grads()
+        pad_to = self._mb_pad_to(mbs.mbs)
         losses, weights, all_stats = [], [], []
         for mb in mbs.mbs:
-            _, arrays = self._pack_for_device(mb)
+            _, arrays = self._pack_for_device(mb, pad_to=pad_to)
             grad_accum, loss, stats, w = grad_fn(self.params, grad_accum, arrays)
             losses.append(loss)
             weights.append(w)
@@ -324,21 +371,29 @@ class SPMDTrainEngine(TrainEngine):
         )
         lr = float(self.lr_schedule(self.step_count))  # lr applied this step
         self.step_count += 1
+        # ONE packed host fetch for every scalar this step produced — each
+        # separate float() is a full device round-trip
+        stat_keys = sorted(all_stats[0])
+        scalars = [ok, grad_norm, total_w] + losses + weights + [
+            s[k] for s in all_stats for k in stat_keys
+        ]
+        blob = np.asarray(
+            jnp.stack([jnp.asarray(x, jnp.float32).reshape(()) for x in scalars])
+        )
+        n_mb = len(mbs.mbs)
+        h_ok, h_gnorm, h_total_w = blob[0], blob[1], blob[2]
+        h_losses = blob[3 : 3 + n_mb]
+        h_weights = blob[3 + n_mb : 3 + 2 * n_mb]
+        h_stats = blob[3 + 2 * n_mb :].reshape(n_mb, len(stat_keys))
         out = {
-            "update_successful": float(ok),
-            "grad_norm": float(grad_norm),
+            "update_successful": float(h_ok),
+            "grad_norm": float(h_gnorm),
             "lr": lr,
-            "loss": float(
-                sum(float(l) * float(w) for l, w in zip(losses, weights))
-                / float(total_w)
-            ),
-            "n_mbs": float(len(mbs.mbs)),
+            "loss": float((h_losses * h_weights).sum() / h_total_w),
+            "n_mbs": float(n_mb),
         }
-        for k in all_stats[0]:
-            out[k] = float(
-                sum(float(s[k]) * float(w) for s, w in zip(all_stats, weights))
-                / float(total_w)
-            )
+        for j, k in enumerate(stat_keys):
+            out[k] = float((h_stats[:, j] * h_weights).sum() / h_total_w)
         return out
 
     def eval_batch(
@@ -348,11 +403,12 @@ class SPMDTrainEngine(TrainEngine):
             input_, self.config.mb_spec.max_tokens_per_mb,
             min_n_mbs=self.config.mb_spec.n_mbs,
         )
-        key = ("eval", loss_fn, loss_weight_fn)
+        window = self._flash_window(input_)
+        key = ("eval", loss_fn, loss_weight_fn, window)
         if key not in self._jit_cache:
             mc = self.model_config
             compute_dtype = self.compute_dtype
-            attend = self._attend_fn()
+            attend = self._attend_fn(window)
 
             def eval_step(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -366,13 +422,24 @@ class SPMDTrainEngine(TrainEngine):
                 return loss, stats, loss_weight_fn(arrays).astype(jnp.float32)
 
             self._jit_cache[key] = jax.jit(eval_step)
+        pad_to = self._mb_pad_to(mbs.mbs)
         losses, weights = [], []
         for mb in mbs.mbs:
-            _, arrays = self._pack_for_device(mb)
+            _, arrays = self._pack_for_device(mb, pad_to=pad_to)
             loss, stats, w = self._jit_cache[key](self.params, arrays)
-            losses.append(float(loss) * float(w))
-            weights.append(float(w))
-        return {"loss": sum(losses) / max(sum(weights), 1.0)}
+            losses.append(loss)
+            weights.append(w)
+        blob = np.asarray(
+            jnp.stack(
+                [jnp.asarray(x, jnp.float32).reshape(()) for x in losses + weights]
+            )
+        )
+        n = len(losses)
+        return {
+            "loss": float(
+                (blob[:n] * blob[n:]).sum() / max(blob[n:].sum(), 1.0)
+            )
+        }
 
     # ------------------------------------------------------------------
     # Forward (inference over the train model, e.g. logprob recompute)
@@ -394,11 +461,12 @@ class SPMDTrainEngine(TrainEngine):
             min_n_mbs=self.config.mb_spec.n_mbs,
         )
         hook = post_hook or _default_logprob_hook
-        key = ("fwd", hook)
+        window = self._flash_window(input_)
+        key = ("fwd", hook, window)
         if key not in self._jit_cache:
             mc = self.model_config
             compute_dtype = self.compute_dtype
-            attend = self._attend_fn()
+            attend = self._attend_fn(window)
 
             def fwd(params, arrays):
                 cparams = jax.tree_util.tree_map(
@@ -411,9 +479,10 @@ class SPMDTrainEngine(TrainEngine):
                 return hook(logits, arrays)
 
             self._jit_cache[key] = jax.jit(fwd)
+        pad_to = self._mb_pad_to(mbs.mbs)
         outs = []
         for mb in mbs.mbs:
-            packed, arrays = self._pack_for_device(mb)
+            packed, arrays = self._pack_for_device(mb, pad_to=pad_to)
             vals = np.asarray(self._jit_cache[key](self.params, arrays))
             outs.append(data_utils.unpack_rows_per_token(packed, vals))
         # scatter back to original order at the input's padded width

@@ -328,12 +328,21 @@ class GenerationEngine:
             self._pending_since = time.monotonic()
         # hold while the queue is still filling (or decode has work) so
         # admission waves arrive full — every distinct wave shape compiles
-        # its own XLA program
+        # its own XLA program. A wave that's already full (or slot-bound)
+        # can't get fuller: admit immediately.
+        wave = max(1, self.config.admit_wave)
         age = time.monotonic() - self._pending_since
-        if age < self.config.admit_hold_s and (got_new or self._active):
+        saturated = (
+            len(self._pending) >= self.allocator.n_free
+            or len({tuple(r.input_ids) for r in self._pending}) >= wave
+        )
+        if (
+            not saturated
+            and age < self.config.admit_hold_s
+            and (got_new or self._active)
+        ):
             return False
         self._pending_since = None
-        wave = max(1, self.config.admit_wave)
         # --- select: group identical prompts; <= wave unique prompts,
         # total admitted <= free slots ---
         groups: Dict[tuple, List[_Request]] = {}

@@ -25,14 +25,21 @@ from jax.experimental.pallas.ops.tpu.splash_attention import (
 
 
 @functools.lru_cache(maxsize=32)
-def _make_kernel(t: int, rep: int):
+def _make_kernel(t: int, rep: int, window: int):
     # ensure_compile_time_eval: this may be reached inside a jit trace, but
     # the kernel object (and the mask arrays it processes) must be concrete —
     # it is cached across traces, and a tracer captured here would escape.
     with jax.ensure_compile_time_eval():
-        mask = _sm.MultiHeadMask(
-            [_sm.CausalMask((t, t)) for _ in range(rep)]
-        )
+        if 0 < window < t:
+            # block-sparse local mask: a packed stream of many short
+            # sequences must NOT pay full-causal T² block iteration — any
+            # same-segment pair is within (max segment length - 1)
+            # positions, so a causal local window >= that bound plus the
+            # runtime segment-id mask is exact
+            head = _sm.LocalMask((t, t), (window, 0), 0)
+        else:
+            head = _sm.CausalMask((t, t))
+        mask = _sm.MultiHeadMask([head for _ in range(rep)])
         return _sk.make_splash_mqa_single_device(mask)
 
 
@@ -42,13 +49,14 @@ def flash_segment_attention(
     v: jnp.ndarray,
     segment_ids: jnp.ndarray,  # [B, T]
     causal: bool = True,
+    window: int = 0,  # 0 = full causal; else >= max segment length
 ) -> jnp.ndarray:
     """Drop-in replacement for ops.basic.segment_attention on TPU."""
     assert causal, "splash path is causal-only (decoder models)"
     b, t, hq, d = q.shape
     hkv = k.shape[2]
     rep = hq // hkv
-    kernel = _make_kernel(t, rep)
+    kernel = _make_kernel(t, rep, int(window))
     scale = d**-0.5
     qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
     qg = qg.transpose(0, 2, 1, 3).reshape(b, hkv, rep, t, d)

@@ -309,8 +309,10 @@ def pack_batch_rows(
     )
     if t_pad < max(row_tokens + [0]):
         raise ValueError(f"pad_to={t_pad} < max row tokens {max(row_tokens)}")
-    s_pad = pad_seqs_to if pad_seqs_to is not None else max(
-        1, max(len(g) for g in row_groups)
+    # bucketed (multiples of 8) so the per-seq dim doesn't force a fresh
+    # compile for every distinct sequence count
+    s_pad = pad_seqs_to if pad_seqs_to is not None else next_bucket_size(
+        max(1, max(len(g) for g in row_groups)), 8
     )
 
     per_token_keys = [

@@ -88,7 +88,7 @@ def main():
 
     pcfg = PPOActorConfig(
         dtype="bfloat16",
-        param_dtype="bfloat16",
+        param_dtype="float32",  # f32 master weights, bf16 compute
         gradient_checkpointing=True,
         attn_impl="flash",
         mb_spec=MicroBatchSpec(max_tokens_per_mb=16384),
@@ -166,11 +166,13 @@ def main():
         seq_lens = [len(p) + len(r["output_ids"]) for p, r in zip(prompts, results)]
         return step_time, rollout_done - t0, tokens, seq_lens, stats
 
-    # warmup (compiles prefill/decode/sample/grad/apply/forward programs)
+    # warmup (compiles prefill/decode/sample/grad/apply/forward programs;
+    # two steps so late-appearing shape buckets compile outside measurement)
+    one_step()
     one_step()
     gen_before = gen.metrics()
     # measured steps
-    n_steps = 2
+    n_steps = 3
     times, rtimes, toks, all_lens = [], [], [], []
     for _ in range(n_steps):
         step_time, rollout_time, tokens, seq_lens, stats = one_step()
@@ -195,7 +197,8 @@ def main():
         - gen_before["total_generated_tokens"]
     )
     prefilled = max(0, prompt_toks - cached_toks)
-    avg_ctx = float(np.mean(all_lens)) * 0.75  # decode ctx grows linearly
+    # average decode context: full prompt + half the (linearly growing) gen
+    avg_ctx = prompt_len + (float(np.mean(all_lens)) - prompt_len) / 2.0
     rollout_flops = flops_util.prefill_flops(
         model_cfg, [prompt_len] * max(1, prefilled // prompt_len)
     ) + flops_util.decode_flops(model_cfg, gen_toks, avg_ctx)
