@@ -326,6 +326,11 @@ class BaseExperimentConfig:
     evaluator: EvaluatorConfig = dataclasses.field(default_factory=EvaluatorConfig)
     recover: RecoverConfig = dataclasses.field(default_factory=RecoverConfig)
     launcher: LauncherConfig = dataclasses.field(default_factory=LauncherConfig)
+    # trainer → generation-server weight path: "disk" (HF checkpoint +
+    # reload) or "device" (host-staged chunked transfer, no disk —
+    # reference NCCL-broadcast analog). Colocated runs always use the
+    # in-memory device path regardless.
+    weight_update_mode: str = "disk"
 
 
 @dataclasses.dataclass

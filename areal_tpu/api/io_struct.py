@@ -84,6 +84,9 @@ class WeightUpdateMeta:
     model_version: int = 0
     chunk_bytes: int = 1 << 30  # device path: FFD chunking budget
     param_specs: List[ParamSpec] = dataclasses.field(default_factory=list)
+    # device path: generation-server addresses (host:port); empty = read
+    # AREAL_LLM_SERVER_ADDRS
+    addrs: List[str] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_disk(cls, experiment_name: str, trial_name: str, fileroot: str,

@@ -210,21 +210,54 @@ class RemoteInferenceEngine(InferenceEngine):
     # Weight updates (disk path)
     # ------------------------------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
-        """Non-blocking: pause servers, reload weights when the trainer's
-        signal lands, resume (reference sglang_remote.py:251-309)."""
-        if meta.type != WeightUpdateMethod.DISK:
-            raise NotImplementedError(
-                "device-path weight update requires colocated engines; "
-                "use LocalSyncInferenceEngine"
-            )
-        for addr in self.addresses:
-            r = _requests.post(
-                f"http://{addr}/pause_generation", timeout=30
-            )
-            r.raise_for_status()
+        """Non-blocking: pause servers, wait for fresh weights to land
+        (disk signal or device-path transfer), resume (reference
+        sglang_remote.py:251-309). The whole sequence — including the
+        pause posts — runs off-thread so one slow server never stalls the
+        train loop."""
+
+        def _pause_all():
+            for addr in self.addresses:
+                r = _requests.post(
+                    f"http://{addr}/pause_generation", timeout=30
+                )
+                r.raise_for_status()
+
+        if meta.type == WeightUpdateMethod.DEVICE:
+
+            def _do_device_update():
+                try:
+                    _pause_all()
+                    # the trainer streams chunks directly to the servers
+                    # (spmd_engine.upload_weights); we wait for every
+                    # server to report the target version
+                    deadline = time.monotonic() + self.config.request_timeout
+                    for addr in self.addresses:
+                        while True:
+                            r = _requests.get(
+                                f"http://{addr}/get_model_info", timeout=30
+                            )
+                            r.raise_for_status()
+                            if (
+                                int(r.json().get("model_version", -1))
+                                >= meta.model_version
+                            ):
+                                break
+                            if time.monotonic() > deadline:
+                                raise TimeoutError(
+                                    f"{addr} never reached weight version "
+                                    f"{meta.model_version}"
+                                )
+                            time.sleep(0.2)
+                    self.set_version(meta.model_version)
+                finally:
+                    self._resume_all_best_effort()
+
+            return self.executor.submit(_do_device_update)
 
         def _do_update():
             try:
+                _pause_all()
                 # the trainer signals checkpoint readiness via name_resolve
                 # (reference fsdp_engine.py:384-395); flows that save before
                 # calling us are detected by the checkpoint on disk
@@ -260,12 +293,20 @@ class RemoteInferenceEngine(InferenceEngine):
                     assert r.json().get("success"), r.json()
                 self.set_version(meta.model_version)
             finally:
-                for addr in self.addresses:
-                    _requests.post(
-                        f"http://{addr}/continue_generation", timeout=30
-                    )
+                self._resume_all_best_effort()
 
         return self.executor.submit(_do_update)
+
+    def _resume_all_best_effort(self):
+        """continue_generation on every server; one dead server must not
+        leave the rest paused (or mask the original exception)."""
+        for addr in self.addresses:
+            try:
+                _requests.post(
+                    f"http://{addr}/continue_generation", timeout=30
+                )
+            except Exception as e:
+                logger.warning(f"continue_generation to {addr} failed: {e}")
 
     # ------------------------------------------------------------------
     # Rollout orchestration (delegated; reference sglang_remote.py:311-365)

@@ -557,18 +557,62 @@ class SPMDTrainEngine(TrainEngine):
                 )
 
     def upload_weights(self, meta: WeightUpdateMeta):
-        """Disk path: write an HF checkpoint the generation engine reloads
-        (reference fsdp_engine.py:384-395). The device path (cross-mesh
-        transfer) lands with the inference engine."""
+        """Push fresh weights to the generation side.
+
+        DISK: write an HF checkpoint the generation engine reloads
+        (reference fsdp_engine.py:384-395).
+
+        DEVICE: gather the sharded params to host, FFD-chunk the leaves
+        (≤ meta.chunk_bytes, reference fsdp_engine.py:435-444), and stream
+        each chunk as one binary POST to every generation server — no disk
+        round-trip (reference _update_weights_from_distributed,
+        fsdp_engine.py:414-433). Server addresses come from meta.addrs or
+        the AREAL_LLM_SERVER_ADDRS environment.
+        """
         from areal_tpu.api.io_struct import WeightUpdateMethod
 
         if meta.type == WeightUpdateMethod.DISK:
             host = jax.device_get(self.params)
             hf_io.save_params(host, self.model_config, meta.path)
-        else:
-            raise NotImplementedError(
-                "device weight transfer is wired up in the inference engine"
+            return
+        import urllib.request
+
+        from areal_tpu.utils import weight_transfer as wt
+
+        addrs = list(meta.addrs or [])
+        if not addrs:
+            env = os.environ.get("AREAL_LLM_SERVER_ADDRS", "")
+            addrs = [a for a in env.split(",") if a]
+        if not addrs:
+            raise ValueError(
+                "WeightUpdateMethod.DEVICE needs server addresses "
+                "(meta.addrs or AREAL_LLM_SERVER_ADDRS)"
             )
+        # gather to host in the serving compute dtype (halves wire bytes
+        # vs f32 master weights)
+        host = jax.device_get(
+            jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype), self.params
+            )
+        )
+        leaves = [(n, np.asarray(a)) for n, a in wt.flatten_params(host)]
+        chunks = wt.chunk_leaves(leaves, meta.chunk_bytes)
+        import json as _json
+
+        for i, chunk in enumerate(chunks):
+            body = wt.encode_chunk(meta.model_version, i, len(chunks), chunk)
+            for addr in addrs:
+                req = urllib.request.Request(
+                    f"http://{addr}/update_weights_from_distributed",
+                    data=body,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    resp = _json.loads(r.read())
+                if resp.get("success") is not True:
+                    raise RuntimeError(
+                        f"weight chunk {i} rejected by {addr}: {resp}"
+                    )
 
 
 def target_aligned_logprobs(

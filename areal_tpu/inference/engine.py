@@ -93,15 +93,77 @@ class GenerationEngine:
             params = hf_io.load_params(
                 config.model_path, model_config, dtype=self.dtype
             )
-        self.params = jax.device_put(params)
+        # --- tensor-parallel serving mesh (per-server tp, the analog of the
+        # reference's SGLang tp inside one server, areal/api/cli_args.py:399;
+        # required to fit 7B+ params on small-HBM chips) ---
+        tp = max(1, config.tensor_parallel_size)
+        if tp > 1:
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} but only {len(devs)} devices"
+                )
+            if (
+                model_config.num_kv_heads % tp != 0
+                or model_config.num_heads % tp != 0
+            ):
+                raise ValueError(
+                    f"tensor_parallel_size={tp} must divide num_heads="
+                    f"{model_config.num_heads} and num_kv_heads="
+                    f"{model_config.num_kv_heads}"
+                )
+            from areal_tpu.models.transformer import param_logical_axes
+            from areal_tpu.parallel import sharding as sharding_lib
+
+            self.mesh = jax.sharding.Mesh(
+                np.asarray(devs[:tp]), ("tensor",)
+            )
+            rules = {
+                "embed": None, "heads": "tensor", "mlp": "tensor",
+                "vocab": None, "layer": None,
+            }
+            self._param_shardings = sharding_lib.tree_shardings(
+                self.mesh, param_logical_axes(model_config), rules
+            )
+            # KV cache [L, S, M, Hkv, D]: heads follow the tensor axis
+            self._kv_sharding = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(
+                    None, None, None, "tensor", None
+                )
+            )
+            self._replicated = sharding_lib.replicated(self.mesh)
+        else:
+            self.mesh = None
+            self._param_shardings = None
+            self._kv_sharding = None
+            self._replicated = None
+        self.params = self._place_params(params)
         self.cache_config = CacheConfig(
             num_slots=config.max_num_seqs, max_model_len=config.max_model_len
         )
-        self.cache = init_kv_cache(model_config, self.cache_config, self.dtype)
+        if self.mesh is None:
+            self.cache = init_kv_cache(
+                model_config, self.cache_config, self.dtype
+            )
+        else:
+            # allocate directly sharded — materializing the full cache on
+            # one device first would OOM exactly the small-HBM configs TP
+            # exists for
+            self.cache = jax.jit(
+                lambda: init_kv_cache(
+                    model_config, self.cache_config, self.dtype
+                ),
+                out_shardings={
+                    "k": self._kv_sharding,
+                    "v": self._kv_sharding,
+                    "lens": self._replicated,
+                },
+            )()
         self.allocator = SlotAllocator(config.max_num_seqs)
         self.model_version = 0
         self._rng_key = jax.random.PRNGKey(config.seed)
 
+        self._jit_cache: Dict[str, Any] = {}
         self._admit_queue: "queue.Queue[_Request]" = queue.Queue()
         self._command_queue: "queue.Queue" = queue.Queue()
         self._active: Dict[int, _Request] = {}  # slot -> request
@@ -110,6 +172,10 @@ class GenerationEngine:
         # freed slot -> tokens its cache line still holds (prefix reuse);
         # flushed on weight update (stale-KV guard)
         self._freed_prefix: Dict[int, np.ndarray] = {}
+        # device-path weight staging (chunked receive)
+        self._staged: Dict[str, Any] = {}
+        self._staging_key = None
+        self._staged_chunks: set = set()
         self._paused = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -125,6 +191,18 @@ class GenerationEngine:
         self._remaining = jnp.zeros(s, jnp.int32)
         self._no_stop = jnp.zeros(s, jnp.int32)
         self._stop_tokens = jnp.full((s, 8), -1, jnp.int32)
+        if self.mesh is not None:
+            # small state must be explicitly replicated on the mesh so jit
+            # doesn't mix committed single-device and sharded inputs
+            for attr in (
+                "_cur_tokens", "_active_dev", "_temp_dev", "_top_p_dev",
+                "_top_k_dev", "_greedy_dev", "_remaining", "_no_stop",
+                "_stop_tokens",
+            ):
+                setattr(
+                    self, attr,
+                    jax.device_put(getattr(self, attr), self._replicated),
+                )
         self._step_counter = 0
         # metrics
         self.total_generated_tokens = 0
@@ -132,6 +210,33 @@ class GenerationEngine:
         self.total_cached_prompt_tokens = 0  # prompt tokens served from KV reuse
         self.total_requests = 0
         self.total_aborted = 0
+
+    def _place_params(self, params: Params) -> Params:
+        """Host or device pytree → this engine's param placement."""
+        if self.mesh is None:
+            return jax.device_put(params)
+        return jax.device_put(params, self._param_shardings)
+
+    def _copy_params_placed(self, params: Params) -> Params:
+        """Fresh, correctly-placed COPY of a (possibly device-resident)
+        pytree — the source may later be donated by its owner, so aliasing
+        is never acceptable."""
+        if self.mesh is None:
+            return jax.tree_util.tree_map(
+                lambda p: jnp.array(p, dtype=self.dtype, copy=True), params
+            )
+        key = "copy_params"
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda p: jnp.copy(p.astype(self.dtype)), t
+                ),
+                out_shardings=self._param_shardings,
+            )
+        # reshard onto this mesh first (the source may live on another
+        # mesh); the un-donated jit then guarantees fresh buffers
+        placed = jax.device_put(params, self._param_shardings)
+        return self._jit_cache[key](placed)
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -193,6 +298,14 @@ class GenerationEngine:
         self._command_queue.put(("update_weights_tensors", (params, version), done))
         return done.result(timeout=600)
 
+    def update_weights_chunk(self, header: Dict, arrays: Dict[str, Any]):
+        """Device-path receive: stage one FFD chunk of host tensors; the
+        final chunk assembles + swaps the full pytree (reference NCCL
+        receive side, areal/engine/sglang_remote.py:411)."""
+        done = Future()
+        self._command_queue.put(("update_weights_chunk", (header, arrays), done))
+        return done.result(timeout=600)
+
     def metrics(self) -> Dict[str, float]:
         return dict(
             running_requests=len(self._active),
@@ -237,9 +350,12 @@ class GenerationEngine:
                     host = hf_io.load_params(
                         path, self.model_config, dtype=self.dtype
                     )
-                    self.params = jax.device_put(host)
-                    # cached KV is from the old policy — never reuse it
+                    self.params = self._place_params(host)
+                    # cached KV is from the old policy — never reuse it;
+                    # drop any abandoned device-path staging too
                     self._freed_prefix.clear()
+                    self._staged = {}
+                    self._staging_key = None
                     self.model_version = (
                         version
                         if version is not None
@@ -249,16 +365,48 @@ class GenerationEngine:
                         f"weights updated from {path} → v{self.model_version}"
                     )
                     done.set_result(self.model_version)
+                elif cmd == "update_weights_chunk":
+                    header, arrays = arg
+                    version = int(header["version"])
+                    # key staging on (version, n_chunks): a retry with a
+                    # different FFD grouping must not merge stale leaves
+                    stage_key = (version, int(header["n_chunks"]))
+                    if getattr(self, "_staging_key", None) != stage_key:
+                        self._staging_key = stage_key
+                        self._staged: Dict[str, Any] = {}
+                        self._staged_chunks = set()
+                    self._staged.update(arrays)
+                    self._staged_chunks.add(int(header["chunk_index"]))
+                    if len(self._staged_chunks) < int(header["n_chunks"]):
+                        done.set_result({"staged": len(self._staged_chunks)})
+                        continue
+                    from areal_tpu.utils.weight_transfer import (
+                        unflatten_params,
+                    )
+
+                    host = jax.tree_util.tree_map(
+                        lambda a: jnp.asarray(a, dtype=self.dtype),
+                        unflatten_params(self._staged),
+                    )
+                    self.params = self._place_params(host)
+                    self._staged = {}
+                    self._staged_chunks = set()
+                    self._staging_key = None
+                    self.model_version = version
+                    self._freed_prefix.clear()
+                    logger.info(
+                        f"weights updated via device path → v{version}"
+                    )
+                    done.set_result({"version": version, "complete": True})
                 elif cmd == "update_weights_tensors":
                     params, version = arg
-                    # copy=True: the caller may later DONATE these buffers
-                    # (the trainer's update step); aliasing them would leave
-                    # us holding deleted arrays
-                    self.params = jax.tree_util.tree_map(
-                        lambda p: jnp.array(p, dtype=self.dtype, copy=True),
-                        params,
-                    )
+                    # the caller may later DONATE these buffers (the
+                    # trainer's update step); aliasing them would leave us
+                    # holding deleted arrays — always copy
+                    self.params = self._copy_params_placed(params)
                     self._freed_prefix.clear()
+                    self._staged = {}
+                    self._staging_key = None
                     self.model_version = (
                         version
                         if version is not None

@@ -90,6 +90,15 @@ class _Handler(BaseHTTPRequestHandler):
                     payload["model_path"], payload.get("version")
                 )
                 self._send_json({"success": True, "model_version": version})
+            elif self.path == "/update_weights_from_distributed":
+                # binary FFD chunk (reference sglang_remote.py:411 NCCL
+                # receive, host-staged over HTTP here)
+                from areal_tpu.utils.weight_transfer import decode_chunk
+
+                n = int(self.headers.get("Content-Length", 0))
+                header, arrays = decode_chunk(self.rfile.read(n))
+                out = eng.update_weights_chunk(header, arrays)
+                self._send_json({"success": True, **out})
             else:
                 self._send_json({"error": f"unknown path {self.path}"}, 404)
         except Exception as e:  # surface engine errors as 500s

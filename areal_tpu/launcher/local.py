@@ -160,6 +160,10 @@ def local_main(
         ):
             server_cfg = getattr(config, "server", None) or JaxGenConfig()
             n_servers = alloc.gen.data_parallel_size
+            # per-server tensor parallelism comes from the allocation mode
+            # (reference: SGLang tp wired at areal/launcher/local.py:277-306)
+            if alloc.gen.tensor_parallel_size > 1:
+                server_cfg.tensor_parallel_size = alloc.gen.tensor_parallel_size
             addrs = launch_servers(launcher, server_cfg, n_servers, env)
             env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
         if alloc is None or alloc.type_ != AllocationType.LLM_SERVER_ONLY:

@@ -116,24 +116,31 @@ def main(argv):
         config.experiment_name, config.trial_name, config.cluster.fileroot
     )
 
-    def weight_update_meta(version: int) -> WeightUpdateMeta:
-        if colocated:
-            return WeightUpdateMeta(
-                type=WeightUpdateMethod.DEVICE, model_version=version
-            )
+    def disk_meta(version: int) -> WeightUpdateMeta:
         return WeightUpdateMeta.from_disk(
             config.experiment_name, config.trial_name,
             config.cluster.fileroot, model_version=version,
         )
+
+    def weight_update_meta(version: int) -> WeightUpdateMeta:
+        # colocated always hands weights over in memory; remote servers use
+        # the host-staged chunked transfer (reference NCCL path semantics)
+        # when weight_update_mode == "device", else the disk checkpoint
+        if colocated or config.weight_update_mode == "device":
+            return WeightUpdateMeta(
+                type=WeightUpdateMethod.DEVICE, model_version=version
+            )
+        return disk_meta(version)
 
     start_step = StepInfo(steps_per_epoch=ft_spec.steps_per_epoch)
     if check_if_recover(config.recover, recover_handler.recover_root):
         info = recover_handler.load(
             engine, saver=saver, evaluator=evaluator, dataloader=dataloader,
             inference_engine=rollout,
-            weight_update_meta=(
-                None if colocated else weight_update_meta(0)
-            ),
+            # recovery always reloads from the recovered HF checkpoint on
+            # disk (it exists already; a DEVICE meta would wait for a push
+            # that never comes)
+            weight_update_meta=(None if colocated else disk_meta(0)),
         )
         if info is not None:
             start_step = info.last_step_info.next()
@@ -182,9 +189,19 @@ def main(argv):
                 rollout.pause()
                 new_version = rollout.get_version() + 1
                 meta = weight_update_meta(new_version)
-                if not colocated:
+                if colocated:
+                    fut = rollout.update_weights(meta)
+                elif meta.type == WeightUpdateMethod.DISK:
+                    # checkpoint write strictly precedes the reload signal
+                    # (the waiter triggers on config.json existing)
                     engine.upload_weights(meta)
-                rollout.update_weights(meta).result(timeout=600)
+                    fut = rollout.update_weights(meta)
+                else:
+                    # device path: servers pause first, then the trainer
+                    # streams chunks to them
+                    fut = rollout.update_weights(meta)
+                    engine.upload_weights(meta)
+                fut.result(timeout=600)
                 engine.set_version(new_version)
                 rollout.resume()
 

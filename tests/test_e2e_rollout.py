@@ -128,6 +128,70 @@ def test_weight_update_from_disk(client, server, tmp_path):
     gen_eng.model_version = 0  # reset for fixture reuse
 
 
+def test_weight_update_device_path(client, server, tmp_path, monkeypatch):
+    """DEVICE weight update: trainer streams FFD-chunked binary weights
+    straight to the server — version bumps with NO checkpoint written
+    (reference fsdp_engine.py:414-444 NCCL path semantics)."""
+    from areal_tpu.api.io_struct import WeightUpdateMethod
+    from areal_tpu.models import hf_io
+
+    gen_eng, addr, model_cfg = server
+    pcfg = PPOActorConfig(
+        dtype="float32", param_dtype="float32",
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=512),
+        optimizer=OptimizerConfig(lr=1e-4),
+        parallel=ParallelismConfig(),
+    )
+    train = SPMDTrainEngine(pcfg)
+    train.initialize(FinetuneSpec(1, 16, 4), model_config=model_cfg, seed=5)
+
+    saves = []
+    monkeypatch.setattr(
+        hf_io, "save_params",
+        lambda *a, **k: saves.append(a),
+    )
+    meta = WeightUpdateMeta(
+        type=WeightUpdateMethod.DEVICE,
+        model_version=7,
+        chunk_bytes=64 * 1024,  # force multiple chunks for the tiny model
+        addrs=[addr],
+    )
+    fut = client.update_weights(meta)
+    train.upload_weights(meta)
+    fut.result(timeout=120)
+    assert client.get_version() == 7
+    assert gen_eng.model_version == 7
+    assert not saves  # no disk checkpoint was written
+    # server generates with the new weights and stamps the new version
+    out = gen_eng.generate(
+        {"input_ids": [1, 2, 3], "sampling_params": {"max_new_tokens": 2}}
+    )
+    assert out["output_versions"] == [7, 7]
+    # and the transferred weights really are the trainer's: greedy outputs
+    # match a colocated engine holding the trainer's params
+    host = jax.device_get(train.params)
+    ref_eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", max_num_seqs=4, max_model_len=64,
+            prefill_chunk=16,
+        ),
+        model_config=model_cfg, params=host,
+    ).start()
+    payload = {
+        "input_ids": [5, 4, 3, 2, 1],
+        "sampling_params": {"max_new_tokens": 6, "greedy": True},
+    }
+    try:
+        assert (
+            gen_eng.generate(payload)["output_ids"]
+            == ref_eng.generate(payload)["output_ids"]
+        )
+    finally:
+        ref_eng.stop()
+    gen_eng.model_version = 0  # reset for fixture reuse
+    client.set_version(0)
+
+
 def test_interruptible_generation_spans_versions(client, server, tmp_path):
     """A long generation interrupted by a weight update must resume with
     accumulated tokens and report mixed per-token versions (reference
