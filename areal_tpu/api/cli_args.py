@@ -304,6 +304,10 @@ class LauncherConfig:
     inference_server_mem: int = 32768
     trainer_cpus_per_task: int = 4
     trainer_mem: int = 32768
+    # >1 spawns that many trainer processes joined into one
+    # jax.distributed world (multi-host SPMD; on TPU pods the per-host
+    # runtime provides this instead)
+    trainer_processes: int = 1
 
 
 # --------------------------------------------------------------------------

@@ -229,10 +229,12 @@ class RemoteInferenceEngine(InferenceEngine):
                 try:
                     _pause_all()
                     # the trainer streams chunks directly to the servers
-                    # (spmd_engine.upload_weights); we wait for every
-                    # server to report the target version
+                    # (spmd_engine.upload_weights); wait on the SAME set of
+                    # addresses it streams to (meta.addrs when given), or
+                    # unstreamed servers would be polled forever
+                    targets = list(meta.addrs) or self.addresses
                     deadline = time.monotonic() + self.config.request_timeout
-                    for addr in self.addresses:
+                    for addr in targets:
                         while True:
                             r = _requests.get(
                                 f"http://{addr}/get_model_info", timeout=30

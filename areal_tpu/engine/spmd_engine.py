@@ -39,6 +39,7 @@ from areal_tpu.models.transformer import (
     init_params,
     param_logical_axes,
 )
+from areal_tpu.parallel import distributed as distributed_lib
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.parallel import sharding as sharding_lib
 from areal_tpu.utils import data as data_utils
@@ -117,7 +118,13 @@ class SPMDTrainEngine(TrainEngine):
             host_params = init_params(
                 mc, jax.random.PRNGKey(seed), dtype=self.param_dtype
             )
-        self.params = jax.device_put(host_params, self._param_shardings)
+        self.params = jax.tree_util.tree_map(
+            lambda a, sh: distributed_lib.make_global_array(
+                np.asarray(a), sh
+            ),
+            host_params,
+            self._param_shardings,
+        )
         if cfg.optimizer is not None:
             total_steps = ft_spec.total_train_steps if ft_spec else 10000
             self.lr_schedule = _lr_schedule(cfg, total_steps)
@@ -229,7 +236,10 @@ class SPMDTrainEngine(TrainEngine):
                 if v.ndim >= 1 and v.shape[0] == packed.tokens.shape[0]
                 else rep
             )
-            dev[k] = jax.device_put(jnp.asarray(v), sh)
+            # multi-host: every process holds the identical full batch (the
+            # DP-head broadcast guarantees it) and contributes only its
+            # addressable shards to the global array
+            dev[k] = distributed_lib.make_global_array(np.asarray(v), sh)
         return packed, dev
 
     # ------------------------------------------------------------------
@@ -478,7 +488,12 @@ class SPMDTrainEngine(TrainEngine):
                 )
                 return hook(logits, arrays)
 
-            self._jit_cache[key] = jax.jit(fwd)
+            # replicated output: under multi-process the per-token result
+            # must be fully addressable for the host np.asarray fetch
+            self._jit_cache[key] = jax.jit(
+                fwd,
+                out_shardings=sharding_lib.replicated(self.mesh),
+            )
         pad_to = self._mb_pad_to(mbs.mbs)
         outs = []
         for mb in mbs.mbs:
@@ -496,10 +511,30 @@ class SPMDTrainEngine(TrainEngine):
     # ------------------------------------------------------------------
     # Save / load / weight push
     # ------------------------------------------------------------------
+    def _host_tree(self, tree, dtype=None):
+        """Gather a (possibly cross-process-sharded) pytree to host.
+
+        Multi-process arrays are not fully addressable, so they are first
+        replicated through a jitted identity (one all-gather — every rank
+        participates: this is a COLLECTIVE and must be called on all
+        processes) and then fetched."""
+        if dtype is not None:
+            tree = jax.tree_util.tree_map(
+                lambda p: p.astype(dtype), tree
+            )
+        if jax.process_count() > 1:
+            rep = sharding_lib.replicated(self.mesh)
+            tree = jax.jit(
+                lambda t: t,
+                out_shardings=jax.tree_util.tree_map(lambda _: rep, tree),
+            )(tree)
+        return jax.device_get(tree)
+
     def save(self, meta: SaveLoadMeta):
         if meta.weight_format == "hf":
-            host = jax.device_get(self.params)
-            hf_io.save_params(host, self.model_config, meta.path)
+            host = self._host_tree(self.params)
+            if jax.process_index() == 0:
+                hf_io.save_params(host, self.model_config, meta.path)
             if meta.with_optim:
                 self._save_optim(os.path.join(meta.path, "optim"))
         else:
@@ -513,8 +548,10 @@ class SPMDTrainEngine(TrainEngine):
             )
 
     def _save_optim(self, path: str):
+        flat, _ = jax.tree_util.tree_flatten(self._host_tree(self.opt_state))
+        if jax.process_index() != 0:
+            return
         os.makedirs(path, exist_ok=True)
-        flat, _ = jax.tree_util.tree_flatten(jax.device_get(self.opt_state))
         np.savez(
             os.path.join(path, "opt_state.npz"),
             *[np.asarray(x) for x in flat],
@@ -572,8 +609,9 @@ class SPMDTrainEngine(TrainEngine):
         from areal_tpu.api.io_struct import WeightUpdateMethod
 
         if meta.type == WeightUpdateMethod.DISK:
-            host = jax.device_get(self.params)
-            hf_io.save_params(host, self.model_config, meta.path)
+            host = self._host_tree(self.params)  # collective: all ranks
+            if jax.process_index() == 0:
+                hf_io.save_params(host, self.model_config, meta.path)
             return
         import urllib.request
 
@@ -589,30 +627,43 @@ class SPMDTrainEngine(TrainEngine):
                 "(meta.addrs or AREAL_LLM_SERVER_ADDRS)"
             )
         # gather to host in the serving compute dtype (halves wire bytes
-        # vs f32 master weights)
-        host = jax.device_get(
-            jax.tree_util.tree_map(
-                lambda p: p.astype(self.compute_dtype), self.params
-            )
-        )
+        # vs f32 master weights); collective — every rank participates,
+        # rank 0 streams
+        host = self._host_tree(self.params, dtype=self.compute_dtype)
+        if jax.process_index() != 0:
+            return
+        import json as _json
+        from concurrent.futures import ThreadPoolExecutor
+
         leaves = [(n, np.asarray(a)) for n, a in wt.flatten_params(host)]
         chunks = wt.chunk_leaves(leaves, meta.chunk_bytes)
-        import json as _json
 
-        for i, chunk in enumerate(chunks):
-            body = wt.encode_chunk(meta.model_version, i, len(chunks), chunk)
-            for addr in addrs:
-                req = urllib.request.Request(
-                    f"http://{addr}/update_weights_from_distributed",
-                    data=body,
-                    headers={"Content-Type": "application/octet-stream"},
+        def _post(addr: str, i: int, body: bytes):
+            req = urllib.request.Request(
+                f"http://{addr}/update_weights_from_distributed",
+                data=body,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                resp = _json.loads(r.read())
+            if resp.get("success") is not True:
+                raise RuntimeError(
+                    f"weight chunk {i} rejected by {addr}: {resp}"
                 )
-                with urllib.request.urlopen(req, timeout=600) as r:
-                    resp = _json.loads(r.read())
-                if resp.get("success") is not True:
-                    raise RuntimeError(
-                        f"weight chunk {i} rejected by {addr}: {resp}"
-                    )
+
+        # fan each chunk out to all servers concurrently (the reference's
+        # broadcast reaches every server at once; servers sit paused for
+        # the whole transfer, so wall time matters)
+        with ThreadPoolExecutor(max_workers=max(1, len(addrs))) as pool:
+            for i, chunk in enumerate(chunks):
+                body = wt.encode_chunk(
+                    meta.model_version, i, len(chunks), chunk
+                )
+                futs = [
+                    pool.submit(_post, addr, i, body) for addr in addrs
+                ]
+                for f in futs:
+                    f.result()
 
 
 def target_aligned_logprobs(

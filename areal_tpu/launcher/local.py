@@ -166,12 +166,36 @@ def local_main(
                 server_cfg.tensor_parallel_size = alloc.gen.tensor_parallel_size
             addrs = launch_servers(launcher, server_cfg, n_servers, env)
             env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
+        n_trainers = max(
+            1, getattr(config.launcher, "trainer_processes", 1)
+        )
         if alloc is None or alloc.type_ != AllocationType.LLM_SERVER_ONLY:
-            launcher.submit(
-                "trainer",
-                [sys.executable, trainer_entry] + trainer_argv,
-                env=env,
-            )
+            if n_trainers == 1:
+                launcher.submit(
+                    "trainer",
+                    [sys.executable, trainer_entry] + trainer_argv,
+                    env=env,
+                )
+            else:
+                # one jax.distributed world of N local trainer processes
+                # (multi-host skeleton; reference: torchrun rendezvous)
+                from areal_tpu.parallel.distributed import (
+                    COORDINATOR_ENV,
+                    NUM_PROCESSES_ENV,
+                    PROCESS_ID_ENV,
+                )
+
+                port = network.find_free_ports(1)[0]
+                for rank in range(n_trainers):
+                    trainer_env = dict(env)
+                    trainer_env[COORDINATOR_ENV] = f"127.0.0.1:{port}"
+                    trainer_env[NUM_PROCESSES_ENV] = str(n_trainers)
+                    trainer_env[PROCESS_ID_ENV] = str(rank)
+                    launcher.submit(
+                        f"trainer_{rank}" if rank else "trainer",
+                        [sys.executable, trainer_entry] + trainer_argv,
+                        env=trainer_env,
+                    )
         # watch loop
         while True:
             exc = launcher.poll()

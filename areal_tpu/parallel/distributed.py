@@ -1,0 +1,114 @@
+"""Multi-host (multi-process) runtime: jax.distributed wiring + host-level
+collectives.
+
+Role of the reference's NCCL world bootstrap + tensor-container broadcast
+(realhf/impl/model/comm/global_comm.py:48 `setup_global_comm`,
+areal/utils/data.py:930 `broadcast_tensor_container`): on TPU pods every
+process joins ONE jax.distributed runtime, `jax.devices()` becomes the
+global device list, and a single SPMD mesh spans hosts — the jitted train
+step is the same program everywhere; XLA routes in-mesh collectives over
+ICI and cross-host ones over DCN.
+
+Environment contract (the launcher sets these; on real TPU pods
+`jax.distributed.initialize()` auto-discovers and none are needed):
+
+    AREAL_COORDINATOR   host:port of process 0
+    AREAL_NUM_PROCESSES world size
+    AREAL_PROCESS_ID    this process's rank
+"""
+
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+COORDINATOR_ENV = "AREAL_COORDINATOR"
+NUM_PROCESSES_ENV = "AREAL_NUM_PROCESSES"
+PROCESS_ID_ENV = "AREAL_PROCESS_ID"
+
+
+def maybe_init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the jax.distributed runtime if configured; returns True when a
+    multi-process world was initialized.
+
+    Explicit args override the AREAL_* environment; on a TPU pod slice with
+    no explicit configuration this is a no-op (JAX handles pod discovery
+    itself when processes are started by the TPU runtime).
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get(COORDINATOR_ENV)
+    if num_processes is None and NUM_PROCESSES_ENV in os.environ:
+        num_processes = int(os.environ[NUM_PROCESSES_ENV])
+    if process_id is None and PROCESS_ID_ENV in os.environ:
+        process_id = int(os.environ[PROCESS_ID_ENV])
+    if not coordinator or not num_processes or num_processes <= 1:
+        return False
+    # CPU multi-process (tests / local constellations) needs a cross-host
+    # collectives backend; TPU pods bring their own
+    if "cpu" in str(jax.config.jax_platforms or ""):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def broadcast_pytree(obj: Any, is_source: Optional[bool] = None) -> Any:
+    """Process-0 → all-processes broadcast of an arbitrary picklable object
+    (the DP-head batch broadcast — reference
+    `broadcast_tensor_container`, areal/utils/data.py:930, which likewise
+    ships pickled buffers). Non-source processes pass anything (ignored).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return obj
+    if is_source is None:
+        is_source = jax.process_index() == 0
+    payload = pickle.dumps(obj) if is_source else b""
+    n = int(
+        multihost_utils.broadcast_one_to_all(
+            np.asarray(len(payload), np.int64)
+        )
+    )
+    buf = (
+        np.frombuffer(payload.ljust(n, b"\0"), np.uint8).copy()
+        if is_source
+        else np.zeros(n, np.uint8)
+    )
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return pickle.loads(np.asarray(out).tobytes())
+
+
+def make_global_array(host_array: np.ndarray, sharding) -> Any:
+    """Full host copy (identical on every process) → one global jax.Array
+    laid out by `sharding`. Each process contributes only its addressable
+    shards; this is how host data enters a mesh that spans processes."""
+    import jax
+
+    if jax.process_count() == 1:
+        import jax.numpy as jnp
+
+        return jax.device_put(jnp.asarray(host_array), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(host_array)
+    )
+
+
+def process_allgather_scalars(value: float) -> np.ndarray:
+    """Gather one float from every process (diagnostics/assertions)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray([value], np.float64))
+    ).reshape(-1)

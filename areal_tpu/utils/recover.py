@@ -94,11 +94,15 @@ class RecoverHandler:
             ),
             extra=extra or {},
         )
-        engine.save(
+        engine.save(  # collective under multi-process (rank 0 writes)
             SaveLoadMeta(
                 path=self.weights_path, weight_format="hf", with_optim=True
             )
         )
+        import jax
+
+        if jax.process_index() != 0:
+            return True
         tmp = self.info_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(info, f)

@@ -70,7 +70,9 @@ class Saver:
                 ),
             )
         )
-        if tokenizer is not None:
+        import jax
+
+        if tokenizer is not None and jax.process_index() == 0:
             tokenizer.save_pretrained(path)
         logger.info(f"saved checkpoint to {path}")
         return path

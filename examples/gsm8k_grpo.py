@@ -52,6 +52,17 @@ def load_tokenizer(path: str):
 
 
 def main(argv):
+    # join the jax.distributed world first if the launcher configured one
+    # (must precede any other jax use)
+    from areal_tpu.parallel.distributed import maybe_init_distributed
+
+    maybe_init_distributed()
+    import jax
+
+    from areal_tpu.parallel.distributed import broadcast_pytree
+
+    is_main = jax.process_index() == 0
+    multi_process = jax.process_count() > 1
     config, _ = load_expr_config(argv, GRPOConfig)
     tokenizer = load_tokenizer(config.tokenizer_path)
 
@@ -83,18 +94,27 @@ def main(argv):
         PPOActor(config.ref, ref_engine) if ref_engine is not None else None
     )
 
-    # rollout: remote servers if announced, else colocated in-process
+    # rollout: remote servers if announced, else colocated in-process.
+    # In a multi-process world only process 0 drives rollout (the DP head,
+    # reference gsm8k_grpo.py:168); peers receive the batch by broadcast.
     colocated = not os.environ.get(SERVER_ADDRS_ENV)
-    if colocated:
-        gen_cfg = config.server
-        if not gen_cfg.model_path:
-            gen_cfg.model_path = config.actor.path
-        rollout = LocalSyncInferenceEngine(
-            config.rollout, gen_cfg, model_config=engine.model_config
+    if multi_process and colocated:
+        raise ValueError(
+            "multi-process training needs remote generation servers "
+            "(colocated generation would pin the whole mesh's chips)"
         )
-        rollout.initialize(train_engine=engine)
-    else:
-        rollout = RemoteInferenceEngine(config.rollout).initialize()
+    rollout = None
+    if is_main:
+        if colocated:
+            gen_cfg = config.server
+            if not gen_cfg.model_path:
+                gen_cfg.model_path = config.actor.path
+            rollout = LocalSyncInferenceEngine(
+                config.rollout, gen_cfg, model_config=engine.model_config
+            )
+            rollout.initialize(train_engine=engine)
+        else:
+            rollout = RemoteInferenceEngine(config.rollout).initialize()
 
     workflow = RLVRWorkflow(
         gsm8k_reward_fn,
@@ -162,16 +182,24 @@ def main(argv):
     while step.global_step < total_steps:
         with stats_tracker.record_timing("e2e"):
             with stats_tracker.record_timing("rollout"):
-                if config.async_training:
-                    batch = rollout.prepare_batch(dataloader, workflow)
-                else:
-                    # one persistent iterator: StatefulDataLoader tracks its
-                    # epoch position on the instance, so a fresh iter() at an
-                    # epoch boundary would raise StopIteration immediately
-                    if data_generator is None:
-                        data_generator = cycle_dataloader(dataloader)
-                    items = next(data_generator)
-                    batch = rollout.rollout_batch(items, workflow)
+                batch = None
+                if is_main:
+                    if config.async_training:
+                        batch = rollout.prepare_batch(dataloader, workflow)
+                    else:
+                        # one persistent iterator: StatefulDataLoader tracks
+                        # its epoch position on the instance, so a fresh
+                        # iter() at an epoch boundary would raise
+                        # StopIteration immediately
+                        if data_generator is None:
+                            data_generator = cycle_dataloader(dataloader)
+                        items = next(data_generator)
+                        batch = rollout.rollout_batch(items, workflow)
+                if multi_process:
+                    # DP-head batch broadcast (reference
+                    # broadcast_tensor_container, utils/data.py:930): the
+                    # SPMD step below needs the identical batch everywhere
+                    batch = broadcast_pytree(batch)
 
             if ref_actor is not None:
                 with stats_tracker.record_timing("ref_logp"):
@@ -186,26 +214,38 @@ def main(argv):
                 train_stats = actor.ppo_update(batch)
 
             with stats_tracker.record_timing("weight_update"):
-                rollout.pause()
-                new_version = rollout.get_version() + 1
+                if is_main:
+                    rollout.pause()
+                new_version = engine.get_version() + 1
                 meta = weight_update_meta(new_version)
                 if colocated:
                     fut = rollout.update_weights(meta)
+                    fut.result(timeout=600)
                 elif meta.type == WeightUpdateMethod.DISK:
                     # checkpoint write strictly precedes the reload signal
-                    # (the waiter triggers on config.json existing)
+                    # (the waiter triggers on config.json existing);
+                    # upload_weights is a COLLECTIVE (all ranks gather,
+                    # rank 0 writes)
                     engine.upload_weights(meta)
-                    fut = rollout.update_weights(meta)
+                    if is_main:
+                        rollout.update_weights(meta).result(timeout=600)
                 else:
                     # device path: servers pause first, then the trainer
-                    # streams chunks to them
-                    fut = rollout.update_weights(meta)
+                    # streams chunks to them (collective gather, rank 0
+                    # streams)
+                    fut = (
+                        rollout.update_weights(meta) if is_main else None
+                    )
                     engine.upload_weights(meta)
-                fut.result(timeout=600)
+                    if fut is not None:
+                        fut.result(timeout=600)
                 engine.set_version(new_version)
-                rollout.resume()
+                if is_main:
+                    rollout.resume()
 
             with stats_tracker.record_timing("save_eval_recover"):
+                # engine.save is a collective (all ranks gather, rank 0
+                # writes) — every process must enter it
                 saver.save(engine, step, tokenizer=tokenizer)
                 evaluator.evaluate(lambda: None, step)
                 recover_handler.dump(
@@ -219,11 +259,15 @@ def main(argv):
                 stats[f"ppo_actor/{k}"] = v
         stats["ppo_actor/n_tokens"] = float(batch["attention_mask"].sum())
         stats["reward/mean"] = float(np.mean(batch["rewards"]))
-        stats_logger.commit(step.epoch, step.epoch_step, step.global_step, stats)
+        if is_main:
+            stats_logger.commit(
+                step.epoch, step.epoch_step, step.global_step, stats
+            )
         step = step.next()
 
     stats_logger.close()
-    rollout.destroy()
+    if rollout is not None:
+        rollout.destroy()
     logger.info("training complete")
 
 
