@@ -36,8 +36,26 @@ def _gsm8k_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
     return out
 
 
+def _code_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
+    """Code-RLVR schema → workflow item: {question/prompt, test_cases |
+    test_code} (reference code datasets feed functioncall verification;
+    realhf/impl/dataset/ math_code jsonl)."""
+    out: Dict[str, Any] = {}
+    if "test_cases" in row:
+        out["test_cases"] = row["test_cases"]
+    if "test_code" in row:
+        out["test_code"] = row["test_code"]
+    q = row.get("question") or row.get("prompt") or ""
+    if tokenizer is not None:
+        out["messages"] = [{"role": "user", "content": q}]
+    else:
+        out["question"] = q
+    return out
+
+
 _PROCESSORS: Dict[str, Callable] = {
     "gsm8k": _gsm8k_to_rl,
+    "code": _code_to_rl,
     "raw": lambda row, tokenizer=None: row,
 }
 

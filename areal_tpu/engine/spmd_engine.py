@@ -523,11 +523,20 @@ class SPMDTrainEngine(TrainEngine):
                 lambda p: p.astype(dtype), tree
             )
         if jax.process_count() > 1:
-            rep = sharding_lib.replicated(self.mesh)
-            tree = jax.jit(
-                lambda t: t,
-                out_shardings=jax.tree_util.tree_map(lambda _: rep, tree),
-            )(tree)
+            # memoized per tree structure: a fresh lambda per call would
+            # recompile the full all-gather program on every weight push
+            treedef = jax.tree_util.tree_structure(tree)
+            key = ("host_gather", treedef)
+            if key not in self._jit_cache:
+                rep = sharding_lib.replicated(self.mesh)
+                self._jit_cache[key] = jax.jit(
+                    lambda t: t,
+                    out_shardings=jax.tree_util.tree_unflatten(
+                        treedef,
+                        [rep] * treedef.num_leaves,
+                    ),
+                )
+            tree = self._jit_cache[key](tree)
         return jax.device_get(tree)
 
     def save(self, meta: SaveLoadMeta):

@@ -1,0 +1,171 @@
+"""Sandboxed code-execution verifier for code-RLVR.
+
+Role of the reference's functioncall service (functioncall/base/call.py:21-24
+local/remote code verification; legacy
+realhf/impl/environment/math_code_single_step_env.py): a model completion is
+judged by RUNNING it against test cases. The reference ships candidate code
+to a sandboxed verifier service; here verification is an in-host sandboxed
+subprocess — isolated interpreter (-I), resource limits (address space,
+CPU seconds, process count, file size), scratch cwd, stripped environment,
+hard wall-clock timeout. Like the reference's LOCAL verifier mode, this is
+resource containment, not a security boundary (no filesystem/user
+isolation); untrusted-scale deployments should front a remote verifier
+service behind the same reward function (the reference's
+FUNCTIONCALL_SERVICE env, functioncall/base/call.py:21-24).
+
+Two test styles (both appear in the reference's datasets):
+- ``input_output``: run the program with each case's stdin, compare stdout.
+- ``assert`` (HumanEval-style): append the test code (asserts) to the
+  completion's code; exit 0 == pass.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_CODE_BLOCK = re.compile(r"```(?:python|py)?\n(.*?)```", re.DOTALL)
+
+
+def extract_code(completion: str) -> Optional[str]:
+    """Last fenced code block, or the raw text if it looks like bare code
+    (reference agents take the final block of the CoT)."""
+    blocks = _CODE_BLOCK.findall(completion)
+    if blocks:
+        return blocks[-1].strip()
+    if "def " in completion or "print(" in completion or "input()" in completion:
+        return completion.strip()
+    return None
+
+
+def _limit_prelude(memory_mb: int, cpu_seconds: int) -> str:
+    """Child-side resource limiting. Hard limits cannot be raised again by
+    the candidate code, and doing this inside the child (instead of a
+    preexec_fn) keeps the parent on posix_spawn — preexec_fn would force a
+    raw fork(), which deadlocks under multithreaded JAX processes."""
+    b = memory_mb * 1024 * 1024
+    return (
+        "import resource as _r\n"
+        f"_r.setrlimit(_r.RLIMIT_AS, ({b}, {b}))\n"
+        f"_r.setrlimit(_r.RLIMIT_CPU, ({cpu_seconds}, {cpu_seconds}))\n"
+        "_r.setrlimit(_r.RLIMIT_FSIZE, (1 << 20, 1 << 20))\n"
+        "try:\n"
+        "    _r.setrlimit(_r.RLIMIT_NPROC, (16, 16))\n"
+        "except (ValueError, OSError):\n"
+        "    pass\n"
+        "del _r\n"
+    )
+
+
+def run_sandboxed(
+    code: str,
+    stdin: str = "",
+    timeout: float = 5.0,
+    memory_mb: int = 512,
+) -> Tuple[int, str, str]:
+    """Execute `code` in an isolated python subprocess; returns
+    (returncode, stdout, stderr); returncode -9/-24 style on kill."""
+    with tempfile.TemporaryDirectory(prefix="code_rlvr_") as cwd:
+        env = {
+            "PATH": "/usr/bin:/bin",
+            "HOME": cwd,
+            "TMPDIR": cwd,
+            # no proxy/network hints; the sandbox has no creds either way
+        }
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-I",
+                    "-c",
+                    _limit_prelude(memory_mb, int(timeout) + 1) + code,
+                ],
+                input=stdin,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                cwd=cwd,
+                env=env,
+                start_new_session=True,
+            )
+            return proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            return -24, (e.stdout or ""), "TIMEOUT"
+        except Exception as e:  # spawn failure counts as a crash
+            return -1, "", f"{type(e).__name__}: {e}"
+
+
+def _norm_output(s: str) -> List[str]:
+    return [line.rstrip() for line in s.strip().splitlines()]
+
+
+def verify_code(
+    code: str,
+    test_cases: Optional[List[Dict[str, Any]]] = None,
+    test_code: Optional[str] = None,
+    timeout: float = 5.0,
+    memory_mb: int = 512,
+) -> bool:
+    """True iff the candidate passes every test — BOTH styles when a row
+    carries both (grading on the weaker one alone would reward wrong
+    code)."""
+    if test_code is None and not test_cases:
+        return False
+    if test_code is not None:
+        rc, _, _ = run_sandboxed(
+            code + "\n\n" + test_code, timeout=timeout, memory_mb=memory_mb
+        )
+        if rc != 0:
+            return False
+        if not test_cases:
+            return True
+    for case in test_cases or []:
+        rc, out, _ = run_sandboxed(
+            code,
+            stdin=str(case.get("input", "")),
+            timeout=timeout,
+            memory_mb=memory_mb,
+        )
+        if rc != 0:
+            return False
+        if _norm_output(out) != _norm_output(str(case.get("output", ""))):
+            return False
+    return bool(test_cases)
+
+
+def code_reward_fn(
+    prompt: str,
+    completion: str,
+    prompt_ids=None,
+    completion_ids=None,
+    test_cases: Optional[List[Dict[str, Any]]] = None,
+    test_code: Optional[str] = None,
+    timeout: float = 5.0,
+    memory_mb: int = 512,
+    **kwargs,
+) -> float:
+    """RLVR reward: 1.0 iff the completion's code passes all tests
+    (workflow reward signature, see reward/math_parser.gsm8k_reward_fn).
+    `test_cases` may arrive JSON-encoded (jsonl datasets)."""
+    code = extract_code(completion)
+    if code is None:
+        return 0.0
+    if isinstance(test_cases, str):
+        try:
+            test_cases = json.loads(test_cases)
+        except json.JSONDecodeError:
+            return 0.0
+    try:
+        return float(
+            verify_code(
+                code,
+                test_cases=test_cases,
+                test_code=test_code,
+                timeout=timeout,
+                memory_mb=memory_mb,
+            )
+        )
+    except Exception:
+        return 0.0

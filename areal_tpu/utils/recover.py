@@ -138,6 +138,10 @@ class RecoverHandler:
             evaluator.load_state_dict(info.evaluator_state)
         if dataloader is not None and info.dataloader_state:
             dataloader.load_state_dict(info.dataloader_state)
+        # the version counter must survive recovery on EVERY rank and in
+        # every mode — training derives the next version from it, and a
+        # reset would jump staleness accounting backwards
+        engine.set_version(info.model_version)
         if inference_engine is not None:
             inference_engine.set_version(info.model_version)
             if weight_update_meta is not None:
@@ -148,7 +152,6 @@ class RecoverHandler:
                     path=self.weights_path,
                     model_version=info.model_version,
                 )
-                engine.set_version(info.model_version)
                 fut = inference_engine.update_weights(meta)
                 fut.result(timeout=600)
         logger.info(

@@ -1,0 +1,188 @@
+"""Sandboxed code-execution reward (functioncall analog): verifier
+behavior, resource limits, dataset wiring, and the RLVR workflow e2e.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.reward.code_verifier import (
+    code_reward_fn,
+    extract_code,
+    run_sandboxed,
+    verify_code,
+)
+
+
+class TestExtractCode:
+    def test_fenced_block(self):
+        text = "Here you go:\n```python\nprint('hi')\n```\nDone."
+        assert extract_code(text) == "print('hi')"
+
+    def test_last_block_wins(self):
+        text = "```python\nx = 1\n```\nbut actually\n```python\nx = 2\n```"
+        assert extract_code(text) == "x = 2"
+
+    def test_bare_code(self):
+        assert extract_code("def f():\n    return 1") is not None
+
+    def test_prose_only(self):
+        assert extract_code("I cannot solve this problem.") is None
+
+
+class TestSandbox:
+    def test_stdout(self):
+        rc, out, _ = run_sandboxed("print(2 + 2)")
+        assert rc == 0 and out.strip() == "4"
+
+    def test_stdin(self):
+        rc, out, _ = run_sandboxed(
+            "n = int(input())\nprint(n * 3)", stdin="7\n"
+        )
+        assert rc == 0 and out.strip() == "21"
+
+    def test_crash(self):
+        rc, _, err = run_sandboxed("raise ValueError('boom')")
+        assert rc != 0 and "boom" in err
+
+    def test_timeout_bounded(self):
+        t0 = time.monotonic()
+        rc, _, err = run_sandboxed("while True: pass", timeout=2.0)
+        assert rc != 0
+        assert time.monotonic() - t0 < 10
+        assert err == "TIMEOUT" or rc < 0
+
+    def test_memory_limit(self):
+        rc, _, _ = run_sandboxed(
+            "x = bytearray(10**9)\nprint('allocated')",
+            timeout=10.0,
+            memory_mb=128,
+        )
+        assert rc != 0  # MemoryError or kill, never 'allocated'
+
+    def test_isolated_env(self):
+        rc, out, _ = run_sandboxed("import os; print(os.environ.get('PATH'))")
+        assert rc == 0 and "/usr/bin" in out
+
+
+class TestVerify:
+    def test_input_output_pass(self):
+        code = "a, b = map(int, input().split())\nprint(a + b)"
+        cases = [
+            {"input": "1 2\n", "output": "3"},
+            {"input": "10 -4\n", "output": "6"},
+        ]
+        assert verify_code(code, test_cases=cases)
+
+    def test_input_output_fail(self):
+        code = "a, b = map(int, input().split())\nprint(a - b)"
+        cases = [{"input": "1 2\n", "output": "3"}]
+        assert not verify_code(code, test_cases=cases)
+
+    def test_assert_style(self):
+        sol = "def add(a, b):\n    return a + b"
+        good = "assert add(1, 2) == 3\nassert add(-1, 1) == 0"
+        bad = "assert add(1, 2) == 4"
+        assert verify_code(sol, test_code=good)
+        assert not verify_code(sol, test_code=bad)
+
+    def test_no_cases_is_failure(self):
+        assert not verify_code("print(1)", test_cases=[])
+
+
+class TestRewardFn:
+    def test_full_reward(self):
+        completion = (
+            "We read two ints and add them.\n"
+            "```python\na, b = map(int, input().split())\nprint(a + b)\n```"
+        )
+        cases = [{"input": "3 4\n", "output": "7"}]
+        assert code_reward_fn("p", completion, test_cases=cases) == 1.0
+        # JSON-encoded cases (jsonl datasets)
+        assert (
+            code_reward_fn("p", completion, test_cases=json.dumps(cases))
+            == 1.0
+        )
+
+    def test_no_code_zero(self):
+        assert code_reward_fn("p", "no idea", test_cases=[{}]) == 0.0
+
+    def test_wrong_code_zero(self):
+        completion = "```python\nprint('nope')\n```"
+        cases = [{"input": "", "output": "7"}]
+        assert code_reward_fn("p", completion, test_cases=cases) == 0.0
+
+
+def test_code_dataset_loader(tmp_path):
+    from areal_tpu.api.cli_args import DatasetConfig
+    from areal_tpu.dataset import get_custom_dataset
+
+    rows = [
+        {
+            "question": "Add two numbers from stdin.",
+            "test_cases": [{"input": "1 2\n", "output": "3"}],
+        },
+        {
+            "question": "Implement add(a, b).",
+            "test_code": "assert add(1, 1) == 2",
+        },
+    ]
+    p = tmp_path / "train.jsonl"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    ds = get_custom_dataset(DatasetConfig(path=str(p), type="code"))
+    assert len(ds) == 2
+    assert ds[0]["test_cases"][0]["output"] == "3"
+    assert "test_code" in ds[1]
+    assert ds[0]["question"].startswith("Add")
+
+
+def test_code_rlvr_workflow_e2e():
+    """The full RLVR episode path with the code reward: a fake engine
+    'generates' a correct solution for one sample and a wrong one for the
+    other; rewards must come back 1.0 / 0.0 through the async sandbox."""
+    import dataclasses
+
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    good = "```python\na, b = map(int, input().split())\nprint(a + b)\n```"
+    bad = "```python\nprint('wrong')\n```"
+
+    class FakeTokenizer:
+        def decode(self, ids):
+            return good if len(ids) == 1 else bad
+
+    class FakeEngine:
+        def __init__(self):
+            self.calls = 0
+
+        async def agenerate(self, req):
+            self.calls += 1
+            n = 1 if self.calls % 2 == 1 else 2
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=[5] * n,
+                output_logprobs=[-0.1] * n,
+                output_versions=[0] * n,
+                stop_reason="stop",
+            )
+
+    wf = RLVRWorkflow(
+        code_reward_fn,
+        GenerationHyperparameters(n_samples=2, max_new_tokens=4),
+        tokenizer=FakeTokenizer(),
+    )
+    data = {
+        "input_ids": [1, 2, 3],
+        "test_cases": [{"input": "2 5\n", "output": "7"}],
+    }
+    out = asyncio.run(wf.arun_episode(FakeEngine(), data))
+    assert out is not None
+    rewards = np.asarray(out["rewards"]).reshape(-1)
+    assert sorted(rewards.tolist()) == [0.0, 1.0]

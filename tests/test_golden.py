@@ -1,0 +1,173 @@
+"""Golden-value numerics regression: fixed-seed SFT and GRPO runs must
+reproduce committed reference losses (reference areal/tests/sft/
+ref_losses.json asserted by test_sft.py / test_grpo.py).
+
+"Loss goes down" catches broken training; only golden values catch a
+*quietly different* loss — dtype drift, attention-mask edits, optimizer
+reorderings. Regenerate intentionally with:
+
+    python tests/test_golden.py regen
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "ref_losses.json")
+
+
+def _sft_losses():
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        ParallelismConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.sft.lm_engine import sft_loss_fn, sft_loss_weight_fn
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.models.config import tiny_config
+
+    cfg = TrainEngineConfig(
+        dtype="float32",
+        param_dtype="float32",
+        init_from_scratch=True,
+        gradient_checkpointing=False,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=4096),
+        optimizer=OptimizerConfig(
+            lr=1e-3, warmup_steps_proportion=0.0, weight_decay=0.01
+        ),
+        parallel=ParallelismConfig(),
+    )
+    engine = SPMDTrainEngine(cfg)
+    engine.initialize(
+        ft_spec=FinetuneSpec(1, 16, 4),
+        model_config=tiny_config("qwen2"),
+        seed=0,
+    )
+    rng = np.random.default_rng(12345)
+    losses = []
+    for _ in range(4):
+        L = 20
+        batch = {
+            "input_ids": rng.integers(
+                0, 128, size=(4, L), dtype=np.int64
+            ).astype(np.int32),
+            "attention_mask": np.ones((4, L), np.bool_),
+            "loss_mask": (rng.random((4, L)) > 0.25).astype(np.int32),
+        }
+        stats = engine.train_batch(batch, sft_loss_fn, sft_loss_weight_fn)
+        losses.append(round(float(stats["loss"]), 6))
+    return losses
+
+
+def _grpo_losses():
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        ParallelismConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.ppo.actor import PPOActor
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.models.config import tiny_config
+
+    pcfg = PPOActorConfig(
+        dtype="float32",
+        param_dtype="float32",
+        init_from_scratch=True,
+        gradient_checkpointing=False,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=4096),
+        optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        parallel=ParallelismConfig(),
+        group_size=2,
+        ppo_n_minibatches=1,
+        group_reward_norm=True,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+        kl_ctl=0.05,
+    )
+    engine = SPMDTrainEngine(pcfg)
+    engine.initialize(
+        ft_spec=FinetuneSpec(1, 16, 4),
+        model_config=tiny_config("qwen2"),
+        seed=1,
+    )
+    actor = PPOActor(pcfg, engine)
+    rng = np.random.default_rng(777)
+    out = []
+    # fixed seed for the minibatch permutation inside ppo_update
+    np.random.seed(4242)
+    for step in range(2):
+        bsz, L, plen = 4, 18, 6
+        batch = {
+            "input_ids": rng.integers(
+                0, 128, size=(bsz, L), dtype=np.int64
+            ).astype(np.int32),
+            "attention_mask": np.ones((bsz, L), np.bool_),
+            "loss_mask": np.asarray(
+                [[0] * plen + [1] * (L - plen)] * bsz, np.int32
+            ),
+            "logprobs": (rng.random((bsz, L)) * -2.0).astype(np.float32)
+            * np.asarray([[0] * plen + [1] * (L - plen)] * bsz, np.float32),
+            "versions": np.full((bsz, L), -1, np.int32),
+            "rewards": rng.random(bsz).astype(np.float32),
+            "ref_logp": (rng.random((bsz, L)) * -2.0).astype(np.float32),
+        }
+        adv = actor.compute_advantages(dict(batch))
+        stats = actor.ppo_update(adv)
+        out.append(
+            {
+                "loss": round(float(stats[0]["loss"]), 6),
+                "grad_norm": round(float(stats[0]["grad_norm"]), 5),
+            }
+        )
+    return out
+
+
+def _compute_all():
+    return {"sft_losses": _sft_losses(), "grpo_steps": _grpo_losses()}
+
+
+def test_golden_values():
+    assert os.path.exists(GOLDEN_PATH), (
+        f"golden file missing: {GOLDEN_PATH} — run "
+        "`python tests/test_golden.py regen`"
+    )
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    got = _compute_all()
+    np.testing.assert_allclose(
+        got["sft_losses"], golden["sft_losses"], rtol=2e-3,
+        err_msg="SFT loss numerics drifted from golden values",
+    )
+    for g, ref in zip(got["grpo_steps"], golden["grpo_steps"]):
+        np.testing.assert_allclose(
+            g["loss"], ref["loss"], rtol=5e-3, atol=1e-5,
+            err_msg="GRPO loss numerics drifted from golden values",
+        )
+        np.testing.assert_allclose(
+            g["grad_norm"], ref["grad_norm"], rtol=5e-3,
+            err_msg="GRPO grad-norm numerics drifted from golden values",
+        )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "regen":
+        from __graft_entry__ import _ensure_virtual_devices
+
+        _ensure_virtual_devices(8)
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        vals = _compute_all()
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(vals, f, indent=1)
+        print(f"wrote {GOLDEN_PATH}: {vals}")
+    else:
+        print(__doc__)
