@@ -94,6 +94,37 @@ class ParallelStrategy:
                 out.append(f"{ch}{v}")
         return "".join(out) or "d1"
 
+    def to_tpu_parallelism(self):
+        """Map the DSL factors onto the TPU mesh axes, rejecting what the
+        backend doesn't implement INSTEAD of silently misbehaving
+        downstream: d → fsdp (ZeRO-style), c → seq, t → tensor; e is
+        carved OUT of d (DSL semantics: experts shard within the d·c
+        degrees — `expert_data_parallel_size` — so total devices stay
+        d·t·c). p>1 is refused (XLA SPMD over the other axes is the TPU
+        answer to the scales the reference reaches with its
+        instruction-interpreted pipeline engine)."""
+        from areal_tpu.api.cli_args import ParallelismConfig
+
+        if self.pipeline_parallel_size > 1:
+            raise AllocationValidationError(
+                "pipeline parallelism (p>1) is not implemented on the TPU "
+                "backend — use fsdp/tensor/seq/expert axes instead "
+                f"(got {self.to_str()!r})"
+            )
+        e = self.expert_parallel_size
+        if self.data_parallel_size % e != 0:
+            raise AllocationValidationError(
+                f"e={e} must divide d={self.data_parallel_size} on the "
+                "TPU backend (experts shard within the data degrees)"
+            )
+        return ParallelismConfig(
+            data_parallel_size=1,
+            fsdp_parallel_size=self.data_parallel_size // e,
+            tensor_parallel_size=self.tensor_parallel_size,
+            seq_parallel_size=self.context_parallel_size,
+            expert_parallel_size=e,
+        )
+
     @classmethod
     def from_str(cls, s: str) -> "ParallelStrategy":
         s = s.strip()

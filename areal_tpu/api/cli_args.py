@@ -79,6 +79,8 @@ class ParallelismConfig:
     fsdp_parallel_size: int = 1
     tensor_parallel_size: int = 1
     seq_parallel_size: int = 1
+    # MoE expert-parallel degree (experts shard over this mesh axis)
+    expert_parallel_size: int = 1
 
     @property
     def world_size(self) -> int:
@@ -87,6 +89,7 @@ class ParallelismConfig:
             * self.fsdp_parallel_size
             * self.tensor_parallel_size
             * self.seq_parallel_size
+            * self.expert_parallel_size
         )
 
 

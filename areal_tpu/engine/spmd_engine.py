@@ -289,11 +289,15 @@ class SPMDTrainEngine(TrainEngine):
                 cparams = jax.tree_util.tree_map(
                     lambda p: p.astype(compute_dtype), params
                 )
-                logits = model_apply(
+                logits, router_aux = model_apply(
                     cparams, mc, arrays["tokens"], arrays["segment_ids"],
                     arrays["positions"], remat=remat, attend_fn=attend,
+                    return_router_loss=True,
                 )
                 loss, stats = loss_fn(logits, arrays)
+                if mc.is_moe and mc.router_aux_loss_coef:
+                    loss = loss + mc.router_aux_loss_coef * router_aux
+                    stats = dict(stats, router_aux_loss=router_aux)
                 w = loss_weight_fn(arrays).astype(jnp.float32)
                 return loss * w, (loss, stats, w)
 

@@ -88,6 +88,12 @@ class GenerationEngine:
         self.dtype = _DTYPES[config.dtype]
         if model_config is None:
             model_config = load_hf_config(config.model_path)
+        if model_config.is_moe:
+            raise NotImplementedError(
+                "MoE serving is not implemented yet (training-side MoE/EP "
+                "is; the generation engine needs an expert-dispatch decode "
+                "path)"
+            )
         self.model_config = model_config
         if params is None:
             params = hf_io.load_params(

@@ -138,6 +138,9 @@ def local_main(
         if config.allocation_mode
         else None
     )
+    if alloc is not None and alloc.train is not None:
+        # fail fast on factors the TPU backend doesn't implement (p>1)
+        alloc.train.to_tpu_parallelism()
     launcher = LocalLauncher(
         config.experiment_name, config.trial_name, config.cluster.fileroot
     )

@@ -28,6 +28,13 @@ class ModelConfig:
     attention_bias: bool = False  # qwen2: QKV bias, no O bias
     use_qk_norm: bool = False  # qwen3: per-head RMSNorm on q and k
     family: str = "llama"
+    # --- MoE (0 experts = dense; reference realhf/impl/model/modules/moe) ---
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0  # 0 → intermediate_size
+    norm_topk_prob: bool = True
+    router_aux_loss_coef: float = 0.001
+    moe_capacity_factor: float = 1.25
 
     @property
     def q_dim(self) -> int:
@@ -37,11 +44,21 @@ class ModelConfig:
     def kv_dim(self) -> int:
         return self.num_kv_heads * self.head_dim
 
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def expert_ffn_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
+
 
 # Supported HF `model_type`s (all share the llama-style decoder block:
 # RMSNorm + SiLU-gated MLP + rotary GQA attention). gemma/gpt2 need
-# architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for now.
-_HF_FAMILIES = ("llama", "qwen2", "qwen3", "mistral")
+# architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for
+# now. qwen2_moe (shared-expert variant) is rejected until shared experts
+# land; qwen3_moe/mixtral are the supported sparse families.
+_HF_FAMILIES = ("llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral")
 
 
 def from_hf_config(d: dict) -> ModelConfig:
@@ -53,6 +70,7 @@ def from_hf_config(d: dict) -> ModelConfig:
     num_heads = d["num_attention_heads"]
     hidden = d["hidden_size"]
     head_dim = d.get("head_dim") or hidden // num_heads
+    num_experts = d.get("num_experts") or d.get("num_local_experts") or 0
     return ModelConfig(
         vocab_size=d["vocab_size"],
         hidden_size=hidden,
@@ -66,8 +84,18 @@ def from_hf_config(d: dict) -> ModelConfig:
         rms_norm_eps=d.get("rms_norm_eps", 1e-6),
         tie_word_embeddings=d.get("tie_word_embeddings", False),
         attention_bias=d.get("attention_bias", model_type == "qwen2"),
-        use_qk_norm=(model_type == "qwen3"),
+        use_qk_norm=(model_type in ("qwen3", "qwen3_moe")),
         family=model_type,
+        num_experts=num_experts,
+        num_experts_per_tok=d.get(
+            "num_experts_per_tok", d.get("top_k", 2)
+        ),
+        moe_intermediate_size=d.get("moe_intermediate_size", 0),
+        # HF Mixtral renormalizes top-k routing weights unconditionally
+        # and qwen3_moe's config ships norm_topk_prob=true — True is the
+        # correct default for every supported MoE family
+        norm_topk_prob=d.get("norm_topk_prob", True),
+        router_aux_loss_coef=d.get("router_aux_loss_coef", 0.001),
     )
 
 
@@ -78,6 +106,7 @@ def load_hf_config(path: str) -> ModelConfig:
 
 def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
     """Small config for tests."""
+    moe = family in ("qwen3_moe", "mixtral")
     return ModelConfig(
         vocab_size=vocab_size,
         hidden_size=64,
@@ -91,6 +120,10 @@ def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
         rms_norm_eps=1e-6,
         tie_word_embeddings=False,
         attention_bias=(family == "qwen2"),
-        use_qk_norm=(family == "qwen3"),
+        use_qk_norm=(family in ("qwen3", "qwen3_moe")),
         family=family,
+        num_experts=4 if moe else 0,
+        num_experts_per_tok=2,
+        moe_intermediate_size=32 if moe else 0,
+        norm_topk_prob=True,
     )

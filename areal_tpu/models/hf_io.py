@@ -39,6 +39,23 @@ _LAYER_MAP = {
     "w_down": ("mlp.down_proj.weight", True),
 }
 
+# MoE families: per-expert FFN weights stack along a leading expert axis.
+# our key -> (hf suffix template with {e}, transpose?)
+_MOE_MAPS = {
+    "qwen3_moe": {
+        "w_router": ("mlp.gate.weight", True),
+        "w_gate": ("mlp.experts.{e}.gate_proj.weight", True),
+        "w_up": ("mlp.experts.{e}.up_proj.weight", True),
+        "w_down": ("mlp.experts.{e}.down_proj.weight", True),
+    },
+    "mixtral": {
+        "w_router": ("block_sparse_moe.gate.weight", True),
+        "w_gate": ("block_sparse_moe.experts.{e}.w1.weight", True),
+        "w_up": ("block_sparse_moe.experts.{e}.w3.weight", True),
+        "w_down": ("block_sparse_moe.experts.{e}.w2.weight", True),
+    },
+}
+
 
 def _open_shards(path: str) -> Dict[str, str]:
     """tensor name -> shard file path."""
@@ -89,7 +106,10 @@ def load_params(
         return arr
 
     layers: Dict[str, np.ndarray] = {}
+    moe_map = _MOE_MAPS.get(cfg.family) if cfg.is_moe else None
     for our_key, (suffix, transpose) in _LAYER_MAP.items():
+        if moe_map and our_key in moe_map:
+            continue  # expert-shaped in MoE families (handled below)
         name0 = f"model.layers.0.{suffix}"
         if name0 not in reader:
             continue
@@ -98,6 +118,22 @@ def load_params(
             w = g(f"model.layers.{i}.{suffix}")
             per_layer.append(w.T if transpose else w)
         layers[our_key] = jnp.asarray(np.stack(per_layer), dtype=dtype)
+    if moe_map:
+        for our_key, (tmpl, transpose) in moe_map.items():
+            per_layer = []
+            for i in range(cfg.num_layers):
+                if "{e}" in tmpl:
+                    per_exp = []
+                    for ei in range(cfg.num_experts):
+                        w = g(
+                            f"model.layers.{i}.{tmpl.format(e=ei)}"
+                        )
+                        per_exp.append(w.T if transpose else w)
+                    per_layer.append(np.stack(per_exp))
+                else:
+                    w = g(f"model.layers.{i}.{tmpl}")
+                    per_layer.append(w.T if transpose else w)
+            layers[our_key] = jnp.asarray(np.stack(per_layer), dtype=dtype)
     params: Params = {
         "embedding": jnp.asarray(g("model.embed_tokens.weight"), dtype=dtype),
         "layers": layers,
@@ -128,8 +164,11 @@ def save_params(
     tensors["model.norm.weight"] = as_np32(params["final_norm"])
     if not cfg.tie_word_embeddings:
         tensors["lm_head.weight"] = as_np32(params["lm_head"]).T.copy()
+    moe_map = _MOE_MAPS.get(cfg.family) if cfg.is_moe else None
     for our_key, (suffix, transpose) in _LAYER_MAP.items():
         if our_key not in params["layers"]:
+            continue
+        if moe_map and our_key in moe_map:
             continue
         stacked = as_np32(params["layers"][our_key])
         for i in range(cfg.num_layers):
@@ -137,6 +176,21 @@ def save_params(
             tensors[f"model.layers.{i}.{suffix}"] = (
                 w.T.copy() if transpose else w.copy()
             )
+    if moe_map:
+        for our_key, (tmpl, transpose) in moe_map.items():
+            stacked = as_np32(params["layers"][our_key])
+            for i in range(cfg.num_layers):
+                if "{e}" in tmpl:
+                    for ei in range(cfg.num_experts):
+                        w = stacked[i, ei]
+                        tensors[f"model.layers.{i}.{tmpl.format(e=ei)}"] = (
+                            w.T.copy() if transpose else w.copy()
+                        )
+                else:
+                    w = stacked[i]
+                    tensors[f"model.layers.{i}.{tmpl}"] = (
+                        w.T.copy() if transpose else w.copy()
+                    )
     save_file(tensors, os.path.join(path, "model.safetensors"))
     if hf_config_dict is None:
         hf_config_dict = default_hf_config_dict(cfg)
@@ -165,5 +219,19 @@ def default_hf_config_dict(cfg: ModelConfig) -> dict:
             "qwen2": ["Qwen2ForCausalLM"],
             "qwen3": ["Qwen3ForCausalLM"],
             "mistral": ["MistralForCausalLM"],
+            "qwen3_moe": ["Qwen3MoeForCausalLM"],
+            "mixtral": ["MixtralForCausalLM"],
         }.get(cfg.family, ["LlamaForCausalLM"]),
+        **(
+            {
+                "num_experts": cfg.num_experts,
+                "num_local_experts": cfg.num_experts,
+                "num_experts_per_tok": cfg.num_experts_per_tok,
+                "moe_intermediate_size": cfg.expert_ffn_size,
+                "norm_topk_prob": cfg.norm_topk_prob,
+                "router_aux_loss_coef": cfg.router_aux_loss_coef,
+            }
+            if cfg.is_moe
+            else {}
+        ),
     }

@@ -43,7 +43,7 @@ def init_params(
     """Random init (scaled normal), HF-compatible structure."""
     L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     Qd, KVd = cfg.q_dim, cfg.kv_dim
-    keys = jax.random.split(rng, 8)
+    keys = jax.random.split(rng, 9)
 
     def nrm(key, shape, scale):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
@@ -56,10 +56,17 @@ def init_params(
         "wk": nrm(keys[1], (L, D, KVd), std),
         "wv": nrm(keys[2], (L, D, KVd), std),
         "wo": nrm(keys[3], (L, Qd, D), std),
-        "w_gate": nrm(keys[4], (L, D, F), std),
-        "w_up": nrm(keys[5], (L, D, F), std),
-        "w_down": nrm(keys[6], (L, F, D), std),
     }
+    if cfg.is_moe:
+        E, Fe = cfg.num_experts, cfg.expert_ffn_size
+        layers["w_router"] = nrm(keys[8], (L, D, E), std)
+        layers["w_gate"] = nrm(keys[4], (L, E, D, Fe), std)
+        layers["w_up"] = nrm(keys[5], (L, E, D, Fe), std)
+        layers["w_down"] = nrm(keys[6], (L, E, Fe, D), std)
+    else:
+        layers["w_gate"] = nrm(keys[4], (L, D, F), std)
+        layers["w_up"] = nrm(keys[5], (L, D, F), std)
+        layers["w_down"] = nrm(keys[6], (L, F, D), std)
     if cfg.attention_bias:
         layers["bq"] = jnp.zeros((L, Qd), dtype)
         layers["bk"] = jnp.zeros((L, KVd), dtype)
@@ -93,10 +100,16 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
         "wk": ("layer", "embed", "heads"),
         "wv": ("layer", "embed", "heads"),
         "wo": ("layer", "heads", "embed"),
-        "w_gate": ("layer", "embed", "mlp"),
-        "w_up": ("layer", "embed", "mlp"),
-        "w_down": ("layer", "mlp", "embed"),
     }
+    if cfg.is_moe:
+        layers["w_router"] = ("layer", "embed", None)
+        layers["w_gate"] = ("layer", "expert", "embed", "mlp")
+        layers["w_up"] = ("layer", "expert", "embed", "mlp")
+        layers["w_down"] = ("layer", "expert", "mlp", "embed")
+    else:
+        layers["w_gate"] = ("layer", "embed", "mlp")
+        layers["w_up"] = ("layer", "embed", "mlp")
+        layers["w_down"] = ("layer", "mlp", "embed")
     if cfg.attention_bias:
         layers["bq"] = ("layer", "heads")
         layers["bk"] = ("layer", "heads")
@@ -126,7 +139,7 @@ def _layer_body(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     attend_fn: Optional[Any] = None,
-) -> jnp.ndarray:
+):
     b, t, d = x.shape
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
     q = h @ lp["wq"]
@@ -150,8 +163,22 @@ def _layer_body(
         attn = attend_fn(q, k, v, segment_ids)
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        from areal_tpu.ops.moe import moe_ffn
+
+        ffn, aux = moe_ffn(
+            h,
+            lp["w_router"],
+            lp["w_gate"],
+            lp["w_up"],
+            lp["w_down"],
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            norm_topk_prob=cfg.norm_topk_prob,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        return x + ffn, aux
     ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
-    return x + ffn
+    return x + ffn, jnp.zeros((), jnp.float32)
 
 
 def apply(
@@ -162,8 +189,11 @@ def apply(
     positions: jnp.ndarray,  # [B, T] int32; restart per sequence
     remat: bool = True,
     attend_fn: Optional[Any] = None,
-) -> jnp.ndarray:
-    """Forward to logits [B, T, vocab] (fp32).
+    return_router_loss: bool = False,
+):
+    """Forward to logits [B, T, vocab] (fp32); with
+    ``return_router_loss=True`` returns (logits, mean per-layer MoE
+    load-balancing loss — 0.0 for dense models).
 
     `attend_fn(q, k, v, segment_ids)` overrides the attention kernel (e.g.
     ring / Ulysses shard_map from ops/ring_attention.py); default is the
@@ -175,21 +205,24 @@ def apply(
     x = params["embedding"][tokens]
 
     def body(carry, lp):
-        out = _layer_body(
+        out, aux = _layer_body(
             cfg, carry, lp, segment_ids, positions, cos, sin, attend_fn
         )
-        return out, None
+        return out, aux
 
     if remat:
         body = jax.checkpoint(body, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, aux = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = (
         params["embedding"].T
         if cfg.tie_word_embeddings
         else params["lm_head"]
     )
-    return (x.astype(jnp.float32)) @ head.astype(jnp.float32)
+    logits = (x.astype(jnp.float32)) @ head.astype(jnp.float32)
+    if return_router_loss:
+        return logits, jnp.mean(aux)
+    return logits
 
 
 def count_params(params: Params) -> int:

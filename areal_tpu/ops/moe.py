@@ -1,0 +1,134 @@
+"""Mixture-of-Experts FFN: top-k routing with blocked capacity dispatch.
+
+Role of reference realhf/impl/model/modules/moe/{router,experts,
+token_dispatcher,layer}.py (top-k router + grouped GEMM + all-to-all token
+dispatcher), re-designed TPU-first: instead of a device-side all-to-all of
+ragged token groups, tokens dispatch into fixed-capacity per-expert slots
+via one-hot einsums — every shape static, XLA lowers the dispatch/combine
+einsums to gathers/scatters and, with expert weights sharded on the
+"expert" mesh axis, inserts the EP collectives itself.
+
+Capacity is enforced per fixed-size token BLOCK (the dispatch tensor is
+[block, k, E, C]; blocking keeps it ~MBs instead of GBs for long packed
+streams). Tokens over a block's per-expert capacity are dropped (standard
+Switch/GShard semantics — the residual stream carries them unchanged).
+A Pallas ragged-dispatch kernel (megablox analog) can slot in behind this
+same interface later for dropless MoE.
+"""
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(
+    logits: jnp.ndarray,  # [G, E] fp32
+    k: int,
+    norm_topk_prob: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (topk_probs [G,k], topk_idx [G,k], full probs [G,E])."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topk_p, topk_i = jax.lax.top_k(probs, k)
+    if norm_topk_prob:
+        topk_p = topk_p / jnp.maximum(
+            topk_p.sum(-1, keepdims=True), 1e-9
+        )
+    return topk_p, topk_i, probs
+
+
+def load_balancing_loss(
+    probs: jnp.ndarray,  # [G, E] full router probs
+    topk_idx: jnp.ndarray,  # [G, k]
+    num_experts: int,
+) -> jnp.ndarray:
+    """Switch-style aux loss: E * Σ_e f_e · P_e, where f_e is the fraction
+    of tokens routed to e and P_e the mean router prob (reference
+    modules/moe/router.py aux losses)."""
+    assign = jax.nn.one_hot(topk_idx, num_experts, dtype=jnp.float32)
+    f = assign.sum(1).mean(0)  # [E] fraction (sums to k)
+    p = probs.mean(0)  # [E]
+    return num_experts * jnp.sum(f * p) / topk_idx.shape[-1]
+
+
+def moe_ffn(
+    x: jnp.ndarray,  # [B, T, D]
+    w_router: jnp.ndarray,  # [D, E]
+    w_gate: jnp.ndarray,  # [E, D, F]
+    w_up: jnp.ndarray,  # [E, D, F]
+    w_down: jnp.ndarray,  # [E, F, D]
+    num_experts_per_tok: int,
+    norm_topk_prob: bool = True,
+    capacity_factor: float = 1.25,
+    block: int = 1024,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (output [B, T, D], aux_loss scalar fp32)."""
+    b, t, d = x.shape
+    e = w_router.shape[-1]
+    k = num_experts_per_tok
+    xf = x.reshape(-1, d)  # [G, D]
+    g = xf.shape[0]
+    logits = xf.astype(jnp.float32) @ w_router.astype(jnp.float32)
+    topk_p, topk_i, probs = router_topk(logits, k, norm_topk_prob)
+    aux = load_balancing_loss(probs, topk_i, e)
+
+    blk = min(block, g)
+    pad = (-g) % blk
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, d), xf.dtype)])
+        topk_p = jnp.concatenate(
+            [topk_p, jnp.zeros((pad, k), topk_p.dtype)]
+        )
+        # padding routes to expert 0 with zero combine weight
+        topk_i = jnp.concatenate(
+            [topk_i, jnp.zeros((pad, k), topk_i.dtype)]
+        )
+    nb = xf.shape[0] // blk
+    cap = max(8, int(blk * k * capacity_factor / e + 0.5))
+    cap = min(cap, blk * k)
+
+    def per_block(xb, ib, pb):
+        # xb [blk, D], ib [blk, k], pb [blk, k]
+        mask = jax.nn.one_hot(ib, e, dtype=jnp.float32)  # [blk, k, E]
+        # position of each (token, slot) within its expert's capacity:
+        # exclusive cumulative count in (token-major, slot-minor) order
+        flat = mask.reshape(blk * k, e)
+        pos = (jnp.cumsum(flat, axis=0) - flat).reshape(blk, k, e)
+        keep = mask * (pos < cap)
+        disp = (
+            jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+            * keep[..., None]
+        )  # [blk, k, E, C]
+        dd = disp.astype(xb.dtype)
+        exp_in = jnp.einsum(
+            "skec,sd->ecd", dd, xb, preferred_element_type=jnp.float32
+        ).astype(xb.dtype)  # [E, C, D]
+        h = jax.nn.silu(
+            jnp.einsum(
+                "ecd,edf->ecf", exp_in, w_gate,
+                preferred_element_type=jnp.float32,
+            )
+        ) * jnp.einsum(
+            "ecd,edf->ecf", exp_in, w_up,
+            preferred_element_type=jnp.float32,
+        )
+        out_e = jnp.einsum(
+            "ecf,efd->ecd", h.astype(xb.dtype), w_down,
+            preferred_element_type=jnp.float32,
+        )  # [E, C, D] fp32
+        comb = dd * pb[:, :, None, None].astype(xb.dtype)
+        out = jnp.einsum(
+            "skec,ecd->sd", comb, out_e.astype(xb.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(xb.dtype)
+
+    out = jax.vmap(per_block)(
+        xf.reshape(nb, blk, d),
+        topk_i.reshape(nb, blk, k),
+        topk_p.reshape(nb, blk, k),
+    ).reshape(-1, d)
+    if pad:
+        out = out[:g]
+    return out.reshape(b, t, d), aux

@@ -6,9 +6,16 @@ realhf/base/topology.py (ProcessTopology/ParallelGrid) — on TPU a single
 plumbing: XLA derives the collectives from shardings, and they ride ICI.
 
 Mesh axes, outermost → innermost (innermost = fastest-varying device index =
-closest ICI neighbors; tensor needs the tightest coupling, then seq):
+closest ICI neighbors; tensor needs the tightest coupling, then expert's
+all-to-all-ish dispatch, then seq):
 
-    ("data", "fsdp", "seq", "tensor")
+    ("data", "fsdp", "seq", "expert", "tensor")
+
+Pipeline parallelism is deliberately ABSENT: on TPU the XLA SPMD program
+over these axes covers the scales the reference reaches with PP (its
+instruction-interpreted 1F1B engine, realhf/impl/model/backend/
+pipe_runner.py, exists because torch needs explicit stage scheduling);
+configs requesting p>1 are rejected loudly rather than silently ignored.
 """
 
 from typing import Optional, Sequence
@@ -19,7 +26,7 @@ from jax.sharding import Mesh
 
 from areal_tpu.api.cli_args import ParallelismConfig
 
-MESH_AXES = ("data", "fsdp", "seq", "tensor")
+MESH_AXES = ("data", "fsdp", "seq", "expert", "tensor")
 
 
 def make_mesh(
@@ -32,6 +39,7 @@ def make_mesh(
         parallel.data_parallel_size,
         parallel.fsdp_parallel_size,
         parallel.seq_parallel_size,
+        getattr(parallel, "expert_parallel_size", 1),
         parallel.tensor_parallel_size,
     )
     n = int(np.prod(shape))

@@ -25,6 +25,7 @@ DEFAULT_RULES: Dict[str, Optional[str]] = {
     "embed": "fsdp",
     "heads": "tensor",
     "mlp": "tensor",
+    "expert": "expert",  # MoE expert dim (EP)
     "vocab": None,
     "layer": None,
 }

@@ -43,13 +43,21 @@ def device_peak_flops(device_kind: str) -> Optional[float]:
 
 
 def matmul_weights(cfg: ModelConfig, with_head: bool = True) -> int:
-    """Total matmul-weight elements touched by one token's forward pass."""
+    """Total matmul-weight elements touched by one token's forward pass
+    (MoE: router + the k ACTIVE experts only)."""
     d = cfg.hidden_size
+    if cfg.is_moe:
+        ffn = (
+            d * cfg.num_experts  # router
+            + cfg.num_experts_per_tok * 3 * d * cfg.expert_ffn_size
+        )
+    else:
+        ffn = 3 * d * cfg.intermediate_size  # gate, up, down
     per_layer = (
         d * cfg.q_dim  # wq
         + 2 * d * cfg.kv_dim  # wk, wv
         + cfg.q_dim * d  # wo
-        + 3 * d * cfg.intermediate_size  # gate, up, down
+        + ffn
     )
     total = cfg.num_layers * per_layer
     if with_head:
