@@ -111,17 +111,25 @@ class ParallelStrategy:
                 "backend — use fsdp/tensor/seq/expert axes instead "
                 f"(got {self.to_str()!r})"
             )
+        import math
+
         e = self.expert_parallel_size
-        if self.data_parallel_size % e != 0:
+        d, c = self.data_parallel_size, self.context_parallel_size
+        # experts shard within the d·c degrees (expert_data_parallel
+        # semantics): carve e out of d first, then out of c
+        ed = math.gcd(e, d)
+        ec = e // ed
+        if c % ec != 0:
             raise AllocationValidationError(
-                f"e={e} must divide d={self.data_parallel_size} on the "
-                "TPU backend (experts shard within the data degrees)"
+                f"e={e} must divide d*c={d * c} factorwise on the TPU "
+                f"backend (experts shard within the data/context degrees; "
+                f"got d={d}, c={c})"
             )
         return ParallelismConfig(
             data_parallel_size=1,
-            fsdp_parallel_size=self.data_parallel_size // e,
+            fsdp_parallel_size=d // ed,
             tensor_parallel_size=self.tensor_parallel_size,
-            seq_parallel_size=self.context_parallel_size,
+            seq_parallel_size=c // ec,
             expert_parallel_size=e,
         )
 

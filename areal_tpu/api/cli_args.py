@@ -174,6 +174,11 @@ class InferenceEngineConfig:
     request_retries: int = 3
     setup_timeout: float = 120.0
     pause_grace_period: float = 0.0
+    # chunked partial rollout (reference realhf/system/partial_rollout.py:29
+    # PartialRolloutManager): each /generate asks for at most this many new
+    # tokens, so weight updates interleave at chunk boundaries even without
+    # server-side aborts; 0 = request everything at once
+    new_tokens_per_chunk: int = 0
 
 
 @dataclasses.dataclass

@@ -23,6 +23,9 @@ class ModelRequest:
     gconfig: GenerationHyperparameters = dataclasses.field(
         default_factory=GenerationHyperparameters
     )
+    # VLM inputs: base64-encoded images interleaved with image tokens in
+    # input_ids (reference io_struct.py ModelRequest.image_data)
+    image_data: List[str] = dataclasses.field(default_factory=list)
     metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

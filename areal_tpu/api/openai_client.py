@@ -1,0 +1,225 @@
+"""OpenAI-compatible async client over the InferenceEngine.
+
+Role of reference areal/experimental/openai/client.py (`ArealOpenAI`, an
+AsyncOpenAI subclass whose chat.completions.create routes through the
+in-repo engine, caches `CompletionWithTokenLogpReward`, and exports cached
+completions as RL training rows): agentic code written against the OpenAI
+chat API runs unchanged on top of this framework's generation engines,
+while every completion's token ids / behavior logprobs / model versions
+are captured for the trainer.
+
+The `openai` package is not a dependency here — the response objects are
+lightweight dataclasses with the same attribute shape
+(`resp.choices[0].message.content`, `resp.usage`, `resp.id`), which is
+what agent code actually touches. Tool-call parsing is left to the agent
+(the reference's tool_call_parser is model-specific string surgery).
+"""
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+
+
+@dataclasses.dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+
+@dataclasses.dataclass
+class Choice:
+    index: int
+    message: ChatMessage
+    finish_reason: str
+
+
+@dataclasses.dataclass
+class CompletionUsage:
+    prompt_tokens: int
+    completion_tokens: int
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+@dataclasses.dataclass
+class ChatCompletion:
+    id: str
+    choices: List[Choice]
+    created: int
+    model: str
+    usage: CompletionUsage
+
+
+@dataclasses.dataclass
+class CompletionWithTokenLogpReward:
+    """Cached RL view of one completion (reference
+    experimental/openai/types.py:38)."""
+
+    completion: ChatCompletion
+    messages: List[Dict[str, str]]
+    input_tokens: List[int]
+    output_tokens: List[int]
+    output_logprobs: List[float]
+    output_versions: List[int]
+    reward: Optional[float] = None
+
+    def to_training_row(self) -> Dict[str, np.ndarray]:
+        """Padded [1, L] tensors in the workflow batch schema."""
+        ids = list(self.input_tokens) + list(self.output_tokens)
+        plen, olen = len(self.input_tokens), len(self.output_tokens)
+        row = {
+            "input_ids": np.asarray([ids], np.int32),
+            "attention_mask": np.ones((1, plen + olen), np.bool_),
+            "loss_mask": np.asarray([[0] * plen + [1] * olen], np.int32),
+            "logprobs": np.asarray(
+                [[0.0] * plen + list(self.output_logprobs)], np.float32
+            ),
+            "versions": np.asarray(
+                [[-1] * plen + list(self.output_versions)], np.int32
+            ),
+            "rewards": np.asarray([self.reward or 0.0], np.float32),
+        }
+        return row
+
+
+class _ChatCompletions:
+    def __init__(self, client: "ArealOpenAI"):
+        self._client = client
+
+    async def create(
+        self,
+        *,
+        messages: List[Dict[str, str]],
+        max_tokens: Optional[int] = None,
+        max_completion_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        stop: Optional[List[str]] = None,
+        **unsupported: Any,
+    ) -> ChatCompletion:
+        c = self._client
+        base = c.gconfig
+        gconfig = base.new(
+            n_samples=1,
+            max_new_tokens=(
+                max_completion_tokens or max_tokens or base.max_new_tokens
+            ),
+            temperature=(
+                base.temperature if temperature is None else temperature
+            ),
+            top_p=base.top_p if top_p is None else top_p,
+        )
+        input_ids = c.tokenizer.apply_chat_template(
+            list(messages), tokenize=True, add_generation_prompt=True
+        )
+        if stop:
+            stop_ids = []
+            for s in stop if isinstance(stop, list) else [stop]:
+                t = c.tokenizer.encode(s, add_special_tokens=False)
+                if len(t) != 1:
+                    # truncating to a sub-token would halt generation on
+                    # ordinary prose — refuse loudly instead
+                    raise ValueError(
+                        f"stop string {s!r} is not a single token "
+                        f"({len(t)} ids); multi-token stop strings are "
+                        "not supported yet"
+                    )
+                stop_ids.append(t[0])
+            gconfig = gconfig.new(
+                stop_token_ids=list(gconfig.stop_token_ids) + stop_ids
+            )
+        req = ModelRequest(
+            input_ids=list(input_ids),
+            gconfig=gconfig,
+            rid=f"chatcmpl-{uuid.uuid4().hex}",
+        )
+        resp = await c.engine.agenerate(req)
+        text = c.tokenizer.decode(resp.output_tokens)
+        completion = ChatCompletion(
+            id=req.rid,
+            choices=[
+                Choice(
+                    index=0,
+                    message=ChatMessage(role="assistant", content=text),
+                    finish_reason=(
+                        "stop" if resp.stop_reason == "stop" else "length"
+                    ),
+                )
+            ],
+            created=int(time.time()),
+            model="areal-tpu",
+            usage=CompletionUsage(
+                prompt_tokens=len(req.input_ids),
+                completion_tokens=len(resp.output_tokens),
+            ),
+        )
+        c._cache[req.rid] = CompletionWithTokenLogpReward(
+            completion=completion,
+            messages=list(messages),
+            input_tokens=list(req.input_ids),
+            output_tokens=list(resp.output_tokens),
+            output_logprobs=list(resp.output_logprobs),
+            output_versions=list(resp.output_versions),
+        )
+        return completion
+
+
+class _Chat:
+    def __init__(self, client: "ArealOpenAI"):
+        self.completions = _ChatCompletions(client)
+
+
+class ArealOpenAI:
+    """OpenAI-shaped client bound to an InferenceEngine
+    (reference experimental/openai/client.py:194)."""
+
+    def __init__(
+        self,
+        engine,
+        tokenizer,
+        gconfig: Optional[GenerationHyperparameters] = None,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.gconfig = gconfig or GenerationHyperparameters()
+        self._cache: Dict[str, CompletionWithTokenLogpReward] = {}
+        self.chat = _Chat(self)
+
+    def get_completions(
+        self, completion_id: str
+    ) -> Optional[CompletionWithTokenLogpReward]:
+        return self._cache.get(completion_id)
+
+    def set_reward(self, completion_id: str, reward: float) -> None:
+        if completion_id not in self._cache:
+            raise KeyError(f"unknown completion id {completion_id}")
+        self._cache[completion_id].reward = float(reward)
+
+    def export_completions(
+        self, turn_discount: float = 1.0
+    ) -> Dict[str, CompletionWithTokenLogpReward]:
+        """All cached completions; rewards propagate backwards through an
+        agent's conversation turns with `turn_discount` (reference
+        export_completions semantics: later turns' rewards discount back
+        to the earlier turns that produced them)."""
+        items = sorted(self._cache.items(), key=lambda kv: kv[1].completion.created)
+        running: Optional[float] = None
+        for _, c in reversed(items):
+            if c.reward is not None:
+                running = (
+                    c.reward
+                    if running is None
+                    else c.reward + turn_discount * running
+                )
+            elif running is not None:
+                running = turn_discount * running
+                c.reward = running
+        return dict(items)

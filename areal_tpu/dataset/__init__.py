@@ -53,9 +53,45 @@ def _code_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
     return out
 
 
+def _math_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
+    """Generic math schema {question/problem, answer/solution} (MATH,
+    AIME-style jsonl; reference areal/dataset math loaders)."""
+    # explicit key checks: `or` would drop falsy-but-valid answers (0, 0.0)
+    if "answer" in row:
+        answer = row["answer"]
+    else:
+        answer = row.get("solution", "")
+    out = {"answer": str(answer)}
+    q = row.get("question") or row.get("problem") or ""
+    if tokenizer is not None:
+        out["messages"] = [{"role": "user", "content": q}]
+    else:
+        out["question"] = q
+    return out
+
+
+def _vision_to_rl(row: Dict[str, Any], tokenizer=None) -> Dict[str, Any]:
+    """VLM schema {images: [paths], question, answer} (clevr_count /
+    geometry3k-style; reference areal/dataset/__init__.py VLM loaders).
+    Image PATHS stay lazy — the vision workflow decodes them per episode,
+    so a 70k-row dataset never materializes every image in RAM."""
+    out: Dict[str, Any] = {"answer": str(row.get("answer", ""))}
+    paths = row.get("images") or row.get("image") or []
+    if isinstance(paths, str):
+        paths = [paths]
+    out["images"] = list(paths)
+    q = row.get("question") or row.get("prompt") or ""
+    out["messages"] = [{"role": "user", "content": q}]
+    return out
+
+
 _PROCESSORS: Dict[str, Callable] = {
     "gsm8k": _gsm8k_to_rl,
+    "math": _math_to_rl,
     "code": _code_to_rl,
+    "clevr_count": _vision_to_rl,
+    "geometry3k": _vision_to_rl,
+    "vision": _vision_to_rl,
     "raw": lambda row, tokenizer=None: row,
 }
 

@@ -160,13 +160,22 @@ class RemoteInferenceEngine(InferenceEngine):
         versions: List[int] = []
         stop_reason = None
         ttft = None
+        chunk = self.config.new_tokens_per_chunk or 0
         while stop_reason not in ("stop", "length") and len(accumulated) < gconfig.max_new_tokens:
             server = self.choose_server(req.rid)
+            remaining = gconfig.max_new_tokens - len(accumulated)
+            ask = min(remaining, chunk) if chunk > 0 else remaining
             payload = {
                 "rid": req.rid,
                 "input_ids": list(req.input_ids) + accumulated,
                 "sampling_params": {
-                    "max_new_tokens": gconfig.max_new_tokens - len(accumulated),
+                    "max_new_tokens": ask,
+                },
+            }
+            if req.image_data:
+                payload["image_data"] = list(req.image_data)
+            payload["sampling_params"].update(
+                {
                     "min_new_tokens": max(
                         0, gconfig.min_new_tokens - len(accumulated)
                     ),
@@ -175,8 +184,8 @@ class RemoteInferenceEngine(InferenceEngine):
                     "top_k": gconfig.top_k,
                     "greedy": gconfig.greedy,
                     "stop_token_ids": gconfig.stop_token_ids,
-                },
-            }
+                }
+            )
             result = await arequest_with_retry(
                 session,
                 f"http://{server}/generate",
@@ -190,6 +199,15 @@ class RemoteInferenceEngine(InferenceEngine):
             logprobs.extend(result["output_logprobs"])
             versions.extend(result["output_versions"])
             stop_reason = result["meta_info"]["finish_reason"]["type"]
+            if (
+                stop_reason == "length"
+                and ask < remaining
+                and len(result["output_ids"]) >= ask
+            ):
+                # chunk boundary, not a genuine stop: the server delivered
+                # everything this chunk asked for — resume from here
+                # (reference partial_rollout.py:181-250 refresh cycle)
+                stop_reason = None
             if stop_reason == "abort":
                 # server is in a weight-update window; brief backoff then
                 # resume with accumulated tokens

@@ -1,0 +1,6 @@
+"""Offline evaluation harness (reference evaluation/: math_eval etc.)."""
+
+from areal_tpu.evaluation.eval_runner import (  # noqa: F401
+    EvalReport,
+    evaluate_dataset,
+)

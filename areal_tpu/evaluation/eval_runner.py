@@ -1,0 +1,176 @@
+"""Offline evaluation: sample k completions per prompt from generation
+servers, score them with a verifiable-reward function, report accuracy and
+pass@k.
+
+Role of the reference's `evaluation/` harness (math_eval / code_eval — the
+offline loop behind its wall-clock-to-reward claims): the trained policy's
+checkpoints are served (any server speaking the /generate contract) and a
+dataset sweeps through with deterministic sampling, scored by the same
+reward functions training uses (math parser / code verifier), so eval
+accuracy is measured with exactly the training-time success criterion.
+
+Usage (CLI):
+    python -m areal_tpu.evaluation.eval_runner \
+        --data path/to/test.jsonl --type gsm8k \
+        --addrs host:port[,host:port...] --n-samples 4 --out results.jsonl
+"""
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import (
+    DatasetConfig,
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+
+
+@dataclasses.dataclass
+class EvalReport:
+    n_prompts: int
+    n_samples: int
+    accuracy: float  # mean per-sample success
+    pass_at_k: Dict[int, float]
+    avg_gen_tokens: float
+    wall_seconds: float
+    rows: List[Dict[str, Any]]  # per-prompt details
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("rows")
+        return d
+
+
+def _pass_at_k(successes: np.ndarray, k: int) -> float:
+    """Unbiased pass@k estimator (Codex paper): 1 - C(n-c, k)/C(n, k)."""
+    from math import comb
+
+    out = []
+    for row in successes:
+        n, c = len(row), int(row.sum())
+        if n - c < k:
+            out.append(1.0)
+        else:
+            out.append(1.0 - comb(n - c, k) / comb(n, k))
+    return float(np.mean(out)) if out else 0.0
+
+
+def evaluate_dataset(
+    engine,
+    items: List[Dict[str, Any]],
+    reward_fn: Callable,
+    gconfig: GenerationHyperparameters,
+    tokenizer=None,
+) -> EvalReport:
+    """Run the sweep against any InferenceEngine (`agenerate` contract)."""
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    wf = RLVRWorkflow(reward_fn, gconfig, tokenizer=tokenizer)
+    t0 = time.perf_counter()
+
+    async def run_all():
+        sem = asyncio.Semaphore(64)
+
+        async def one(item):
+            async with sem:
+                return await wf.arun_episode(engine, item)
+
+        return await asyncio.gather(*[one(it) for it in items])
+
+    outs = asyncio.run(run_all())
+    successes, rows, gen_tokens = [], [], []
+    for item, out in zip(items, outs):
+        r = np.asarray(out["rewards"]).reshape(-1)
+        successes.append((r > 0).astype(np.float64))
+        gen_tokens.append(
+            float(np.asarray(out["loss_mask"]).sum() / max(len(r), 1))
+        )
+        rows.append(
+            {
+                "question": item.get("question")
+                or str(item.get("messages", ""))[:200],
+                "rewards": r.tolist(),
+            }
+        )
+    succ = np.asarray(successes)
+    n = gconfig.n_samples
+    return EvalReport(
+        n_prompts=len(items),
+        n_samples=n,
+        accuracy=float(succ.mean()) if succ.size else 0.0,
+        pass_at_k={
+            k: _pass_at_k(succ, k)
+            for k in (1, 2, 4, 8, 16)
+            if k <= n
+        },
+        avg_gen_tokens=float(np.mean(gen_tokens)) if gen_tokens else 0.0,
+        wall_seconds=time.perf_counter() - t0,
+        rows=rows,
+    )
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", required=True)
+    p.add_argument("--type", default="gsm8k", help="dataset type (gsm8k|code|raw)")
+    p.add_argument("--addrs", required=True, help="server host:port list")
+    p.add_argument("--tokenizer-path", default="")
+    p.add_argument("--n-samples", type=int, default=1)
+    p.add_argument("--max-new-tokens", type=int, default=1024)
+    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--max-prompts", type=int, default=0)
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+
+    from areal_tpu.dataset import get_custom_dataset
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+
+    tokenizer = None
+    if args.tokenizer_path:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer_path)
+    items = get_custom_dataset(
+        DatasetConfig(path=args.data, type=args.type),
+        tokenizer=tokenizer,
+        split="test",
+    )
+    if args.max_prompts:
+        items = items[: args.max_prompts]
+    if args.type == "code":
+        from areal_tpu.reward.code_verifier import code_reward_fn as reward
+    else:
+        from areal_tpu.reward.math_parser import gsm8k_reward_fn as reward
+    engine = RemoteInferenceEngine(
+        InferenceEngineConfig(experiment_name="eval", trial_name="offline")
+    ).initialize(addrs=args.addrs.split(","))
+    try:
+        report = evaluate_dataset(
+            engine,
+            items,
+            reward,
+            GenerationHyperparameters(
+                n_samples=args.n_samples,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+            ),
+            tokenizer=tokenizer,
+        )
+    finally:
+        engine.destroy()
+    print(json.dumps(report.to_dict()))
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in report.rows:
+                f.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,8 @@ def pad_sequences_to_tensors(
 # padding with 0 would masquerade as weight-version-0 tokens under any
 # staleness filter.
 _KEY_PAD_VALUES = {"versions": -1}
+# per-sequence multimodal payloads: axis 1 is patches, not tokens
+_PER_SEQ_PAYLOAD_KEYS = {"pixel_values", "image_grid_thw"}
 
 
 def concat_padded_tensors(
@@ -73,13 +75,25 @@ def concat_padded_tensors(
     for b in batches[1:]:
         if set(b.keys()) != keys:
             raise ValueError(f"key mismatch: {keys} vs {set(b.keys())}")
+    # per-token keys track the padded token axis; known per-sequence
+    # payload keys (VLM pixel tensors — possibly ragged across batches)
+    # pad their own axis-1 to the common max instead. Explicit
+    # classification: a payload whose axis-1 happens to equal the token
+    # width must not be token-padded.
     per_token_keys = {
-        k for k in keys if np.asarray(batches[0][k]).ndim >= 2
+        k
+        for k in keys
+        if k not in _PER_SEQ_PAYLOAD_KEYS
+        and np.asarray(batches[0][k]).ndim >= 2
+        and np.asarray(batches[0][k]).shape[1]
+        == np.asarray(batches[0]["attention_mask"]).shape[1]
     }
     max_len = max(np.asarray(b["attention_mask"]).shape[1] for b in batches)
     out: Batch = {}
     for k in keys:
         parts = []
+        if k in _PER_SEQ_PAYLOAD_KEYS:
+            dim1 = max(np.asarray(b[k]).shape[1] for b in batches)
         for b in batches:
             v = np.asarray(b[k])
             if k in per_token_keys and v.shape[1] < max_len:
@@ -88,6 +102,11 @@ def concat_padded_tensors(
                     k, False if v.dtype == np.bool_ else pad_value
                 )
                 v = np.pad(v, pad_width, constant_values=fill)
+            elif k in _PER_SEQ_PAYLOAD_KEYS and v.shape[1] < dim1:
+                pad_width = [(0, 0), (0, dim1 - v.shape[1])] + [(0, 0)] * (
+                    v.ndim - 2
+                )
+                v = np.pad(v, pad_width, constant_values=0)
             parts.append(v)
         out[k] = np.concatenate(parts, axis=0)
     return out

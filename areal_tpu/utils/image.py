@@ -1,0 +1,21 @@
+"""Image helpers for VLM workflows (reference areal/utils/image.py)."""
+
+import base64
+import io
+from typing import Any, List
+
+
+def image2base64(images: Any) -> List[str]:
+    """PIL image(s) / raw bytes → base64 PNG strings (the wire format
+    multimodal generation requests carry)."""
+    if not isinstance(images, (list, tuple)):
+        images = [images]
+    out = []
+    for img in images:
+        if isinstance(img, bytes):
+            out.append(base64.b64encode(img).decode())
+            continue
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        out.append(base64.b64encode(buf.getvalue()).decode())
+    return out

@@ -1,0 +1,150 @@
+"""Vision RLVR workflow: VLM episodes with image inputs.
+
+Role of reference areal/workflow/vision_rlvr.py (`VisionRLVRWorkflow`):
+prompts carry images; an HF processor produces interleaved
+text+image-token input ids and pixel tensors; generation requests ship the
+images base64-encoded; the training rows carry the pixel tensors as
+`multi_modal_input` so the trainer can recompute logprobs through the
+vision tower.
+
+The serving/training model stack here is text-only so far — this workflow
+is the data-plane contract (requests, rows, rewards); a VLM model family
+plugs in underneath without touching it.
+"""
+
+import asyncio
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.utils import data as data_utils
+from areal_tpu.api.io_struct import unique_rid
+from areal_tpu.utils.image import image2base64
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+
+class VisionRLVRWorkflow(RLVRWorkflow):
+    def __init__(
+        self,
+        reward_fn,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        processor=None,
+        enable_thinking: bool = False,
+        dump_dir: Optional[str] = None,
+    ):
+        super().__init__(
+            reward_fn,
+            gconfig,
+            tokenizer=tokenizer,
+            enable_thinking=enable_thinking,
+            dump_dir=dump_dir,
+        )
+        self.processor = processor
+
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        images = list(data.get("images") or [])
+        # dataset rows carry lazy PATHS; decode at episode time so 70k-row
+        # VLM datasets don't materialize every image up front
+        for i, img in enumerate(images):
+            if isinstance(img, str):
+                from PIL import Image
+
+                images[i] = Image.open(img).convert("RGB")
+        if self.processor is not None:
+            # chat-template the messages into the prompt STRING the
+            # processor tokenizes (reference vision_rlvr applies the
+            # template before processing)
+            text = self.processor.apply_chat_template(
+                data["messages"],
+                tokenize=False,
+                add_generation_prompt=True,
+            )
+            processed = self.processor(
+                images=images,
+                text=text,
+                padding=False,
+                return_tensors="np",
+            )
+            prompt_ids = [int(t) for t in processed["input_ids"][0]]
+            pixel_values = processed.get("pixel_values")
+            image_grid_thw = processed.get("image_grid_thw")
+        else:  # pre-tokenized items (tests / custom processors)
+            prompt_ids = list(data["input_ids"])
+            pixel_values = data.get("pixel_values")
+            image_grid_thw = data.get("image_grid_thw")
+
+        n = self.gconfig.n_samples
+        byte_images = image2base64(images) if images else []
+        req_template = ModelRequest(
+            input_ids=prompt_ids,
+            gconfig=self.gconfig.new(n_samples=1),
+            image_data=byte_images,
+        )
+        resps = await asyncio.gather(
+            *[
+                engine.agenerate(
+                    dataclasses.replace(req_template, rid=unique_rid())
+                )
+                for _ in range(n)
+            ]
+        )
+        extra = {
+            k: v
+            for k, v in data.items()
+            if k
+            not in (
+                "input_ids",
+                "messages",
+                "images",
+                "pixel_values",
+                "image_grid_thw",
+            )
+        }
+        prompt_str = self._detokenize(prompt_ids)
+        rewards = await asyncio.gather(
+            *[
+                self.reward_fn(
+                    prompt_str,
+                    self._detokenize(r.output_tokens),
+                    prompt_ids,
+                    r.output_tokens,
+                    **extra,
+                )
+                for r in resps
+            ]
+        )
+        rows = []
+        plen = len(prompt_ids)
+        for r, reward in zip(resps, rewards):
+            seq = prompt_ids + r.output_tokens
+            L = len(seq)
+            row = {
+                "input_ids": np.asarray([seq], np.int32),
+                "attention_mask": np.ones((1, L), np.bool_),
+                "loss_mask": np.asarray(
+                    [[0] * plen + [1] * r.output_len], np.int32
+                ),
+                "logprobs": np.asarray(
+                    [[0.0] * plen + list(r.output_logprobs)], np.float32
+                ),
+                "versions": np.asarray(
+                    [[-1] * plen + list(r.output_versions)], np.int32
+                ),
+                "rewards": np.asarray([reward], np.float32),
+            }
+            if pixel_values is not None:
+                # per-sequence multimodal payload (reference vision_rlvr
+                # rows carry pixel_values/image_grid_thw)
+                row["pixel_values"] = np.asarray(pixel_values)[None]
+                if image_grid_thw is not None:
+                    row["image_grid_thw"] = np.asarray(image_grid_thw)[None]
+            rows.append(row)
+        if self.dump_dir is not None:
+            self._dump(engine, prompt_str, resps, rewards)
+        return data_utils.concat_padded_tensors(rows)

@@ -200,10 +200,14 @@ def test_pipeline_parallel_rejected():
     ps = ParallelStrategy.from_str("d2t2p2")
     with pytest.raises(AllocationValidationError, match="pipeline"):
         ps.to_tpu_parallelism()
-    # e is carved out of d (DSL: experts shard within the data degrees)
+    # e is carved out of d·c (DSL: experts shard within the data/context
+    # degrees)
     pc = ParallelStrategy.from_str("d4e2").to_tpu_parallelism()
     assert pc.expert_parallel_size == 2
     assert pc.fsdp_parallel_size == 2
     assert pc.world_size == ParallelStrategy.from_str("d4e2").world_size
+    pc = ParallelStrategy.from_str("d2c2e4").to_tpu_parallelism()
+    assert pc.expert_parallel_size == 4
+    assert pc.fsdp_parallel_size == 1 and pc.seq_parallel_size == 1
     with pytest.raises(AllocationValidationError, match="divide"):
         ParallelStrategy.from_str("d3e2").to_tpu_parallelism()
