@@ -88,12 +88,6 @@ class GenerationEngine:
         self.dtype = _DTYPES[config.dtype]
         if model_config is None:
             model_config = load_hf_config(config.model_path)
-        if model_config.is_moe:
-            raise NotImplementedError(
-                "MoE serving is not implemented yet (training-side MoE/EP "
-                "is; the generation engine needs an expert-dispatch decode "
-                "path)"
-            )
         self.model_config = model_config
         if params is None:
             params = hf_io.load_params(
@@ -118,6 +112,11 @@ class GenerationEngine:
                     f"{model_config.num_heads} and num_kv_heads="
                     f"{model_config.num_kv_heads}"
                 )
+            if model_config.is_moe and model_config.num_experts % tp != 0:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} must divide num_experts="
+                    f"{model_config.num_experts} for MoE serving"
+                )
             from areal_tpu.models.transformer import param_logical_axes
             from areal_tpu.parallel import sharding as sharding_lib
 
@@ -125,7 +124,12 @@ class GenerationEngine:
                 np.asarray(devs[:tp]), ("tensor",)
             )
             rules = {
-                "embed": None, "heads": "tensor", "mlp": "tensor",
+                "embed": None, "heads": "tensor",
+                # MoE serving: the expert dim shards over the per-server
+                # axis (one PartitionSpec can't use the axis twice, so the
+                # within-expert ffn dim stays replicated)
+                "mlp": None if model_config.is_moe else "tensor",
+                "expert": "tensor",
                 "vocab": None, "layer": None,
             }
             self._param_shardings = sharding_lib.tree_shardings(

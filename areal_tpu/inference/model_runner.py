@@ -57,7 +57,22 @@ def _project_qkv(cfg: ModelConfig, lp: Params, h: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(lp: Params, h: jnp.ndarray) -> jnp.ndarray:
+def _mlp(
+    cfg: ModelConfig,
+    lp: Params,
+    h: jnp.ndarray,
+    valid: Optional[jnp.ndarray] = None,  # [...] matching h[..., 0]
+) -> jnp.ndarray:
+    if cfg.is_moe:
+        from areal_tpu.ops.moe import moe_ffn_from_params
+
+        flat = h.reshape(1, -1, h.shape[-1])
+        # padding / inactive-slot tokens must not consume expert capacity
+        # (their identical embeddings would all route to the same experts
+        # and displace real tokens)
+        vflat = None if valid is None else valid.reshape(1, -1)
+        out, _ = moe_ffn_from_params(cfg, lp, flat, valid=vflat)
+        return out.reshape(h.shape)
     return (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
 
 
@@ -142,7 +157,7 @@ def _prefill_impl(
         attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
+        x = x + _mlp(cfg, lp, h2, valid=valid_q)
         k_lines = k_lines.at[slots].set(rows_k, mode="drop")
         v_lines = v_lines.at[slots].set(rows_v, mode="drop")
         return x, (k_lines, v_lines)
@@ -274,7 +289,7 @@ def _decode_impl(
         attn = attn.astype(x.dtype).reshape(s, cfg.q_dim)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
+        x = x + _mlp(cfg, lp, h2, valid=active)
         return x, (k_l, v_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -416,7 +431,7 @@ def decode_multi(
             )
             x = x + attn.astype(x.dtype).reshape(s, cfg.q_dim) @ lp["wo"]
             h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-            x = x + _mlp(lp, h2)
+            x = x + _mlp(cfg, lp, h2, valid=active)
             return (x, kbuf, vbuf), None
 
         (x, kbuf, vbuf), _ = jax.lax.scan(

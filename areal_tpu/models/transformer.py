@@ -164,18 +164,10 @@ def _layer_body(
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        from areal_tpu.ops.moe import moe_ffn
+        from areal_tpu.ops.moe import moe_ffn_from_params
 
-        ffn, aux = moe_ffn(
-            h,
-            lp["w_router"],
-            lp["w_gate"],
-            lp["w_up"],
-            lp["w_down"],
-            num_experts_per_tok=cfg.num_experts_per_tok,
-            norm_topk_prob=cfg.norm_topk_prob,
-            capacity_factor=cfg.moe_capacity_factor,
-        )
+        # padding tokens (segment 0) must not consume expert capacity
+        ffn, aux = moe_ffn_from_params(cfg, lp, h, valid=segment_ids > 0)
         return x + ffn, aux
     ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
     return x + ffn, jnp.zeros((), jnp.float32)

@@ -17,7 +17,7 @@ same interface later for dropless MoE.
 """
 
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,13 +42,20 @@ def load_balancing_loss(
     probs: jnp.ndarray,  # [G, E] full router probs
     topk_idx: jnp.ndarray,  # [G, k]
     num_experts: int,
+    valid: Optional[jnp.ndarray] = None,  # [G] bool
 ) -> jnp.ndarray:
     """Switch-style aux loss: E * Σ_e f_e · P_e, where f_e is the fraction
-    of tokens routed to e and P_e the mean router prob (reference
-    modules/moe/router.py aux losses)."""
+    of (valid) tokens routed to e and P_e their mean router prob
+    (reference modules/moe/router.py aux losses)."""
     assign = jax.nn.one_hot(topk_idx, num_experts, dtype=jnp.float32)
-    f = assign.sum(1).mean(0)  # [E] fraction (sums to k)
-    p = probs.mean(0)  # [E]
+    if valid is None:
+        f = assign.sum(1).mean(0)  # [E] fraction (sums to k)
+        p = probs.mean(0)  # [E]
+    else:
+        w = valid.astype(jnp.float32)[:, None]
+        denom = jnp.maximum(w.sum(), 1.0)
+        f = (assign.sum(1) * w).sum(0) / denom
+        p = (probs * w).sum(0) / denom
     return num_experts * jnp.sum(f * p) / topk_idx.shape[-1]
 
 
@@ -62,8 +69,13 @@ def moe_ffn(
     norm_topk_prob: bool = True,
     capacity_factor: float = 1.25,
     block: int = 1024,
+    valid: Optional[jnp.ndarray] = None,  # [B, T] bool
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (output [B, T, D], aux_loss scalar fp32)."""
+    """Returns (output [B, T, D], aux_loss scalar fp32).
+
+    ``valid`` masks padding / inactive tokens OUT of dispatch entirely —
+    they consume no expert capacity (identical padding embeddings would
+    otherwise all route to the same experts and displace real tokens)."""
     b, t, d = x.shape
     e = w_router.shape[-1]
     k = num_experts_per_tok
@@ -71,7 +83,13 @@ def moe_ffn(
     g = xf.shape[0]
     logits = xf.astype(jnp.float32) @ w_router.astype(jnp.float32)
     topk_p, topk_i, probs = router_topk(logits, k, norm_topk_prob)
-    aux = load_balancing_loss(probs, topk_i, e)
+    vf = None if valid is None else valid.reshape(-1)
+    aux = load_balancing_loss(probs, topk_i, e, valid=vf)
+    vmask = (
+        jnp.ones((g,), jnp.float32)
+        if vf is None
+        else vf.astype(jnp.float32)
+    )
 
     blk = min(block, g)
     pad = (-g) % blk
@@ -84,13 +102,17 @@ def moe_ffn(
         topk_i = jnp.concatenate(
             [topk_i, jnp.zeros((pad, k), topk_i.dtype)]
         )
+        vmask = jnp.concatenate([vmask, jnp.zeros((pad,), jnp.float32)])
     nb = xf.shape[0] // blk
     cap = max(8, int(blk * k * capacity_factor / e + 0.5))
     cap = min(cap, blk * k)
 
-    def per_block(xb, ib, pb):
-        # xb [blk, D], ib [blk, k], pb [blk, k]
-        mask = jax.nn.one_hot(ib, e, dtype=jnp.float32)  # [blk, k, E]
+    def per_block(xb, ib, pb, vb):
+        # xb [blk, D], ib [blk, k], pb [blk, k], vb [blk]
+        # invalid tokens get a zero routing mask: no capacity, no output
+        mask = (
+            jax.nn.one_hot(ib, e, dtype=jnp.float32) * vb[:, None, None]
+        )  # [blk, k, E]
         # position of each (token, slot) within its expert's capacity:
         # exclusive cumulative count in (token-major, slot-minor) order
         flat = mask.reshape(blk * k, e)
@@ -128,7 +150,26 @@ def moe_ffn(
         xf.reshape(nb, blk, d),
         topk_i.reshape(nb, blk, k),
         topk_p.reshape(nb, blk, k),
+        vmask.reshape(nb, blk),
     ).reshape(-1, d)
     if pad:
         out = out[:g]
     return out.reshape(b, t, d), aux
+
+
+def moe_ffn_from_params(
+    cfg, lp: Dict, h: jnp.ndarray, valid: Optional[jnp.ndarray] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared dispatch for training and serving layer bodies — one place
+    to evolve routing arguments."""
+    return moe_ffn(
+        h,
+        lp["w_router"],
+        lp["w_gate"],
+        lp["w_up"],
+        lp["w_down"],
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        norm_topk_prob=cfg.norm_topk_prob,
+        capacity_factor=cfg.moe_capacity_factor,
+        valid=valid,
+    )

@@ -113,6 +113,47 @@ def test_save_load_roundtrip(tmp_path):
     )
 
 
+def test_llama_logits_match_hf(tmp_path):
+    """Llama family (no qkv bias, grouped kv) vs HF torch golden — the
+    family was previously claimed but only qwen2 was exercised."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(1)
+    hf_cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    model = LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    d = tmp_path / "hf_llama"
+    model.save_pretrained(d, safe_serialization=True)
+
+    cfg = hf_io.load_hf_config(str(d))
+    assert cfg.family == "llama" and not cfg.attention_bias
+    params = hf_io.load_params(str(d), cfg, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 19))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    seq_len = tokens.shape[1]
+    seg = np.ones((1, seq_len), np.int32)
+    pos = np.arange(seq_len, dtype=np.int32)[None]
+    ours = np.asarray(
+        apply(params, cfg, jnp.asarray(tokens, jnp.int32), jnp.asarray(seg),
+              jnp.asarray(pos), remat=False)
+    )
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_qwen3_qk_norm_forward():
     cfg = tiny_config("qwen3")
     assert cfg.use_qk_norm and not cfg.attention_bias

@@ -97,6 +97,40 @@ def test_moe_capacity_drops_tokens():
         assert dropped[idx[8:]].all()
 
 
+def test_moe_padding_does_not_steal_capacity():
+    """Invalid (padding / inactive-slot) tokens must consume NO expert
+    capacity: identical padding embeddings would otherwise all route to
+    the same experts and displace real tokens under tight capacity."""
+    rng = np.random.default_rng(2)
+    d, f, e = 8, 16, 2
+    real = rng.standard_normal((1, 8, d)).astype(np.float32)
+    pad = np.zeros((1, 24, d), np.float32)  # identical padding embeddings
+    x = np.concatenate([pad, real], axis=1)  # padding FIRST in flat order
+    valid = np.concatenate(
+        [np.zeros((1, 24), bool), np.ones((1, 8), bool)], axis=1
+    )
+    wr = rng.standard_normal((d, e)).astype(np.float32)
+    wg = rng.standard_normal((e, d, f)).astype(np.float32)
+    wu = rng.standard_normal((e, d, f)).astype(np.float32)
+    wd = rng.standard_normal((e, f, d)).astype(np.float32)
+
+    kw = dict(num_experts_per_tok=1, capacity_factor=1.0)
+    # capacity 1.0 on 32 tokens = 16/expert; 24 identical padding tokens
+    # would overflow one expert without masking
+    out_masked, _ = moe_ffn(
+        jnp.asarray(x), jnp.asarray(wr), jnp.asarray(wg), jnp.asarray(wu),
+        jnp.asarray(wd), valid=jnp.asarray(valid), **kw,
+    )
+    ref, _ = moe_ffn(
+        jnp.asarray(real), jnp.asarray(wr), jnp.asarray(wg),
+        jnp.asarray(wu), jnp.asarray(wd), **kw,
+    )
+    got = np.asarray(out_masked)[0, 24:]
+    np.testing.assert_allclose(got, np.asarray(ref)[0], rtol=1e-4, atol=1e-5)
+    # and masked-out tokens contribute exactly nothing
+    assert np.abs(np.asarray(out_masked)[0, :24]).max() == 0.0
+
+
 def test_moe_model_forward_and_ep_parity():
     """Full qwen3_moe forward; EP=2-sharded params give identical logits
     to unsharded execution."""
@@ -189,6 +223,51 @@ def test_moe_hf_io_roundtrip(tmp_path):
             np.asarray(params["layers"][key]),
             rtol=1e-6,
         )
+
+
+def test_moe_generation_matches_full_forward():
+    """MoE serving: the engine's prefill+decode path reproduces the
+    training stack's forward token-for-token (greedy), incl. under tp=2
+    expert sharding."""
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+
+    cfg = tiny_config("qwen3_moe")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, size=9).tolist()
+
+    # ground truth: full forward greedy continuation
+    def full_next(seq):
+        t = jnp.asarray(seq, jnp.int32)[None]
+        seg = jnp.ones_like(t)
+        pos = jnp.arange(t.shape[1], dtype=jnp.int32)[None]
+        logits = apply(params, cfg, t, seg, pos, remat=False)
+        return int(jnp.argmax(logits[0, -1]))
+
+    seq = list(prompt)
+    for _ in range(6):
+        seq.append(full_next(seq))
+    expected = seq[len(prompt):]
+
+    for tp in (1, 2):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16, tensor_parallel_size=tp,
+            ),
+            model_config=cfg, params=params,
+        ).start()
+        try:
+            out = eng.generate(
+                {
+                    "input_ids": prompt,
+                    "sampling_params": {"max_new_tokens": 6, "greedy": True},
+                }
+            )
+            assert out["output_ids"] == expected, (tp, out["output_ids"])
+        finally:
+            eng.stop()
 
 
 def test_pipeline_parallel_rejected():
