@@ -254,6 +254,16 @@ class JaxGenConfig:
 # Aux subsystems
 # --------------------------------------------------------------------------
 @dataclasses.dataclass
+class ProfilingConfig:
+    """jax-profiler trace capture for selected steps (reference
+    model_worker.py:829-910 per-MFC torch profiler)."""
+
+    enabled: bool = False
+    # global step numbers to trace (empty + enabled = trace step 1)
+    steps: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class SaverConfig:
     experiment_name: str = ""
     trial_name: str = ""
@@ -343,6 +353,9 @@ class BaseExperimentConfig:
     # reference NCCL-broadcast analog). Colocated runs always use the
     # in-memory device path regardless.
     weight_update_mode: str = "disk"
+    profiling: ProfilingConfig = dataclasses.field(
+        default_factory=ProfilingConfig
+    )
 
 
 @dataclasses.dataclass

@@ -1,0 +1,65 @@
+"""Vision-task rewards (reference areal/reward clevr_count_70k /
+geometry3k scorers): exact/numeric answer matching over VLM completions.
+"""
+
+import re
+from typing import Optional
+
+from areal_tpu.reward.math_parser import extract_boxed
+
+_NUM = re.compile(r"-?\d+(?:\.\d+)?")
+_ANSWER_TAG = re.compile(r"<answer>(.*?)</answer>", re.DOTALL)
+
+
+def extract_final_answer(completion: str) -> Optional[str]:
+    """Last <answer> tag, \\boxed{} (brace-balanced, via the math
+    parser's extractor), or trailing number — the formats the reference's
+    VLM recipes prompt for."""
+    m = _ANSWER_TAG.findall(completion)
+    if m:
+        return m[-1].strip()
+    boxed = extract_boxed(completion)
+    if boxed is not None:
+        return boxed.strip()
+    m = _NUM.findall(completion)
+    if m:
+        return m[-1]
+    return None
+
+
+def _num_eq(a: str, b: str) -> bool:
+    try:
+        return abs(float(a) - float(b)) < 1e-6
+    except ValueError:
+        return False
+
+
+def clevr_count_reward_fn(
+    prompt: str,
+    completion: str,
+    prompt_ids=None,
+    completion_ids=None,
+    answer: str = "",
+    **kwargs,
+) -> float:
+    """Counting tasks: the predicted count must equal the label
+    (reference clevr_count_70k reward)."""
+    pred = extract_final_answer(completion)
+    if pred is None:
+        return 0.0
+    return float(_num_eq(pred, str(answer).strip()) or pred == str(answer).strip())
+
+
+def geometry3k_reward_fn(
+    prompt: str,
+    completion: str,
+    prompt_ids=None,
+    completion_ids=None,
+    answer: str = "",
+    **kwargs,
+) -> float:
+    """Geometry answers: numeric-or-exact match (reference geometry3k
+    reward)."""
+    return clevr_count_reward_fn(
+        prompt, completion, prompt_ids, completion_ids, answer=answer
+    )

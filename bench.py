@@ -78,7 +78,7 @@ def main():
             max_num_seqs=n_samples,
             max_model_len=1024,
             prefill_chunk=128,
-            decode_chunk=32,
+            decode_chunk=64,
             admit_wave=16,
             kv_bucket=128,
         ),

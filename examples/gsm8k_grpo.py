@@ -135,6 +135,12 @@ def main(argv):
     stats_logger = StatsLogger(
         config.experiment_name, config.trial_name, config.cluster.fileroot
     )
+    from areal_tpu.utils.profiling import PhaseProfiler
+
+    profiler = PhaseProfiler(
+        getattr(config, "profiling", None), config.cluster.fileroot,
+        config.experiment_name, config.trial_name,
+    )
 
     def disk_meta(version: int) -> WeightUpdateMeta:
         return WeightUpdateMeta.from_disk(
@@ -180,7 +186,9 @@ def main(argv):
         f"{'colocated' if colocated else 'remote'} generation"
     )
     while step.global_step < total_steps:
-        with stats_tracker.record_timing("e2e"):
+        with profiler.step(step.global_step), stats_tracker.record_timing(
+            "e2e"
+        ):
             with stats_tracker.record_timing("rollout"):
                 batch = None
                 if is_main:

@@ -211,6 +211,55 @@ def test_vision_dataset_processor(tmp_path):
     assert ds[0]["messages"][0]["content"] == "How many?"
 
 
+def test_vision_rewards():
+    from areal_tpu.reward.vision import (
+        clevr_count_reward_fn,
+        extract_final_answer,
+    )
+
+    assert extract_final_answer("I count <answer>7</answer>") == "7"
+    assert extract_final_answer("thus \\boxed{12} objects") == "12"
+    # nested braces must not fall through to the trailing-number heuristic
+    assert extract_final_answer("so \\boxed{\\frac{1}{2}}") == "\\frac{1}{2}"
+    assert extract_final_answer("there are 3 spheres") == "3"
+    assert extract_final_answer("no clue") is None
+    assert clevr_count_reward_fn("p", "<answer>4</answer>", answer="4") == 1.0
+    assert clevr_count_reward_fn("p", "I see 4.0 cubes", answer="4") == 1.0
+    assert clevr_count_reward_fn("p", "<answer>5</answer>", answer="4") == 0.0
+
+
+def test_phase_profiler(tmp_path):
+    """Selected steps run under jax.profiler.trace and produce a trace
+    directory; unselected steps are no-ops."""
+    from areal_tpu.api.cli_args import ProfilingConfig
+    from areal_tpu.utils.profiling import PhaseProfiler, annotate
+
+    import jax.numpy as jnp
+
+    prof = PhaseProfiler(
+        ProfilingConfig(enabled=True, steps=[2]), str(tmp_path), "exp", "t0"
+    )
+    assert not prof.should_trace(1) and prof.should_trace(2)
+    with prof.step(1):
+        pass  # no-op
+    assert not os.path.exists(os.path.join(prof.trace_root, "step1"))
+    with prof.step(2):
+        with annotate("tiny"):
+            (jnp.ones(8) * 2).sum().block_until_ready()
+    d = os.path.join(prof.trace_root, "step2")
+    assert os.path.isdir(d)
+    # something was written (xplane pb under plugins/profile/...)
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs
+    ]
+    assert found, "no trace artifacts written"
+    # exceptions inside the profiled body propagate with their own type
+    # (the profiler guard must not swallow them)
+    with pytest.raises(ValueError, match="boom"):
+        with prof.step(2):
+            raise ValueError("boom")
+
+
 # ---------------------------------------------------------------------------
 # Offline eval harness
 # ---------------------------------------------------------------------------
