@@ -259,7 +259,8 @@ class ProfilingConfig:
     model_worker.py:829-910 per-MFC torch profiler)."""
 
     enabled: bool = False
-    # global step numbers to trace (empty + enabled = trace step 1)
+    # 0-based global step numbers to trace (empty + enabled = trace the
+    # first step)
     steps: List[int] = dataclasses.field(default_factory=list)
 
 

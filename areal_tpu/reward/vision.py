@@ -5,26 +5,19 @@ geometry3k scorers): exact/numeric answer matching over VLM completions.
 import re
 from typing import Optional
 
-from areal_tpu.reward.math_parser import extract_boxed
+from areal_tpu.reward.math_parser import extract_answer
 
-_NUM = re.compile(r"-?\d+(?:\.\d+)?")
 _ANSWER_TAG = re.compile(r"<answer>(.*?)</answer>", re.DOTALL)
 
 
 def extract_final_answer(completion: str) -> Optional[str]:
-    """Last <answer> tag, \\boxed{} (brace-balanced, via the math
-    parser's extractor), or trailing number — the formats the reference's
-    VLM recipes prompt for."""
+    """Last <answer> tag (vision-recipe specific), else the math parser's
+    extraction chain (brace-balanced \\boxed{}, trailing number) — ONE
+    shared implementation so number-format fixes reach VLM rewards too."""
     m = _ANSWER_TAG.findall(completion)
     if m:
         return m[-1].strip()
-    boxed = extract_boxed(completion)
-    if boxed is not None:
-        return boxed.strip()
-    m = _NUM.findall(completion)
-    if m:
-        return m[-1]
-    return None
+    return extract_answer(completion)
 
 
 def _num_eq(a: str, b: str) -> bool:

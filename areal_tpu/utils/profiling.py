@@ -39,18 +39,25 @@ class PhaseProfiler:
         self.config = config or ProfilingConfig()
         env_steps = os.environ.get("AREAL_PROFILE_STEPS", "")
         if env_steps:
-            self.config = ProfilingConfig(
-                enabled=True,
-                steps=[int(s) for s in env_steps.split(",") if s],
-            )
+            try:
+                self.config = ProfilingConfig(
+                    enabled=True,
+                    steps=[int(s) for s in env_steps.split(",") if s],
+                )
+            except ValueError as e:  # profiling must never kill training
+                logger.warning(
+                    f"ignoring malformed AREAL_PROFILE_STEPS="
+                    f"{env_steps!r}: {e}"
+                )
         self.trace_root = os.path.join(
             fileroot, experiment_name, trial_name, "traces"
         )
 
     def should_trace(self, step: int) -> bool:
+        """`step` is the 0-based global step the train loops pass in."""
         if not self.config.enabled:
             return False
-        return step in (self.config.steps or [1])
+        return step in (self.config.steps or [0])
 
     @contextlib.contextmanager
     def step(self, step: int):

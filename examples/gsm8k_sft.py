@@ -1,0 +1,155 @@
+"""GSM8K SFT — supervised fine-tuning entry point (reference
+examples/math/gsm8k_sft.py): tokenize question+answer pairs, mask the loss
+to answer tokens, run the SPMD LM engine with saver/evaluator/recover/
+stats, multi-epoch with resumable dataloading.
+
+Run:
+    python examples/gsm8k_sft.py --config examples/gsm8k_sft.yaml
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.cli_args import SFTConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.dataset import StatefulDataLoader, get_custom_dataset
+from areal_tpu.engine.sft.lm_engine import LMEngine
+from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+from areal_tpu.utils import logging as logging_util, stats_tracker
+from areal_tpu.utils.data import concat_padded_tensors
+from areal_tpu.utils.evaluator import Evaluator
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = logging_util.getLogger("gsm8k_sft")
+
+
+def tokenize_pair(tokenizer, question: str, answer: str, max_len: int):
+    """Chat-templated prompt + answer; loss only on answer tokens
+    (reference SFT data pipeline convention)."""
+    prompt_ids = tokenizer.apply_chat_template(
+        [{"role": "user", "content": question}],
+        tokenize=True,
+        add_generation_prompt=True,
+    )
+    answer_ids = tokenizer.encode(answer, add_special_tokens=False)
+    if tokenizer.eos_token_id is not None:
+        answer_ids = answer_ids + [tokenizer.eos_token_id]
+    ids = (prompt_ids + answer_ids)[:max_len]
+    n_ans = max(0, len(ids) - len(prompt_ids))
+    loss_mask = [0] * (len(ids) - n_ans) + [1] * n_ans
+    return ids, loss_mask
+
+
+def collate(items, tokenizer, max_len: int):
+    rows = []
+    for it in items:
+        q = it.get("question") or (
+            it["messages"][0]["content"] if "messages" in it else ""
+        )
+        ids, lm = tokenize_pair(tokenizer, q, it.get("answer", ""), max_len)
+        L = len(ids)
+        rows.append(
+            {
+                "input_ids": np.asarray([ids], np.int32),
+                "attention_mask": np.ones((1, L), np.bool_),
+                "loss_mask": np.asarray([lm], np.int32),
+            }
+        )
+    return concat_padded_tensors(rows)
+
+
+def main(argv):
+    from areal_tpu.parallel.distributed import maybe_init_distributed
+
+    maybe_init_distributed()
+    import jax
+
+    is_main = jax.process_index() == 0
+    config, _ = load_expr_config(argv, SFTConfig)
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(config.tokenizer_path)
+    max_len = config.train_dataset.max_length or 1024
+
+    train_dataset = get_custom_dataset(
+        config.train_dataset, tokenizer=tokenizer, split="train"
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        seed=config.seed,
+        drop_last=config.train_dataset.drop_last,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+    engine = SPMDTrainEngine(config.model)
+    engine.initialize(ft_spec=ft_spec, seed=config.seed)
+    lm = LMEngine(engine)
+
+    saver = Saver(config.saver, ft_spec, for_recover=False)
+    evaluator = Evaluator(config.evaluator, ft_spec)
+    recover_handler = RecoverHandler(
+        config.recover, config.cluster.fileroot,
+        config.experiment_name, config.trial_name,
+    )
+    stats_logger = StatsLogger(
+        config.experiment_name, config.trial_name, config.cluster.fileroot
+    )
+    step = StepInfo(steps_per_epoch=ft_spec.steps_per_epoch)
+    if check_if_recover(config.recover, recover_handler.recover_root):
+        info = recover_handler.load(
+            engine, saver=saver, evaluator=evaluator, dataloader=dataloader
+        )
+        if info is not None:
+            step = info.last_step_info.next()
+
+    if len(dataloader) == 0:
+        raise ValueError(
+            f"dataset yields zero batches (size {len(train_dataset)} < "
+            f"batch_size {config.train_dataset.batch_size} with drop_last)"
+        )
+    from areal_tpu.api.workflow_api import cycle_dataloader
+
+    data_generator = cycle_dataloader(dataloader)
+    total_steps = config.total_train_steps or (
+        ft_spec.total_train_epochs * ft_spec.steps_per_epoch
+    )
+    logger.info(f"starting SFT: {total_steps} steps")
+    while step.global_step < total_steps:
+        items = next(data_generator)
+        with stats_tracker.record_timing("e2e"):
+            batch = collate(items, tokenizer, max_len)
+            with stats_tracker.record_timing("train_step"):
+                train_stats = lm.train_lm(batch)
+            with stats_tracker.record_timing("save_eval_recover"):
+                saver.save(engine, step, tokenizer=tokenizer)
+                evaluator.evaluate(lambda: None, step)
+                recover_handler.dump(
+                    engine, step, saver=saver, evaluator=evaluator,
+                    dataloader=dataloader,
+                )
+        stats = stats_tracker.export_all()
+        for k, v in train_stats.items():
+            stats[f"sft/{k}"] = v
+        stats["sft/n_tokens"] = float(batch["attention_mask"].sum())
+        if is_main:
+            stats_logger.commit(
+                step.epoch, step.epoch_step, step.global_step, stats
+            )
+        step = step.next()
+    # final checkpoint
+    saver.save(engine, step, force=True, tokenizer=tokenizer)
+    stats_logger.close()
+    logger.info("SFT complete")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
