@@ -210,10 +210,22 @@ class JaxGenConfig:
     # lax.top_k candidate count for truncated sampling (raised to the max
     # requested per-slot top_k); 0 would force the exact full-vocab sort
     sample_topk_bound: int = 64
-    # reuse a freed slot's cached KV when >= this many prompt tokens match
-    # (0 disables prefix reuse)
+    # reuse freed requests' cached KV (prefix registry) when >= this many
+    # prompt tokens match (0 disables prefix reuse); matches are shared at
+    # page granularity by refcount, not copied
     prefix_reuse_min: int = 16
-    page_size: int = 128
+    # --- paged KV pool (the radix/paged-cache analog) ---
+    page_size: int = 256  # tokens per KV page
+    # total pages in the pool; 0 = auto (full provisioning: every slot can
+    # reach max_model_len). Set explicitly to oversubscribe — the engine
+    # preempts transparently under pool pressure, which is what makes
+    # 16k+ max_model_len serveable without 16k*slots of HBM
+    num_pages: int = 0
+    # paged-attention backend: "auto" (Pallas kernel on single-device TPU,
+    # jnp gather elsewhere), "kernel", or "jnp"
+    attn_impl: str = "auto"
+    pages_per_compute_block: int = 4  # kernel flash-block size, in pages
+    slots_per_block: int = 8  # kernel grid-step slot grouping
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85
     enable_metrics: bool = True

@@ -1,78 +1,184 @@
-"""Slot-based KV cache for continuous-batching decode.
+"""Paged KV-block cache for continuous-batching generation.
 
-Role of the reference's serving-engine KV pool (SGLang radix/paged cache,
-used via HTTP in areal/engine/sglang_remote.py): on TPU a fixed-geometry
-cache is the XLA-friendly design — one array per K/V of shape
-[L, S, M, Hkv, D] (layers × slots × max_model_len × kv heads × head dim),
-updated with static-shape dynamic slices inside jit. Slot allocation is
-host-side bookkeeping; the device never sees dynamic shapes.
+TPU-native analog of the paged/radix KV cache the reference relies on via
+SGLang (areal/api/cli_args.py:408 ``disable_radix_cache``; 27k-token
+generation recipe blog/AReaL_v0_3.md:263-284): device memory is a pool of
+fixed-size pages shared by every sequence; each slot owns a *page table*
+(list of logical page ids). One logical page serves all layers (the pool's
+leading layer dim), so allocation is per-sequence, not per-layer.
 
-Prefix reuse (the radix-cache analog, reference
-areal/engine/sglang_remote.py:158-168) is host-side bookkeeping over this
-fixed geometry: the engine remembers what tokens a freed slot still caches
-and re-claims the slot (``alloc_specific``) when a new request shares the
-prefix — the interruptible-generation resubmit (prompt + accumulated
-tokens) then re-prefills only the suffix.
+Host-side structures (this module) are pure bookkeeping — the device never
+sees dynamic shapes:
+
+- ``PageManager`` — refcounted allocator. Pages are *shared* between
+  sequences (GRPO siblings share prompt pages; concurrent requests share
+  any cached prefix), the radix-tree benefit without the tree.
+- ``PrefixRegistry`` — freed sequences park their full pages here with the
+  token string they cache; new requests claim the longest matching prefix
+  by bumping refcounts (no copy). LRU-evicted when the pool runs short.
+
+Capacity discipline: admission reserves only the pages a prompt needs now;
+decode allocates pages as sequences grow. When the pool runs dry the engine
+evicts the registry and, if needed, *preempts* the youngest running request
+— its pages go to the registry, so the transparent resubmit usually
+re-claims them for free (matching the reference's interruptible-generation
+semantics, realhf/system/partial_rollout.py:181-250).
 """
 
 import dataclasses
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from areal_tpu.models.config import ModelConfig
+from areal_tpu.ops.paged_attention import packed_pool_shape
 
 
 @dataclasses.dataclass
 class CacheConfig:
-    num_slots: int
+    num_pages: int  # logical pages in the pool (shared across slots)
+    page_size: int  # tokens per page
     max_model_len: int
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return -(-self.max_model_len // self.page_size)
 
     def hbm_bytes(self, cfg: ModelConfig, dtype_bytes: int = 2) -> int:
         per_tok = 2 * cfg.num_kv_heads * cfg.head_dim * dtype_bytes
-        return cfg.num_layers * self.num_slots * self.max_model_len * per_tok
+        return cfg.num_layers * self.num_pages * self.page_size * per_tok
 
 
-def init_kv_cache(
+def init_kv_pool(
     cfg: ModelConfig, ccfg: CacheConfig, dtype=jnp.bfloat16
-) -> dict:
-    shape = (
+) -> Dict[str, jnp.ndarray]:
+    """Packed page pool (see ops/paged_attention.py layout contract)."""
+    shape = packed_pool_shape(
         cfg.num_layers,
-        ccfg.num_slots,
-        ccfg.max_model_len,
         cfg.num_kv_heads,
+        ccfg.num_pages,
+        ccfg.page_size,
         cfg.head_dim,
     )
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
-        # per-slot current length (tokens already cached)
-        "lens": jnp.zeros((ccfg.num_slots,), jnp.int32),
-    }
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-class SlotAllocator:
-    """Host-side free-list of decode slots."""
+class PageManager:
+    """Refcounted page allocator over the device pool (host bookkeeping)."""
 
-    def __init__(self, num_slots: int):
-        self.num_slots = num_slots
-        self._free: List[int] = list(range(num_slots))
-
-    def alloc(self) -> Optional[int]:
-        return self._free.pop() if self._free else None
-
-    def alloc_specific(self, slot: int) -> bool:
-        """Claim a particular free slot (prefix-cache reuse)."""
-        if slot in self._free:
-            self._free.remove(slot)
-            return True
-        return False
-
-    def free(self, slot: int) -> None:
-        assert 0 <= slot < self.num_slots and slot not in self._free
-        self._free.append(slot)
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self.refcount = np.zeros(num_pages, np.int32)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
 
     @property
     def n_free(self) -> int:
         return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate n fresh pages (refcount 1 each) or None if short."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            assert self.refcount[p] == 0
+            self.refcount[p] = 1
+        return pages
+
+    def share(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert self.refcount[p] > 0
+            self.refcount[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            self.refcount[p] -= 1
+            assert self.refcount[p] >= 0
+            if self.refcount[p] == 0:
+                self._free.append(p)
+
+
+class PrefixRegistry:
+    """Freed sequences' cached tokens → shareable full pages (radix analog).
+
+    Each entry holds one reference on its pages; claiming shares them
+    (refcount++), so many concurrent requests can ride one cached prefix.
+    """
+
+    def __init__(self, page_size: int, min_match: int):
+        self.page_size = page_size
+        self.min_match = min_match
+        self._entries: List[Tuple[np.ndarray, Tuple[int, ...], float]] = []
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def add(
+        self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
+    ) -> None:
+        """Park `pages` (full pages caching `tokens`); takes ownership of
+        one reference per page (caller must NOT release them)."""
+        n_full = min(len(pages), len(tokens) // self.page_size)
+        if n_full == 0 or self.min_match <= 0:
+            pm.release(pages)
+            return
+        keep = tuple(pages[:n_full])
+        if n_full < len(pages):
+            pm.release(pages[n_full:])
+        self._entries.append(
+            (np.asarray(tokens[: n_full * self.page_size], np.int32), keep,
+             time.monotonic())
+        )
+
+    def claim(
+        self, pm: PageManager, prompt: Sequence[int]
+    ) -> Tuple[List[int], int]:
+        """Longest full-page prefix match; shares the matched pages.
+        Returns (pages, cached_tokens). At least one prompt token must
+        remain uncached (to produce next-token logits)."""
+        if self.min_match <= 0 or not self._entries:
+            return [], 0
+        prompt_arr = np.asarray(prompt, np.int32)
+        limit = len(prompt_arr) - 1
+        best, best_len, best_i = None, 0, -1
+        for i, (tokens, pages, _) in enumerate(self._entries):
+            n = min(len(tokens), limit)
+            if n <= best_len:
+                continue
+            eq = tokens[:n] == prompt_arr[:n]
+            match = n if eq.all() else int(np.argmin(eq))
+            match = (match // self.page_size) * self.page_size
+            if match > best_len:
+                best_len, best, best_i = match, pages, i
+        if best is None or best_len < max(self.min_match, 1):
+            return [], 0
+        # refresh the hit's LRU stamp: hot shared prefixes (system prompts)
+        # must outlive cold one-off entries under eviction pressure
+        tokens, pages, _ = self._entries[best_i]
+        self._entries[best_i] = (tokens, pages, time.monotonic())
+        shared = list(best[: best_len // self.page_size])
+        pm.share(shared)
+        return shared, best_len
+
+    def evict(self, pm: PageManager, pages_needed: int) -> int:
+        """LRU-evict entries until the allocator could satisfy
+        `pages_needed` (or the registry is empty). Returns entries evicted.
+
+        Eviction drops the registry's reference; pages still shared by live
+        requests survive (their refcount stays > 0)."""
+        evicted = 0
+        self._entries.sort(key=lambda e: e[2])
+        while self._entries and pm.n_free < pages_needed:
+            _, pages, _ = self._entries.pop(0)
+            pm.release(pages)
+            evicted += 1
+        return evicted
+
+    def flush(self, pm: PageManager) -> None:
+        """Drop everything (weight update → cached KV is stale)."""
+        for _, pages, _ in self._entries:
+            pm.release(pages)
+        self._entries.clear()

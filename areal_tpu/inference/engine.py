@@ -1,17 +1,30 @@
-"""Generation engine: continuous batching over the slot KV cache.
+"""Generation engine: continuous batching over the paged KV-block pool.
 
 Role of the SGLang server the reference drives over HTTP (areal/engine/
 sglang_remote.py + realhf/system/generation_server.py), rebuilt TPU-native:
-a single background loop thread owns the device state (params, KV cache) and
-interleaves admissions (prefill) with batched decode steps. Everything the
-device executes is one of two compiled programs (model_runner.prefill /
-decode_step), so continuous batching never recompiles.
+a single background loop thread owns the device state (params, page pool)
+and interleaves admissions (prefill) with fused multi-step decode. Every
+device program is compiled once per shape bucket — continuous batching
+never recompiles.
 
-Interruption protocol (matches reference semantics sglang_remote.py:186-234):
-``pause()`` aborts all in-flight requests — they resolve with
-``stop_reason="abort"`` and whatever tokens they have; the client re-submits
-with accumulated tokens after ``continue_generation``. Weight updates happen
-between decode steps, so a paused engine swaps weights atomically.
+Memory model (the paged/radix-cache analog, inference/cache.py):
+- prompts and generations live in refcounted pages; GRPO siblings *share*
+  full prompt pages (one prefill, no copy) and copy at most one partial
+  tail page; finished requests park their pages in a prefix registry that
+  later requests claim by refcount — so identical system prompts and
+  interrupted-generation resubmits pay only the unseen suffix.
+- decode allocates pages lazily as sequences grow. When the pool runs dry
+  the engine evicts the registry LRU-first and then *preempts* the
+  youngest running requests: their pages move to the registry and the
+  request transparently re-queues (it usually re-claims its own pages, so
+  preemption costs one partial-page re-prefill at most). This is what lets
+  max_model_len be 16k+ without reserving 16k tokens per slot.
+
+Interruption protocol (matches reference semantics
+sglang_remote.py:186-234): ``pause()`` aborts in-flight requests — they
+resolve with ``stop_reason="abort"`` and their tokens; the client
+re-submits with accumulated tokens after ``continue_generation``; the
+registry serves the already-cached prefix back without recompute.
 """
 
 import dataclasses
@@ -27,7 +40,12 @@ import numpy as np
 
 from areal_tpu.api.cli_args import JaxGenConfig
 from areal_tpu.inference import model_runner
-from areal_tpu.inference.cache import CacheConfig, SlotAllocator, init_kv_cache
+from areal_tpu.inference.cache import (
+    CacheConfig,
+    PageManager,
+    PrefixRegistry,
+    init_kv_pool,
+)
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import ModelConfig, load_hf_config
 from areal_tpu.models.transformer import Params
@@ -57,6 +75,21 @@ class _Request:
     output_versions: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    preemptions: int = 0
+
+    @property
+    def all_tokens(self) -> List[int]:
+        """Prompt for (re-)prefill: original prompt + everything generated
+        (a preempted request resumes by re-prefilling its own output)."""
+        return self.input_ids + self.output_ids
+
+    @property
+    def budget_left(self) -> int:
+        return self.max_new_tokens - len(self.output_ids)
+
+    @property
+    def min_left(self) -> int:
+        return self.min_new_tokens - len(self.output_ids)
 
 
 def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
@@ -135,10 +168,11 @@ class GenerationEngine:
             self._param_shardings = sharding_lib.tree_shardings(
                 self.mesh, param_logical_axes(model_config), rules
             )
-            # KV cache [L, S, M, Hkv, D]: heads follow the tensor axis
+            # paged pool [L, Hkv, NP, BS//f, f*D]: kv heads follow the
+            # tensor axis
             self._kv_sharding = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec(
-                    None, None, None, "tensor", None
+                    None, "tensor", None, None, None
                 )
             )
             self._replicated = sharding_lib.replicated(self.mesh)
@@ -148,28 +182,53 @@ class GenerationEngine:
             self._kv_sharding = None
             self._replicated = None
         self.params = self._place_params(params)
+
+        # --- paged KV pool ---
+        bs = config.page_size
+        num_pages = config.num_pages
+        if num_pages <= 0:
+            # conservative auto: full provisioning (every slot can reach
+            # max_model_len) — set num_pages explicitly to oversubscribe
+            num_pages = config.max_num_seqs * (-(-config.max_model_len // bs))
         self.cache_config = CacheConfig(
-            num_slots=config.max_num_seqs, max_model_len=config.max_model_len
+            num_pages=num_pages,
+            page_size=bs,
+            max_model_len=config.max_model_len,
         )
         if self.mesh is None:
-            self.cache = init_kv_cache(
+            self.cache = init_kv_pool(
                 model_config, self.cache_config, self.dtype
             )
         else:
-            # allocate directly sharded — materializing the full cache on
-            # one device first would OOM exactly the small-HBM configs TP
-            # exists for
+            # allocate directly sharded — materializing on one device
+            # first would OOM exactly the small-HBM configs TP exists for
             self.cache = jax.jit(
-                lambda: init_kv_cache(
+                lambda: init_kv_pool(
                     model_config, self.cache_config, self.dtype
                 ),
                 out_shardings={
                     "k": self._kv_sharding,
                     "v": self._kv_sharding,
-                    "lens": self._replicated,
                 },
             )()
-        self.allocator = SlotAllocator(config.max_num_seqs)
+        self.pm = PageManager(num_pages)
+        self.registry = PrefixRegistry(
+            bs, config.prefix_reuse_min
+        )
+        s = config.max_num_seqs
+        self._free_slots: List[int] = list(range(s - 1, -1, -1))
+        self._tables = np.full(
+            (s, self.cache_config.max_pages_per_seq), num_pages, np.int32
+        )
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._cached_len = np.zeros(s, np.int64)
+        # attention backend: Pallas kernel on single-device TPU, jnp
+        # gather fallback elsewhere (CPU tests, TP serving)
+        if config.attn_impl == "auto":
+            on_tpu = jax.devices()[0].platform == "tpu"
+            self._attn_impl = "kernel" if (tp == 1 and on_tpu) else "jnp"
+        else:
+            self._attn_impl = config.attn_impl
         self.model_version = 0
         self._rng_key = jax.random.PRNGKey(config.seed)
 
@@ -179,9 +238,6 @@ class GenerationEngine:
         self._active: Dict[int, _Request] = {}  # slot -> request
         self._pending: List[_Request] = []  # drained but not yet admitted
         self._pending_since: Optional[float] = None
-        # freed slot -> tokens its cache line still holds (prefix reuse);
-        # flushed on weight update (stale-KV guard)
-        self._freed_prefix: Dict[int, np.ndarray] = {}
         # device-path weight staging (chunked receive)
         self._staged: Dict[str, Any] = {}
         self._staging_key = None
@@ -191,7 +247,6 @@ class GenerationEngine:
         self._thread: Optional[threading.Thread] = None
         # device-resident decode state: the generation loop's only host
         # traffic per step is ONE result fetch (tokens+logprobs)
-        s = config.max_num_seqs
         self._cur_tokens = jnp.zeros(s, jnp.int32)
         self._active_dev = jnp.zeros(s, bool)
         self._temp_dev = jnp.ones(s, jnp.float32)
@@ -220,6 +275,7 @@ class GenerationEngine:
         self.total_cached_prompt_tokens = 0  # prompt tokens served from KV reuse
         self.total_requests = 0
         self.total_aborted = 0
+        self.total_preemptions = 0
 
     def _place_params(self, params: Params) -> Params:
         """Host or device pytree → this engine's param placement."""
@@ -243,8 +299,6 @@ class GenerationEngine:
                 ),
                 out_shardings=self._param_shardings,
             )
-        # reshard onto this mesh first (the source may live on another
-        # mesh); the un-donated jit then guarantees fresh buffers
         placed = jax.device_put(params, self._param_shardings)
         return self._jit_cache[key](placed)
 
@@ -270,11 +324,20 @@ class GenerationEngine:
     def submit(self, payload: Dict[str, Any]) -> Future:
         fut: Future = Future()
         req = _parse_request(payload, fut)
+        bs = self.cache_config.page_size
         if len(req.input_ids) >= self.config.max_model_len:
             fut.set_exception(
                 ValueError(
                     f"prompt length {len(req.input_ids)} >= max_model_len "
                     f"{self.config.max_model_len}"
+                )
+            )
+            return fut
+        if -(-len(req.input_ids) // bs) + 1 > self.cache_config.num_pages:
+            fut.set_exception(
+                ValueError(
+                    f"prompt needs more pages than the pool has "
+                    f"({self.cache_config.num_pages} x {bs} tokens)"
                 )
             )
             return fut
@@ -320,12 +383,15 @@ class GenerationEngine:
         return dict(
             running_requests=len(self._active),
             queued_requests=self._admit_queue.qsize() + len(self._pending),
-            free_slots=self.allocator.n_free,
+            free_slots=len(self._free_slots),
+            free_pages=self.pm.n_free,
+            registry_entries=len(self.registry),
             total_generated_tokens=self.total_generated_tokens,
             total_prompt_tokens=self.total_prompt_tokens,
             total_cached_prompt_tokens=self.total_cached_prompt_tokens,
             total_requests=self.total_requests,
             total_aborted=self.total_aborted,
+            total_preemptions=self.total_preemptions,
             model_version=self.model_version,
             paused=float(self._paused.is_set()),
         )
@@ -363,7 +429,7 @@ class GenerationEngine:
                     self.params = self._place_params(host)
                     # cached KV is from the old policy — never reuse it;
                     # drop any abandoned device-path staging too
-                    self._freed_prefix.clear()
+                    self.registry.flush(self.pm)
                     self._staged = {}
                     self._staging_key = None
                     self.model_version = (
@@ -403,18 +469,16 @@ class GenerationEngine:
                     self._staged_chunks = set()
                     self._staging_key = None
                     self.model_version = version
-                    self._freed_prefix.clear()
+                    self.registry.flush(self.pm)
                     logger.info(
                         f"weights updated via device path → v{version}"
                     )
                     done.set_result({"version": version, "complete": True})
                 elif cmd == "update_weights_tensors":
                     params, version = arg
-                    # the caller may later DONATE these buffers (the
-                    # trainer's update step); aliasing them would leave us
-                    # holding deleted arrays — always copy
+                    # the caller may later DONATE these buffers — copy
                     self.params = self._copy_params_placed(params)
-                    self._freed_prefix.clear()
+                    self.registry.flush(self.pm)
                     self._staged = {}
                     self._staging_key = None
                     self.model_version = (
@@ -428,51 +492,68 @@ class GenerationEngine:
             except Exception as e:  # surface errors to the caller
                 done.set_exception(e)
 
+    # ------------------------------------------------------------------
+    # Page accounting
+    # ------------------------------------------------------------------
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages, evicting the prefix registry if needed."""
+        pages = self.pm.alloc(n)
+        if pages is None:
+            self.registry.evict(self.pm, n)
+            pages = self.pm.alloc(n)
+        return pages
+
+    def _preempt_youngest(self) -> bool:
+        """Preempt the most recently submitted active request: its pages
+        go to the registry (the transparent re-queue usually re-claims
+        them) and the request returns to the FRONT of the pending list."""
+        if not self._active:
+            return False
+        slot = max(
+            self._active, key=lambda sl: self._active[sl].submit_time
+        )
+        req = self._active.pop(slot)
+        self._release_slot(slot, park_tokens=req.all_tokens)
+        req.slot = None
+        req.preemptions += 1
+        self.total_preemptions += 1
+        self._pending.insert(0, req)
+        logger.info(
+            f"preempted {req.rid} ({len(req.output_ids)} tokens in) — "
+            f"pool pressure"
+        )
+        return True
+
+    def _release_slot(self, slot: int, park_tokens: Optional[List[int]]):
+        """Free a slot; its pages go to the registry (shared-prefix pool)
+        or straight back to the allocator."""
+        pages = self._slot_pages.pop(slot, [])
+        cached = int(self._cached_len[slot])
+        self._active_dev = self._active_dev.at[slot].set(False)
+        self._tables[slot] = self.cache_config.num_pages
+        self._cached_len[slot] = 0
+        self._free_slots.append(slot)
+        if park_tokens is not None and cached > 0:
+            self.registry.add(
+                self.pm, np.asarray(park_tokens[:cached], np.int32), pages
+            )
+        else:
+            self.pm.release(pages)
+
+    # ------------------------------------------------------------------
+    # Admission
+    # ------------------------------------------------------------------
     def _prefill_bucket(self, n: int) -> int:
         quantum = min(self.config.prefill_chunk, self.config.max_model_len)
         b = data_utils.next_bucket_size(n, quantum)
         return min(b, self.config.max_model_len)
 
-    def _alloc_slot(self) -> int:
-        slot = self.allocator.alloc()
-        assert slot is not None  # selection is capped by n_free
-        self._freed_prefix.pop(slot, None)  # line is being overwritten
-        return slot
-
-    def _try_prefix_reuse(self, input_ids: List[int]):
-        """Find a free slot whose cached tokens share the longest prefix
-        with `input_ids`; claim it. Returns (slot, cached_len) or (None, 0).
-
-        The radix-cache analog (reference sglang_remote.py:158-168): the
-        interruptible-generation resubmit (prompt + accumulated tokens) and
-        repeated system prompts hit this path.
-        """
-        if self.config.prefix_reuse_min <= 0 or not self._freed_prefix:
-            return None, 0
-        prompt = np.asarray(input_ids, np.int32)
-        # at least one suffix token must remain to produce next-token logits
-        limit = len(prompt) - 1
-        best_slot, best_len = None, 0
-        for slot, cached in self._freed_prefix.items():
-            n = min(len(cached), limit)
-            if n <= best_len:
-                continue
-            eq = cached[:n] == prompt[:n]
-            match = n if eq.all() else int(np.argmin(eq))
-            if match > best_len:
-                best_len, best_slot = match, slot
-        if best_slot is None or best_len < self.config.prefix_reuse_min:
-            return None, 0
-        claimed = self.allocator.alloc_specific(best_slot)
-        assert claimed  # _freed_prefix only tracks free slots
-        del self._freed_prefix[best_slot]
-        return best_slot, best_len
-
     def _admit(self) -> bool:
         """Admit queued requests: identical prompts (GRPO siblings) group
-        behind ONE prefill row + KV line copies; unique prompts prefill as
-        one batched [N, Tp] dispatch, each row resuming from its slot's
-        reusable cached prefix (offset)."""
+        behind ONE prefill row, sharing full prompt pages and copying at
+        most one partial tail page; unique prompts prefill as one batched
+        [N, Tp] dispatch, each row resuming from its registry-claimed
+        prefix (offset)."""
         got_new = 0
         while True:
             try:
@@ -480,19 +561,18 @@ class GenerationEngine:
                 got_new += 1
             except queue.Empty:
                 break
-        if not self._pending or self.allocator.n_free == 0:
+        if not self._pending or not self._free_slots:
             return False
         if self._pending_since is None:
             self._pending_since = time.monotonic()
         # hold while the queue is still filling (or decode has work) so
         # admission waves arrive full — every distinct wave shape compiles
-        # its own XLA program. A wave that's already full (or slot-bound)
-        # can't get fuller: admit immediately.
+        # its own XLA program
         wave = max(1, self.config.admit_wave)
         age = time.monotonic() - self._pending_since
         saturated = (
-            len(self._pending) >= self.allocator.n_free
-            or len({tuple(r.input_ids) for r in self._pending}) >= wave
+            len(self._pending) >= len(self._free_slots)
+            or len({tuple(r.all_tokens) for r in self._pending}) >= wave
         )
         if (
             not saturated
@@ -505,9 +585,9 @@ class GenerationEngine:
         # total admitted <= free slots ---
         groups: Dict[tuple, List[_Request]] = {}
         rest: List[_Request] = []
-        budget = self.allocator.n_free
+        budget = len(self._free_slots)
         for req in self._pending:
-            key = tuple(req.input_ids)
+            key = tuple(req.all_tokens)
             if budget > 0 and key in groups:
                 groups[key].append(req)
                 budget -= 1
@@ -521,75 +601,124 @@ class GenerationEngine:
             return False
 
         m = self.config.max_model_len
+        bs = self.cache_config.page_size
+        num_pages = self.cache_config.num_pages
         reps = [g[0] for g in groups.values()]
-        # --- prefix reuse + suffix planning per representative ---
-        rep_slots, offsets = [], []
-        for rep in reps:
-            slot, off = self._try_prefix_reuse(rep.input_ids)
-            if slot is None:
-                slot, off = self._alloc_slot(), 0
+        # --- prefix claim + page allocation per representative ---
+        rep_slots: List[int] = []
+        offsets: List[int] = []
+        rep_pages: List[List[int]] = []
+        admitted_groups: List[List[_Request]] = []
+        for rep, group in zip(reps, groups.values()):
+            prompt = rep.all_tokens
+            shared, off = self.registry.claim(self.pm, prompt)
+            need = -(-len(prompt) // bs) - len(shared)
+            fresh = self._alloc_pages(need)
+            if fresh is None:
+                # pool exhausted — return the whole group to pending
+                self.pm.release(shared)
+                self._pending = group + self._pending
+                continue
+            slot = self._free_slots.pop()
+            pages = shared + fresh
             rep_slots.append(slot)
             offsets.append(off)
-        # suffix bucket; clamp offsets so every row fits (off + tp <= m)
-        while True:
-            tp = self._prefill_bucket(
-                max(
-                    len(rep.input_ids) - off
-                    for rep, off in zip(reps, offsets)
-                )
+            rep_pages.append(pages)
+            admitted_groups.append(group)
+        if not rep_slots:
+            return False
+
+        # suffix bucket (offsets are page-aligned and < prompt len)
+        tp = self._prefill_bucket(
+            max(
+                len(g[0].all_tokens) - off
+                for g, off in zip(admitted_groups, offsets)
             )
-            bad = [i for i, off in enumerate(offsets) if off + tp > m]
-            if not bad:
-                break
-            for i in bad:
-                offsets[i] = max(0, m - tp)
-        # count reuse from the post-clamp offsets (what was actually served
-        # from cache)
-        self.total_cached_prompt_tokens += sum(offsets)
-        pf_bound = min(
-            m,
-            data_utils.next_bucket_size(
-                max(offsets) + tp, self.config.kv_bucket
-            ),
         )
+        # rows whose suffix exceeds the bucket fall back to offset 0?
+        # cannot happen: offset <= len(prompt)-1 and bucket >= max suffix.
+        self.total_cached_prompt_tokens += sum(offsets)
+        pf_prefix_bound = 0
+        if max(offsets) > 0:
+            pf_prefix_bound = min(
+                m,
+                data_utils.next_bucket_size(
+                    max(offsets), self.config.kv_bucket
+                ),
+            )
+        pps_pf = max(
+            1,
+            -(-data_utils.next_bucket_size(
+                max(len(g[0].all_tokens) for g in admitted_groups),
+                self.config.kv_bucket,
+            ) // bs),
+        )
+        pps_pf = min(pps_pf, self.cache_config.max_pages_per_seq)
         # pow2 row bucket: a lone unique prompt (a GRPO group) doesn't pay
         # for wave-1 padding rows of compute
-        n_rows = 1 << (len(reps) - 1).bit_length() if len(reps) > 1 else 1
+        n_rows = (
+            1 << (len(rep_slots) - 1).bit_length() if len(rep_slots) > 1 else 1
+        )
         tokens = np.zeros((n_rows, tp), np.int32)
         true_lens = np.zeros(n_rows, np.int32)
-        row_slots = np.zeros(n_rows, np.int32)
         row_offsets = np.zeros(n_rows, np.int32)
-        for i, (rep, slot, off) in enumerate(zip(reps, rep_slots, offsets)):
-            suffix = rep.input_ids[off:]
+        row_tables = np.full((n_rows, pps_pf), num_pages, np.int32)
+        for i, (group, slot, off, pages) in enumerate(
+            zip(admitted_groups, rep_slots, offsets, rep_pages)
+        ):
+            prompt = group[0].all_tokens
+            suffix = prompt[off:]
             tokens[i, : len(suffix)] = suffix
             true_lens[i] = len(suffix)
-            row_slots[i] = slot
             row_offsets[i] = off
+            row_tables[i, : len(pages)] = pages
         self.cache, wave_logits = model_runner.prefill_batch(
             self.params, self.model_config, self.cache,
             jnp.asarray(tokens), jnp.asarray(row_offsets),
-            jnp.asarray(true_lens), jnp.asarray(row_slots),
-            kv_bound=pf_bound,
+            jnp.asarray(true_lens), jnp.asarray(row_tables),
+            prefix_bound=pf_prefix_bound,
         )
 
-        # --- sibling fan-out: copy the representative's KV line ---
-        copy_src, copy_dst = [], []
+        # --- sibling fan-out: share full prompt pages, copy the partial
+        # tail page (if any) ---
+        copy_src: List[int] = []
+        copy_dst: List[int] = []
         admitted: List[tuple] = []  # (req, slot, logits_row)
-        for i, group in enumerate(groups.values()):
-            admitted.append((group[0], rep_slots[i], i))
+        for i, (group, slot, pages) in enumerate(
+            zip(admitted_groups, rep_slots, rep_pages)
+        ):
+            plen = len(group[0].all_tokens)
+            self._install(group[0], slot, pages, plen)
+            admitted.append((group[0], slot, i))
+            n_full = plen // bs
             for sib in group[1:]:
-                slot = self._alloc_slot()
-                copy_src.append(rep_slots[i])
-                copy_dst.append(slot)
-                admitted.append((sib, slot, i))
-                self.total_cached_prompt_tokens += len(sib.input_ids)
+                if not self._free_slots:
+                    self._pending.insert(0, sib)
+                    continue
+                shared = pages[:n_full]
+                sib_pages = list(shared)
+                self.pm.share(shared)
+                if plen % bs:
+                    tail = self._alloc_pages(1)
+                    if tail is None:
+                        # pool dry mid-fanout: requeue the sibling
+                        self.pm.release(shared)
+                        self._pending.insert(0, sib)
+                        continue
+                    copy_src.append(pages[n_full])
+                    copy_dst.append(tail[0])
+                    sib_pages += tail
+                sslot = self._free_slots.pop()
+                self._install(sib, sslot, sib_pages, plen)
+                admitted.append((sib, sslot, i))
+                self.total_cached_prompt_tokens += plen
         if copy_src:
             pad = data_utils.next_bucket_size(len(copy_src), 8)
             src = np.zeros(pad, np.int32)
-            dst = np.full(pad, self.cache_config.num_slots, np.int32)
+            dst = np.full(pad, num_pages, np.int32)
             src[: len(copy_src)] = copy_src
             dst[: len(copy_dst)] = copy_dst
-            self.cache = model_runner.copy_slots(
+            self.cache = model_runner.copy_pages(
                 self.cache, jnp.asarray(src), jnp.asarray(dst)
             )
 
@@ -604,9 +733,7 @@ class GenerationEngine:
         no_stops = np.zeros(n, np.int32)
         stops = np.full((n, 8), -1, np.int32)
         for j, (req, slot, _) in enumerate(admitted):
-            plen = len(req.input_ids)
-            req.slot = slot
-            self._active[slot] = req
+            plen = len(req.all_tokens)
             self.total_prompt_tokens += plen
             self.total_requests += 1
             slots_np[j] = slot
@@ -616,8 +743,8 @@ class GenerationEngine:
             greedys[j] = req.greedy
             # the first token is sampled at admission (below), so the
             # device-side budget starts at allowed − 1
-            remainings[j] = min(req.max_new_tokens, m - plen) - 1
-            no_stops[j] = req.min_new_tokens - 1
+            remainings[j] = min(req.budget_left, m - plen) - 1
+            no_stops[j] = req.min_left - 1
             ids = np.asarray(req.stop_token_ids[:8], np.int32)
             stops[j, : len(ids)] = ids
         sl = jnp.asarray(slots_np)
@@ -634,25 +761,75 @@ class GenerationEngine:
         # representative's last-token logits row ---
         rows = jnp.asarray([r for (_, _, r) in admitted])
         full = jnp.zeros(
-            (self.cache_config.num_slots, wave_logits.shape[-1]),
+            (self.config.max_num_seqs, wave_logits.shape[-1]),
             wave_logits.dtype,
         ).at[sl].set(wave_logits[rows])
-        self._sample_and_append(full, only_slots=[int(s) for s in slots_np])
+        self._sample_and_append(full, only_slots=[int(x) for x in slots_np])
         return True
 
-    def _kv_bound(self, steps: int) -> int:
-        """Static decode-attention bound: bucketed longest CACHED length.
-        decode_multi's chunk buffer carries the in-flight tokens, so the
-        bound only needs to cover what's already in the cache."""
-        del steps
-        max_len = max(
-            len(r.input_ids) + len(r.output_ids)
-            for r in self._active.values()
-        )
-        return min(
+    def _install(
+        self, req: _Request, slot: int, pages: List[int], cached: int
+    ):
+        req.slot = slot
+        self._active[slot] = req
+        self._slot_pages[slot] = pages
+        self._cached_len[slot] = cached
+        self._tables[slot] = self.cache_config.num_pages
+        self._tables[slot, : len(pages)] = pages
+
+    # ------------------------------------------------------------------
+    # Decode
+    # ------------------------------------------------------------------
+    def _ensure_decode_pages(self, steps: int) -> bool:
+        """Grow every active slot's page table to cover pos0+steps;
+        preempt under pool pressure. Returns False if nothing decodable."""
+        bs = self.cache_config.page_size
+        while self._active:
+            shortfall = 0
+            grow: List[tuple] = []
+            for slot, req in self._active.items():
+                cached = int(self._cached_len[slot])
+                need = -(-min(cached + steps, self.config.max_model_len) // bs)
+                have = len(self._slot_pages[slot])
+                if need > have:
+                    grow.append((slot, need - have))
+                    shortfall += need - have
+            if shortfall == 0:
+                return True
+            if shortfall > self.pm.n_free:
+                self.registry.evict(self.pm, shortfall)
+            if shortfall <= self.pm.n_free:
+                for slot, n in grow:
+                    pages = self.pm.alloc(n)
+                    assert pages is not None
+                    sp = self._slot_pages[slot]
+                    self._tables[slot, len(sp) : len(sp) + n] = pages
+                    sp.extend(pages)
+                return True
+            if len(self._active) == 1:
+                # a lone request larger than the whole pool cannot be
+                # preempted into progress — truncate it
+                slot = next(iter(self._active))
+                logger.warning(
+                    f"pool smaller than one request; truncating "
+                    f"{self._active[slot].rid}"
+                )
+                self._finish(slot, "length")
+                return False
+            if not self._preempt_youngest():
+                return False
+        return False
+
+    def _pages_bound(self, steps: int) -> int:
+        """Static page-window bound: bucketed longest cached length plus
+        the in-flight chunk."""
+        bs = self.cache_config.page_size
+        max_len = max(int(self._cached_len[s]) for s in self._active) + steps
+        tokens = min(
             self.config.max_model_len,
             data_utils.next_bucket_size(max_len, self.config.kv_bucket),
         )
+        return min(-(-tokens // bs), self.cache_config.max_pages_per_seq)
 
     def _sampling_mode(self) -> int:
         """Static topk_bound for the sampling kernel, from the live mix of
@@ -664,8 +841,6 @@ class GenerationEngine:
         if self.config.sample_topk_bound <= 0:
             return 0  # exact full-vocab sort requested
         mx = max((r.top_k for r in reqs), default=0)
-        # bucketed so varying client top_k values don't each force a fresh
-        # XLA compile of the fused decode program
         return data_utils.next_bucket_size(
             max(self.config.sample_topk_bound, mx),
             self.config.sample_topk_bound,
@@ -675,25 +850,33 @@ class GenerationEngine:
         if not self._active:
             return False
         steps = max(1, self.config.decode_chunk)
+        if not self._ensure_decode_pages(steps):
+            return False
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
+        pps = self._pages_bound(steps)
+        tables_dev = jnp.asarray(self._tables[:, :pps])
+        pos0 = jnp.asarray(self._cached_len.astype(np.int32))
         (
             self.cache, toks, logps, emitted, active_after,
             self._remaining, self._no_stop,
         ) = model_runner.decode_multi(
             self.params, self.model_config, self.cache,
+            tables_dev, pos0,
             self._cur_tokens, self._active_dev, self._remaining,
             self._no_stop, self._stop_tokens, key,
             self._temp_dev, self._top_p_dev, self._top_k_dev,
             self._greedy_dev, steps=steps,
-            kv_bound=self._kv_bound(steps),
             topk_bound=self._sampling_mode(),
+            attn_impl=self._attn_impl,
+            ppcb=self.config.pages_per_compute_block,
+            spb=self.config.slots_per_block,
         )
         self._cur_tokens = toks[-1]
         self._active_dev = active_after
         # the ONE host fetch per `steps` generated tokens (packed: each
         # separate array fetch is a full round-trip over a driver tunnel)
-        s = self.cache_config.num_slots
+        s = self.config.max_num_seqs
         packed = np.asarray(
             model_runner.pack_host(toks, logps, emitted, active_after)
         )
@@ -711,6 +894,8 @@ class GenerationEngine:
                     break
                 if req.first_token_time is None:
                     req.first_token_time = now
+                # this step cached the slot's previous input token
+                self._cached_len[slot] += 1
                 tok = int(h_toks[t, slot])
                 req.output_ids.append(tok)
                 req.output_logprobs.append(float(h_logps[t, slot]))
@@ -743,10 +928,9 @@ class GenerationEngine:
             self._greedy_dev, topk_bound=self._sampling_mode(),
         )
         # record sampled tokens as the next decode inputs for these slots
-        # (one batched scatter, one packed host fetch)
         sl = jnp.asarray(np.asarray(only_slots, np.int32))
         self._cur_tokens = self._cur_tokens.at[sl].set(toks[sl])
-        s = self.cache_config.num_slots
+        s = self.config.max_num_seqs
         packed = np.asarray(model_runner.pack_host(toks, logps))
         host_toks = packed[:s].astype(np.int64)
         host_logps = packed[s:]
@@ -780,17 +964,16 @@ class GenerationEngine:
 
     def _finish(self, slot: int, reason: str):
         req = self._active.pop(slot)
-        self.allocator.free(slot)
-        self._active_dev = self._active_dev.at[slot].set(False)
         if reason == "abort":
             self.total_aborted += 1
-        if self.config.prefix_reuse_min > 0:
-            # the slot's line holds the prompt plus all generated tokens
-            # except the last sampled one (it was never fed back)
-            cached = len(req.input_ids) + max(0, len(req.output_ids) - 1)
-            self._freed_prefix[slot] = np.asarray(
-                (req.input_ids + req.output_ids)[:cached], np.int32
-            )
+        # the slot's pages hold the prompt plus all generated tokens
+        # except the last sampled one (it was never fed back)
+        self._release_slot(
+            slot,
+            park_tokens=(
+                req.all_tokens if self.config.prefix_reuse_min > 0 else None
+            ),
+        )
         now = time.monotonic()
         result = {
             "output_ids": req.output_ids,
@@ -803,6 +986,7 @@ class GenerationEngine:
                 "latency": now - req.submit_time,
                 "ttft": (req.first_token_time or now) - req.submit_time,
                 "model_version": self.model_version,
+                "preemptions": req.preemptions,
             },
         }
         if not req.future.done():

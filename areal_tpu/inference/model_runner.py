@@ -1,29 +1,29 @@
-"""Jitted prefill / decode-step programs over the slot KV cache.
+"""Jitted prefill / decode programs over the paged KV-block pool.
 
 The TPU-native core of the generation engine (role of SGLang's model runner
-behind the reference's HTTP API). Compiled programs:
+behind the reference's HTTP API, driven at areal/engine/sglang_remote.py).
+Compiled programs over the page pool (inference/cache.py layout):
 
-- ``prefill_batch``: N requests' prompt suffixes as ONE batched [N, Tp]
-  forward — the whole admission wave is a single large matmul program
-  instead of N serial prompt passes. Each row carries a per-row ``offset``:
-  the number of tokens already cached in its slot (prefix reuse — the
-  radix-cache analog, reference areal/engine/sglang_remote.py:158-168).
-  K/V for the suffix land at [offset, offset+len) in the slot's line.
-- ``decode_step``: ALL active slots advance one token in a single batched
-  program — continuous batching is "the batch dim is the slot dim". K/V for
-  the new token scatter into each slot's line; attention reads the cache
-  line up to a static ``kv_bound`` (host-bucketed to the longest active
-  sequence) under a length mask, so short sequences don't pay
-  max_model_len HBM traffic.
-- ``copy_slots``: duplicate cache lines across slots — GRPO's group_size
-  identical prompts prefill once and fan out by an HBM copy.
+- ``prefill_batch``: N prompt suffixes as ONE batched [N, Tp] forward.
+  Each row resumes from ``offset`` tokens already cached in its pages
+  (prefix reuse — the radix-cache analog): attention = gathered page
+  window [0, offset) ++ in-flight suffix (causal), and the suffix K/V for
+  all layers lands in the pool with one donated scatter after the layer
+  scan — the pool itself never rides the scan (a mutated multi-GB scan
+  carry costs a full copy per step on TPU; measured, not folklore).
+- ``decode_multi``: `steps` fused decode+sample iterations in ONE dispatch
+  with device-side stop handling. The pool is READ-ONLY inside the step
+  loop; new tokens' K/V accumulate in a small [L, S, T] chunk buffer that
+  the paged-attention kernel folds into the same online softmax, and one
+  bulk scatter merges the chunk into the pool at the end.
+- ``decode_step``: single step without sampling (tests / TP fallback).
+- ``copy_pages``: page-granular pool copy (GRPO sibling partial-tail pages;
+  full prompt pages are *shared* host-side, no copy).
 
-All programs scan over the stacked layer params (compile once per bucket,
-O(1) in depth), keep fp32 softmax/logits, and use
-``preferred_element_type=f32`` einsums so bf16 stays on the MXU. Sampling
-(temperature / top-k / top-p / greedy, per-slot) runs on device with a
-static ``topk_bound`` (lax.top_k instead of a full-vocab sort); stop
-handling is on device in ``decode_multi``, host backstopped.
+Attention backend is static per call: "kernel" (Pallas manual-DMA flash,
+TPU) or "jnp" (gather fallback — CPU tests and tensor-parallel serving).
+Sampling (temperature / top-k / top-p / greedy, per slot) runs on device
+with a static ``topk_bound``; fp32 softmax/logits throughout.
 """
 
 import functools
@@ -35,6 +35,11 @@ import jax.numpy as jnp
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.models.transformer import Params
 from areal_tpu.ops.basic import apply_rope, rms_norm, rope_frequencies
+from areal_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_jnp,
+    unpacked_view,
+)
 
 NEG_INF = -2.3819763e38
 
@@ -68,8 +73,6 @@ def _mlp(
 
         flat = h.reshape(1, -1, h.shape[-1])
         # padding / inactive-slot tokens must not consume expert capacity
-        # (their identical embeddings would all route to the same experts
-        # and displace real tokens)
         vflat = None if valid is None else valid.reshape(1, -1)
         out, _ = moe_ffn_from_params(cfg, lp, flat, valid=vflat)
         return out.reshape(h.shape)
@@ -84,30 +87,52 @@ def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
     return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
+def flat_positions(
+    tables: jnp.ndarray,  # [N, PPS] int32 logical page ids
+    positions: jnp.ndarray,  # [N, T] int32 token positions
+    page_size: int,
+    num_pages: int,
+    valid: jnp.ndarray,  # [N, T] bool
+) -> jnp.ndarray:
+    """Token position → flat pool index (page*BS + off); invalid rows map
+    to num_pages*BS (dropped by scatter mode='drop')."""
+    page = jnp.take_along_axis(
+        tables, jnp.clip(positions // page_size, 0, tables.shape[1] - 1),
+        axis=1,
+    )
+    flat = page * page_size + positions % page_size
+    return jnp.where(valid, flat, num_pages * page_size)
+
+
 # ---------------------------------------------------------------------------
 # Prefill (batched, prefix-aware)
 # ---------------------------------------------------------------------------
-def _prefill_impl(
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "prefix_bound"),
+    donate_argnames=("cache",),
+)
+def prefill_batch(
     params: Params,
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
     tokens: jnp.ndarray,  # [N, Tp] int32 suffix tokens, padded to bucket
     offsets: jnp.ndarray,  # [N] int32 tokens already cached (prefix reuse)
     true_lens: jnp.ndarray,  # [N] int32 suffix lengths (0 = padding row)
-    slots: jnp.ndarray,  # [N] int32 target slot per row
-    kv_bound: Optional[int],
+    tables: jnp.ndarray,  # [N, PPS] logical pages covering offset+Tp
+    prefix_bound: int = 0,  # static: gathered window >= max(offsets), 0 = none
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """One batched forward over N prompt suffixes; writes K/V into each
-    row's slot at its offset; returns last-real-token logits [N, V].
+    """One batched forward over N prompt suffixes; writes each row's suffix
+    K/V into its pages; returns last-real-token logits [N, V] (fp32).
 
-    Host contract: ``offsets[i] + Tp <= kv_bound <= max_model_len`` for
-    every real row, so the dynamic_update_slice never clamps.
+    Host contract: tables cover ceil((offset+Tp)/BS) pages per real row;
+    ``prefix_bound`` >= every row's offset; offsets are page-aligned.
     """
     n, tp = tokens.shape
-    num_slots, m = cache["k"].shape[1], cache["k"].shape[2]
-    mb = m if kv_bound is None else min(kv_bound, m)
-    # padding rows scatter out-of-range → dropped
-    slots = jnp.where(true_lens > 0, slots, num_slots)
+    d = cfg.head_dim
+    nl, hkv, num_pages, prow, fd = cache["k"].shape
+    page_size = prow * fd // d
+    mb0 = prefix_bound
     sidx = jnp.arange(tp, dtype=jnp.int32)[None, :]
     pos = offsets[:, None] + sidx  # [N, Tp] absolute positions
     valid_q = sidx < true_lens[:, None]
@@ -115,335 +140,245 @@ def _prefill_impl(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     x = params["embedding"][tokens]  # [N, Tp, D]
-    # key j visible to row-i query at suffix index s iff j <= offset_i + s
-    att_mask = jnp.arange(mb)[None, None, :] <= pos[:, :, None]  # [N, Tp, mb]
     scale = cfg.head_dim**-0.5
     g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
-    k_all = cache["k"][:, :, :mb]
-    v_all = cache["v"][:, :, :mb]
+    kpool = unpacked_view(cache["k"], d)  # [L, Hkv, NP*BS..] view
+    vpool = unpacked_view(cache["v"], d)
+    kflat = kpool.reshape(nl, hkv, num_pages * page_size, d)
+    vflat = vpool.reshape(nl, hkv, num_pages * page_size, d)
 
-    def upd(line, new, off):
-        zero = jnp.zeros((), jnp.int32)
-        return jax.lax.dynamic_update_slice(line, new, (off, zero, zero))
+    if mb0 > 0:
+        widx = flat_positions(
+            tables,
+            jnp.broadcast_to(jnp.arange(mb0, dtype=jnp.int32)[None], (n, mb0)),
+            page_size,
+            num_pages,
+            jnp.broadcast_to(
+                jnp.arange(mb0, dtype=jnp.int32)[None] < offsets[:, None],
+                (n, mb0),
+            ),
+        )
+        widx = jnp.minimum(widx, num_pages * page_size - 1)  # clamp pads
+        prefix_mask = (
+            jnp.arange(mb0, dtype=jnp.int32)[None, None, :] < pos[:, :, None]
+        ) & (jnp.arange(mb0, dtype=jnp.int32)[None, None, :]
+             < offsets[:, None, None])  # [N, Tp, mb0]
 
-    def layer(x, xs):
-        lp, k_lines, v_lines = xs  # lines [S, mb, Hkv, Dh]
+    # causal within the in-flight suffix
+    suffix_mask = (sidx[:, :, None] >= sidx[:, None, :]) & valid_q[:, None, :]
+
+    def layer(carry, xs):
+        x = carry
+        lp, li = xs
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q, k, v = _project_qkv(cfg, lp, h)
+        q, k, v = _project_qkv(cfg, lp, h)  # [N, Tp, H*, Dh]
         q = apply_rope(q, pos, cos, sin)
         k = apply_rope(k, pos, cos, sin)
-        kz = jnp.where(valid_q[..., None, None], k, 0).astype(k_lines.dtype)
-        vz = jnp.where(valid_q[..., None, None], v, 0).astype(v_lines.dtype)
-        rows_k = jax.vmap(upd)(k_lines[slots], kz, offsets)  # [N, mb, Hkv, Dh]
-        rows_v = jax.vmap(upd)(v_lines[slots], vz, offsets)
-        # GQA without materializing repeated KV: queries grouped by their
-        # shared kv head (head h uses group h // rep — HF layout); bf16
-        # stays on the MXU, accumulation fp32
+        kz = jnp.where(valid_q[..., None, None], k, 0)
+        vz = jnp.where(valid_q[..., None, None], v, 0)
         qg = q.reshape(n, tp, g, rep, cfg.head_dim)
-        scores = (
+        # suffix-vs-suffix scores (causal)
+        sc_sfx = (
             jnp.einsum(
-                "nqgrd,nkgd->ngrqk", qg, rows_k,
+                "nqgrd,nkgd->ngrqk", qg, kz,
                 preferred_element_type=jnp.float32,
             )
             * scale
         )
-        scores = jnp.where(att_mask[:, None, None], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum(
-            "ngrqk,nkgd->nqgrd", probs.astype(rows_v.dtype), rows_v,
-            preferred_element_type=jnp.float32,
-        )
+        sc_sfx = jnp.where(suffix_mask[:, None, None], sc_sfx, NEG_INF)
+        if mb0 > 0:
+            kl = jax.lax.dynamic_index_in_dim(kflat, li, 0, keepdims=False)
+            vl = jax.lax.dynamic_index_in_dim(vflat, li, 0, keepdims=False)
+            win_k = jnp.take(kl, widx, axis=1)  # [Hkv, N, mb0, D]
+            win_v = jnp.take(vl, widx, axis=1)
+            sc_pre = (
+                jnp.einsum(
+                    "nqgrd,gnkd->ngrqk", qg, win_k,
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            sc_pre = jnp.where(
+                prefix_mask[:, None, None], sc_pre, NEG_INF
+            )
+            sc = jnp.concatenate([sc_pre, sc_sfx], axis=-1)
+        else:
+            sc = sc_sfx
+        probs = jax.nn.softmax(sc, axis=-1)
+        if mb0 > 0:
+            attn = jnp.einsum(
+                "ngrqk,gnkd->nqgrd",
+                probs[..., :mb0].astype(win_v.dtype), win_v,
+                preferred_element_type=jnp.float32,
+            ) + jnp.einsum(
+                "ngrqk,nkgd->nqgrd",
+                probs[..., mb0:].astype(vz.dtype), vz,
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            attn = jnp.einsum(
+                "ngrqk,nkgd->nqgrd", probs.astype(vz.dtype), vz,
+                preferred_element_type=jnp.float32,
+            )
         attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h2, valid=valid_q)
-        k_lines = k_lines.at[slots].set(rows_k, mode="drop")
-        v_lines = v_lines.at[slots].set(rows_v, mode="drop")
-        return x, (k_lines, v_lines)
+        kv_dtype = cache["k"].dtype
+        return x, (kz.astype(kv_dtype), vz.astype(kv_dtype))
 
-    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], k_all, v_all))
-    if mb < m:
-        cache_k = cache["k"].at[:, :, :mb].set(new_k)
-        cache_v = cache["v"].at[:, :, :mb].set(new_v)
-    else:
-        cache_k, cache_v = new_k, new_v
-    lens = cache["lens"].at[slots].set(offsets + true_lens, mode="drop")
+    x, (k_sfx, v_sfx) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(nl, dtype=jnp.int32))
+    )
+    # ONE donated scatter of every layer's suffix K/V into the pool
+    dest = flat_positions(tables, pos, page_size, num_pages, valid_q)  # [N,Tp]
+    kw = k_sfx.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, n * tp, d)
+    vw = v_sfx.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, n * tp, d)
+    kflat = kflat.at[:, :, dest.reshape(-1)].set(kw, mode="drop")
+    vflat = vflat.at[:, :, dest.reshape(-1)].set(vw, mode="drop")
+    new_cache = {
+        "k": kflat.reshape(cache["k"].shape),
+        "v": vflat.reshape(cache["v"].shape),
+    }
     last = x[jnp.arange(n), jnp.maximum(true_lens - 1, 0)]  # [N, D]
     logits = _final_logits(params, cfg, last)  # [N, V] fp32
-    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
-
-
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "kv_bound"), donate_argnames=("cache",)
-)
-def prefill_batch(
-    params: Params,
-    cfg: ModelConfig,
-    cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [N, Tp]
-    offsets: jnp.ndarray,  # [N]
-    true_lens: jnp.ndarray,  # [N]
-    slots: jnp.ndarray,  # [N]
-    kv_bound: Optional[int] = None,
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Prefill N prompt suffixes in ONE batched dispatch (see module doc)."""
-    return _prefill_impl(
-        params, cfg, cache, tokens, offsets, true_lens, slots, kv_bound
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def prefill(
-    params: Params,
-    cfg: ModelConfig,
-    cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [Tp] int32, padded to bucket
-    true_len: jnp.ndarray,  # scalar int32
-    slot: jnp.ndarray,  # scalar int32
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Single-request prefill (batch of one; kept for tests/simple callers)."""
-    cache, logits = _prefill_impl(
-        params,
-        cfg,
-        cache,
-        tokens[None],
-        jnp.zeros((1,), jnp.int32),
-        true_len[None],
-        slot[None],
-        None,
-    )
-    return cache, logits[0]
+    return new_cache, logits
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))
-def copy_slots(
+def copy_pages(
     cache: Dict[str, jnp.ndarray],
-    src: jnp.ndarray,  # [P] int32 source slot per copy
-    dst: jnp.ndarray,  # [P] int32 destination (>= num_slots rows are dropped)
+    src: jnp.ndarray,  # [P] int32 source page per copy
+    dst: jnp.ndarray,  # [P] int32 destination (>= num_pages rows dropped)
 ) -> Dict[str, jnp.ndarray]:
-    """Duplicate cache lines src→dst (GRPO sibling fan-out after one
-    shared prompt prefill). Padding rows use dst >= num_slots."""
-    k = cache["k"].at[:, dst].set(cache["k"][:, src], mode="drop")
-    v = cache["v"].at[:, dst].set(cache["v"][:, src], mode="drop")
-    lens = cache["lens"].at[dst].set(cache["lens"][src], mode="drop")
-    return {"k": k, "v": v, "lens": lens}
+    """Duplicate pool pages src→dst (GRPO sibling partial-tail pages after
+    one shared prompt prefill; full pages are shared host-side instead).
+    Padding rows use dst >= num_pages."""
+    k = cache["k"].at[:, :, dst].set(cache["k"][:, :, src], mode="drop")
+    v = cache["v"].at[:, :, dst].set(cache["v"][:, :, src], mode="drop")
+    return {"k": k, "v": v}
 
 
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
-def _decode_impl(
-    params: Params,
+def _attend(
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [S] int32: current input token per slot
-    active: jnp.ndarray,  # [S] bool
-    kv_bound: Optional[int],
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """All slots advance one position; returns logits [S, V] (fp32).
-
-    Attention reads only the first ``kv_bound`` cache positions (host
-    guarantees every active length + 1 fits inside it).
-    """
-    s, m = cache["k"].shape[1], cache["k"].shape[2]
-    mb = m if kv_bound is None else min(kv_bound, m)
-    positions = cache["lens"]  # [S] next position per slot
-    cos, sin = rope_frequencies(
-        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
-    )
-    x = params["embedding"][tokens]  # [S, D]
-    att_mask = jnp.arange(mb)[None, :] <= positions[:, None]  # [S, mb]
-    scale = cfg.head_dim**-0.5
-    g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
-
-    def layer(carry, xs):
-        x = carry  # [S, D]
-        lp, k_l, v_l = xs  # cache line [S, mb, Hkv, Dh]
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q, k, v = _project_qkv(cfg, lp, h)  # q [S, Hq, Dh], k/v [S, Hkv, Dh]
-        q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
-        # scatter new k/v at each ACTIVE slot's position; inactive slots'
-        # lines (possibly freed-but-reusable prefixes longer than this
-        # dispatch's kv_bound) must not be touched — dynamic_update_slice
-        # clamps out-of-range starts, which would corrupt position mb-1
-        k_l = _scatter_token(k_l, k, positions, active)
-        v_l = _scatter_token(v_l, v, positions, active)
-        # GQA without materializing repeated KV (the decode step is HBM
-        # bound on exactly these cache-line reads)
-        qg = q.reshape(s, g, rep, cfg.head_dim)
-        scores = (
-            jnp.einsum(
-                "sgrd,smgd->sgrm", qg, k_l,
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )
-        scores = jnp.where(att_mask[:, None, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum(
-            "sgrm,smgd->sgrd", probs.astype(v_l.dtype), v_l,
-            preferred_element_type=jnp.float32,
-        )
-        attn = attn.astype(x.dtype).reshape(s, cfg.q_dim)
-        x = x + attn @ lp["wo"]
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, lp, h2, valid=active)
-        return x, (k_l, v_l)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"][:, :, :mb], cache["v"][:, :, :mb])
-    )
-    logits = _final_logits(params, cfg, x)  # [S, V]
-    lens = jnp.where(active, positions + 1, positions)
-    if mb < m:
-        cache_k = cache["k"].at[:, :, :mb].set(new_k)
-        cache_v = cache["v"].at[:, :, :mb].set(new_v)
-    else:
-        cache_k, cache_v = new_k, new_v
-    return {"k": cache_k, "v": cache_v, "lens": lens}, logits
-
-
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "kv_bound"), donate_argnames=("cache",)
-)
-def decode_step(
-    params: Params,
-    cfg: ModelConfig,
-    cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [S] int32
-    active: jnp.ndarray,  # [S] bool
-    kv_bound: Optional[int] = None,
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    return _decode_impl(params, cfg, cache, tokens, active, kv_bound)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "steps", "kv_bound", "topk_bound"),
-    donate_argnames=("cache",),
-)
-def decode_multi(
-    params: Params,
-    cfg: ModelConfig,
-    cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,  # [S] current input token per slot
-    active: jnp.ndarray,  # [S] bool
-    remaining: jnp.ndarray,  # [S] int32 tokens still allowed per slot
-    no_stop_before: jnp.ndarray,  # [S] int32 (min_new_tokens countdown)
-    stop_tokens: jnp.ndarray,  # [S, K] int32, -1 padded
-    key: jax.Array,
-    temperature: jnp.ndarray,
-    top_p: jnp.ndarray,
-    top_k: jnp.ndarray,
-    greedy: jnp.ndarray,
-    steps: int,
-    kv_bound: Optional[int] = None,
-    topk_bound: int = 0,
+    li: jnp.ndarray,
+    q: jnp.ndarray,  # [S, Hq, D]
+    pos0: jnp.ndarray,  # [S] cached lengths
+    tables: jnp.ndarray,  # [S, PPS]
+    ck: jnp.ndarray,  # [S, Hkv, T, D]
+    cv: jnp.ndarray,
+    counts: jnp.ndarray,  # [S]
+    attn_impl: str,
+    ppcb: int,
+    spb: int,
 ):
-    """`steps` fused decode+sample iterations in ONE dispatch, with stop
-    handling on device — the host round-trip (which dominates serving
-    latency, especially over a driver link) is amortized over `steps`
-    tokens. A slot deactivates in-device when it emits a stop token (past
-    its min_new_tokens window) or exhausts its budget; inactive slots stop
-    advancing their cache line.
+    if attn_impl == "kernel":
+        return paged_decode_attention(
+            q, cache["k"], cache["v"], li, pos0, tables, ck, cv, counts,
+            pages_per_compute_block=ppcb, slots_per_block=spb,
+        )
+    return paged_decode_attention_jnp(
+        q, cache["k"], cache["v"], li, pos0, tables, ck, cv, counts
+    )
 
-    The big KV cache is READ-ONLY inside the step loop — mutating a
-    multi-hundred-MB loop carry costs a full copy per step on TPU. New
-    tokens' K/V accumulate in a small ``[L, S, steps]`` chunk buffer;
-    attention covers the (bounded) cached window plus the chunk window;
-    one bulk scatter merges the chunk into the cache at the end.
 
-    Host contract: ``max(lens) <= kv_bound`` (the chunk window carries the
-    in-flight tokens, so the bound needn't cover ``+ steps``).
-
-    Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S] bool,
-    active_after [S], remaining_after, no_stop_after).
-    """
-    s, m = cache["k"].shape[1], cache["k"].shape[2]
-    mb = m if kv_bound is None else min(kv_bound, m)
-    g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
-    nl = cfg.num_layers
-    pos0 = cache["lens"]  # [S] cached tokens per slot (fixed this chunk)
+def _decode_core(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,  # [S, PPS]
+    pos0: jnp.ndarray,  # [S] cached tokens per slot (fixed this chunk)
+    tokens0: jnp.ndarray,  # [S] current input token per slot
+    active0: jnp.ndarray,  # [S] bool
+    key: Optional[jax.Array],
+    sample_args: Optional[tuple],
+    stop_args: Optional[tuple],
+    steps: int,
+    attn_impl: str,
+    ppcb: int,
+    spb: int,
+    topk_bound: int,
+):
+    """Shared body of decode_multi / decode_step. When sample_args is None,
+    runs exactly one step and returns the logits instead of sampling."""
+    s = tables.shape[0]
+    d = cfg.head_dim
+    nl, hkv, num_pages, prow, fd = cache["k"].shape
+    page_size = prow * fd // d
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     srange = jnp.arange(s)
-    cache_mask = jnp.arange(mb)[None, :] < pos0[:, None]  # [S, mb] static
-    k_ro = cache["k"][:, :, :mb]  # read-only views
-    v_ro = cache["v"][:, :, :mb]
-    scale = cfg.head_dim**-0.5
+    kv_dtype = cache["k"].dtype
 
-    def step(carry, step_key):
-        kbuf, vbuf, tokens, clen, active, remaining, no_stop = carry
+    def model_step(kbuf, vbuf, tokens, clen, active):
+        """One forward pass for all slots; new K/V appended to the chunk
+        buffers (inactive slots drop). Returns (kbuf, vbuf, logits)."""
         x = params["embedding"][tokens]  # [S, D]
         pos = pos0 + clen
+        counts = clen + 1  # the just-written self token is visible
 
         def layer(xc, xs):
             x, kbuf, vbuf = xc
             lp, li = xs
             h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-            q, k, v = _project_qkv(cfg, lp, h)
+            q, k, v = _project_qkv(cfg, lp, h)  # q [S,Hq,D] k/v [S,Hkv,D]
             q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
-            # new token K/V → chunk buffer (inactive slots drop)
             ci = jnp.where(active, clen, steps)
             kbuf = kbuf.at[li, srange, ci].set(
-                k.astype(kbuf.dtype), mode="drop"
+                k.astype(kv_dtype), mode="drop"
             )
             vbuf = vbuf.at[li, srange, ci].set(
-                v.astype(vbuf.dtype), mode="drop"
+                v.astype(kv_dtype), mode="drop"
             )
-            k_l = jax.lax.dynamic_index_in_dim(k_ro, li, 0, keepdims=False)
-            v_l = jax.lax.dynamic_index_in_dim(v_ro, li, 0, keepdims=False)
             kb = jax.lax.dynamic_index_in_dim(kbuf, li, 0, keepdims=False)
             vb = jax.lax.dynamic_index_in_dim(vbuf, li, 0, keepdims=False)
-            # GQA grouped attention over cached ++ chunk windows
-            qg = q.reshape(s, g, rep, cfg.head_dim)
-            sc = (
-                jnp.einsum(
-                    "sgrd,smgd->sgrm", qg, k_l,
-                    preferred_element_type=jnp.float32,
-                )
-                * scale
+            attn = _attend(
+                cfg, cache, li, q, pos0, tables,
+                kb.transpose(0, 2, 1, 3), vb.transpose(0, 2, 1, 3),
+                counts, attn_impl, ppcb, spb,
             )
-            sc = jnp.where(cache_mask[:, None, None, :], sc, NEG_INF)
-            sb = (
-                jnp.einsum(
-                    "sgrd,stgd->sgrt", qg, kb,
-                    preferred_element_type=jnp.float32,
-                )
-                * scale
-            )
-            chunk_mask = jnp.arange(steps)[None, :] <= clen[:, None]
-            sb = jnp.where(chunk_mask[:, None, None, :], sb, NEG_INF)
-            probs = jax.nn.softmax(
-                jnp.concatenate([sc, sb], axis=-1), axis=-1
-            )
-            pc, pb = probs[..., :mb], probs[..., mb:]
-            attn = jnp.einsum(
-                "sgrm,smgd->sgrd", pc.astype(v_l.dtype), v_l,
-                preferred_element_type=jnp.float32,
-            ) + jnp.einsum(
-                "sgrt,stgd->sgrd", pb.astype(vb.dtype), vb,
-                preferred_element_type=jnp.float32,
-            )
-            x = x + attn.astype(x.dtype).reshape(s, cfg.q_dim) @ lp["wo"]
+            x = x + attn.reshape(s, cfg.q_dim).astype(x.dtype) @ lp["wo"]
             h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, lp, h2, valid=active)
             return (x, kbuf, vbuf), None
 
         (x, kbuf, vbuf), _ = jax.lax.scan(
-            layer, (x, kbuf, vbuf), (params["layers"], jnp.arange(nl))
+            layer, (x, kbuf, vbuf),
+            (params["layers"], jnp.arange(nl, dtype=jnp.int32)),
         )
-        logits = _final_logits(params, cfg, x)
+        return kbuf, vbuf, _final_logits(params, cfg, x)
+
+    # inactive slots scatter at index `steps` (out of range → dropped)
+    kbuf0 = jnp.zeros((nl, s, steps, hkv, d), kv_dtype)
+    vbuf0 = jnp.zeros_like(kbuf0)
+
+    if sample_args is None:
+        kbuf, vbuf, logits = model_step(
+            kbuf0, vbuf0, tokens0, jnp.zeros(s, jnp.int32), active0
+        )
+        clen_final = active0.astype(jnp.int32)
+        cache = _merge_chunk(
+            cache, kbuf, vbuf, tables, pos0, clen_final, page_size, num_pages
+        )
+        return cache, logits
+
+    temperature, top_p, top_k, greedy = sample_args
+    remaining0, no_stop0, stop_tokens = stop_args
+
+    def step(carry, step_key):
+        kbuf, vbuf, tokens, clen, active, remaining, no_stop = carry
+        kbuf, vbuf, logits = model_step(kbuf, vbuf, tokens, clen, active)
         toks, logps = _sample_impl(
             logits, step_key, temperature, top_p, top_k, greedy, topk_bound
         )
         emitted = active
-        # a stop token may end the slot once it would have emitted
-        # >= min_new_tokens INCLUDING this one (no_stop holds min - emitted)
         hit_stop = jnp.any(
             toks[:, None] == stop_tokens, axis=1
         ) & (no_stop <= 1)
@@ -455,75 +390,111 @@ def decode_multi(
             toks, logps, emitted,
         )
 
-    kbuf0 = jnp.zeros(
-        (nl, s, steps, g, cfg.head_dim), cache["k"].dtype
-    )
-    vbuf0 = jnp.zeros_like(kbuf0)
     keys = jax.random.split(key, steps)
     (kbuf, vbuf, tokens, clen, active, remaining, no_stop), (
         toks, logps, emitted,
     ) = jax.lax.scan(
         step,
-        (kbuf0, vbuf0, tokens, jnp.zeros(s, jnp.int32), active,
-         remaining, no_stop_before),
+        (kbuf0, vbuf0, tokens0, jnp.zeros(s, jnp.int32),
+         active0, remaining0, no_stop0),
         keys,
     )
-    # bulk merge: chunk buffer → cache at absolute positions (one scatter)
-    tgrid = jnp.arange(steps)[None, :]
-    tgt = jnp.where(tgrid < clen[:, None], pos0[:, None] + tgrid, m)  # [S, T]
-    cache_k = cache["k"].at[:, srange[:, None], tgt].set(kbuf, mode="drop")
-    cache_v = cache["v"].at[:, srange[:, None], tgt].set(vbuf, mode="drop")
-    lens = pos0 + clen
-    cache = {"k": cache_k, "v": cache_v, "lens": lens}
+    cache = _merge_chunk(
+        cache, kbuf, vbuf, tables, pos0, clen, page_size, num_pages
+    )
     return cache, toks, logps, emitted, active, remaining, no_stop
+
+
+def _merge_chunk(
+    cache, kbuf, vbuf, tables, pos0, clen, page_size, num_pages
+):
+    """Bulk scatter: chunk buffers [L, S, T, Hkv, D] → pool at absolute
+    positions pos0..pos0+clen (one donated scatter per tensor)."""
+    nl, s, t, hkv, d = kbuf.shape
+    tgrid = jnp.arange(t, dtype=jnp.int32)[None, :]
+    dest = flat_positions(
+        tables, pos0[:, None] + tgrid, page_size, num_pages,
+        tgrid < clen[:, None],
+    ).reshape(-1)  # [S*T]
+    kw = kbuf.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, s * t, d)
+    vw = vbuf.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, s * t, d)
+    kflat = unpacked_view(cache["k"], d).reshape(
+        nl, hkv, num_pages * page_size, d
+    )
+    vflat = unpacked_view(cache["v"], d).reshape(
+        nl, hkv, num_pages * page_size, d
+    )
+    kflat = kflat.at[:, :, dest].set(kw, mode="drop")
+    vflat = vflat.at[:, :, dest].set(vw, mode="drop")
+    return {
+        "k": kflat.reshape(cache["k"].shape),
+        "v": vflat.reshape(cache["v"].shape),
+    }
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "kv_bound", "topk_bound"),
+    static_argnames=("cfg", "steps", "topk_bound", "attn_impl", "ppcb", "spb"),
     donate_argnames=("cache",),
 )
-def decode_and_sample(
+def decode_multi(
     params: Params,
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,  # [S, PPS] int32 (bucketed page window)
+    pos0: jnp.ndarray,  # [S] int32 cached tokens per slot
+    tokens: jnp.ndarray,  # [S] current input token per slot
+    active: jnp.ndarray,  # [S] bool
+    remaining: jnp.ndarray,  # [S] int32 tokens still allowed per slot
+    no_stop_before: jnp.ndarray,  # [S] int32 (min_new_tokens countdown)
+    stop_tokens: jnp.ndarray,  # [S, K] int32, -1 padded
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    greedy: jnp.ndarray,
+    steps: int,
+    topk_bound: int = 0,
+    attn_impl: str = "jnp",
+    ppcb: int = 4,
+    spb: int = 8,
+):
+    """`steps` fused decode+sample iterations in ONE dispatch with stop
+    handling on device (see module doc). Host contract: tables cover
+    ceil((pos0[s]+steps)/page_size) pages for every active slot.
+
+    Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S],
+    active_after [S], remaining_after, no_stop_after)."""
+    return _decode_core(
+        params, cfg, cache, tables, pos0, tokens, active, key,
+        (temperature, top_p, top_k, greedy),
+        (remaining, no_stop_before, stop_tokens),
+        steps, attn_impl, ppcb, spb, topk_bound,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "ppcb", "spb"),
+    donate_argnames=("cache",),
+)
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,  # [S, PPS]
+    pos0: jnp.ndarray,  # [S]
     tokens: jnp.ndarray,  # [S]
     active: jnp.ndarray,  # [S] bool
-    key: jax.Array,
-    temperature: jnp.ndarray,  # [S]
-    top_p: jnp.ndarray,  # [S]
-    top_k: jnp.ndarray,  # [S]
-    greedy: jnp.ndarray,  # [S] bool
-    kv_bound: Optional[int] = None,
-    topk_bound: int = 0,
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
-    """Fused decode step + sampling: ONE dispatch and one host fetch per
-    generation step (the per-step host round-trip is the latency floor of the
-    serving loop, so everything between two steps stays on device)."""
-    cache, logits = _decode_impl(params, cfg, cache, tokens, active, kv_bound)
-    toks, logps = _sample_impl(
-        logits, key, temperature, top_p, top_k, greedy, topk_bound
+    attn_impl: str = "jnp",
+    ppcb: int = 4,
+    spb: int = 8,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Single decode step for all slots; returns (cache, logits [S, V])."""
+    return _decode_core(
+        params, cfg, cache, tables, pos0, tokens, active, None, None, None,
+        1, attn_impl, ppcb, spb, 0,
     )
-    return cache, toks, logps
-
-
-def _scatter_token(
-    cache_line: jnp.ndarray,  # [S, M, Hkv, D]
-    new: jnp.ndarray,  # [S, Hkv, D]
-    positions: jnp.ndarray,  # [S]
-    active: jnp.ndarray,  # [S] bool — inactive rows are left untouched
-) -> jnp.ndarray:
-    new = new.astype(cache_line.dtype)
-
-    def one(line, tok, pos, act):
-        zero = jnp.zeros((), jnp.int32)
-        cur = jax.lax.dynamic_slice(
-            line, (pos, zero, zero), (1,) + line.shape[1:]
-        )
-        tok = jnp.where(act, tok[None], cur)
-        return jax.lax.dynamic_update_slice(line, tok, (pos, zero, zero))
-
-    return jax.vmap(one)(cache_line, new, positions, active)
 
 
 # ---------------------------------------------------------------------------
@@ -545,16 +516,16 @@ def _sample_impl(
           ``categorical`` over the scaled logits; no sort at all.
        0  exact full-vocab sort (argsort) — the always-correct fallback.
       K>0 ``lax.top_k(K)`` candidates, top-k/top-p masks applied within
-          them — the fast serving path (host picks K >= every slot's
-          top_k; top_p truncation beyond K candidates is approximated,
-          standard practice on accelerator serving stacks).
+          them — the fast serving path. Slots that request NO truncation
+          (top_k=0, top_p>=1) sample full-vocab categorical instead, so
+          their behavior logprob matches their true sampling distribution
+          (advisor round-2 finding).
 
     The returned logprob is under the temperature-scaled (untruncated)
     distribution — the behavior-policy logprob the trainer consumes
-    (reference ModelResponse.output_logprobs semantics). Greedy slots are
-    the exception: they pick argmax over the raw logits, so their logprob
-    is reported under the *unscaled* distribution (temperature never enters
-    their behavior policy).
+    (reference ModelResponse.output_logprobs semantics). Greedy slots
+    report the logprob under the *unscaled* distribution (temperature
+    never enters their behavior policy).
     """
     s, v = logits.shape
     temp = jnp.maximum(temperature, 1e-5)[:, None]
@@ -566,8 +537,8 @@ def _sample_impl(
     elif topk_bound > 0:
         kb = min(topk_bound, v)
         vals, idx = jax.lax.top_k(scaled, kb)  # [S, kb]
-        # top_p cutoffs are defined against the FULL-vocab distribution, not
-        # renormalized over the kb candidates (matching the exact path)
+        # top_p cutoffs are defined against the FULL-vocab distribution,
+        # not renormalized over the kb candidates (matching the exact path)
         cand_probs = jnp.exp(jnp.take_along_axis(logp_full, idx, axis=-1))
         cumprev = jnp.cumsum(cand_probs, axis=-1) - cand_probs
         rank = jnp.arange(kb)[None, :]
@@ -576,7 +547,13 @@ def _sample_impl(
         keep = keep.at[:, 0].set(True)  # always keep the argmax token
         trunc = jnp.where(keep, vals, NEG_INF)
         choice = jax.random.categorical(key, trunc, axis=-1)
-        sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+        truncated_pick = jnp.take_along_axis(
+            idx, choice[:, None], axis=-1
+        )[:, 0]
+        # untruncated slots keep the exact full-vocab distribution
+        untruncated = (top_k <= 0) & (top_p >= 1.0)
+        full_pick = jax.random.categorical(key, scaled, axis=-1)
+        sampled = jnp.where(untruncated, full_pick, truncated_pick)
     else:
         # exact path: full sort (slow; tests / host-side calls)
         sort_idx = jnp.argsort(-scaled, axis=-1)
@@ -586,9 +563,8 @@ def _sample_impl(
         rank = jnp.arange(v)[None, :]
         keep = jnp.ones((s, v), bool)
         keep &= jnp.where(top_k[:, None] > 0, rank < top_k[:, None], True)
-        # keep tokens while cumulative prob (exclusive) < top_p
         keep &= (cumprobs - sorted_probs) < top_p[:, None]
-        keep = keep.at[:, 0].set(True)  # always keep the argmax token
+        keep = keep.at[:, 0].set(True)
         trunc_sorted = jnp.where(keep, sorted_logits, NEG_INF)
         trunc = jnp.full_like(scaled, NEG_INF).at[
             jnp.arange(s)[:, None], sort_idx
@@ -598,9 +574,7 @@ def _sample_impl(
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
     # Greedy slots ignore temperature when picking the token, so report the
-    # logprob under the *unscaled* distribution — mixing argmax(logits) with
-    # the temperature-scaled softmax would hand the trainer importance
-    # ratios from a distribution that was never sampled.
+    # logprob under the *unscaled* distribution.
     lp_sampled = jnp.take_along_axis(
         logp_full, tokens[:, None], axis=-1
     ).squeeze(-1)

@@ -1,6 +1,7 @@
-"""Batched prefix-aware prefill, KV slot copies, and engine-level prefix
+"""Batched prefix-aware prefill, page sharing, and engine-level prefix
 reuse / sibling dedup — the serving-path analogs of the reference's radix
-cache (areal/engine/sglang_remote.py:158-168).
+cache (areal/engine/sglang_remote.py:158-168), rebuilt as refcounted page
+sharing over the paged pool.
 
 Correctness bar: every reuse path must be token-identical to the fresh
 full-prefill path under greedy decoding.
@@ -14,32 +15,51 @@ import jax.numpy as jnp
 
 from areal_tpu.api.cli_args import JaxGenConfig
 from areal_tpu.inference import model_runner
-from areal_tpu.inference.cache import CacheConfig, init_kv_cache
+from areal_tpu.inference.cache import (
+    CacheConfig,
+    PageManager,
+    PrefixRegistry,
+    init_kv_pool,
+)
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.models.config import tiny_config
 from areal_tpu.models.transformer import init_params
+
+BS = 16
+NSLOTS = 4
+PPS = 4
+NPAGES = NSLOTS * PPS
 
 
 @pytest.fixture(scope="module")
 def setup():
     cfg = tiny_config("qwen2")
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
-    ccfg = CacheConfig(num_slots=4, max_model_len=64)
+    ccfg = CacheConfig(num_pages=NPAGES, page_size=BS, max_model_len=64)
     return cfg, params, ccfg
 
 
-def _prefill_rows(params, cfg, cache, rows, offsets, slots, tp, kv_bound=None):
+def _tables():
+    return (
+        np.arange(NSLOTS)[:, None] * PPS + np.arange(PPS)[None]
+    ).astype(np.int32)
+
+
+def _prefill_rows(
+    params, cfg, cache, rows, offsets, slots, tp, prefix_bound=0
+):
     n = len(rows)
     tokens = np.zeros((n, tp), np.int32)
     true_lens = np.zeros(n, np.int32)
     for i, r in enumerate(rows):
         tokens[i, : len(r)] = r
         true_lens[i] = len(r)
+    tables = _tables()[np.asarray(slots)]
     return model_runner.prefill_batch(
         params, cfg, cache,
         jnp.asarray(tokens), jnp.asarray(offsets, jnp.int32),
-        jnp.asarray(true_lens), jnp.asarray(slots, jnp.int32),
-        kv_bound=kv_bound,
+        jnp.asarray(true_lens), jnp.asarray(tables),
+        prefix_bound=prefix_bound,
     )
 
 
@@ -50,24 +70,20 @@ def test_batched_prefill_matches_single(setup):
     prompts = [
         rng.integers(0, cfg.vocab_size, size=n).tolist() for n in (5, 9, 3)
     ]
-    # batched
-    cache_b = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    cache_b = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
     cache_b, logits_b = _prefill_rows(
         params, cfg, cache_b, prompts, [0, 0, 0], [0, 1, 2], tp=16
     )
-    # singles
-    cache_s = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    cache_s = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
     for i, p in enumerate(prompts):
-        pad = np.zeros(16, np.int32)
-        pad[: len(p)] = p
-        cache_s, logits_1 = model_runner.prefill(
-            params, cfg, cache_s, jnp.asarray(pad),
-            jnp.asarray(len(p), jnp.int32), jnp.asarray(i, jnp.int32),
+        cache_s, logits_1 = _prefill_rows(
+            params, cfg, cache_s, [p], [0], [i], tp=16
         )
         np.testing.assert_allclose(
-            np.asarray(logits_b[i]), np.asarray(logits_1), rtol=1e-4, atol=1e-4
+            np.asarray(logits_b[i]), np.asarray(logits_1[0]),
+            rtol=1e-4, atol=1e-4,
         )
-    for key in ("k", "v", "lens"):
+    for key in ("k", "v"):
         np.testing.assert_allclose(
             np.asarray(cache_b[key]), np.asarray(cache_s[key]),
             rtol=1e-5, atol=1e-5,
@@ -75,57 +91,67 @@ def test_batched_prefill_matches_single(setup):
 
 
 def test_extend_prefill_matches_full(setup):
-    """Prefilling [prefix] then extending with [suffix] at offset gives the
-    same logits and decode continuation as prefilling [prefix+suffix]."""
+    """Prefilling [prefix] then extending with the page-aligned [suffix]
+    gives the same logits and decode continuation as prefilling the whole
+    prompt."""
     cfg, params, ccfg = setup
     rng = np.random.default_rng(1)
-    full = rng.integers(0, cfg.vocab_size, size=12).tolist()
-    prefix, suffix = full[:7], full[7:]
+    full = rng.integers(0, cfg.vocab_size, size=BS + 5).tolist()
+    prefix, suffix = full[:BS], full[BS:]
 
-    cache_f = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    cache_f = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
     cache_f, logits_f = _prefill_rows(
-        params, cfg, cache_f, [full], [0], [0], tp=16
+        params, cfg, cache_f, [full], [0], [0], tp=32
     )
 
-    cache_e = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
-    cache_e, _ = _prefill_rows(params, cfg, cache_e, [prefix], [0], [0], tp=16)
+    cache_e = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    cache_e, _ = _prefill_rows(
+        params, cfg, cache_e, [prefix], [0], [0], tp=16
+    )
     cache_e, logits_e = _prefill_rows(
-        params, cfg, cache_e, [suffix], [7], [0], tp=16
+        params, cfg, cache_e, [suffix], [BS], [0], tp=16, prefix_bound=BS
     )
     np.testing.assert_allclose(
         np.asarray(logits_e[0]), np.asarray(logits_f[0]), rtol=1e-4, atol=1e-4
     )
-    assert int(cache_e["lens"][0]) == 12
 
     # greedy decode continues identically from both caches
     tok_f = int(jnp.argmax(logits_f[0]))
     tok_e = int(jnp.argmax(logits_e[0]))
     assert tok_f == tok_e
-    toks = jnp.zeros((ccfg.num_slots,), jnp.int32).at[0].set(tok_f)
-    active = jnp.zeros((ccfg.num_slots,), bool).at[0].set(True)
-    cache_f, lf = model_runner.decode_step(params, cfg, cache_f, toks, active)
-    cache_e, le = model_runner.decode_step(params, cfg, cache_e, toks, active)
+    toks = jnp.zeros((NSLOTS,), jnp.int32).at[0].set(tok_f)
+    active = jnp.zeros((NSLOTS,), bool).at[0].set(True)
+    pos0 = jnp.zeros(NSLOTS, jnp.int32).at[0].set(len(full))
+    tb = jnp.asarray(_tables())
+    cache_f, lf = model_runner.decode_step(
+        params, cfg, cache_f, tb, pos0, toks, active
+    )
+    cache_e, le = model_runner.decode_step(
+        params, cfg, cache_e, tb, pos0, toks, active
+    )
     assert int(jnp.argmax(lf[0])) == int(jnp.argmax(le[0]))
 
 
-def test_kv_bound_decode_matches_unbounded(setup):
-    """Bounded decode attention == full-line decode attention."""
+def test_pages_bound_decode_matches_full_tables(setup):
+    """Decode with a bucketed page window == decode with the full table."""
     cfg, params, ccfg = setup
     rng = np.random.default_rng(2)
     prompt = rng.integers(0, cfg.vocab_size, size=6).tolist()
     caches = []
     for _ in range(2):
-        c = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+        c = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
         c, lg = _prefill_rows(params, cfg, c, [prompt], [0], [0], tp=16)
         caches.append((c, lg))
     tok = int(jnp.argmax(caches[0][1][0]))
-    toks = jnp.zeros((ccfg.num_slots,), jnp.int32).at[0].set(tok)
-    active = jnp.zeros((ccfg.num_slots,), bool).at[0].set(True)
+    toks = jnp.zeros((NSLOTS,), jnp.int32).at[0].set(tok)
+    active = jnp.zeros((NSLOTS,), bool).at[0].set(True)
+    pos0 = jnp.zeros(NSLOTS, jnp.int32).at[0].set(len(prompt))
     c0, l0 = model_runner.decode_step(
-        params, cfg, caches[0][0], toks, active, kv_bound=None
+        params, cfg, caches[0][0], jnp.asarray(_tables()), pos0, toks, active
     )
     c1, l1 = model_runner.decode_step(
-        params, cfg, caches[1][0], toks, active, kv_bound=16
+        params, cfg, caches[1][0], jnp.asarray(_tables()[:, :1]), pos0,
+        toks, active,
     )
     np.testing.assert_allclose(
         np.asarray(l0[0]), np.asarray(l1[0]), rtol=1e-4, atol=1e-4
@@ -135,126 +161,64 @@ def test_kv_bound_decode_matches_unbounded(setup):
     )
 
 
-def test_copy_slots(setup):
-    cfg, params, ccfg = setup
-    rng = np.random.default_rng(3)
-    prompt = rng.integers(0, cfg.vocab_size, size=5).tolist()
-    cache = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
-    cache, logits = _prefill_rows(params, cfg, cache, [prompt], [0], [0], tp=16)
-    cache = model_runner.copy_slots(
-        cache,
-        jnp.asarray([0, 0, 0], jnp.int32),
-        # last row out-of-range → dropped
-        jnp.asarray([1, 2, ccfg.num_slots], jnp.int32),
-    )
-    np.testing.assert_array_equal(
-        np.asarray(cache["k"][:, 0]), np.asarray(cache["k"][:, 1])
-    )
-    np.testing.assert_array_equal(
-        np.asarray(cache["v"][:, 0]), np.asarray(cache["v"][:, 2])
-    )
-    assert int(cache["lens"][1]) == 5 and int(cache["lens"][2]) == 5
-    assert int(cache["lens"][3]) == 0
-    # both copies decode identically to the original
-    tok = int(jnp.argmax(logits[0]))
-    toks = jnp.full((ccfg.num_slots,), tok, jnp.int32)
-    active = jnp.asarray([True, True, True, False])
-    cache, lg = model_runner.decode_step(params, cfg, cache, toks, active)
-    assert (
-        int(jnp.argmax(lg[0])) == int(jnp.argmax(lg[1])) == int(jnp.argmax(lg[2]))
-    )
-
-
-def test_topk_bound_sampling_matches_exact():
-    """Bounded top_k sampling draws from the SAME truncated distribution as
-    the exact full-sort path (same support, matching frequencies) whenever
-    the truncation set fits inside the bound. The two paths use different
-    sample shapes, so tokens differ per-key — the distribution is the
-    contract."""
-    rng = np.random.default_rng(4)
-    logits = jnp.asarray(rng.normal(size=(4, 128)).astype(np.float32)) * 3.0
-    s = logits.shape[0]
-    temp = jnp.asarray([1.0, 0.7, 1.3, 1.0])
-    top_p = jnp.asarray([0.9, 1.0, 0.8, 0.95])
-    top_k = jnp.asarray([5, 20, 0, 50], jnp.int32)
-    greedy = jnp.zeros(s, bool)
-    n_draws = 400
-    exact = np.zeros((n_draws, s), np.int64)
-    fast = np.zeros((n_draws, s), np.int64)
-    for seed in range(n_draws):
-        key = jax.random.PRNGKey(seed)
-        t_exact, lp_exact = model_runner.sample_tokens(
-            logits, key, temp, top_p, top_k, greedy, topk_bound=0
-        )
-        t_fast, lp_fast = model_runner.sample_tokens(
-            logits, key, temp, top_p, top_k, greedy, topk_bound=64
-        )
-        exact[seed] = np.asarray(t_exact)
-        fast[seed] = np.asarray(t_fast)
-        # behavior logprob is truncation-independent: same token → same logp
-        scaled = np.asarray(logits) / np.asarray(temp)[:, None]
-        ref_lp = scaled - np.log(np.exp(scaled).sum(-1, keepdims=True))
-        for i in range(s):
-            np.testing.assert_allclose(
-                float(lp_fast[i]), ref_lp[i, int(t_fast[i])], rtol=1e-4
-            )
-    for i in range(s):
-        sup_exact = set(np.unique(exact[:, i]))
-        sup_fast = set(np.unique(fast[:, i]))
-        # identical support (both truncate to the same candidate set)
-        assert sup_fast <= sup_exact | sup_fast  # sanity
-        assert sup_fast == sup_exact or (
-            # sampling noise may miss ultra-rare tail members on one side
-            len(sup_fast ^ sup_exact) <= max(2, len(sup_exact) // 5)
-        )
-        # the modal token matches and its frequency is close
-        vals, counts = np.unique(exact[:, i], return_counts=True)
-        mode = vals[np.argmax(counts)]
-        f_exact = (exact[:, i] == mode).mean()
-        f_fast = (fast[:, i] == mode).mean()
-        assert abs(f_exact - f_fast) < 0.12
-
-
-def test_free_mode_sampling_logprobs():
-    """topk_bound=-1 (no truncation): logprob still the temperature-scaled
-    behavior logprob."""
-    rng = np.random.default_rng(5)
-    logits = jnp.asarray(rng.normal(size=(2, 64)).astype(np.float32))
-    temp = jnp.asarray([0.8, 1.0])
-    ones = jnp.ones(2)
-    toks, lps = model_runner.sample_tokens(
-        logits, jax.random.PRNGKey(0), temp, ones,
-        jnp.zeros(2, jnp.int32), jnp.zeros(2, bool), topk_bound=-1,
-    )
-    ref = jax.nn.log_softmax(logits / temp[:, None], axis=-1)
-    for i in range(2):
-        np.testing.assert_allclose(
-            float(lps[i]), float(ref[i, int(toks[i])]), rtol=1e-5
-        )
-
-
-def test_inactive_slot_line_untouched_by_bounded_decode(setup):
-    """A freed slot's cached prefix longer than the decode kv_bound must
-    survive decode dispatches untouched (dynamic_update_slice clamps
-    out-of-range starts, which would otherwise corrupt position mb-1)."""
+def test_inactive_slot_pages_untouched_by_decode(setup):
+    """A freed slot's cached pages must survive decode dispatches
+    untouched (the chunk merge only scatters active slots' positions)."""
     cfg, params, ccfg = setup
     rng = np.random.default_rng(6)
     long_prompt = rng.integers(0, cfg.vocab_size, size=30).tolist()
     short_prompt = rng.integers(0, cfg.vocab_size, size=4).tolist()
-    cache = init_kv_cache(cfg, ccfg, dtype=jnp.float32)
+    cache = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
     cache, _ = _prefill_rows(
         params, cfg, cache, [long_prompt, short_prompt], [0, 0], [0, 1], tp=32
     )
-    line_before = np.asarray(cache["k"][:, 0]).copy()
-    # slot 0 inactive (freed, reusable); slot 1 decodes with a small bound
-    toks = jnp.zeros((ccfg.num_slots,), jnp.int32).at[1].set(3)
-    active = jnp.zeros((ccfg.num_slots,), bool).at[1].set(True)
+    pages0 = _tables()[0]
+    before = np.asarray(cache["k"][:, :, pages0]).copy()
+    toks = jnp.zeros((NSLOTS,), jnp.int32).at[1].set(3)
+    active = jnp.zeros((NSLOTS,), bool).at[1].set(True)
+    pos0 = np.zeros(NSLOTS, np.int32)
+    pos0[0], pos0[1] = 30, 4
     for _ in range(3):
         cache, _ = model_runner.decode_step(
-            params, cfg, cache, toks, active, kv_bound=16
+            params, cfg, cache, jnp.asarray(_tables()), jnp.asarray(pos0),
+            toks, active,
         )
-    np.testing.assert_array_equal(np.asarray(cache["k"][:, 0]), line_before)
-    assert int(cache["lens"][0]) == 30  # length untouched too
+        pos0[1] += 1
+    np.testing.assert_array_equal(
+        np.asarray(cache["k"][:, :, pages0]), before
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host bookkeeping: PageManager + PrefixRegistry
+# ---------------------------------------------------------------------------
+def test_page_manager_refcounts():
+    pm = PageManager(8)
+    a = pm.alloc(3)
+    assert pm.n_free == 5
+    pm.share(a[:2])
+    pm.release(a)  # shared pages survive
+    assert pm.n_free == 6
+    pm.release(a[:2])
+    assert pm.n_free == 8
+    assert pm.alloc(9) is None
+
+
+def test_prefix_registry_claim_and_evict():
+    pm = PageManager(8)
+    reg = PrefixRegistry(page_size=4, min_match=4)
+    tokens = np.arange(10, dtype=np.int32)
+    pages = pm.alloc(3)  # 2 full pages (8 tokens) + partial
+    reg.add(pm, tokens, pages)
+    assert pm.n_free == 6  # partial page released immediately
+    # claim: prompt shares 8-token prefix
+    shared, off = reg.claim(pm, list(range(8)) + [99, 98])
+    assert off == 8 and shared == pages[:2]
+    assert pm.refcount[pages[0]] == 2
+    pm.release(shared)
+    # eviction drops the registry's reference
+    reg.evict(pm, pages_needed=8)
+    assert pm.n_free == 8
 
 
 # ---------------------------------------------------------------------------
@@ -267,9 +231,10 @@ def engine_factory():
     def make(**kw):
         cfg = tiny_config("qwen2")
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_num_seqs", 8)
         gcfg = JaxGenConfig(
-            dtype="float32", max_num_seqs=8, max_model_len=64,
-            prefill_chunk=16, **kw,
+            dtype="float32", max_model_len=64, prefill_chunk=16, **kw,
         )
         eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
         engines.append(eng)
@@ -281,8 +246,8 @@ def engine_factory():
 
 
 def test_sibling_dedup_one_prefill(engine_factory):
-    """group_size identical prompts: one prefill row, siblings identical
-    to a fresh engine's output under greedy decoding."""
+    """group_size identical prompts: one prefill row + shared prompt pages,
+    siblings identical to a fresh engine's output under greedy decoding."""
     eng = engine_factory(prefix_reuse_min=0)
     prompt = [3, 1, 4, 1, 5, 9, 2, 6]
     futs = [
@@ -295,7 +260,6 @@ def test_sibling_dedup_one_prefill(engine_factory):
         for _ in range(4)
     ]
     outs = [f.result(timeout=60) for f in futs]
-    # all siblings agree (greedy)
     for o in outs[1:]:
         assert o["output_ids"] == outs[0]["output_ids"]
     # dedup actually happened: siblings' prompt tokens served from cache
@@ -313,8 +277,8 @@ def test_sibling_dedup_one_prefill(engine_factory):
 
 def test_prefix_reuse_after_abort_resume(engine_factory):
     """The interruptible-generation resubmit (prompt + accumulated tokens)
-    extends the freed slot's KV instead of re-prefilling, and the result is
-    identical to an uninterrupted greedy run."""
+    claims the freed request's pages instead of re-prefilling, and the
+    result is identical to an uninterrupted greedy run."""
     eng = engine_factory(prefix_reuse_min=4)
     prompt = [7, 7, 3, 2, 9, 9, 1, 8]
     full = eng.generate(
@@ -324,8 +288,6 @@ def test_prefix_reuse_after_abort_resume(engine_factory):
         }
     )
     assert len(full["output_ids"]) == 12
-    # simulate the remote client's abort/resume: take the first 6 tokens as
-    # "accumulated", resubmit prompt+accumulated
     accumulated = full["output_ids"][:6]
     cached_before = eng.total_cached_prompt_tokens
     resumed = eng.generate(
@@ -334,7 +296,7 @@ def test_prefix_reuse_after_abort_resume(engine_factory):
             "sampling_params": {"max_new_tokens": 6, "greedy": True},
         }
     )
-    # the resubmit found the freed slot's prefix
+    # the resubmit claimed the parked prefix pages
     assert eng.total_cached_prompt_tokens > cached_before
     assert resumed["output_ids"] == full["output_ids"][6:]
 
@@ -345,9 +307,44 @@ def test_prefix_cache_flushed_on_weight_update(engine_factory):
     eng.generate(
         {"input_ids": prompt, "sampling_params": {"max_new_tokens": 4}}
     )
-    assert eng._freed_prefix  # something cached
+    assert len(eng.registry)  # something parked
+    free_before = eng.pm.n_free
     new_params = init_params(
         eng.model_config, jax.random.PRNGKey(7), dtype=jnp.float32
     )
     eng.update_weights_from_tensors(new_params)
-    assert not eng._freed_prefix
+    assert not len(eng.registry)
+    assert eng.pm.n_free > free_before
+
+
+def test_preemption_transparent(engine_factory):
+    """Oversubscribed pool: long generations preempt + resume
+    transparently, outputs identical to an uncontended run."""
+    # pool: 16 pages x 8 tokens = 128 tokens for up to 4 concurrent
+    # 8-prompt + 24-token requests (each needs 4 pages at peak)
+    eng = engine_factory(
+        prefix_reuse_min=8, num_pages=12, max_num_seqs=4, admit_wave=4,
+    )
+    prompts = [[i + 1] * 8 for i in range(4)]
+    futs = [
+        eng.submit(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 24, "greedy": True},
+            }
+        )
+        for p in prompts
+    ]
+    outs = [f.result(timeout=120) for f in futs]
+    for o in outs:
+        assert len(o["output_ids"]) == 24
+    # reference: uncontended engine, same weights
+    eng2 = engine_factory(admit_wave=1)
+    for p, o in zip(prompts, outs):
+        ref = eng2.generate(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 24, "greedy": True},
+            }
+        )
+        assert ref["output_ids"] == o["output_ids"]

@@ -1,0 +1,104 @@
+"""Streamed weight gather: peak host memory stays O(chunk), not O(model).
+
+Round-2 verdict #7: the old DEVICE upload replicated the FULL model to
+host before chunking (O(model) host RAM + stop-the-world gather). The fix
+streams per-FFD-chunk gather→post→free (reference analog: ≤1 GB chunk
+broadcast, areal/engine/fsdp_engine.py:435-444).
+"""
+
+import dataclasses
+import gc
+import weakref
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import (
+    MicroBatchSpec,
+    OptimizerConfig,
+    ParallelismConfig,
+    PPOActorConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.utils import weight_transfer as wt
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = PPOActorConfig(
+        dtype="float32",
+        param_dtype="float32",
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=4096),
+        optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        parallel=ParallelismConfig(fsdp_parallel_size=2, tensor_parallel_size=2),
+    )
+    eng = SPMDTrainEngine(cfg)
+    eng.initialize(
+        ft_spec=FinetuneSpec(1, 4, 4), model_config=tiny_config("qwen2"),
+        seed=0,
+    )
+    return eng
+
+
+def test_chunks_stream_and_free(engine):
+    """Earlier chunks' host arrays must be collectable once the consumer
+    drops them — the generator retains no full-model host copy."""
+    gen = engine.iter_weight_chunks(chunk_bytes=32 * 1024, dtype=jnp.bfloat16)
+    refs = []
+    seen = 0
+    names = set()
+    for i, n_chunks, chunk in gen:
+        assert n_chunks >= 3, "pick chunk_bytes small enough to split"
+        for name, arr in chunk:
+            assert arr.dtype == jnp.bfloat16
+            names.add(name)
+            refs.append(weakref.ref(arr))
+        del chunk, arr
+        seen += 1
+        if seen >= 3:
+            gc.collect()
+            dead = sum(r() is None for r in refs[:2])
+            assert dead >= 1, (
+                "first chunk's host arrays survived two chunks later — "
+                "the generator is retaining a full host copy"
+            )
+    # every leaf appears exactly once across chunks
+    flat = wt.flatten_params(engine.params)
+    assert names == {n for n, _ in flat}
+
+
+def test_chunk_plan_bounded_at_7b_shapes():
+    """FFD chunk planning bounds every chunk at max(cap, largest leaf) —
+    verified on Qwen2-7B-shaped leaves WITHOUT materializing them."""
+
+    @dataclasses.dataclass
+    class FakeLeaf:
+        nbytes: int
+
+    # Qwen2-7B geometry: hidden 3584, inter 18944, 28 layers, vocab 152064
+    h, inter, layers, vocab = 3584, 18944, 28, 152064
+    leaves = [("embedding", FakeLeaf(vocab * h * 2))]
+    for i in range(layers):
+        for name, sz in (
+            ("wq", h * h), ("wk", h * 512), ("wv", h * 512), ("wo", h * h),
+            ("w_gate", h * inter), ("w_up", h * inter),
+            ("w_down", inter * h),
+        ):
+            leaves.append((f"layers/{i}/{name}", FakeLeaf(sz * 2)))
+    leaves.append(("lm_head", FakeLeaf(vocab * h * 2)))
+    cap = 1 << 30  # 1 GB, the reference's chunk size
+    plan = wt.chunk_leaves(leaves, cap)
+    largest = max(leaf.nbytes for _, leaf in leaves)
+    bound = max(cap, largest)
+    total = 0
+    for chunk in plan:
+        csize = sum(leaf.nbytes for _, leaf in chunk)
+        assert csize <= bound
+        total += csize
+    assert total == sum(leaf.nbytes for _, leaf in leaves)
+    assert len(plan) >= 10  # a 7B model genuinely streams in many chunks
