@@ -196,6 +196,11 @@ class JaxGenConfig:
     # round-trip; stop handling happens on device so at most one dispatch
     # of latency is added to a finished request)
     decode_chunk: int = 8
+    # decode chunks kept in flight while the previous chunk's results are
+    # fetched/processed on host (0 = fully synchronous). Overlapping hides
+    # the host round-trip — essential over a driver tunnel, still worth a
+    # dispatch latency on a local chip
+    decode_pipeline: int = 1
     # unique prompts prefilled in one batched dispatch (rows are padded to
     # this wave size so the program shape is static per bucket); identical
     # prompts (GRPO siblings) share one row + a KV line copy

@@ -12,7 +12,8 @@ accuracy is measured with exactly the training-time success criterion.
 Usage (CLI):
     python -m areal_tpu.evaluation.eval_runner \
         --data path/to/test.jsonl --type gsm8k \
-        --addrs host:port[,host:port...] --n-samples 4 --out results.jsonl
+        --addrs host:port[,host:port...] --tokenizer-path <hf_dir> \
+        --n-samples 4 --out results.jsonl
 """
 
 import argparse
@@ -38,6 +39,7 @@ class EvalReport:
     n_samples: int
     accuracy: float  # mean per-sample success
     pass_at_k: Dict[int, float]
+    maj_at_k: Dict[int, float]  # majority-vote accuracy (math only)
     avg_gen_tokens: float
     wall_seconds: float
     rows: List[Dict[str, Any]]  # per-prompt details
@@ -60,6 +62,27 @@ def _pass_at_k(successes: np.ndarray, k: int) -> float:
         else:
             out.append(1.0 - comb(n - c, k) / comb(n, k))
     return float(np.mean(out)) if out else 0.0
+
+
+def _majority_correct(answers: List[str], truth: str) -> float:
+    """Majority voting over extracted answers (reference eval aggregation:
+    cluster equivalent answers, check the largest cluster against truth)."""
+    from areal_tpu.reward.math_parser import answers_equal
+
+    clusters: List[List[str]] = []
+    for a in answers:
+        if a is None:
+            continue
+        for c in clusters:
+            if answers_equal(a, c[0]):
+                c.append(a)
+                break
+        else:
+            clusters.append([a])
+    if not clusters:
+        return 0.0
+    best = max(clusters, key=len)
+    return float(answers_equal(best[0], truth))
 
 
 def evaluate_dataset(
@@ -85,20 +108,43 @@ def evaluate_dataset(
         return await asyncio.gather(*[one(it) for it in items])
 
     outs = asyncio.run(run_all())
-    successes, rows, gen_tokens = [], [], []
+    successes, rows, gen_tokens, majorities = [], [], [], {}
     for item, out in zip(items, outs):
         r = np.asarray(out["rewards"]).reshape(-1)
         successes.append((r > 0).astype(np.float64))
         gen_tokens.append(
             float(np.asarray(out["loss_mask"]).sum() / max(len(r), 1))
         )
-        rows.append(
-            {
-                "question": item.get("question")
-                or str(item.get("messages", ""))[:200],
-                "rewards": r.tolist(),
-            }
-        )
+        row = {
+            "question": item.get("question")
+            or str(item.get("messages", ""))[:200],
+            "rewards": r.tolist(),
+        }
+        # maj@k needs the completion TEXTS: detokenize the loss-masked
+        # region of each sample
+        if tokenizer is not None and item.get("answer") is not None:
+            from areal_tpu.reward.math_parser import extract_answer
+
+            ids = np.asarray(out["input_ids"])
+            lm = np.asarray(out["loss_mask"])
+            answers = [
+                extract_answer(
+                    tokenizer.decode(ids[i][lm[i] > 0].tolist())
+                )
+                for i in range(ids.shape[0])
+            ]
+            row["answers"] = answers
+            # GSM8K truth keeps its rationale + "#### N" tail — reduce it
+            # to the final answer exactly like process_results does
+            truth = str(item["answer"])
+            if "####" in truth or "\\boxed" in truth:
+                truth = extract_answer(truth) or truth
+            for k in (1, 2, 4, 8, 16):
+                if k <= len(answers):
+                    majorities.setdefault(k, []).append(
+                        _majority_correct(answers[:k], truth)
+                    )
+        rows.append(row)
     succ = np.asarray(successes)
     n = gconfig.n_samples
     return EvalReport(
@@ -109,6 +155,9 @@ def evaluate_dataset(
             k: _pass_at_k(succ, k)
             for k in (1, 2, 4, 8, 16)
             if k <= n
+        },
+        maj_at_k={
+            k: float(np.mean(v)) for k, v in sorted(majorities.items())
         },
         avg_gen_tokens=float(np.mean(gen_tokens)) if gen_tokens else 0.0,
         wall_seconds=time.perf_counter() - t0,
@@ -121,7 +170,11 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--data", required=True)
     p.add_argument("--type", default="gsm8k", help="dataset type (gsm8k|code|raw)")
     p.add_argument("--addrs", required=True, help="server host:port list")
-    p.add_argument("--tokenizer-path", default="")
+    p.add_argument(
+        "--tokenizer-path", required=True,
+        help="HF tokenizer dir (prompts are tokenized, completions "
+        "detokenized for scoring)",
+    )
     p.add_argument("--n-samples", type=int, default=1)
     p.add_argument("--max-new-tokens", type=int, default=1024)
     p.add_argument("--temperature", type=float, default=0.6)
@@ -132,11 +185,9 @@ def main(argv: Optional[List[str]] = None):
     from areal_tpu.dataset import get_custom_dataset
     from areal_tpu.engine.remote import RemoteInferenceEngine
 
-    tokenizer = None
-    if args.tokenizer_path:
-        from transformers import AutoTokenizer
+    from transformers import AutoTokenizer
 
-        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer_path)
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer_path)
     items = get_custom_dataset(
         DatasetConfig(path=args.data, type=args.type),
         tokenizer=tokenizer,

@@ -67,12 +67,20 @@ def init_kv_pool(
 
 
 class PageManager:
-    """Refcounted page allocator over the device pool (host bookkeeping)."""
+    """Refcounted page allocator over the device pool (host bookkeeping).
 
-    def __init__(self, num_pages: int):
+    ``reserve_first`` permanently reserves page 0 as the device-side
+    trash target for dropped row writes (dynamic_update_slice clamps
+    out-of-range starts, so invalid merge rows are pointed at a page that
+    never holds real data instead)."""
+
+    def __init__(self, num_pages: int, reserve_first: bool = False):
         self.num_pages = num_pages
         self.refcount = np.zeros(num_pages, np.int32)
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        first = 1 if reserve_first else 0
+        if reserve_first:
+            self.refcount[0] = 1
+        self._free: List[int] = list(range(num_pages - 1, first - 1, -1))
 
     @property
     def n_free(self) -> int:

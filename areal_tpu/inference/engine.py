@@ -188,8 +188,11 @@ class GenerationEngine:
         num_pages = config.num_pages
         if num_pages <= 0:
             # conservative auto: full provisioning (every slot can reach
-            # max_model_len) — set num_pages explicitly to oversubscribe
-            num_pages = config.max_num_seqs * (-(-config.max_model_len // bs))
+            # max_model_len) — set num_pages explicitly to oversubscribe.
+            # +1 for the permanently reserved trash page
+            num_pages = (
+                config.max_num_seqs * (-(-config.max_model_len // bs)) + 1
+            )
         self.cache_config = CacheConfig(
             num_pages=num_pages,
             page_size=bs,
@@ -211,7 +214,8 @@ class GenerationEngine:
                     "v": self._kv_sharding,
                 },
             )()
-        self.pm = PageManager(num_pages)
+        # page 0 is the trash target for dropped merge rows — reserved
+        self.pm = PageManager(num_pages, reserve_first=True)
         self.registry = PrefixRegistry(
             bs, config.prefix_reuse_min
         )
@@ -256,18 +260,39 @@ class GenerationEngine:
         self._remaining = jnp.zeros(s, jnp.int32)
         self._no_stop = jnp.zeros(s, jnp.int32)
         self._stop_tokens = jnp.full((s, 8), -1, jnp.int32)
+        # device-resident cached length per slot: decode chunk N+1 can
+        # dispatch before chunk N's results reach the host
+        self._lens_dev = jnp.zeros(s, jnp.int32)
+        # per-slot last (partial) pool row — lets merges avoid reading the
+        # pool (see model_runner.init_last_rows)
+        from areal_tpu.inference.model_runner import init_last_rows
+        from areal_tpu.ops.paged_attention import pack_factor
+
+        fd = pack_factor(model_config.head_dim) * model_config.head_dim
+        self._last_rows = init_last_rows(
+            model_config.num_layers, s, model_config.num_kv_heads, fd,
+            self.dtype,
+        )
+        # pipelined decode: dispatched-but-unprocessed chunks, and page
+        # releases deferred until the pipeline drains (an in-flight chunk
+        # may still write to a host-finished slot's pages)
+        self._inflight: List[Dict[str, Any]] = []
+        self._deferred_release: List[tuple] = []
         if self.mesh is not None:
             # small state must be explicitly replicated on the mesh so jit
             # doesn't mix committed single-device and sharded inputs
             for attr in (
                 "_cur_tokens", "_active_dev", "_temp_dev", "_top_p_dev",
                 "_top_k_dev", "_greedy_dev", "_remaining", "_no_stop",
-                "_stop_tokens",
+                "_stop_tokens", "_lens_dev",
             ):
                 setattr(
                     self, attr,
                     jax.device_put(getattr(self, attr), self._replicated),
                 )
+            self._last_rows = jax.device_put(
+                self._last_rows, self._replicated
+            )
         self._step_counter = 0
         # metrics
         self.total_generated_tokens = 0
@@ -417,6 +442,10 @@ class GenerationEngine:
                 return did
             did = True
             try:
+                # every command needs a quiesced device pipeline: aborts
+                # must not race in-flight chunks, and weight swaps would
+                # mis-attribute in-flight tokens to the new version
+                self._drain_pipeline()
                 if cmd == "abort_all":
                     for slot in list(self._active):
                         self._finish(slot, "abort")
@@ -526,19 +555,47 @@ class GenerationEngine:
 
     def _release_slot(self, slot: int, park_tokens: Optional[List[int]]):
         """Free a slot; its pages go to the registry (shared-prefix pool)
-        or straight back to the allocator."""
+        or straight back to the allocator. While decode chunks are in
+        flight the release is DEFERRED — an in-flight chunk may still
+        write into these pages (host-backstop stops finish a slot the
+        device considers active)."""
         pages = self._slot_pages.pop(slot, [])
         cached = int(self._cached_len[slot])
         self._active_dev = self._active_dev.at[slot].set(False)
+        # the device-side length must be zeroed too: a stale length with a
+        # reset table row would make the next decode dispatch DMA pages at
+        # the table fill value (one past the pool)
+        self._lens_dev = self._lens_dev.at[slot].set(0)
         self._tables[slot] = self.cache_config.num_pages
         self._cached_len[slot] = 0
         self._free_slots.append(slot)
-        if park_tokens is not None and cached > 0:
-            self.registry.add(
-                self.pm, np.asarray(park_tokens[:cached], np.int32), pages
-            )
+        tokens = (
+            np.asarray(park_tokens[:cached], np.int32)
+            if park_tokens is not None and cached > 0
+            else None
+        )
+        if self._inflight:
+            self._deferred_release.append((pages, tokens))
+        else:
+            self._do_release(pages, tokens)
+
+    def _do_release(self, pages: List[int], tokens: Optional[np.ndarray]):
+        if tokens is not None:
+            self.registry.add(self.pm, tokens, pages)
         else:
             self.pm.release(pages)
+
+    def _flush_deferred(self):
+        if not self._inflight:
+            for pages, tokens in self._deferred_release:
+                self._do_release(pages, tokens)
+            self._deferred_release.clear()
+
+    def _drain_pipeline(self):
+        """Process every in-flight decode chunk (and release deferrals)."""
+        while self._inflight:
+            self._process_chunk(self._inflight.pop(0))
+        self._flush_deferred()
 
     # ------------------------------------------------------------------
     # Admission
@@ -672,11 +729,16 @@ class GenerationEngine:
             true_lens[i] = len(suffix)
             row_offsets[i] = off
             row_tables[i, : len(pages)] = pages
-        self.cache, wave_logits = model_runner.prefill_batch(
+        row_slots = np.zeros(n_rows, np.int32)
+        for i, slot in enumerate(rep_slots):
+            row_slots[i] = slot
+        self.cache, wave_logits, pf_last = model_runner.prefill_batch(
             self.params, self.model_config, self.cache,
             jnp.asarray(tokens), jnp.asarray(row_offsets),
             jnp.asarray(true_lens), jnp.asarray(row_tables),
             prefix_bound=pf_prefix_bound,
+            last_rows=self._last_rows,
+            slot_ids=jnp.asarray(row_slots),
         )
 
         # --- sibling fan-out: share full prompt pages, copy the partial
@@ -731,6 +793,7 @@ class GenerationEngine:
         greedys = np.zeros(n, bool)
         remainings = np.zeros(n, np.int32)
         no_stops = np.zeros(n, np.int32)
+        plens = np.zeros(n, np.int32)
         stops = np.full((n, 8), -1, np.int32)
         for j, (req, slot, _) in enumerate(admitted):
             plen = len(req.all_tokens)
@@ -741,6 +804,7 @@ class GenerationEngine:
             top_ps[j] = req.top_p
             top_ks[j] = req.top_k
             greedys[j] = req.greedy
+            plens[j] = plen
             # the first token is sampled at admission (below), so the
             # device-side budget starts at allowed − 1
             remainings[j] = min(req.budget_left, m - plen) - 1
@@ -748,6 +812,7 @@ class GenerationEngine:
             ids = np.asarray(req.stop_token_ids[:8], np.int32)
             stops[j, : len(ids)] = ids
         sl = jnp.asarray(slots_np)
+        self._lens_dev = self._lens_dev.at[sl].set(jnp.asarray(plens))
         self._temp_dev = self._temp_dev.at[sl].set(jnp.asarray(temps))
         self._top_p_dev = self._top_p_dev.at[sl].set(jnp.asarray(top_ps))
         self._top_k_dev = self._top_k_dev.at[sl].set(jnp.asarray(top_ks))
@@ -756,6 +821,32 @@ class GenerationEngine:
         self._remaining = self._remaining.at[sl].set(jnp.asarray(remainings))
         self._no_stop = self._no_stop.at[sl].set(jnp.asarray(no_stops))
         self._stop_tokens = self._stop_tokens.at[sl].set(jnp.asarray(stops))
+
+        # --- last-row state for every admitted slot (siblings share the
+        # representative's prefill row content) ---
+        adm_rows = np.asarray([r for (_, _, r) in admitted], np.int32)
+        adm_slots = np.asarray([sl_ for (_, sl_, _) in admitted], np.int32)
+        onehot = jnp.asarray(
+            (adm_slots[:, None]
+             == np.arange(self.config.max_num_seqs)[None, :]).astype(
+                np.float32
+            )
+        )
+        sel = {
+            k_: jnp.take(v_, jnp.asarray(adm_rows), axis=1)
+            for k_, v_ in pf_last.items()
+        }
+        mask = (onehot.sum(0) > 0)[None, :, None, None]
+        self._last_rows = {
+            k_: jnp.where(
+                mask,
+                jnp.einsum(
+                    "lnhf,ns->lshf", sel[k_].astype(jnp.float32), onehot
+                ).astype(v_.dtype),
+                v_,
+            )
+            for k_, v_ in self._last_rows.items()
+        }
 
         # --- first token for every admitted slot: siblings share the
         # representative's last-token logits row ---
@@ -780,16 +871,23 @@ class GenerationEngine:
     # ------------------------------------------------------------------
     # Decode
     # ------------------------------------------------------------------
-    def _ensure_decode_pages(self, steps: int) -> bool:
-        """Grow every active slot's page table to cover pos0+steps;
-        preempt under pool pressure. Returns False if nothing decodable."""
+    def _ensure_decode_pages(self, margin_tokens: int) -> bool:
+        """Grow every active slot's page table to cover its cached length
+        plus ``margin_tokens`` (the host view lags in-flight chunks, so
+        the margin covers pipeline depth × chunk). Preempts under pool
+        pressure ONLY when the pipeline is empty — an in-flight chunk may
+        still write to a victim's pages. Returns False if nothing can be
+        dispatched right now."""
         bs = self.cache_config.page_size
         while self._active:
             shortfall = 0
             grow: List[tuple] = []
             for slot, req in self._active.items():
                 cached = int(self._cached_len[slot])
-                need = -(-min(cached + steps, self.config.max_model_len) // bs)
+                need = -(
+                    -min(cached + margin_tokens, self.config.max_model_len)
+                    // bs
+                )
                 have = len(self._slot_pages[slot])
                 if need > have:
                     grow.append((slot, need - have))
@@ -806,6 +904,8 @@ class GenerationEngine:
                     self._tables[slot, len(sp) : len(sp) + n] = pages
                     sp.extend(pages)
                 return True
+            if self._inflight:
+                return False  # drain first, then evict/preempt
             if len(self._active) == 1:
                 # a lone request larger than the whole pool cannot be
                 # preempted into progress — truncate it
@@ -820,11 +920,14 @@ class GenerationEngine:
                 return False
         return False
 
-    def _pages_bound(self, steps: int) -> int:
+    def _pages_bound(self, margin_tokens: int) -> int:
         """Static page-window bound: bucketed longest cached length plus
-        the in-flight chunk."""
+        the in-flight margin."""
         bs = self.cache_config.page_size
-        max_len = max(int(self._cached_len[s]) for s in self._active) + steps
+        max_len = (
+            max(int(self._cached_len[s]) for s in self._active)
+            + margin_tokens
+        )
         tokens = min(
             self.config.max_model_len,
             data_utils.next_bucket_size(max_len, self.config.kv_bucket),
@@ -847,22 +950,38 @@ class GenerationEngine:
         )
 
     def _decode(self) -> bool:
-        if not self._active:
-            return False
-        steps = max(1, self.config.decode_chunk)
-        if not self._ensure_decode_pages(steps):
-            return False
+        """Pipelined decode: dispatch chunk N+1, then process chunk N's
+        results while N+1 executes on device — the result fetch (a full
+        round-trip over a driver tunnel) overlaps device compute."""
+        depth = max(0, self.config.decode_pipeline)
+        did = False
+        dispatched = False
+        if self._active and len(self._inflight) <= depth:
+            steps = max(1, self.config.decode_chunk)
+            margin = steps * (len(self._inflight) + 1)
+            if self._ensure_decode_pages(margin):
+                self._dispatch_chunk(steps, margin)
+                dispatched = did = True
+        if self._inflight and (
+            len(self._inflight) > depth or not dispatched
+        ):
+            self._process_chunk(self._inflight.pop(0))
+            self._flush_deferred()
+            did = True
+        return did
+
+    def _dispatch_chunk(self, steps: int, margin: int):
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
-        pps = self._pages_bound(steps)
+        pps = self._pages_bound(margin)
         tables_dev = jnp.asarray(self._tables[:, :pps])
-        pos0 = jnp.asarray(self._cached_len.astype(np.int32))
         (
             self.cache, toks, logps, emitted, active_after,
-            self._remaining, self._no_stop,
+            self._remaining, self._no_stop, self._lens_dev,
+            self._last_rows,
         ) = model_runner.decode_multi(
             self.params, self.model_config, self.cache,
-            tables_dev, pos0,
+            tables_dev, self._lens_dev,
             self._cur_tokens, self._active_dev, self._remaining,
             self._no_stop, self._stop_tokens, key,
             self._temp_dev, self._top_p_dev, self._top_k_dev,
@@ -871,23 +990,39 @@ class GenerationEngine:
             attn_impl=self._attn_impl,
             ppcb=self.config.pages_per_compute_block,
             spb=self.config.slots_per_block,
+            last_rows=self._last_rows,
         )
         self._cur_tokens = toks[-1]
         self._active_dev = active_after
-        # the ONE host fetch per `steps` generated tokens (packed: each
-        # separate array fetch is a full round-trip over a driver tunnel)
-        s = self.config.max_num_seqs
-        packed = np.asarray(
-            model_runner.pack_host(toks, logps, emitted, active_after)
+        # ONE packed fetch per chunk (lazy: np.asarray in _process_chunk
+        # blocks; until then the device crunches the next chunk)
+        self._inflight.append(
+            {
+                "packed": model_runner.pack_host(
+                    toks, logps, emitted, active_after
+                ),
+                "steps": steps,
+                # dispatch-time slot→request snapshot: a slot finished and
+                # re-admitted between dispatch and processing must not
+                # absorb this chunk's stale results
+                "reqs": dict(self._active),
+                "version": self.model_version,
+            }
         )
+
+    def _process_chunk(self, chunk: Dict[str, Any]):
+        steps = chunk["steps"]
+        s = self.config.max_num_seqs
+        packed = np.asarray(chunk["packed"])  # blocks on the device here
         n = steps * s
         h_toks = packed[:n].reshape(steps, s).astype(np.int64)
         h_logps = packed[n : 2 * n].reshape(steps, s)
         h_emitted = packed[2 * n : 3 * n].reshape(steps, s) > 0.5
         h_active = packed[3 * n : 3 * n + s] > 0.5
         now = time.monotonic()
-        for slot in list(self._active):
-            req = self._active[slot]
+        for slot, req in chunk["reqs"].items():
+            if self._active.get(slot) is not req:
+                continue  # finished/preempted since dispatch
             stopped_host = False
             for t in range(steps):
                 if not h_emitted[t, slot]:
@@ -899,7 +1034,7 @@ class GenerationEngine:
                 tok = int(h_toks[t, slot])
                 req.output_ids.append(tok)
                 req.output_logprobs.append(float(h_logps[t, slot]))
-                req.output_versions.append(self.model_version)
+                req.output_versions.append(chunk["version"])
                 self.total_generated_tokens += 1
                 # host backstop over the FULL stop list (the device buffer
                 # only holds the first 8 stop ids)
@@ -913,7 +1048,6 @@ class GenerationEngine:
                 self._finish(slot, "stop")
             elif not h_active[slot]:
                 self._finish(slot, "length")
-        return True
 
     def _sample_and_append(
         self, logits: jnp.ndarray, only_slots: List[int]

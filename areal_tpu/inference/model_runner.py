@@ -87,21 +87,206 @@ def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
     return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
-def flat_positions(
+def _row_flat(
     tables: jnp.ndarray,  # [N, PPS] int32 logical page ids
-    positions: jnp.ndarray,  # [N, T] int32 token positions
+    row_positions: jnp.ndarray,  # [N, R] int32 TOKEN position of row start
     page_size: int,
+    pack: int,
     num_pages: int,
-    valid: jnp.ndarray,  # [N, T] bool
+    valid: jnp.ndarray,  # [N, R] bool
 ) -> jnp.ndarray:
-    """Token position → flat pool index (page*BS + off); invalid rows map
-    to num_pages*BS (dropped by scatter mode='drop')."""
+    """Pool-row index for row-granular access. The pool's unit of access
+    is one 128-lane row = ``pack`` consecutive tokens (any view with a
+    trailing dim < 128 forces a full relaid copy of the pool on TPU —
+    measured as a 2x HBM blowup — so every jnp read/write goes through
+    [*, pack*D] rows). Invalid rows map past the pool (scatter drop)."""
+    prow = page_size // pack
     page = jnp.take_along_axis(
-        tables, jnp.clip(positions // page_size, 0, tables.shape[1] - 1),
+        tables,
+        jnp.clip(row_positions // page_size, 0, tables.shape[1] - 1),
         axis=1,
     )
-    flat = page * page_size + positions % page_size
-    return jnp.where(valid, flat, num_pages * page_size)
+    flat = page * prow + (row_positions % page_size) // pack
+    return jnp.where(valid, flat, num_pages * prow)
+
+
+def _rows_view(pool: jnp.ndarray) -> jnp.ndarray:
+    """[L, Hkv, NP, BS//f, f*D] → [L, Hkv, NP*(BS//f), f*D] (free)."""
+    nl, hkv, np_, prow, fd = pool.shape
+    return pool.reshape(nl, hkv, np_ * prow, fd)
+
+
+def init_last_rows(
+    num_layers: int, num_slots: int, num_kv_heads: int, fd: int, dtype
+) -> Dict[str, jnp.ndarray]:
+    """Per-slot copy of the last (possibly partial) pool row each sequence
+    wrote. Merges consult it instead of READING the pool: on this backend
+    any computation that both reads and writes a buffer pays a full copy
+    of it, and gathers/scatters with index arrays serialize per index —
+    write-only DUS chains are the only fast pool mutation."""
+    shape = (num_layers, num_slots, num_kv_heads, fd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+@functools.partial(jax.jit, static_argnames=("num_pages", "prow", "pack"))
+def assemble_rows(
+    tables: jnp.ndarray,  # [N, PPS]
+    pos0: jnp.ndarray,  # [N] absolute start position of kv[…, 0]
+    counts: jnp.ndarray,  # [N] valid tokens per row
+    kbuf: jnp.ndarray,  # [L, N, T, Hkv, D] token-order new K
+    vbuf: jnp.ndarray,
+    last_rows: Dict[str, jnp.ndarray],  # [L, S?, Hkv, FD] (rows N used)
+    slot_ids: jnp.ndarray,  # [N] engine slot of each row (last_rows index)
+    num_pages: int,
+    prow: int,
+    pack: int,
+):
+    """Pack token-order K/V into full 128-lane pool rows.
+
+    Returns (dest [N*NR] flat row ids with row 0 of the pool as the drop
+    target for invalid rows, kvals/vvals [N*NR, L, Hkv, FD], new
+    last_rows {k,v} [L, N, Hkv, FD]). Pure compute — the pool itself is
+    neither read nor written here (see init_last_rows)."""
+    nl, n, t, hkv, d = kbuf.shape
+    f = pack
+    fd = f * d
+    bs = prow * f
+    kv_dtype = kbuf.dtype
+    nr = t // f + 2  # worst-case rows touched (alignment + remainder)
+    a = pos0 % f  # [N] first-row misalignment
+    j = jnp.arange(nr, dtype=jnp.int32)[None, :]  # [1, NR]
+
+    def shifted_stride(buf, start: int):
+        """buf[:, :, start::f] padded/truncated to NR rows along axis 2.
+        ``start`` may be negative (leading zero row). Pure strided slices
+        + pads — a generic gather here was measured ~150x slower."""
+        if start < 0:
+            sl = buf[:, :, f + start :: f]
+            sl = jnp.pad(sl, ((0, 0), (0, 0), (1, 0), (0, 0), (0, 0)))
+        else:
+            sl = buf[:, :, start :: f]
+        rows = sl.shape[2]
+        if rows < nr:
+            sl = jnp.pad(
+                sl, ((0, 0), (0, 0), (0, nr - rows), (0, 0), (0, 0))
+            )
+        return sl[:, :, :nr]
+
+    def assemble(buf, last):  # buf [L,N,T,Hkv,D], last [L,N,Hkv,FD]
+        halves = []
+        for g in range(f):
+            tg = j * f + g - a[:, None]  # [N, NR]
+            valid = (tg >= 0) & (tg < counts[:, None])
+            gathered = shifted_stride(buf, g)  # a == 0
+            for aa in range(1, f):
+                cand = shifted_stride(buf, g - aa)
+                pick = (a == aa)[None, :, None, None, None]
+                gathered = jnp.where(pick, cand, gathered)
+            # first-row halves before pos0 come from the slot's remembered
+            # last partial row (NOT a pool read)
+            keep_old = (j == 0) & (g < a[:, None]) & (counts[:, None] > 0)
+            old = last[:, :, None, :, g * d : (g + 1) * d]  # [L,N,1,Hkv,D]
+            val = jnp.where(
+                valid[None, :, :, None, None],
+                gathered,
+                jnp.where(
+                    keep_old[None, :, :, None, None],
+                    jnp.broadcast_to(old, gathered.shape),
+                    jnp.zeros((), kv_dtype),
+                ),
+            )
+            halves.append(val.astype(kv_dtype))
+        # [L, N, NR, Hkv, f*D] — lane order g*D:(g+1)*D = token row*f+g
+        return jnp.concatenate(halves, axis=-1)
+
+    last_k = jnp.take(last_rows["k"], slot_ids, axis=1)  # [L, N, Hkv, FD]
+    last_v = jnp.take(last_rows["v"], slot_ids, axis=1)
+    kvals = assemble(kbuf, last_k)
+    vvals = assemble(vbuf, last_v)
+    row_pos = (pos0 - a)[:, None] + j * f  # [N, NR]
+    any_valid = (
+        ((j + 1) * f - a[:, None] > 0)
+        & (j * f - a[:, None] < counts[:, None])
+        & (counts[:, None] > 0)
+    )
+    dest = _row_flat(tables, row_pos, bs, f, num_pages, any_valid)
+    # invalid rows are redirected to row 0 — the engine RESERVES page 0 as
+    # a trash page (DUS clamps out-of-range starts, which would corrupt a
+    # real page)
+    dest = jnp.where(any_valid, dest, 0).reshape(-1)
+    kw = kvals.transpose(1, 2, 0, 3, 4).reshape(n * nr, nl, hkv, fd)
+    vw = vvals.transpose(1, 2, 0, 3, 4).reshape(n * nr, nl, hkv, fd)
+    # new last-row per sequence: the row containing token pos0+counts-1
+    # (selected by one-hot reduce — index gathers serialize on TPU)
+    last_j = jnp.clip((a + counts - 1) // f, 0, nr - 1)  # [N]
+    onehot = (j == last_j[:, None]).astype(kvals.dtype)  # [N, NR]
+    sel_k = jnp.einsum("lnrhf,nr->lnhf", kvals, onehot)
+    sel_v = jnp.einsum("lnrhf,nr->lnhf", vvals, onehot)
+    wrote = (counts > 0)[None, :, None, None]
+    new_last = {
+        "k": jnp.where(wrote, sel_k, last_k).astype(kv_dtype),
+        "v": jnp.where(wrote, sel_v, last_v).astype(kv_dtype),
+    }
+    return dest, kw, vw, new_last
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def write_rows(
+    cache: Dict[str, jnp.ndarray],
+    dest: jnp.ndarray,  # [M] flat row ids (0 = engine trash page)
+    kvals: jnp.ndarray,  # [M, L, Hkv, FD]
+    vvals: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """WRITE-ONLY pool update: a scan of per-row dynamic_update_slice ops
+    on the donated pool — the only pool-mutation shape this backend runs
+    in place (index-array scatters serialize per index; any read of the
+    pool in the same dispatch forces a full copy)."""
+    krows = _rows_view(cache["k"])
+    vrows = _rows_view(cache["v"])
+
+    def body(carry, xs):
+        kr, vr = carry
+        d_, kv_, vv_ = xs  # kv_ [L, Hkv, FD]
+        kr = jax.lax.dynamic_update_slice(
+            kr, kv_[:, :, None, :], (0, 0, d_, 0)
+        )
+        vr = jax.lax.dynamic_update_slice(
+            vr, vv_[:, :, None, :], (0, 0, d_, 0)
+        )
+        return (kr, vr), None
+
+    (krows, vrows), _ = jax.lax.scan(body, (krows, vrows), (dest, kvals, vvals))
+    return {
+        "k": krows.reshape(cache["k"].shape),
+        "v": vrows.reshape(cache["v"].shape),
+    }
+
+
+def merge_tokens(
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,
+    pos0: jnp.ndarray,
+    counts: jnp.ndarray,
+    kbuf: jnp.ndarray,  # [L, N, T, Hkv, D]
+    vbuf: jnp.ndarray,
+    last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
+):
+    """Two-dispatch merge: assemble rows (pure), then write-only DUS scan.
+    Returns (cache, new_last_rows [L, N, Hkv, FD])."""
+    nl, n, t, hkv, d = kbuf.shape
+    _, _, num_pages, prow, fd = cache["k"].shape
+    f = fd // d
+    if last_rows is None:
+        last_rows = init_last_rows(nl, n, hkv, fd, kbuf.dtype)
+    if slot_ids is None:
+        slot_ids = jnp.arange(n, dtype=jnp.int32)
+    dest, kw, vw, new_last = assemble_rows(
+        tables, pos0, counts, kbuf, vbuf, last_rows, slot_ids,
+        num_pages=num_pages, prow=prow, pack=f,
+    )
+    cache = write_rows(cache, dest, kw, vw)
+    return cache, new_last
 
 
 # ---------------------------------------------------------------------------
@@ -110,9 +295,8 @@ def flat_positions(
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "prefix_bound"),
-    donate_argnames=("cache",),
 )
-def prefill_batch(
+def prefill_forward(
     params: Params,
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
@@ -121,9 +305,12 @@ def prefill_batch(
     true_lens: jnp.ndarray,  # [N] int32 suffix lengths (0 = padding row)
     tables: jnp.ndarray,  # [N, PPS] logical pages covering offset+Tp
     prefix_bound: int = 0,  # static: gathered window >= max(offsets), 0 = none
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """One batched forward over N prompt suffixes; writes each row's suffix
-    K/V into its pages; returns last-real-token logits [N, V] (fp32).
+):
+    """One batched READ-ONLY forward over N prompt suffixes; returns
+    (logits [N, V] fp32, k_sfx, v_sfx [L, N, Tp, Hkv, D]) — the caller
+    merges the suffix K/V with the separate write-only dispatch
+    (merge_tokens), keeping this dispatch free of pool writes (a
+    read+write dispatch pays a full pool copy on this backend).
 
     Host contract: tables cover ceil((offset+Tp)/BS) pages per real row;
     ``prefix_bound`` >= every row's offset; offsets are page-aligned.
@@ -131,7 +318,8 @@ def prefill_batch(
     n, tp = tokens.shape
     d = cfg.head_dim
     nl, hkv, num_pages, prow, fd = cache["k"].shape
-    page_size = prow * fd // d
+    f = fd // d
+    page_size = prow * f
     mb0 = prefix_bound
     sidx = jnp.arange(tp, dtype=jnp.int32)[None, :]
     pos = offsets[:, None] + sidx  # [N, Tp] absolute positions
@@ -143,27 +331,43 @@ def prefill_batch(
     scale = cfg.head_dim**-0.5
     g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
-    kpool = unpacked_view(cache["k"], d)  # [L, Hkv, NP*BS..] view
-    vpool = unpacked_view(cache["v"], d)
-    kflat = kpool.reshape(nl, hkv, num_pages * page_size, d)
-    vflat = vpool.reshape(nl, hkv, num_pages * page_size, d)
+    krows_all = _rows_view(cache["k"])  # [L, Hkv, NP*prow, FD]
+    vrows_all = _rows_view(cache["v"])
 
     if mb0 > 0:
-        widx = flat_positions(
-            tables,
-            jnp.broadcast_to(jnp.arange(mb0, dtype=jnp.int32)[None], (n, mb0)),
-            page_size,
-            num_pages,
-            jnp.broadcast_to(
-                jnp.arange(mb0, dtype=jnp.int32)[None] < offsets[:, None],
-                (n, mb0),
-            ),
-        )
-        widx = jnp.minimum(widx, num_pages * page_size - 1)  # clamp pads
-        prefix_mask = (
-            jnp.arange(mb0, dtype=jnp.int32)[None, None, :] < pos[:, :, None]
-        ) & (jnp.arange(mb0, dtype=jnp.int32)[None, None, :]
-             < offsets[:, None, None])  # [N, Tp, mb0]
+        npg = -(-mb0 // page_size)  # window pages (offsets page-aligned)
+        wr = npg * prow  # window rows
+        rpos = jnp.arange(wr, dtype=jnp.int32)[None, :] * f  # [1, WR]
+        # page-run gather: one dynamic_slice per (row, page) — index-array
+        # gathers serialize per index on TPU, DS runs at copy speed
+        page_starts = (
+            jnp.clip(tables[:, :npg], 0, num_pages - 1) * prow
+        ).reshape(-1)  # [N*npg]
+
+        def fetch(carry, st):
+            win_k = jax.lax.dynamic_slice(
+                krows_all, (0, 0, st, 0), (nl, hkv, prow, fd)
+            )
+            win_v = jax.lax.dynamic_slice(
+                vrows_all, (0, 0, st, 0), (nl, hkv, prow, fd)
+            )
+            return carry, (win_k, win_v)
+
+        _, (wk_pages, wv_pages) = jax.lax.scan(fetch, 0, page_starts)
+        # [N*npg, L, Hkv, prow, FD] → [L, Hkv, N, WR, FD]
+        def arrange(w):
+            w = w.reshape(n, npg, nl, hkv, prow, fd)
+            return w.transpose(2, 3, 0, 1, 4, 5).reshape(
+                nl, hkv, n, wr, fd
+            )
+
+        win_k_all = arrange(wk_pages)
+        win_v_all = arrange(wv_pages)
+        # per-half key masks: token at (row r, half h) has position r*f+h
+        half_masks = [
+            (rpos + h < offsets[:, None])[:, None, None, None]  # [N,1,1,1,WR]
+            for h in range(f)
+        ]
 
     # causal within the in-flight suffix
     suffix_mask = (sidx[:, :, None] >= sidx[:, None, :]) & valid_q[:, None, :]
@@ -188,34 +392,50 @@ def prefill_batch(
         )
         sc_sfx = jnp.where(suffix_mask[:, None, None], sc_sfx, NEG_INF)
         if mb0 > 0:
-            kl = jax.lax.dynamic_index_in_dim(kflat, li, 0, keepdims=False)
-            vl = jax.lax.dynamic_index_in_dim(vflat, li, 0, keepdims=False)
-            win_k = jnp.take(kl, widx, axis=1)  # [Hkv, N, mb0, D]
-            win_v = jnp.take(vl, widx, axis=1)
-            sc_pre = (
-                jnp.einsum(
-                    "nqgrd,gnkd->ngrqk", qg, win_k,
-                    preferred_element_type=jnp.float32,
+            # pre-gathered page windows (full 128-lane rows), lane-half
+            # slices — key order is [half0 rows..., half1 rows...,
+            # suffix], which softmax doesn't care about
+            win_k = jax.lax.dynamic_index_in_dim(
+                win_k_all, li, 0, keepdims=False
+            )  # [Hkv, N, WR, FD]
+            win_v = jax.lax.dynamic_index_in_dim(
+                win_v_all, li, 0, keepdims=False
+            )
+            scs = []
+            vhs = []
+            for hh in range(f):
+                wk = win_k[..., hh * d : (hh + 1) * d]  # [Hkv, N, WR, D]
+                vhs.append(win_v[..., hh * d : (hh + 1) * d])
+                sc_h = (
+                    jnp.einsum(
+                        "nqgrd,gnkd->ngrqk", qg, wk,
+                        preferred_element_type=jnp.float32,
+                    )
+                    * scale
                 )
-                * scale
-            )
-            sc_pre = jnp.where(
-                prefix_mask[:, None, None], sc_pre, NEG_INF
-            )
-            sc = jnp.concatenate([sc_pre, sc_sfx], axis=-1)
+                scs.append(jnp.where(half_masks[hh], sc_h, NEG_INF))
+            # segment layout [half0 .. halfN, suffix] — the probs slicing
+            # below depends on this order
+            sc = jnp.concatenate(scs + [sc_sfx], axis=-1)
         else:
             sc = sc_sfx
         probs = jax.nn.softmax(sc, axis=-1)
         if mb0 > 0:
+            wr_n = vhs[0].shape[2]
             attn = jnp.einsum(
-                "ngrqk,gnkd->nqgrd",
-                probs[..., :mb0].astype(win_v.dtype), win_v,
-                preferred_element_type=jnp.float32,
-            ) + jnp.einsum(
                 "ngrqk,nkgd->nqgrd",
-                probs[..., mb0:].astype(vz.dtype), vz,
+                probs[..., f * wr_n :].astype(vz.dtype), vz,
                 preferred_element_type=jnp.float32,
             )
+            for hh in range(f):
+                attn = attn + jnp.einsum(
+                    "ngrqk,gnkd->nqgrd",
+                    probs[..., hh * wr_n : (hh + 1) * wr_n].astype(
+                        vhs[hh].dtype
+                    ),
+                    vhs[hh],
+                    preferred_element_type=jnp.float32,
+                )
         else:
             attn = jnp.einsum(
                 "ngrqk,nkgd->nqgrd", probs.astype(vz.dtype), vz,
@@ -231,19 +451,34 @@ def prefill_batch(
     x, (k_sfx, v_sfx) = jax.lax.scan(
         layer, x, (params["layers"], jnp.arange(nl, dtype=jnp.int32))
     )
-    # ONE donated scatter of every layer's suffix K/V into the pool
-    dest = flat_positions(tables, pos, page_size, num_pages, valid_q)  # [N,Tp]
-    kw = k_sfx.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, n * tp, d)
-    vw = v_sfx.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, n * tp, d)
-    kflat = kflat.at[:, :, dest.reshape(-1)].set(kw, mode="drop")
-    vflat = vflat.at[:, :, dest.reshape(-1)].set(vw, mode="drop")
-    new_cache = {
-        "k": kflat.reshape(cache["k"].shape),
-        "v": vflat.reshape(cache["v"].shape),
-    }
     last = x[jnp.arange(n), jnp.maximum(true_lens - 1, 0)]  # [N, D]
     logits = _final_logits(params, cfg, last)  # [N, V] fp32
-    return new_cache, logits
+    return logits, k_sfx, v_sfx
+
+
+def prefill_batch(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,
+    offsets: jnp.ndarray,
+    true_lens: jnp.ndarray,
+    tables: jnp.ndarray,
+    prefix_bound: int = 0,
+    last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
+):
+    """Read-only forward + write-only merge (two dispatches).
+    Returns (cache, logits, new_last_rows [L, N, Hkv, FD])."""
+    logits, k_sfx, v_sfx = prefill_forward(
+        params, cfg, cache, tokens, offsets, true_lens, tables,
+        prefix_bound=prefix_bound,
+    )
+    cache, new_last = merge_tokens(
+        cache, tables, offsets, true_lens, k_sfx, v_sfx,
+        last_rows=last_rows, slot_ids=slot_ids,
+    )
+    return cache, logits, new_last
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))
@@ -318,27 +553,28 @@ def _decode_core(
 
     def model_step(kbuf, vbuf, tokens, clen, active):
         """One forward pass for all slots; new K/V appended to the chunk
-        buffers (inactive slots drop). Returns (kbuf, vbuf, logits)."""
+        buffers (inactive slots drop). Returns (kbuf, vbuf, logits).
+
+        The 50MB-class chunk buffers are READ-ONLY inside the layer scan
+        (a scatter on a nested scan carry costs a full buffer copy per
+        layer — measured at ~25ms/step): each layer overlays only its own
+        small [S, T] slice for the self-token, the per-layer K/V stack
+        out as scan ys, and ONE bulk scatter per step appends them."""
         x = params["embedding"][tokens]  # [S, D]
         pos = pos0 + clen
         counts = clen + 1  # the just-written self token is visible
+        ci = jnp.where(active, clen, steps)
 
-        def layer(xc, xs):
-            x, kbuf, vbuf = xc
+        def layer(x, xs):
             lp, li = xs
             h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
             q, k, v = _project_qkv(cfg, lp, h)  # q [S,Hq,D] k/v [S,Hkv,D]
             q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
-            ci = jnp.where(active, clen, steps)
-            kbuf = kbuf.at[li, srange, ci].set(
-                k.astype(kv_dtype), mode="drop"
-            )
-            vbuf = vbuf.at[li, srange, ci].set(
-                v.astype(kv_dtype), mode="drop"
-            )
             kb = jax.lax.dynamic_index_in_dim(kbuf, li, 0, keepdims=False)
             vb = jax.lax.dynamic_index_in_dim(vbuf, li, 0, keepdims=False)
+            kb = kb.at[srange, ci].set(k.astype(kv_dtype), mode="drop")
+            vb = vb.at[srange, ci].set(v.astype(kv_dtype), mode="drop")
             attn = _attend(
                 cfg, cache, li, q, pos0, tables,
                 kb.transpose(0, 2, 1, 3), vb.transpose(0, 2, 1, 3),
@@ -347,12 +583,14 @@ def _decode_core(
             x = x + attn.reshape(s, cfg.q_dim).astype(x.dtype) @ lp["wo"]
             h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
             x = x + _mlp(cfg, lp, h2, valid=active)
-            return (x, kbuf, vbuf), None
+            return x, (k.astype(kv_dtype), v.astype(kv_dtype))
 
-        (x, kbuf, vbuf), _ = jax.lax.scan(
-            layer, (x, kbuf, vbuf),
-            (params["layers"], jnp.arange(nl, dtype=jnp.int32)),
+        x, (knew, vnew) = jax.lax.scan(
+            layer, x, (params["layers"], jnp.arange(nl, dtype=jnp.int32))
         )
+        # ONE bulk append per step: [L, S, Hkv, D] at (slot, ci)
+        kbuf = kbuf.at[:, srange, ci].set(knew, mode="drop")
+        vbuf = vbuf.at[:, srange, ci].set(vnew, mode="drop")
         return kbuf, vbuf, _final_logits(params, cfg, x)
 
     # inactive slots scatter at index `steps` (out of range → dropped)
@@ -364,10 +602,7 @@ def _decode_core(
             kbuf0, vbuf0, tokens0, jnp.zeros(s, jnp.int32), active0
         )
         clen_final = active0.astype(jnp.int32)
-        cache = _merge_chunk(
-            cache, kbuf, vbuf, tables, pos0, clen_final, page_size, num_pages
-        )
-        return cache, logits
+        return logits, kbuf, vbuf, clen_final
 
     temperature, top_p, top_k, greedy = sample_args
     remaining0, no_stop0, stop_tokens = stop_args
@@ -399,45 +634,17 @@ def _decode_core(
          active0, remaining0, no_stop0),
         keys,
     )
-    cache = _merge_chunk(
-        cache, kbuf, vbuf, tables, pos0, clen, page_size, num_pages
+    return (
+        toks, logps, emitted, active, remaining, no_stop, pos0 + clen,
+        kbuf, vbuf, clen,
     )
-    return cache, toks, logps, emitted, active, remaining, no_stop
-
-
-def _merge_chunk(
-    cache, kbuf, vbuf, tables, pos0, clen, page_size, num_pages
-):
-    """Bulk scatter: chunk buffers [L, S, T, Hkv, D] → pool at absolute
-    positions pos0..pos0+clen (one donated scatter per tensor)."""
-    nl, s, t, hkv, d = kbuf.shape
-    tgrid = jnp.arange(t, dtype=jnp.int32)[None, :]
-    dest = flat_positions(
-        tables, pos0[:, None] + tgrid, page_size, num_pages,
-        tgrid < clen[:, None],
-    ).reshape(-1)  # [S*T]
-    kw = kbuf.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, s * t, d)
-    vw = vbuf.transpose(0, 3, 1, 2, 4).reshape(nl, hkv, s * t, d)
-    kflat = unpacked_view(cache["k"], d).reshape(
-        nl, hkv, num_pages * page_size, d
-    )
-    vflat = unpacked_view(cache["v"], d).reshape(
-        nl, hkv, num_pages * page_size, d
-    )
-    kflat = kflat.at[:, :, dest].set(kw, mode="drop")
-    vflat = vflat.at[:, :, dest].set(vw, mode="drop")
-    return {
-        "k": kflat.reshape(cache["k"].shape),
-        "v": vflat.reshape(cache["v"].shape),
-    }
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "steps", "topk_bound", "attn_impl", "ppcb", "spb"),
-    donate_argnames=("cache",),
 )
-def decode_multi(
+def _decode_multi_forward(
     params: Params,
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
@@ -463,8 +670,8 @@ def decode_multi(
     handling on device (see module doc). Host contract: tables cover
     ceil((pos0[s]+steps)/page_size) pages for every active slot.
 
-    Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S],
-    active_after [S], remaining_after, no_stop_after)."""
+    READ-ONLY forward chunk (the merge is a separate dispatch in
+    decode_multi)."""
     return _decode_core(
         params, cfg, cache, tables, pos0, tokens, active, key,
         (temperature, top_p, top_k, greedy),
@@ -473,11 +680,72 @@ def decode_multi(
     )
 
 
+def decode_multi(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,
+    pos0: jnp.ndarray,
+    tokens: jnp.ndarray,
+    active: jnp.ndarray,
+    remaining: jnp.ndarray,
+    no_stop_before: jnp.ndarray,
+    stop_tokens: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    greedy: jnp.ndarray,
+    steps: int,
+    topk_bound: int = 0,
+    attn_impl: str = "jnp",
+    ppcb: int = 1,
+    spb: int = 16,
+    last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+):
+    """`steps` fused decode+sample iterations: one READ-ONLY forward
+    dispatch + one WRITE-ONLY merge dispatch (reading and writing the
+    pool in one computation costs a full pool copy on this backend).
+    Host contract: tables cover ceil((pos0[s]+steps)/page_size) pages for
+    every active slot.
+
+    Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S],
+    active_after [S], remaining_after, no_stop_after, lens_after [S],
+    new_last_rows). ``lens_after`` keeps the per-slot cached length
+    device-resident so the host can dispatch chunk N+1 before fetching
+    chunk N's results (the serving loop pipelines dispatch against result
+    processing)."""
+    (
+        toks, logps, emitted, active_a, remaining_a, no_stop_a, lens_a,
+        kbuf, vbuf, clen,
+    ) = _decode_multi_forward(
+        params, cfg, cache, tables, pos0, tokens, active, remaining,
+        no_stop_before, stop_tokens, key, temperature, top_p, top_k,
+        greedy, steps, topk_bound, attn_impl, ppcb, spb,
+    )
+    cache, new_last = merge_tokens(
+        cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows
+    )
+    return (
+        cache, toks, logps, emitted, active_a, remaining_a, no_stop_a,
+        lens_a, new_last,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "attn_impl", "ppcb", "spb"),
-    donate_argnames=("cache",),
 )
+def _decode_step_forward(
+    params, cfg, cache, tables, pos0, tokens, active,
+    attn_impl="jnp", ppcb=1, spb=16,
+):
+    return _decode_core(
+        params, cfg, cache, tables, pos0, tokens, active, None, None, None,
+        1, attn_impl, ppcb, spb, 0,
+    )
+
+
 def decode_step(
     params: Params,
     cfg: ModelConfig,
@@ -487,14 +755,22 @@ def decode_step(
     tokens: jnp.ndarray,  # [S]
     active: jnp.ndarray,  # [S] bool
     attn_impl: str = "jnp",
-    ppcb: int = 4,
-    spb: int = 8,
-) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Single decode step for all slots; returns (cache, logits [S, V])."""
-    return _decode_core(
-        params, cfg, cache, tables, pos0, tokens, active, None, None, None,
-        1, attn_impl, ppcb, spb, 0,
+    ppcb: int = 1,
+    spb: int = 16,
+    last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+):
+    """Single decode step for all slots (read-only forward + write-only
+    merge); returns (cache, logits [S, V], new_last_rows). Callers MUST
+    thread last_rows between sequential calls (it preserves the partial
+    first row when pos0 isn't row-aligned)."""
+    logits, kbuf, vbuf, clen = _decode_step_forward(
+        params, cfg, cache, tables, pos0, tokens, active, attn_impl,
+        ppcb, spb,
     )
+    cache, new_last = merge_tokens(
+        cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows
+    )
+    return cache, logits, new_last
 
 
 # ---------------------------------------------------------------------------

@@ -136,7 +136,12 @@ def _kernel(
 
             @pl.when(pidx < pcnt)
             def _go(pidx=pidx, s=s, b=b, j=j):
-                page = tables_flat_ref[b * pps + pidx]
+                # defensive clamp: a stale/fill-value table entry must not
+                # DMA past the pool
+                page = jnp.minimum(
+                    tables_flat_ref[b * pps + pidx],
+                    k_hbm_ref.shape[2] - 1,
+                )
                 for h in range(hkv):
                     pltpu.make_async_copy(
                         k_hbm_ref.at[li, h, page],
@@ -156,7 +161,10 @@ def _kernel(
 
             @pl.when(pidx < pcnt)
             def _wait(pidx=pidx, s=s, b=b, j=j):
-                page = tables_flat_ref[b * pps + pidx]
+                page = jnp.minimum(
+                    tables_flat_ref[b * pps + pidx],
+                    k_hbm_ref.shape[2] - 1,
+                )
                 for h in range(hkv):
                     pltpu.make_async_copy(
                         k_hbm_ref.at[li, h, page],
@@ -418,30 +426,47 @@ def paged_decode_attention_jnp(
 ) -> jnp.ndarray:
     """Gather-based fallback with identical semantics (CPU / TP serving).
 
-    Materializes each slot's page window ([S, PPS*BS] keys) — ~3x the HBM
+    Gathers each slot's page window at full-row granularity (a pool view
+    with trailing dim < 128 lanes would force a relaid full-pool copy on
+    TPU), then splits lane-halves — key order is [half0 rows..., half1
+    rows..., chunk], which softmax doesn't care about. ~3x the HBM
     traffic of the kernel; correctness-first path.
     """
     s, hq, d = q.shape
-    k_pages = unpacked_view(k_pages, d)
-    v_pages = unpacked_view(v_pages, d)
-    nl, hkv, np_, bs, _ = k_pages.shape
+    nl, hkv, np_, prow, fd = k_pages.shape
+    f = fd // d
+    bs = prow * f
     rep = hq // hkv
     pps = tables.shape[1]
-    kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
-    # [Hkv, S, PPS, BS, D] → [S, PPS*BS, Hkv, D]
-    win_k = kl[:, tables].transpose(1, 2, 3, 0, 4).reshape(s, pps * bs, hkv, d)
-    win_v = vl[:, tables].transpose(1, 2, 3, 0, 4).reshape(s, pps * bs, hkv, d)
+    wr = pps * prow  # window rows
+    kl = jax.lax.dynamic_index_in_dim(
+        k_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0, keepdims=False
+    )
+    vl = jax.lax.dynamic_index_in_dim(
+        v_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0, keepdims=False
+    )
+    # flat row ids per slot: page-major row order
+    rflat = (tables[:, :, None] * prow + jnp.arange(prow)[None, None, :])
+    rflat = jnp.clip(rflat.reshape(s, wr), 0, np_ * prow - 1)
+    win_k = kl[:, rflat]  # [Hkv, S, WR, FD]
+    win_v = vl[:, rflat]
     qg = q.reshape(s, hkv, rep, d)
     scale = d**-0.5
-    qk = (
-        jnp.einsum(
-            "sgrd,smgd->sgrm", qg, win_k, preferred_element_type=jnp.float32
-        )
-        * scale
-    )  # [S, Hkv, rep, PPS*BS]
-    col = jnp.arange(pps * bs)[None, None, None, :]
-    qk = jnp.where(col < lengths[:, None, None, None], qk, NEG_INF)
+    rpos = jnp.arange(wr)[None, None, None, :] * f  # token pos of row start
+    qks, vhs = [], []
+    for g in range(f):
+        wk = win_k[..., g * d : (g + 1) * d]  # [Hkv, S, WR, D]
+        vhs.append(win_v[..., g * d : (g + 1) * d])
+        qk_g = (
+            jnp.einsum(
+                "sgrd,gskd->sgrk", qg, wk,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [S, Hkv, rep, WR]
+        mask = rpos + g < lengths[:, None, None, None]
+        qks.append(jnp.where(mask, qk_g, NEG_INF))
+    qk = jnp.concatenate(qks, axis=-1)  # [S, Hkv, rep, f*WR]
     if chunk_k is not None:
         tl = chunk_k.shape[2]
         qc = (
@@ -454,15 +479,21 @@ def paged_decode_attention_jnp(
         tcol = jnp.arange(tl)[None, None, None, :]
         qc = jnp.where(tcol < chunk_counts[:, None, None, None], qc, NEG_INF)
         qk = jnp.concatenate([qk, qc], axis=-1)
-        win_v = jnp.concatenate(
-            [win_v, chunk_v.transpose(0, 2, 1, 3)], axis=1
-        )
     # guard fully-masked rows (length 0, no chunk): softmax of all -inf
     all_masked = jnp.all(qk <= NEG_INF / 2, axis=-1, keepdims=True)
     p = jax.nn.softmax(jnp.where(all_masked, 0.0, qk), axis=-1)
     p = jnp.where(all_masked, 0.0, p)
-    out = jnp.einsum(
-        "sgrm,smgd->sgrd", p.astype(win_v.dtype), win_v,
-        preferred_element_type=jnp.float32,
-    )
+    out = jnp.zeros((s, hkv, rep, d), jnp.float32)
+    for g in range(f):
+        out = out + jnp.einsum(
+            "sgrk,gskd->sgrd",
+            p[..., g * wr : (g + 1) * wr].astype(vhs[g].dtype), vhs[g],
+            preferred_element_type=jnp.float32,
+        )
+    if chunk_k is not None:
+        out = out + jnp.einsum(
+            "sgrt,sgtd->sgrd",
+            p[..., f * wr :].astype(chunk_v.dtype), chunk_v,
+            preferred_element_type=jnp.float32,
+        )
     return out.reshape(s, hq, d).astype(q.dtype)

@@ -1,0 +1,147 @@
+"""Math answer equivalence vectors.
+
+Derived from the observable behaviors of the reference's sympy-based
+equivalence engine (/root/reference/areal/reward/math_parser.py:
+strip_string, math_equal, symbolic_equal) — reward noise directly corrupts
+RL, so these are correctness tests for the reward channel itself.
+"""
+
+import pytest
+
+from areal_tpu.reward.math_parser import (
+    answers_equal,
+    extract_answer,
+    extract_boxed,
+    normalize_answer,
+    process_results,
+)
+
+EQUAL = [
+    # plain numerics
+    ("42", "42"),
+    ("42.0", "42"),
+    ("0.5", "1/2"),
+    ("1,234", "1234"),
+    ("3.14159", "3.14159"),
+    ("  7 ", "7"),
+    ("-0.25", "-1/4"),
+    # percentage ambiguity (reference include_percentage=True)
+    ("50", "0.5"),
+    ("0.5", "50%"),
+    ("50%", "50"),
+    # latex fractions incl. brace-less forms
+    (r"\frac{1}{2}", "0.5"),
+    (r"\frac12", "1/2"),
+    (r"\frac1{72}", "1/72"),
+    (r"\dfrac{3}{4}", "0.75"),
+    (r"\tfrac{3}{4}", "3/4"),
+    (r"\frac{\frac{1}{2}}{2}", "1/4"),
+    # sqrt forms
+    (r"\sqrt{8}", r"2\sqrt{2}"),
+    (r"\sqrt2", r"\sqrt{2}"),
+    (r"\sqrt[3]{27}", "3"),
+    # symbolic equivalence
+    ("2*pi", r"2\pi"),
+    ("x**2 - 1", "(x-1)*(x+1)"),
+    (r"\frac{x}{2}", "x/2"),
+    # dollar / units / degrees / text
+    (r"\$5", "5"),
+    ("5 dollars", "5"),
+    ("90^\\circ", "90"),
+    (r"5\text{ cm}", "5"),
+    ("10 miles", "10"),
+    # equation prefixes
+    ("x = 5", "5"),
+    ("k=1/2", "0.5"),
+    # equations both sides (lhs-rhs difference, either sign)
+    ("x + y = 3", "y + x = 3"),
+    ("2a - b = 4", "b - 2a = -4"),
+    # tuples / intervals element-wise
+    ("(1, 2)", "(1.0, 2.0)"),
+    ("(1/2, 3)", "(0.5, 3)"),
+    (r"[0, \frac{1}{2}]", "[0, 0.5]"),
+    # bracket style ignored, matching the reference's bracket stripping
+    ("(0, 1]", "[0, 1]"),
+    # matrices
+    (
+        r"\begin{pmatrix}1 & 2\\3 & 4\end{pmatrix}",
+        r"\begin{bmatrix}1.0 & 2\\3 & 4.0\end{bmatrix}",
+    ),
+    # scientific notation / products
+    (r"3 \times 10^2", "300"),
+    ("2e3", "2000"),
+    # word numbers
+    ("two", "2"),
+    # choices
+    ("(B)", "B"),
+    ("B.", "B"),
+    ("The answer is B", "B"),
+    # mixed number
+    ("2 1/2", "2.5"),
+    # trailing zeros / leading dots
+    (".5", "0.5"),
+    ("7.000", "7"),
+]
+
+NOT_EQUAL = [
+    ("42", "43"),
+    ("1/2", "1/3"),
+    (r"\sqrt{2}", "2"),
+    ("(1, 2)", "(2, 1)"),
+    ("(1, 2)", "(1, 2, 3)"),
+    ("x + 1", "x - 1"),
+    ("B", "C"),
+    # the article "a" must NOT match choice A (case-sensitive letters)
+    ("The answer is C, a tricky one", "A"),
+    # "m" is algebra, not meters
+    ("2m", "2"),
+    ("", "5"),
+    ("0.5001", "0.52"),
+    (
+        r"\begin{pmatrix}1 & 2\\3 & 4\end{pmatrix}",
+        r"\begin{pmatrix}1 & 2\\3 & 5\end{pmatrix}",
+    ),
+]
+
+
+@pytest.mark.parametrize("pred,truth", EQUAL)
+def test_equal(pred, truth):
+    assert answers_equal(pred, truth), (
+        f"{pred!r} should equal {truth!r} "
+        f"(normalized: {normalize_answer(pred)!r} vs "
+        f"{normalize_answer(truth)!r})"
+    )
+
+
+@pytest.mark.parametrize("pred,truth", NOT_EQUAL)
+def test_not_equal(pred, truth):
+    assert not answers_equal(pred, truth), f"{pred!r} must differ from {truth!r}"
+
+
+def test_extract_boxed_nested():
+    assert extract_boxed(r"so \boxed{\frac{1}{2}} done") == r"\frac{1}{2}"
+    assert extract_boxed(r"\boxed{a} then \boxed{b}") == "b"
+    assert extract_boxed("no box") is None
+
+
+def test_extract_answer_priority():
+    assert extract_answer(r"stuff \boxed{7} and 9") == "7"
+    assert extract_answer("work work #### 42") == "42"
+    assert extract_answer("The final answer is 12.") == "12"
+    assert extract_answer("The final answer is 3.14") == "3.14"
+    assert extract_answer("The answer is 5. That is all.") == "5"
+    assert extract_answer("numbers 3 then 5") == "5"
+
+
+def test_process_results_gsm8k_truth():
+    assert process_results("reasoning... #### 72", "blah blah #### 72") == 1.0
+    assert process_results(r"thus \boxed{72}", "#### 72") == 1.0
+    assert process_results("#### 71", "#### 72") == 0.0
+
+
+def test_hostile_expression_times_out_fast():
+    import time
+
+    t0 = time.monotonic()
+    assert not answers_equal("9**9**9**9**9", "12")
+    assert time.monotonic() - t0 < 10.0

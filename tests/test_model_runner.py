@@ -21,7 +21,7 @@ from areal_tpu.ops.paged_attention import unpacked_view
 BS = 16  # page size (tokens)
 NSLOTS = 4
 PAGES_PER_SLOT = 4  # 64 tokens per slot
-NPAGES = NSLOTS * PAGES_PER_SLOT
+NPAGES = NSLOTS * PAGES_PER_SLOT + 1  # page 0 reserved (merge drop target)
 
 
 @pytest.fixture(scope="module")
@@ -33,28 +33,59 @@ def setup():
 
 
 def _tables():
-    """Disjoint page tables: slot s owns pages [s*4, s*4+4)."""
+    """Disjoint page tables: slot s owns pages [1+s*4, 1+s*4+4) (page 0 is
+    the reserved trash target for dropped merge rows)."""
     return (
-        np.arange(NSLOTS)[:, None] * PAGES_PER_SLOT
+        1 + np.arange(NSLOTS)[:, None] * PAGES_PER_SLOT
         + np.arange(PAGES_PER_SLOT)[None]
     ).astype(np.int32)
 
 
-def _prefill_one(params, cfg, cache, prompt, slot, offset=0):
-    """Single-row batched prefill into `slot`'s pages."""
-    suffix = prompt[offset:]
-    tp = max(16, -(-len(suffix) // 16) * 16)
-    padded = np.zeros((1, tp), np.int32)
-    padded[0, : len(suffix)] = suffix
-    tables = _tables()[slot : slot + 1]
-    cache, logits = model_runner.prefill_batch(
-        params, cfg, cache, jnp.asarray(padded),
-        jnp.asarray([offset], jnp.int32),
-        jnp.asarray([len(suffix)], jnp.int32),
-        jnp.asarray(tables),
-        prefix_bound=(BS * PAGES_PER_SLOT if offset else 0),
-    )
-    return cache, logits[0]
+class Harness:
+    """Threads the per-slot last_rows state between dispatches (the engine
+    does the same)."""
+
+    def __init__(self, cfg):
+        from areal_tpu.inference.model_runner import init_last_rows
+        from areal_tpu.ops.paged_attention import pack_factor
+
+        fd = pack_factor(cfg.head_dim) * cfg.head_dim
+        self.last = init_last_rows(
+            cfg.num_layers, NSLOTS, cfg.num_kv_heads, fd, jnp.float32
+        )
+
+    def prefill_one(self, params, cfg, cache, prompt, slot, offset=0):
+        suffix = prompt[offset:]
+        tp = max(16, -(-len(suffix) // 16) * 16)
+        padded = np.zeros((1, tp), np.int32)
+        padded[0, : len(suffix)] = suffix
+        tables = _tables()[slot : slot + 1]
+        cache, logits, new_last = model_runner.prefill_batch(
+            params, cfg, cache, jnp.asarray(padded),
+            jnp.asarray([offset], jnp.int32),
+            jnp.asarray([len(suffix)], jnp.int32),
+            jnp.asarray(tables),
+            prefix_bound=(BS * PAGES_PER_SLOT if offset else 0),
+            last_rows=self.last,
+            slot_ids=jnp.asarray([slot], jnp.int32),
+        )
+        for kk in ("k", "v"):
+            self.last[kk] = self.last[kk].at[:, slot].set(new_last[kk][:, 0])
+        return cache, logits[0]
+
+    def decode_step(self, params, cfg, cache, tables, pos0, tokens, active):
+        cache, logits, self.last = model_runner.decode_step(
+            params, cfg, cache, tables, pos0, tokens, active,
+            last_rows=self.last,
+        )
+        return cache, logits
+
+    def decode_multi(self, params, cfg, cache, *args, **kw):
+        out = model_runner.decode_multi(
+            params, cfg, cache, *args, last_rows=self.last, **kw
+        )
+        self.last = out[-1]
+        return out[:-1]
 
 
 def _full_forward_argmax(params, cfg, tokens):
@@ -82,10 +113,11 @@ def _slot_kv(cache, cfg, slot, n):
 def test_greedy_decode_matches_full_forward(setup):
     cfg, params, ccfg = setup
     cache = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    h = Harness(cfg)
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, size=7).tolist()
 
-    cache, logits = _prefill_one(params, cfg, cache, prompt, slot=0)
+    cache, logits = h.prefill_one(params, cfg, cache, prompt, slot=0)
     ref_tok, ref_logits = _full_forward_argmax(params, cfg, prompt)
     np.testing.assert_allclose(
         np.asarray(logits), ref_logits, rtol=1e-4, atol=1e-4
@@ -101,7 +133,7 @@ def test_greedy_decode_matches_full_forward(setup):
         seq.append(tok)
         tokens = jnp.zeros((NSLOTS,), jnp.int32).at[0].set(tok)
         active = jnp.zeros((NSLOTS,), bool).at[0].set(True)
-        cache, logits = model_runner.decode_step(
+        cache, logits = h.decode_step(
             params, cfg, cache, jnp.asarray(_tables()),
             jnp.asarray(pos0), tokens, active,
         )
@@ -117,17 +149,18 @@ def test_greedy_decode_matches_full_forward(setup):
 def test_two_slots_decode_independently(setup):
     cfg, params, ccfg = setup
     cache = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    h = Harness(cfg)
     rng = np.random.default_rng(1)
     p0 = rng.integers(0, cfg.vocab_size, size=5).tolist()
     p1 = rng.integers(0, cfg.vocab_size, size=9).tolist()
-    cache, l0 = _prefill_one(params, cfg, cache, p0, slot=0)
-    cache, l1 = _prefill_one(params, cfg, cache, p1, slot=1)
+    cache, l0 = h.prefill_one(params, cfg, cache, p0, slot=0)
+    cache, l1 = h.prefill_one(params, cfg, cache, p1, slot=1)
     t0, t1 = int(jnp.argmax(l0)), int(jnp.argmax(l1))
     tokens = jnp.zeros((NSLOTS,), jnp.int32).at[0].set(t0).at[1].set(t1)
     active = jnp.zeros((NSLOTS,), bool).at[0].set(True).at[1].set(True)
     pos0 = np.zeros(NSLOTS, np.int32)
     pos0[0], pos0[1] = len(p0), len(p1)
-    cache, logits = model_runner.decode_step(
+    cache, logits = h.decode_step(
         params, cfg, cache, jnp.asarray(_tables()), jnp.asarray(pos0),
         tokens, active,
     )
@@ -143,13 +176,15 @@ def test_prefill_offset_matches_full(setup):
     rng = np.random.default_rng(3)
     prompt = rng.integers(0, cfg.vocab_size, size=2 * BS + 5).tolist()
     cache_f = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_f, logits_f = _prefill_one(params, cfg, cache_f, prompt, slot=0)
+    hf = Harness(cfg)
+    cache_f, logits_f = hf.prefill_one(params, cfg, cache_f, prompt, slot=0)
 
     cache_r = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    hr = Harness(cfg)
     # cache the first 2 pages via a full prefill, then re-prefill only the
     # suffix with offset 2*BS
-    cache_r, _ = _prefill_one(params, cfg, cache_r, prompt, slot=0)
-    cache_r, logits_r = _prefill_one(
+    cache_r, _ = hr.prefill_one(params, cfg, cache_r, prompt, slot=0)
+    cache_r, logits_r = hr.prefill_one(
         params, cfg, cache_r, prompt, slot=0, offset=2 * BS
     )
     np.testing.assert_allclose(
@@ -170,15 +205,17 @@ def test_decode_multi_matches_stepwise(setup):
     p0 = rng.integers(0, cfg.vocab_size, size=6).tolist()
     p1 = rng.integers(0, cfg.vocab_size, size=9).tolist()
 
-    def prefill_two(cache):
-        cache, l0 = _prefill_one(params, cfg, cache, p0, slot=0)
-        cache, l1 = _prefill_one(params, cfg, cache, p1, slot=1)
+    def prefill_two(cache, h):
+        cache, l0 = h.prefill_one(params, cfg, cache, p0, slot=0)
+        cache, l1 = h.prefill_one(params, cfg, cache, p1, slot=1)
         return cache, l0, l1
 
     cache_a = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_a, l0, l1 = prefill_two(cache_a)
+    ha = Harness(cfg)
+    cache_a, l0, l1 = prefill_two(cache_a, ha)
     cache_b = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_b, _, _ = prefill_two(cache_b)
+    hb = Harness(cfg)
+    cache_b, _, _ = prefill_two(cache_b, hb)
 
     t0, t1 = int(jnp.argmax(l0)), int(jnp.argmax(l1))
     tokens = jnp.zeros((s,), jnp.int32).at[0].set(t0).at[1].set(t1)
@@ -192,8 +229,8 @@ def test_decode_multi_matches_stepwise(setup):
     tb = jnp.asarray(_tables())
 
     # A: fused decode_multi
-    cache_a, toks_a, logps_a, emitted_a, active_a, _, _ = (
-        model_runner.decode_multi(
+    cache_a, toks_a, logps_a, emitted_a, active_a, _, _, lens_a = (
+        ha.decode_multi(
             params, cfg, cache_a, tb, jnp.asarray(pos0), tokens, active,
             jnp.full((s,), 100, jnp.int32), jnp.zeros(s, jnp.int32),
             jnp.full((s, 4), -1, jnp.int32), jax.random.PRNGKey(0),
@@ -205,7 +242,7 @@ def test_decode_multi_matches_stepwise(setup):
     pos_b = pos0.copy()
     toks_b = []
     for _ in range(steps):
-        cache_b, logits = model_runner.decode_step(
+        cache_b, logits = hb.decode_step(
             params, cfg, cache_b, tb, jnp.asarray(pos_b), cur, active
         )
         pos_b[0] += 1
@@ -218,6 +255,7 @@ def test_decode_multi_matches_stepwise(setup):
         np.asarray(toks_a)[:, :2], toks_b[:, :2]
     )
     assert bool(np.all(np.asarray(emitted_a)[:, :2]))
+    assert int(lens_a[0]) == len(p0) + steps and int(lens_a[1]) == len(p1) + steps
     # cache state converged identically (active slots' pages)
     for slot, plen in ((0, len(p0)), (1, len(p1))):
         ka, va = _slot_kv(cache_a, cfg, slot, plen + steps)
@@ -228,10 +266,11 @@ def test_decode_multi_matches_stepwise(setup):
     # early stop inside the chunk: use the 3rd emitted token as a stop id
     stop_id = int(toks_b[2, 0])
     cache_c = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_c, _, _ = prefill_two(cache_c)
+    hc = Harness(cfg)
+    cache_c, _, _ = prefill_two(cache_c, hc)
     stops = jnp.full((s, 4), -1, jnp.int32).at[0, 0].set(stop_id)
-    cache_c, toks_c, _, emitted_c, active_c, _, _ = (
-        model_runner.decode_multi(
+    cache_c, toks_c, _, emitted_c, active_c, _, _, _ = (
+        hc.decode_multi(
             params, cfg, cache_c, tb, jnp.asarray(pos0), tokens, active,
             jnp.full((s,), 100, jnp.int32), jnp.zeros(s, jnp.int32),
             stops, jax.random.PRNGKey(0),
@@ -249,9 +288,10 @@ def test_copy_pages(setup):
     """Page copy duplicates KV content (sibling partial-tail fan-out)."""
     cfg, params, ccfg = setup
     cache = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    h = Harness(cfg)
     rng = np.random.default_rng(9)
     prompt = rng.integers(0, cfg.vocab_size, size=BS + 3).tolist()
-    cache, _ = _prefill_one(params, cfg, cache, prompt, slot=0)
+    cache, _ = h.prefill_one(params, cfg, cache, prompt, slot=0)
     # copy slot 0's partial tail page (page index 1) to slot 1's first page
     src = jnp.asarray([_tables()[0, 1]], jnp.int32)
     dst = jnp.asarray([_tables()[1, 0]], jnp.int32)

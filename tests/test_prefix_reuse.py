@@ -28,7 +28,7 @@ from areal_tpu.models.transformer import init_params
 BS = 16
 NSLOTS = 4
 PPS = 4
-NPAGES = NSLOTS * PPS
+NPAGES = NSLOTS * PPS + 1  # page 0 reserved (merge drop target)
 
 
 @pytest.fixture(scope="module")
@@ -41,26 +41,51 @@ def setup():
 
 def _tables():
     return (
-        np.arange(NSLOTS)[:, None] * PPS + np.arange(PPS)[None]
+        1 + np.arange(NSLOTS)[:, None] * PPS + np.arange(PPS)[None]
     ).astype(np.int32)
 
 
-def _prefill_rows(
-    params, cfg, cache, rows, offsets, slots, tp, prefix_bound=0
-):
-    n = len(rows)
-    tokens = np.zeros((n, tp), np.int32)
-    true_lens = np.zeros(n, np.int32)
-    for i, r in enumerate(rows):
-        tokens[i, : len(r)] = r
-        true_lens[i] = len(r)
-    tables = _tables()[np.asarray(slots)]
-    return model_runner.prefill_batch(
-        params, cfg, cache,
-        jnp.asarray(tokens), jnp.asarray(offsets, jnp.int32),
-        jnp.asarray(true_lens), jnp.asarray(tables),
-        prefix_bound=prefix_bound,
-    )
+class Harness:
+    def __init__(self, cfg):
+        from areal_tpu.inference.model_runner import init_last_rows
+        from areal_tpu.ops.paged_attention import pack_factor
+
+        fd = pack_factor(cfg.head_dim) * cfg.head_dim
+        self.last = init_last_rows(
+            cfg.num_layers, NSLOTS, cfg.num_kv_heads, fd, jnp.float32
+        )
+
+    def prefill_rows(
+        self, params, cfg, cache, rows, offsets, slots, tp, prefix_bound=0
+    ):
+        n = len(rows)
+        tokens = np.zeros((n, tp), np.int32)
+        true_lens = np.zeros(n, np.int32)
+        for i, r in enumerate(rows):
+            tokens[i, : len(r)] = r
+            true_lens[i] = len(r)
+        tables = _tables()[np.asarray(slots)]
+        cache, logits, new_last = model_runner.prefill_batch(
+            params, cfg, cache,
+            jnp.asarray(tokens), jnp.asarray(offsets, jnp.int32),
+            jnp.asarray(true_lens), jnp.asarray(tables),
+            prefix_bound=prefix_bound,
+            last_rows=self.last,
+            slot_ids=jnp.asarray(slots, jnp.int32),
+        )
+        for i, sl in enumerate(slots):
+            for kk in ("k", "v"):
+                self.last[kk] = self.last[kk].at[:, sl].set(
+                    new_last[kk][:, i]
+                )
+        return cache, logits
+
+    def decode_step(self, params, cfg, cache, tables, pos0, tokens, active):
+        cache, logits, self.last = model_runner.decode_step(
+            params, cfg, cache, tables, pos0, tokens, active,
+            last_rows=self.last,
+        )
+        return cache, logits
 
 
 def test_batched_prefill_matches_single(setup):
@@ -71,12 +96,13 @@ def test_batched_prefill_matches_single(setup):
         rng.integers(0, cfg.vocab_size, size=n).tolist() for n in (5, 9, 3)
     ]
     cache_b = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_b, logits_b = _prefill_rows(
+    cache_b, logits_b = Harness(cfg).prefill_rows(
         params, cfg, cache_b, prompts, [0, 0, 0], [0, 1, 2], tp=16
     )
     cache_s = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
+    hs = Harness(cfg)
     for i, p in enumerate(prompts):
-        cache_s, logits_1 = _prefill_rows(
+        cache_s, logits_1 = hs.prefill_rows(
             params, cfg, cache_s, [p], [0], [i], tp=16
         )
         np.testing.assert_allclose(
@@ -100,15 +126,17 @@ def test_extend_prefill_matches_full(setup):
     prefix, suffix = full[:BS], full[BS:]
 
     cache_f = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_f, logits_f = _prefill_rows(
+    hf = Harness(cfg)
+    cache_f, logits_f = hf.prefill_rows(
         params, cfg, cache_f, [full], [0], [0], tp=32
     )
 
     cache_e = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache_e, _ = _prefill_rows(
+    he = Harness(cfg)
+    cache_e, _ = he.prefill_rows(
         params, cfg, cache_e, [prefix], [0], [0], tp=16
     )
-    cache_e, logits_e = _prefill_rows(
+    cache_e, logits_e = he.prefill_rows(
         params, cfg, cache_e, [suffix], [BS], [0], tp=16, prefix_bound=BS
     )
     np.testing.assert_allclose(
@@ -123,12 +151,8 @@ def test_extend_prefill_matches_full(setup):
     active = jnp.zeros((NSLOTS,), bool).at[0].set(True)
     pos0 = jnp.zeros(NSLOTS, jnp.int32).at[0].set(len(full))
     tb = jnp.asarray(_tables())
-    cache_f, lf = model_runner.decode_step(
-        params, cfg, cache_f, tb, pos0, toks, active
-    )
-    cache_e, le = model_runner.decode_step(
-        params, cfg, cache_e, tb, pos0, toks, active
-    )
+    cache_f, lf = hf.decode_step(params, cfg, cache_f, tb, pos0, toks, active)
+    cache_e, le = he.decode_step(params, cfg, cache_e, tb, pos0, toks, active)
     assert int(jnp.argmax(lf[0])) == int(jnp.argmax(le[0]))
 
 
@@ -140,16 +164,17 @@ def test_pages_bound_decode_matches_full_tables(setup):
     caches = []
     for _ in range(2):
         c = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-        c, lg = _prefill_rows(params, cfg, c, [prompt], [0], [0], tp=16)
-        caches.append((c, lg))
+        hh = Harness(cfg)
+        c, lg = hh.prefill_rows(params, cfg, c, [prompt], [0], [0], tp=16)
+        caches.append((c, lg, hh))
     tok = int(jnp.argmax(caches[0][1][0]))
     toks = jnp.zeros((NSLOTS,), jnp.int32).at[0].set(tok)
     active = jnp.zeros((NSLOTS,), bool).at[0].set(True)
     pos0 = jnp.zeros(NSLOTS, jnp.int32).at[0].set(len(prompt))
-    c0, l0 = model_runner.decode_step(
+    c0, l0 = caches[0][2].decode_step(
         params, cfg, caches[0][0], jnp.asarray(_tables()), pos0, toks, active
     )
-    c1, l1 = model_runner.decode_step(
+    c1, l1 = caches[1][2].decode_step(
         params, cfg, caches[1][0], jnp.asarray(_tables()[:, :1]), pos0,
         toks, active,
     )
@@ -169,7 +194,8 @@ def test_inactive_slot_pages_untouched_by_decode(setup):
     long_prompt = rng.integers(0, cfg.vocab_size, size=30).tolist()
     short_prompt = rng.integers(0, cfg.vocab_size, size=4).tolist()
     cache = init_kv_pool(cfg, ccfg, dtype=jnp.float32)
-    cache, _ = _prefill_rows(
+    h = Harness(cfg)
+    cache, _ = h.prefill_rows(
         params, cfg, cache, [long_prompt, short_prompt], [0, 0], [0, 1], tp=32
     )
     pages0 = _tables()[0]
@@ -179,7 +205,7 @@ def test_inactive_slot_pages_untouched_by_decode(setup):
     pos0 = np.zeros(NSLOTS, np.int32)
     pos0[0], pos0[1] = 30, 4
     for _ in range(3):
-        cache, _ = model_runner.decode_step(
+        cache, _ = h.decode_step(
             params, cfg, cache, jnp.asarray(_tables()), jnp.asarray(pos0),
             toks, active,
         )
@@ -307,6 +333,13 @@ def test_prefix_cache_flushed_on_weight_update(engine_factory):
     eng.generate(
         {"input_ids": prompt, "sampling_params": {"max_new_tokens": 4}}
     )
+    # pipelined decode: the page release may be deferred until the loop
+    # drains the trailing in-flight chunk
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    while not len(eng.registry) and _time.monotonic() < deadline:
+        _time.sleep(0.02)
     assert len(eng.registry)  # something parked
     free_before = eng.pm.n_free
     new_params = init_params(
