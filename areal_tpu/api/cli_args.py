@@ -173,6 +173,9 @@ class InferenceEngineConfig:
     request_timeout: float = 3600.0
     request_retries: int = 3
     setup_timeout: float = 120.0
+    # bound on the pause→transfer→version-bump window of a weight update:
+    # a failed upload must not hold servers paused for request_timeout
+    weight_update_timeout: float = 300.0
     pause_grace_period: float = 0.0
     # chunked partial rollout (reference realhf/system/partial_rollout.py:29
     # PartialRolloutManager): each /generate asks for at most this many new

@@ -105,6 +105,21 @@ class _ChatCompletions:
         stop: Optional[List[str]] = None,
         **unsupported: Any,
     ) -> ChatCompletion:
+        # silently ignoring OpenAI params we don't implement would corrupt
+        # agent loops written against the real API (n>1 returning one
+        # choice, stream=True returning a non-stream, tools never firing)
+        hard = {
+            k: v
+            for k, v in unsupported.items()
+            if k in ("n", "stream", "tools", "tool_choice", "functions")
+            and v not in (None, False, 1, [])
+        }
+        if hard:
+            raise NotImplementedError(
+                f"unsupported OpenAI parameters: {sorted(hard)} "
+                "(this client returns a single non-streamed completion "
+                "without tool execution)"
+            )
         c = self._client
         base = c.gconfig
         gconfig = base.new(
@@ -210,9 +225,17 @@ class ArealOpenAI:
         agent's conversation turns with `turn_discount` (reference
         export_completions semantics: later turns' rewards discount back
         to the earlier turns that produced them)."""
-        items = sorted(self._cache.items(), key=lambda kv: kv[1].completion.created)
+        import copy as _copy
+
+        items = sorted(
+            self._cache.items(), key=lambda kv: kv[1].completion.created
+        )
+        # propagate into COPIES: writing discounted rewards back into the
+        # cache would make a second export (or a different turn_discount)
+        # compound them as if they were explicit (round-2 advisor finding)
+        out = [(k, _copy.copy(c)) for k, c in items]
         running: Optional[float] = None
-        for _, c in reversed(items):
+        for _, c in reversed(out):
             if c.reward is not None:
                 running = (
                     c.reward
@@ -222,4 +245,4 @@ class ArealOpenAI:
             elif running is not None:
                 running = turn_discount * running
                 c.reward = running
-        return dict(items)
+        return dict(out)

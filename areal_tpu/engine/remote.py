@@ -241,17 +241,29 @@ class RemoteInferenceEngine(InferenceEngine):
                 )
                 r.raise_for_status()
 
+        # Pause SYNCHRONOUSLY before returning (reference pauses inline,
+        # sglang_remote.py:252-254): callers overlap `update_weights(...)`
+        # with `engine.upload_weights(meta)`, and streaming chunks into a
+        # not-yet-paused server would swap weights mid-decode (round-2
+        # advisor finding).
+        _pause_all()
+
         if meta.type == WeightUpdateMethod.DEVICE:
 
             def _do_device_update():
                 try:
-                    _pause_all()
                     # the trainer streams chunks directly to the servers
                     # (spmd_engine.upload_weights); wait on the SAME set of
                     # addresses it streams to (meta.addrs when given), or
                     # unstreamed servers would be polled forever
                     targets = list(meta.addrs) or self.addresses
-                    deadline = time.monotonic() + self.config.request_timeout
+                    # dedicated (shorter) bound: a failed upload must not
+                    # hold every server paused for the full request
+                    # timeout (3600s default)
+                    deadline = time.monotonic() + min(
+                        self.config.request_timeout,
+                        getattr(self.config, "weight_update_timeout", 300.0),
+                    )
                     for addr in targets:
                         while True:
                             r = _requests.get(
@@ -277,7 +289,6 @@ class RemoteInferenceEngine(InferenceEngine):
 
         def _do_update():
             try:
-                _pause_all()
                 # the trainer signals checkpoint readiness via name_resolve
                 # (reference fsdp_engine.py:384-395); flows that save before
                 # calling us are detected by the checkpoint on disk
