@@ -150,6 +150,24 @@ class PPOActorConfig(TrainEngineConfig):
     overlong_tokens: int = 0
     overlong_penalty_factor: float = 0.0
     max_new_tokens: int = 512
+    # adaptive KL controller (reference
+    # realhf/impl/model/utils/ppo_functional.py:14-49): when kl_adaptive,
+    # kl_ctl is the INITIAL coefficient, adapted toward kl_target over
+    # kl_horizon tokens
+    kl_adaptive: bool = False
+    kl_target: float = 0.1
+    kl_horizon: float = 10000.0
+
+
+@dataclasses.dataclass
+class PPOCriticConfig(TrainEngineConfig):
+    """Value-model options (reference PPOCriticInterface,
+    realhf/impl/model/interface/ppo_interface.py:984)."""
+
+    is_critic: bool = True
+    value_eps_clip: float = 0.2
+    ppo_n_minibatches: int = 4
+    temperature: float = 1.0
 
 
 # --------------------------------------------------------------------------

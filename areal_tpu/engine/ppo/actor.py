@@ -40,7 +40,14 @@ class PPOActor:
         self.reward_scaling = config.reward_scaling
         self.reward_clip = config.reward_clip
         self.group_size = config.group_size
-        self.kl_ctl = config.kl_ctl
+        # KL coefficient: fixed, or adapted toward kl_target (reference
+        # ppo_functional.py:14-49 controllers)
+        if getattr(config, "kl_adaptive", False):
+            self.kl_controller = F.AdaptiveKLController(
+                config.kl_ctl, config.kl_target, config.kl_horizon
+            )
+        else:
+            self.kl_controller = F.FixedKLController(config.kl_ctl)
 
     # ------------------------------------------------------------------
     def compute_logp(self, data: Batch, temperature: Optional[float] = None) -> np.ndarray:
@@ -94,11 +101,15 @@ class PPOActor:
         ).astype(np.float32)
         ref_logp = data.get("ref_logp")
         # dense KL reward on completion positions
-        if ref_logp is not None and self.kl_ctl != 0.0:
-            kl_rewards = (
-                -self.kl_ctl
-                * (logprobs - np.asarray(ref_logp, np.float32))
-                * loss_mask
+        kl_ctl = self.kl_controller.value
+        if ref_logp is not None and kl_ctl != 0.0:
+            kl_est = (
+                logprobs - np.asarray(ref_logp, np.float32)
+            ) * loss_mask
+            kl_rewards = -kl_ctl * kl_est
+            n_tok = max(1.0, float(loss_mask.sum()))
+            self.kl_controller.update(
+                float(kl_est.sum() / n_tok), int(loss_mask.sum())
             )
         else:
             kl_rewards = np.zeros_like(loss_mask)
@@ -116,6 +127,9 @@ class PPOActor:
             jnp.asarray(mask.astype(np.float32)), cfg.gamma, cfg.lam,
         )
         adv = np.asarray(adv)
+        # returns feed the critic's clipped value loss (PPOCritic)
+        data["returns"] = np.asarray(returns)
+        data["values"] = values
         an = cfg.adv_norm
         if an is not None and (an.mean_level != "none" or an.std_level != "none"):
             adv = _adv_normalize(adv, loss_mask, an, self.group_size)

@@ -1,0 +1,88 @@
+"""PPO critic: value-model training over any TrainEngine.
+
+Role of reference PPOCriticInterface
+(realhf/impl/model/interface/ppo_interface.py:984): a decoder trunk with a
+scalar value head, trained on clipped value loss against GAE returns; its
+values feed the actor's advantage estimation (PPOActor.compute_advantages
+consumes ``data["values"]``). The engine side is the same SPMDTrainEngine
+with ``config.is_critic=True`` (transformer value_head — models/
+transformer.py), so every parallelism/微batching path is shared.
+"""
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.cli_args import PPOCriticConfig
+from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.utils.data import Batch, batch_select, batch_size
+
+
+def critic_value_hook(logits, arrays):
+    """Engine forward post-hook: [R, T, 1] value logits → [R, T] values."""
+    return logits[..., 0]
+
+
+def critic_loss_fn_factory(eps: float):
+    def critic_loss_fn(logits, arrays):
+        """Clipped value loss (reference ppo_functional critic loss):
+        max((v-R)^2, (clip(v, v_old±eps) - R)^2) over loss-masked tokens."""
+        values = logits[..., 0].astype(jnp.float32)  # [R, T]
+        returns = arrays["t_returns"].astype(jnp.float32)
+        old_values = arrays["t_values"].astype(jnp.float32)
+        mask = (arrays["t_loss_mask"] > 0).astype(jnp.float32)
+        clipped = jnp.clip(values, old_values - eps, old_values + eps)
+        l1 = (values - returns) ** 2
+        l2 = (clipped - returns) ** 2
+        loss_tok = jnp.maximum(l1, l2)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = 0.5 * (loss_tok * mask).sum() / denom
+        clip_frac = ((l2 > l1).astype(jnp.float32) * mask).sum() / denom
+        return loss, {
+            "value_loss": loss,
+            "value_clip_frac": clip_frac,
+            "value_mean": (values * mask).sum() / denom,
+        }
+
+    return critic_loss_fn
+
+
+def _loss_weight_fn(arrays) -> jnp.ndarray:
+    return jnp.maximum(
+        (arrays["t_loss_mask"] > 0).astype(jnp.float32).sum(), 1.0
+    )
+
+
+class PPOCritic:
+    """Value-model algorithm wrapper (mirrors PPOActor)."""
+
+    def __init__(self, config: PPOCriticConfig, engine: TrainEngine):
+        self.config = config
+        self.engine = engine
+
+    def compute_values(self, data: Batch) -> np.ndarray:
+        """Per-position values [B, L] under current critic weights."""
+        return self.engine.forward(data, post_hook=critic_value_hook)
+
+    def critic_update(self, data: Batch) -> List[Dict[str, float]]:
+        """Minibatched clipped-value update. ``data`` must carry
+        ``returns`` (from the actor's GAE) and ``values`` (the old values
+        used for that GAE)."""
+        cfg = self.config
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = critic_loss_fn_factory(cfg.value_eps_clip)
+        bsz = batch_size(data)
+        n_mbs = min(cfg.ppo_n_minibatches, max(bsz, 1))
+        perm = np.random.permutation(bsz)
+        groups = np.array_split(perm, n_mbs)
+        out = []
+        for g in groups:
+            if len(g) == 0:
+                continue
+            out.append(
+                self.engine.train_batch(
+                    batch_select(data, g), self._loss_fn, _loss_weight_fn
+                )
+            )
+        return out

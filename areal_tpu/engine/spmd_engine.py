@@ -110,13 +110,30 @@ class SPMDTrainEngine(TrainEngine):
         else:
             raise ValueError("need config.path or explicit model_config")
         mc = self.model_config
-        logical = param_logical_axes(mc)
+        is_critic = bool(getattr(cfg, "is_critic", False))
+        logical = param_logical_axes(mc, value_head=is_critic)
         self._param_shardings = sharding_lib.tree_shardings(self.mesh, logical)
         if cfg.path and not cfg.init_from_scratch:
             host_params = hf_io.load_params(cfg.path, mc, dtype=self.param_dtype)
+            if is_critic:
+                # fresh scalar head on top of the pretrained trunk
+                # (reference critic init: actor trunk + new value head)
+                import numpy as _np
+
+                host_params["value_head"] = (
+                    _np.asarray(
+                        jax.random.normal(
+                            jax.random.PRNGKey(seed + 101),
+                            (mc.hidden_size, 1),
+                        )
+                    )
+                    * 0.02
+                ).astype(self.param_dtype)
+                host_params.pop("lm_head", None)
         else:
             host_params = init_params(
-                mc, jax.random.PRNGKey(seed), dtype=self.param_dtype
+                mc, jax.random.PRNGKey(seed), dtype=self.param_dtype,
+                value_head=is_critic,
             )
         self.params = jax.tree_util.tree_map(
             lambda a, sh: distributed_lib.make_global_array(

@@ -1,0 +1,294 @@
+"""Server-tier router: one service fronting N generation servers.
+
+Role of the reference's GserverManager (realhf/system/gserver_manager.py) —
+the piece that lets MULTIPLE trainer/rollout-worker clients share one
+generation fleet, which client-side policies in each process cannot do:
+
+- ``POST /schedule_request`` — pick a server for a request: qid affinity
+  (a GRPO group's n samples land on one server so sibling KV dedup works),
+  else round_robin / least_requests / least_token_usage
+  (gserver_manager.py:358-391).
+- ``POST /allocate_rollout`` — global capacity + staleness gate: a new
+  rollout is admitted iff concurrency < max_concurrent_rollouts AND
+  expected_version <= max_head_offpolicyness + current_version
+  (gserver_manager.py:334-349,400-435).
+- ``POST /finish_rollout`` — return capacity, count a consumed sample.
+- ``POST /update_weights`` — fan-out pause → update (disk path) →
+  continue over every server (gserver_manager.py:158-173); bumps the
+  router's version, which re-opens the staleness gate.
+- ``GET /metrics`` — aggregated Prometheus scrape of all servers
+  (gserver_manager.py:293-325).
+
+Servers are discovered from ``name_resolve`` (names.gen_servers) or given
+explicitly. Thread-safe; stdlib HTTP only (the reference uses FastAPI —
+rejected here to keep the serving tier dependency-free).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils import name_resolve, names, network
+
+logger = logging_util.getLogger("Router")
+
+
+class RouterState:
+    def __init__(
+        self,
+        addresses: List[str],
+        train_batch_size: int = 1,
+        max_head_offpolicyness: int = 10**9,
+        max_concurrent_rollouts: int = 10**9,
+        schedule_policy: str = "least_token_usage",
+    ):
+        self.lock = threading.Lock()
+        self.addresses = list(addresses)
+        self.train_batch_size = max(1, train_batch_size)
+        self.max_head_offpolicyness = max_head_offpolicyness
+        self.max_concurrent_rollouts = max_concurrent_rollouts
+        self.schedule_policy = schedule_policy
+        self.version = 0
+        self.running = 0  # live rollouts (allocate/finish)
+        self.accepted = 0  # total allocated
+        self.finished = 0  # total finished (≈ samples produced)
+        self._rr = 0
+        self._qid_server: Dict[str, str] = {}
+        self._requests: Dict[str, int] = {a: 0 for a in addresses}
+        self._tokens: Dict[str, float] = {a: 0.0 for a in addresses}
+
+    # -- scheduling ----------------------------------------------------
+    def schedule(self, meta: Dict) -> Dict:
+        with self.lock:
+            qid = str(meta.get("qid") or meta.get("rid") or "")
+            prev = meta.get("previous_server")
+            if prev and int(meta.get("previous_version", -1)) == self.version:
+                # sticky while the version is unchanged (interruptible
+                # resubmits reuse the server's cached prefix)
+                return {"url": prev, "version": self.version}
+            if qid and qid in self._qid_server:
+                addr = self._qid_server[qid]
+                return {"url": addr, "version": self.version}
+            if self.schedule_policy == "round_robin":
+                addr = self.addresses[self._rr % len(self.addresses)]
+                self._rr += 1
+            elif self.schedule_policy == "least_requests":
+                addr = min(self.addresses, key=lambda a: self._requests[a])
+            else:  # least_token_usage
+                addr = min(self.addresses, key=lambda a: self._tokens[a])
+            if qid:
+                self._qid_server[qid] = addr
+            self._requests[addr] += 1
+            # expected token load: prompt + a fraction of the budget (the
+            # reference's 0.4 heuristic — most gens stop well before the
+            # budget)
+            self._tokens[addr] += float(meta.get("prompt_len", 0)) + 0.4 * (
+                float(meta.get("new_token_budget", 0))
+                * max(1, int(meta.get("group_size", 1)))
+            )
+            return {"url": addr, "version": self.version}
+
+    # -- capacity + staleness gate ------------------------------------
+    def allocate(self) -> Dict:
+        with self.lock:
+            if self.running >= self.max_concurrent_rollouts:
+                return {"success": False, "reason": "capacity"}
+            expected_version = (
+                self.finished + self.running
+            ) // self.train_batch_size
+            if expected_version > self.max_head_offpolicyness + self.version:
+                return {"success": False, "reason": "staleness"}
+            self.running += 1
+            self.accepted += 1
+            return {"success": True, "version": self.version}
+
+    def finish(self) -> Dict:
+        with self.lock:
+            self.running = max(0, self.running - 1)
+            self.finished += 1
+            return {"success": True}
+
+    # -- weight update fan-out ----------------------------------------
+    def update_weights(self, meta: Dict) -> Dict:
+        """pause → update_weights_from_disk → continue on every server
+        (strict ordering per server; version bump re-opens the gate)."""
+        path = meta.get("path", "")
+        version = int(meta.get("version", self.version + 1))
+        results = {}
+        for addr in self.addresses:
+            self._post(addr, "/pause_generation", {})
+        try:
+            for addr in self.addresses:
+                results[addr] = self._post(
+                    addr, "/update_weights_from_disk",
+                    {"path": path, "version": version},
+                    timeout=600,
+                )
+        finally:
+            for addr in self.addresses:
+                try:
+                    self._post(addr, "/continue_generation", {})
+                except Exception as e:  # keep resuming the rest
+                    logger.error(f"continue_generation {addr}: {e}")
+        with self.lock:
+            self.version = version
+            # fresh version invalidates the qid affinity map (the cached
+            # prefixes it pointed at were flushed by the servers)
+            self._qid_server.clear()
+        return {"success": True, "version": version, "servers": results}
+
+    def metrics(self) -> str:
+        lines = []
+        with self.lock:
+            lines += [
+                f"areal_tpu_router_version {self.version}",
+                f"areal_tpu_router_running {self.running}",
+                f"areal_tpu_router_accepted {self.accepted}",
+                f"areal_tpu_router_finished {self.finished}",
+                f"areal_tpu_router_servers {len(self.addresses)}",
+            ]
+        for addr in self.addresses:
+            try:
+                req = urllib.request.Request(f"http://{addr}/metrics")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    body = r.read().decode()
+                tag = addr.replace(":", "_").replace(".", "_")
+                for line in body.strip().split("\n"):
+                    k, v = line.rsplit(" ", 1)
+                    lines.append(f'{k}{{server="{tag}"}} {v}')
+            except Exception as e:
+                logger.warning(f"metrics scrape {addr}: {e}")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _post(addr: str, path: str, payload: Dict, timeout: float = 60.0):
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: RouterState = None  # type: ignore
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send_json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._send_json({"status": "ok"})
+        elif self.path == "/metrics":
+            body = self.state.metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/servers":
+            self._send_json({"servers": self.state.addresses,
+                             "version": self.state.version})
+        else:
+            self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_POST(self):
+        try:
+            payload = self._read_json()
+            if self.path == "/schedule_request":
+                self._send_json(self.state.schedule(payload))
+            elif self.path == "/allocate_rollout":
+                self._send_json(self.state.allocate())
+            elif self.path == "/finish_rollout":
+                self._send_json(self.state.finish())
+            elif self.path == "/update_weights":
+                self._send_json(self.state.update_weights(payload))
+            elif self.path == "/set_version":
+                with self.state.lock:
+                    self.state.version = int(payload["version"])
+                self._send_json({"success": True})
+            else:
+                self._send_json({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:  # surface errors as 500 JSON
+            self._send_json({"error": str(e)}, 500)
+
+
+def serve_router(
+    addresses: Optional[List[str]] = None,
+    experiment_name: str = "",
+    trial_name: str = "",
+    host: str = "127.0.0.1",
+    port: int = 0,
+    background: bool = True,
+    **state_kwargs,
+) -> ThreadingHTTPServer:
+    """Start the router; discovers servers from name_resolve when
+    ``addresses`` is not given (reference generation_server registration,
+    generation_server.py:159-170)."""
+    if addresses is None:
+        key = names.gen_servers(experiment_name, trial_name)
+        addresses = sorted(name_resolve.get_subtree(key))
+    if not addresses:
+        raise ValueError("router needs at least one generation server")
+    state = RouterState(addresses, **state_kwargs)
+    handler = type("Handler", (_Handler,), {"state": state})
+    if port == 0:
+        port = network.find_free_ports(1)[0]
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    httpd.router_state = state  # for tests/introspection
+    logger.info(
+        f"router on {host}:{port} fronting {len(addresses)} server(s)"
+    )
+    if background:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    else:
+        httpd.serve_forever()
+    return httpd
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--addrs", default="", help="host:port,... (else discover)")
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--train-batch-size", type=int, default=1)
+    p.add_argument("--max-head-offpolicyness", type=int, default=10**9)
+    p.add_argument("--max-concurrent-rollouts", type=int, default=10**9)
+    p.add_argument("--schedule-policy", default="least_token_usage")
+    args = p.parse_args(argv)
+    serve_router(
+        addresses=[a for a in args.addrs.split(",") if a] or None,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        port=args.port,
+        background=False,
+        train_batch_size=args.train_batch_size,
+        max_head_offpolicyness=args.max_head_offpolicyness,
+        max_concurrent_rollouts=args.max_concurrent_rollouts,
+        schedule_policy=args.schedule_policy,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -38,9 +38,12 @@ Params = Dict[str, Any]
 # Init
 # --------------------------------------------------------------------------
 def init_params(
-    cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16
+    cfg: ModelConfig, rng: jax.Array, dtype=jnp.bfloat16,
+    value_head: bool = False,
 ) -> Params:
-    """Random init (scaled normal), HF-compatible structure."""
+    """Random init (scaled normal), HF-compatible structure.
+    ``value_head`` adds a scalar head [D, 1] (critic models — reference
+    SequenceParallelCriticHead, realhf/impl/model/nn/real_llm_base.py)."""
     L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     Qd, KVd = cfg.q_dim, cfg.kv_dim
     keys = jax.random.split(rng, 9)
@@ -79,14 +82,19 @@ def init_params(
         "layers": layers,
         "final_norm": jnp.ones((D,), dtype),
     }
-    if not cfg.tie_word_embeddings:
+    if value_head:
+        # critics replace the LM head with the scalar head entirely
+        params["value_head"] = nrm(
+            jax.random.fold_in(rng, 101), (D, 1), std
+        )
+    elif not cfg.tie_word_embeddings:
         params["lm_head"] = nrm(
             jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std
         )
     return params
 
 
-def param_logical_axes(cfg: ModelConfig) -> Params:
+def param_logical_axes(cfg: ModelConfig, value_head: bool = False) -> Params:
     """Same-structure tree of logical axis name tuples.
 
     Logical names: "vocab" (vocab-parallel), "embed" (fsdp-sharded model
@@ -122,7 +130,9 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
         "layers": layers,
         "final_norm": (None,),
     }
-    if not cfg.tie_word_embeddings:
+    if value_head:
+        axes["value_head"] = ("embed", None)
+    elif not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
 
@@ -206,11 +216,13 @@ def apply(
         body = jax.checkpoint(body, prevent_cse=False)
     x, aux = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = (
-        params["embedding"].T
-        if cfg.tie_word_embeddings
-        else params["lm_head"]
-    )
+    if "value_head" in params:
+        # critic: scalar head — "logits" [B, T, 1] (value per position)
+        head = params["value_head"]
+    elif cfg.tie_word_embeddings:
+        head = params["embedding"].T
+    else:
+        head = params["lm_head"]
     logits = (x.astype(jnp.float32)) @ head.astype(jnp.float32)
     if return_router_loss:
         return logits, jnp.mean(aux)

@@ -279,3 +279,31 @@ def reward_overlong_penalty(
         exceed / max(overlong_tokens, 1), 0.0, 1.0
     ) * overlong_penalty_factor
     return rewards - penalty
+
+
+# ---------------------------------------------------------------------------
+# KL controllers (reference realhf/impl/model/utils/ppo_functional.py:14-49)
+# ---------------------------------------------------------------------------
+class FixedKLController:
+    """Constant KL coefficient."""
+
+    def __init__(self, kl_coef: float):
+        self.value = float(kl_coef)
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        pass
+
+
+class AdaptiveKLController:
+    """Adaptive KL coefficient (Ziegler et al.): the coefficient drifts so
+    the observed per-token KL tracks ``target`` over ``horizon`` tokens."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: float):
+        self.value = float(init_kl_coef)
+        self.target = float(target)
+        self.horizon = float(horizon)
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        error = min(max(current_kl / self.target - 1.0, -0.2), 0.2)
+        mult = 1.0 + error * n_steps / self.horizon
+        self.value *= mult
