@@ -169,6 +169,34 @@ class SPMDTrainEngine(TrainEngine):
         self.initialized = True
         return self
 
+    def rebuild_optimizer(
+        self, opt_config, total_steps: int = 10000
+    ) -> None:
+        """Swap the optimizer (fresh state) without touching params —
+        e.g. an RL phase following SFT needs a far smaller step size.
+        Clears the jitted-program cache (apply programs close over the
+        optimizer)."""
+        cfg = self.config
+        old_opt = cfg.optimizer
+        cfg.optimizer = opt_config
+        try:
+            self.lr_schedule = _lr_schedule(cfg, total_steps)
+        finally:
+            cfg.optimizer = old_opt
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(opt_config.gradient_clipping),
+            optax.adamw(
+                learning_rate=self.lr_schedule,
+                b1=opt_config.beta1,
+                b2=opt_config.beta2,
+                eps=opt_config.eps,
+                weight_decay=opt_config.weight_decay,
+                mu_dtype=jnp.float32,
+            ),
+        )
+        self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        self._jit_cache.clear()
+
     def destroy(self):
         self.params = None
         self.opt_state = None

@@ -1,0 +1,3 @@
+"""Environment implementations for agentic workflows."""
+
+from areal_tpu.env.math_code_env import MathCodeSingleStepEnv  # noqa: F401

@@ -1,0 +1,59 @@
+"""Single-step verifiable-reward environment (math and code).
+
+Role of reference realhf/impl/environment/math_code_single_step_env.py:
+the Env the legacy agents step once per episode — the action is the
+model's full completion; the reward is the verifiable score (math answer
+equivalence or code execution), and the episode is done.
+
+Query metadata decides the verifier per reset:
+  {"task": "math", "answer": "..."}         → reward/math_parser
+  {"task": "code", "tests": [...], ...}     → reward/code_verifier
+"""
+
+import asyncio
+from typing import Any, Dict, Tuple
+
+from areal_tpu.api.env_api import Env
+
+
+class MathCodeSingleStepEnv(Env):
+    def __init__(self, timeout_s: float = 15.0):
+        self.timeout_s = timeout_s
+        self._query: Dict[str, Any] = {}
+
+    async def areset(self, **kwargs) -> Any:
+        """kwargs = the query metadata (task, answer/tests, prompt...)."""
+        self._query = dict(kwargs)
+        return self._query.get("prompt", "")
+
+    async def astep(
+        self, action: Any
+    ) -> Tuple[Any, float, bool, Dict[str, Any]]:
+        completion = str(action)
+        task = self._query.get("task", "math")
+        loop = asyncio.get_running_loop()
+        if task == "code":
+            from areal_tpu.reward.code_verifier import code_reward_fn
+
+            reward = await loop.run_in_executor(
+                None,
+                lambda: code_reward_fn(
+                    self._query.get("prompt", ""),
+                    completion,
+                    None,
+                    None,
+                    test_cases=self._query.get("test_cases"),
+                    test_code=self._query.get("test_code"),
+                    timeout=self.timeout_s,
+                ),
+            )
+        else:
+            from areal_tpu.reward.math_parser import process_results
+
+            reward = await loop.run_in_executor(
+                None,
+                lambda: process_results(
+                    completion, str(self._query.get("answer", ""))
+                ),
+            )
+        return None, float(reward), True, {"task": task}

@@ -1,0 +1,149 @@
+"""Slurm launcher: submit the trainer constellation as sbatch jobs.
+
+Role of reference areal/launcher/slurm.py (`SlurmLauncher`): place
+generation servers and the trainer on a Slurm cluster. TPU-native shape:
+one trainer job array (one task per pod worker host joining a single
+jax.distributed world over the AREAL_* rendezvous env) plus one job per
+generation server; addresses rendezvous through ``name_resolve`` exactly
+like the local/pod launchers.
+
+``submit`` is pluggable (tests inject a recorder instead of ``sbatch``),
+so script generation and wiring are testable without a Slurm cluster.
+"""
+
+import os
+import shlex
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.utils import logging as logging_util, names
+
+logger = logging_util.getLogger("SlurmLauncher")
+
+
+def _default_submit(script_path: str) -> str:
+    """sbatch the script; returns the job id."""
+    out = subprocess.check_output(["sbatch", "--parsable", script_path])
+    return out.decode().strip().split(";")[0]
+
+
+class SlurmLauncher:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        fileroot: str = "/tmp/areal_tpu",
+        partition: str = "",
+        account: str = "",
+        trainer_nodes: int = 1,
+        trainer_gpus_per_node: str = "",  # e.g. "tpu:4" gres spec
+        server_count: int = 0,
+        time_limit: str = "24:00:00",
+        container_env: Optional[Dict[str, str]] = None,
+        submit: Callable[[str], str] = _default_submit,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.run_dir = os.path.join(
+            fileroot, experiment_name, trial_name, "slurm"
+        )
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.partition = partition
+        self.account = account
+        self.trainer_nodes = trainer_nodes
+        self.gres = trainer_gpus_per_node
+        self.server_count = server_count
+        self.time_limit = time_limit
+        self.env = dict(container_env or {})
+        self.submit = submit
+        self.job_ids: List[str] = []
+
+    # ------------------------------------------------------------------
+    def _header(self, job_name: str, nodes: int, array: int = 0) -> List[str]:
+        lines = [
+            "#!/bin/bash",
+            f"#SBATCH --job-name={self.experiment_name}.{self.trial_name}.{job_name}",
+            f"#SBATCH --nodes={nodes}",
+            "#SBATCH --ntasks-per-node=1",
+            f"#SBATCH --time={self.time_limit}",
+            f"#SBATCH --output={self.run_dir}/{job_name}-%j.log",
+        ]
+        if self.partition:
+            lines.append(f"#SBATCH --partition={self.partition}")
+        if self.account:
+            lines.append(f"#SBATCH --account={self.account}")
+        if self.gres:
+            lines.append(f"#SBATCH --gres={self.gres}")
+        if array:
+            lines.append(f"#SBATCH --array=0-{array - 1}")
+        for k, v in self.env.items():
+            lines.append(f"export {k}={shlex.quote(str(v))}")
+        return lines
+
+    def _write(self, name: str, lines: List[str]) -> str:
+        path = os.path.join(self.run_dir, f"{name}.sbatch")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+
+    # ------------------------------------------------------------------
+    def launch_servers(self, server_cmd: List[str]) -> List[str]:
+        """One sbatch job per generation server; each registers its
+        address in name_resolve (server.py does this on startup)."""
+        ids = []
+        for i in range(self.server_count):
+            lines = self._header(f"server{i}", nodes=1)
+            lines += [
+                f"export AREAL_SERVER_INDEX={i}",
+                " ".join(shlex.quote(c) for c in server_cmd),
+            ]
+            ids.append(self.submit(self._write(f"server{i}", lines)))
+        self.job_ids += ids
+        return ids
+
+    def launch_trainer(self, trainer_cmd: List[str]) -> str:
+        """Trainer job: `trainer_nodes` tasks joining one jax.distributed
+        world. Rank 0's node is the rendezvous coordinator (SLURM_NODEID /
+        SLURMD_NODENAME wire the AREAL_* env the trainer reads)."""
+        lines = self._header("trainer", nodes=self.trainer_nodes)
+        cmd = " ".join(shlex.quote(c) for c in trainer_cmd)
+        lines += [
+            "head=$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n1)",
+            # port derived from the job id so it is (a) identical on every
+            # node and (b) per-job unique on the COMPUTE nodes — a port
+            # probed on the submit host proves nothing about the head node
+            'port=$((20000 + SLURM_JOB_ID % 20000))',
+            'export AREAL_COORDINATOR=$head:$port',
+            f"export AREAL_NUM_PROCESSES={self.trainer_nodes}",
+            # the batch body runs ONCE on the head node; the per-task rank
+            # must be evaluated inside each srun task, not frozen here
+            "srun bash -c "
+            + shlex.quote(f"AREAL_PROCESS_ID=$SLURM_PROCID exec {cmd}"),
+        ]
+        jid = self.submit(self._write("trainer", lines))
+        self.job_ids.append(jid)
+        return jid
+
+    def wait_servers(self, timeout: float = 300.0) -> List[str]:
+        """Block until all servers registered their addresses."""
+        key = names.gen_servers(self.experiment_name, self.trial_name)
+        from areal_tpu.utils import name_resolve
+
+        deadline = time.monotonic() + timeout
+        while True:
+            addrs = name_resolve.get_subtree(key)
+            if len(addrs) >= self.server_count:
+                return sorted(addrs)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(addrs)}/{self.server_count} servers registered"
+                )
+            time.sleep(1.0)
+
+    def cancel_all(self):
+        for jid in self.job_ids:
+            try:
+                subprocess.run(["scancel", jid], check=False)
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,164 @@
+"""Self-hosted KV rendezvous service + name_resolve backend.
+
+Role of the reference's etcd3 backend (areal/utils/name_resolve.py:411
+``Etcd3NameRecordRepository``): multi-host rendezvous WITHOUT a shared
+filesystem. etcd isn't in this image, so the service itself is in-repo: a
+tiny threaded HTTP KV server (one process, started by the launcher on the
+head host) with the same record semantics as the other backends — add /
+get / delete / subtree / TTL keepalive — and a client-side repository the
+rest of the framework uses through the usual ``name_resolve`` facade:
+
+    # head host
+    python -m areal_tpu.utils.kv_server --port 2379
+    # every process
+    name_resolve.reconfigure("kv", address="head:2379")
+
+TTL records are expired server-side; clients holding keepalive records
+re-PUT them from a daemon thread (the reference's etcd lease analog).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils import network
+
+logger = logging_util.getLogger("kv_server")
+
+
+class ExistsError(Exception):
+    pass
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # name -> (value, expire_at or None)
+        self.data: Dict[str, Tuple[str, Optional[float]]] = {}
+
+    def _expire(self):
+        now = time.monotonic()
+        dead = [
+            k for k, (_, exp) in self.data.items()
+            if exp is not None and exp < now
+        ]
+        for k in dead:
+            del self.data[k]
+
+    def put(self, name: str, value: str, ttl: Optional[float], replace: bool):
+        with self.lock:
+            self._expire()
+            if not replace and name in self.data:
+                raise ExistsError(name)
+            exp = None if ttl is None else time.monotonic() + ttl
+            self.data[name] = (value, exp)
+
+    def get(self, name: str) -> str:
+        with self.lock:
+            self._expire()
+            if name not in self.data:
+                raise KeyError(name)
+            return self.data[name][0]
+
+    def delete(self, name: str):
+        with self.lock:
+            self._expire()
+            if name not in self.data:
+                raise KeyError(name)
+            del self.data[name]
+
+    def subtree(self, root: str) -> List[str]:
+        with self.lock:
+            self._expire()
+            prefix = root.rstrip("/") + "/"
+            return sorted(
+                k for k in self.data if k == root or k.startswith(prefix)
+            )
+
+    def clear_subtree(self, root: str):
+        with self.lock:
+            self._expire()
+            prefix = root.rstrip("/") + "/"
+            for k in [
+                k for k in self.data if k == root or k.startswith(prefix)
+            ]:
+                del self.data[k]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: _Store = None  # type: ignore
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n)) if n else {}
+        op = req.get("op")
+        try:
+            if op == "put":
+                self.store.put(
+                    req["name"], req["value"], req.get("ttl"),
+                    bool(req.get("replace", False)),
+                )
+                self._send({"ok": True})
+            elif op == "get":
+                self._send({"ok": True, "value": self.store.get(req["name"])})
+            elif op == "delete":
+                self.store.delete(req["name"])
+                self._send({"ok": True})
+            elif op == "subtree":
+                self._send(
+                    {"ok": True, "names": self.store.subtree(req["root"])}
+                )
+            elif op == "clear_subtree":
+                self.store.clear_subtree(req["root"])
+                self._send({"ok": True})
+            else:
+                self._send({"ok": False, "error": f"unknown op {op}"}, 400)
+        except ExistsError as e:
+            self._send({"ok": False, "error": "exists", "name": str(e)})
+        except KeyError as e:
+            self._send({"ok": False, "error": "not_found", "name": str(e)})
+        except Exception as e:
+            self._send({"ok": False, "error": str(e)}, 500)
+
+
+def serve_kv(host: str = "0.0.0.0", port: int = 0, background: bool = True):
+    store = _Store()
+    handler = type("Handler", (_Handler,), {"store": store})
+    if port == 0:
+        port = network.find_free_ports(1)[0]
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    logger.info(f"kv rendezvous server on {host}:{port}")
+    if background:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    else:
+        httpd.serve_forever()
+    return httpd
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=2379)
+    args = p.parse_args(argv)
+    serve_kv(args.host, args.port, background=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,16 +195,115 @@ class NfsNameRecordRepository(NameRecordRepository):
                 pass
 
 
+class KvNameRecordRepository(NameRecordRepository):
+    """Client for the in-repo KV rendezvous service (utils/kv_server.py) —
+    the etcd3-backend analog (reference areal/utils/name_resolve.py:411):
+    multi-host rendezvous without a shared filesystem. Keepalive records
+    are re-PUT from a daemon thread (the etcd lease analog)."""
+
+    def __init__(self, address: str, keepalive_interval: float = 5.0):
+        self.address = address
+        self._keepalive: Dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._interval = keepalive_interval
+        self._thread: Optional[threading.Thread] = None
+        # names registered with delete_on_exit=True (removed on reset())
+        self._owned: set = set()
+
+    def _call(self, payload: Dict):
+        import json as _json
+        import urllib.request as _rq
+
+        req = _rq.Request(
+            f"http://{self.address}/",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=30) as r:
+            out = _json.loads(r.read())
+        if not out.get("ok"):
+            if out.get("error") == "not_found":
+                raise NameEntryNotFoundError(
+                    payload.get("name") or payload.get("root")
+                )
+            if out.get("error") == "exists":
+                raise NameEntryExistsError(payload.get("name"))
+            raise RuntimeError(f"kv_server error: {out}")
+        return out
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        self._call({
+            "op": "put", "name": name, "value": str(value),
+            "ttl": keepalive_ttl, "replace": replace,
+        })
+        if delete_on_exit:
+            self._owned.add(name)
+        if keepalive_ttl is not None:
+            self._keepalive[name] = (str(value), keepalive_ttl)
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._keepalive_loop, daemon=True
+                )
+                self._thread.start()
+
+    def _keepalive_loop(self):
+        while True:
+            # refresh fast enough for the shortest TTL held (a fixed 5s
+            # interval would let any ttl < 5s lapse between refreshes)
+            ttls = [ttl for _, ttl in self._keepalive.values()]
+            wait = min([self._interval] + [t / 2.0 for t in ttls if t])
+            if self._stop.wait(max(0.05, wait)):
+                return
+            for name, (value, ttl) in list(self._keepalive.items()):
+                try:
+                    self._call({
+                        "op": "put", "name": name, "value": value,
+                        "ttl": ttl, "replace": True,
+                    })
+                except Exception:
+                    pass
+
+    def get(self, name):
+        return self._call({"op": "get", "name": name})["value"]
+
+    def delete(self, name):
+        self._keepalive.pop(name, None)
+        self._owned.discard(name)
+        self._call({"op": "delete", "name": name})
+
+    def clear_subtree(self, name_root):
+        self._call({"op": "clear_subtree", "root": name_root})
+
+    def find_subtree(self, name_root):
+        return self._call({"op": "subtree", "root": name_root})["names"]
+
+    def reset(self):
+        """Remove this process's registrations (delete_on_exit semantics —
+        the NFS backend and the reference's etcd leases do the same)."""
+        self._stop.set()
+        self._keepalive.clear()
+        for name in list(self._owned):
+            try:
+                self._call({"op": "delete", "name": name})
+            except Exception:
+                pass
+        self._owned.clear()
+
+
 DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
 
 
 def reconfigure(backend: str = "memory", **kwargs) -> NameRecordRepository:
-    """Swap the global repository ('memory' or 'nfs')."""
+    """Swap the global repository ('memory', 'nfs', or 'kv')."""
     global DEFAULT_REPOSITORY
     if backend == "memory":
         DEFAULT_REPOSITORY = MemoryNameRecordRepository()
     elif backend == "nfs":
         DEFAULT_REPOSITORY = NfsNameRecordRepository(**kwargs)
+    elif backend == "kv":
+        DEFAULT_REPOSITORY = KvNameRecordRepository(**kwargs)
     else:
         raise ValueError(f"unknown name_resolve backend: {backend}")
     return DEFAULT_REPOSITORY

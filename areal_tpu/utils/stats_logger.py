@@ -28,6 +28,38 @@ class StatsLogger:
             self._tb = SummaryWriter(log_dir=os.path.join(self.path, "tb"))
         except Exception:
             pass
+        # wandb / swanlab sinks (reference areal/utils/stats_logger.py):
+        # gated on the packages being installed AND an opt-in env var —
+        # this image ships neither, so these stay dormant stubs until a
+        # deployment provides them
+        self._wandb = None
+        if os.environ.get("AREAL_TPU_WANDB"):
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(
+                    project=os.environ.get(
+                        "WANDB_PROJECT", experiment_name or "areal_tpu"
+                    ),
+                    name=trial_name or None,
+                    dir=self.path,
+                )
+            except Exception:
+                self._wandb = None
+        self._swanlab = None
+        if os.environ.get("AREAL_TPU_SWANLAB"):
+            try:
+                import swanlab
+
+                self._swanlab = swanlab
+                swanlab.init(
+                    project=experiment_name or "areal_tpu",
+                    experiment_name=trial_name or None,
+                    logdir=self.path,
+                )
+            except Exception:
+                self._swanlab = None
         self._start = time.time()
 
     def commit(self, epoch: int, step: int, global_step: int, data: Dict[str, float]):
@@ -38,6 +70,10 @@ class StatsLogger:
         if self._tb is not None:
             for k, v in data.items():
                 self._tb.add_scalar(k, v, global_step)
+        if self._wandb is not None:
+            self._wandb.log(dict(data), step=global_step)
+        if self._swanlab is not None:
+            self._swanlab.log(dict(data), step=global_step)
         headline = {
             k: round(float(v), 4)
             for k, v in list(data.items())[:12]
@@ -46,5 +82,15 @@ class StatsLogger:
 
     def close(self):
         self._jsonl.close()
+        if self._wandb is not None:
+            try:
+                self._wandb.finish()
+            except Exception:
+                pass
+        if self._swanlab is not None:
+            try:
+                self._swanlab.finish()
+            except Exception:
+                pass
         if self._tb is not None:
             self._tb.close()

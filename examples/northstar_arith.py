@@ -167,6 +167,12 @@ def main(argv=None):
             )
 
     # ---------------- Phase 2: GRPO with the real RL stack ----------------
+    # RL needs a far smaller step size than SFT — 3e-4 collapses the
+    # policy within a few updates; rebuild the optimizer at RL lr
+    engine.rebuild_optimizer(
+        OptimizerConfig(lr=2e-5, warmup_steps_proportion=0.0)
+    )
+
     gconfig = GenerationHyperparameters(
         n_samples=args.group_size,
         max_new_tokens=8,
