@@ -14,6 +14,7 @@ engine's attn_impl="flash" config) — CPU tests use the XLA kernel.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +23,23 @@ from jax.experimental.pallas.ops.tpu.splash_attention import (
     splash_attention_kernel as _sk,
     splash_attention_mask as _sm,
 )
+
+
+def _block_size(t: int) -> int:
+    """Splash grid-block edge. Per-grid-step overhead dominates this
+    stack's pallas kernels (~50us/step measured), so at long contexts the
+    kernel's small default blocks cost 5-6x: 1024-edge blocks cut a 16k
+    fwd+bwd from 199ms to 35ms — but need the scoped-VMEM limit raised
+    (LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536), so the
+    bigger blocks are opt-in via AREAL_TPU_SPLASH_BLOCK (bench.py sets
+    both). The block must divide the sequence length."""
+    want = int(os.environ.get("AREAL_TPU_SPLASH_BLOCK", "0"))
+    if want <= 0:
+        return 0
+    b = 1
+    while b * 2 <= min(want, t) and t % (b * 2) == 0:
+        b *= 2
+    return b if b >= 128 else 0
 
 
 @functools.lru_cache(maxsize=32)
@@ -40,6 +58,14 @@ def _make_kernel(t: int, rep: int, window: int):
         else:
             head = _sm.CausalMask((t, t))
         mask = _sm.MultiHeadMask([head for _ in range(rep)])
+        b = _block_size(t)
+        if b:
+            bs = _sk.BlockSizes(
+                block_q=b, block_kv=b, block_kv_compute=b,
+                block_q_dkv=b, block_kv_dkv=b, block_kv_dkv_compute=b,
+                block_q_dq=b, block_kv_dq=b,
+            )
+            return _sk.make_splash_mqa_single_device(mask, block_sizes=bs)
         return _sk.make_splash_mqa_single_device(mask)
 
 
