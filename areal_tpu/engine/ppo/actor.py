@@ -108,9 +108,11 @@ class PPOActor:
             ) * loss_mask
             kl_rewards = -kl_ctl * kl_est
             n_tok = max(1.0, float(loss_mask.sum()))
-            self.kl_controller.update(
-                float(kl_est.sum() / n_tok), int(loss_mask.sum())
-            )
+            # n_steps is the SEQUENCE count (reference
+            # realhf/impl/model/interface/ppo_interface.py:176), not the
+            # token count — with kl_horizon ~1e4 a token count would swing
+            # the adaptive coefficient by 5x+ per update
+            self.kl_controller.update(float(kl_est.sum() / n_tok), bsz)
         else:
             kl_rewards = np.zeros_like(loss_mask)
         tok_rewards = kl_rewards.copy()

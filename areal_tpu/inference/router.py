@@ -66,7 +66,10 @@ class RouterState:
         with self.lock:
             qid = str(meta.get("qid") or meta.get("rid") or "")
             prev = meta.get("previous_server")
-            if prev and int(meta.get("previous_version", -1)) == self.version:
+            if (
+                prev in self._requests
+                and int(meta.get("previous_version", -1)) == self.version
+            ):
                 # sticky while the version is unchanged (interruptible
                 # resubmits reuse the server's cached prefix)
                 return {"url": prev, "version": self.version}

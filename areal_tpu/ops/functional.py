@@ -305,5 +305,7 @@ class AdaptiveKLController:
 
     def update(self, current_kl: float, n_steps: int) -> None:
         error = min(max(current_kl / self.target - 1.0, -0.2), 0.2)
-        mult = 1.0 + error * n_steps / self.horizon
+        # floor the multiplier so a large n_steps (e.g. a caller passing
+        # token counts) can never flip the coefficient's sign
+        mult = max(1.0 + error * n_steps / self.horizon, 0.1)
         self.value *= mult

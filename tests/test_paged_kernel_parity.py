@@ -1,0 +1,113 @@
+"""CPU parity: the Pallas paged decode kernel (interpret mode) vs the jnp
+fallback — the kernel is the default single-device TPU serving path
+(``attn_impl='auto'``), so CI must catch kernel/jnp divergence.
+
+Covers ragged lengths, chunk buffers, and pack factors f=1 (head_dim 128)
+and f=2 (head_dim 64). Pool token layout: token t of a page lives in packed
+row t//f, lane group t%f (see ops/paged_attention.packed_pool_shape).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.ops.paged_attention import (
+    pack_factor,
+    packed_pool_shape,
+    paged_decode_attention,
+    paged_decode_attention_jnp,
+)
+
+
+def _build_case(rng, *, head_dim, hq, hkv, page_size, num_pages, lengths,
+                chunk_counts=None, chunk_t=8, dtype=jnp.float32):
+    s = len(lengths)
+    f = pack_factor(head_dim)
+    nl = 2
+    shape = packed_pool_shape(nl, hkv, num_pages, page_size, head_dim)
+    # fill pools token-wise so the packed layout is exercised for real:
+    # generate [L, Hkv, NP, BS, D] then fold token -> (row, lane group)
+    k_tok = rng.standard_normal((nl, hkv, num_pages, page_size, head_dim))
+    v_tok = rng.standard_normal((nl, hkv, num_pages, page_size, head_dim))
+    k_pages = jnp.asarray(k_tok.reshape(shape), dtype)
+    v_pages = jnp.asarray(v_tok.reshape(shape), dtype)
+    pps = max(-(-max(lengths) // page_size), 1) + 1
+    # distinct physical pages per (slot, window position)
+    perm = rng.permutation(num_pages)[: s * pps].reshape(s, pps)
+    tables = jnp.asarray(perm, jnp.int32)
+    q = jnp.asarray(rng.standard_normal((s, hq, head_dim)), dtype)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    kwargs = {}
+    if chunk_counts is not None:
+        kwargs["chunk_k"] = jnp.asarray(
+            rng.standard_normal((s, hkv, chunk_t, head_dim)), dtype
+        )
+        kwargs["chunk_v"] = jnp.asarray(
+            rng.standard_normal((s, hkv, chunk_t, head_dim)), dtype
+        )
+        kwargs["chunk_counts"] = jnp.asarray(chunk_counts, jnp.int32)
+    return q, k_pages, v_pages, lengths, tables, kwargs
+
+
+@pytest.mark.parametrize(
+    "head_dim,hq,hkv",
+    [(64, 4, 2), (128, 4, 4)],
+    ids=["f2_gqa", "f1_mha"],
+)
+@pytest.mark.parametrize("with_chunk", [False, True], ids=["pages", "chunk"])
+def test_kernel_matches_jnp(head_dim, hq, hkv, with_chunk):
+    rng = np.random.default_rng(42 + head_dim + with_chunk)
+    page_size = 16
+    lengths = [0, 1, 7, 16, 23, 37, 48, 5]  # ragged incl. empty + page-exact
+    chunk_counts = [3, 0, 8, 1, 5, 0, 2, 7] if with_chunk else None
+    q, kp, vp, lens, tables, kwargs = _build_case(
+        rng,
+        head_dim=head_dim,
+        hq=hq,
+        hkv=hkv,
+        page_size=page_size,
+        num_pages=64,
+        lengths=lengths,
+        chunk_counts=chunk_counts,
+    )
+    for layer in (0, 1):
+        got = paged_decode_attention(
+            q, kp, vp, jnp.int32(layer), lens, tables,
+            pages_per_compute_block=2, slots_per_block=4,
+            interpret=True, **kwargs,
+        )
+        want = paged_decode_attention_jnp(
+            q, kp, vp, jnp.int32(layer), lens, tables, **kwargs
+        )
+        # slots with nothing to attend to (len 0, no chunk) are undefined
+        # (engine never reads them) — compare only defined slots
+        defined = np.asarray(lens) > 0
+        if chunk_counts is not None:
+            defined |= np.asarray(chunk_counts) > 0
+        np.testing.assert_allclose(
+            np.asarray(got)[defined], np.asarray(want)[defined],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_kernel_matches_jnp_bf16_sb1():
+    """bf16 pools + slots_per_block that doesn't divide S (sb fallback)."""
+    rng = np.random.default_rng(7)
+    lengths = [9, 31, 2]
+    q, kp, vp, lens, tables, kwargs = _build_case(
+        rng, head_dim=64, hq=14, hkv=2, page_size=16, num_pages=32,
+        lengths=lengths, chunk_counts=[1, 0, 4], dtype=jnp.bfloat16,
+    )
+    got = paged_decode_attention(
+        q, kp, vp, jnp.int32(0), lens, tables,
+        pages_per_compute_block=2, slots_per_block=8,
+        interpret=True, **kwargs,
+    )
+    want = paged_decode_attention_jnp(
+        q, kp, vp, jnp.int32(0), lens, tables, **kwargs
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
