@@ -18,3 +18,17 @@ Layer map (mirrors reference areal/README.md:82-130, re-designed TPU-first):
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+# Raise the TPU scoped-VMEM limit before libtpu loads: the large splash
+# blocks (ops/flash.py) need 64 MiB of scoped VMEM and lose 5x throughput
+# at long context without it. Appending is a no-op if the backend already
+# initialized (ops/flash.probe_block_size verifies the effective limit by
+# actually compiling, so a late import degrades loudly, not silently).
+_VMEM_FLAG = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_FLAG.split("=")[0] not in _os.environ.get("LIBTPU_INIT_ARGS", ""):
+    _os.environ["LIBTPU_INIT_ARGS"] = (
+        _os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_FLAG
+    ).strip()
+del _os

@@ -20,6 +20,7 @@ Contracts:
 
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -33,8 +34,8 @@ from areal_tpu.api.engine_api import TrainEngine
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, WeightUpdateMeta
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import ModelConfig, load_hf_config
+from areal_tpu.models.forward import packed_forward
 from areal_tpu.models.transformer import (
-    apply as model_apply,
     count_params,
     init_params,
     param_logical_axes,
@@ -57,7 +58,11 @@ _DTYPES = {
 
 def _lr_schedule(cfg, total_steps: int) -> optax.Schedule:
     opt = cfg.optimizer
-    warmup = max(1, int(opt.warmup_steps_proportion * total_steps))
+    # proportion 0 means NO warmup: the first step must run at full lr
+    # (max(1, ...) here made step 0 a silent no-op update)
+    warmup = int(opt.warmup_steps_proportion * total_steps)
+    if opt.warmup_steps_proportion > 0:
+        warmup = max(1, warmup)
     end = opt.lr * opt.min_lr_ratio
     if opt.lr_scheduler_type == "cosine":
         main = optax.cosine_decay_schedule(
@@ -69,6 +74,8 @@ def _lr_schedule(cfg, total_steps: int) -> optax.Schedule:
         )
     else:
         main = optax.constant_schedule(opt.lr)
+    if warmup == 0:
+        return main
     return optax.join_schedules(
         [optax.linear_schedule(0.0, opt.lr, warmup), main], [warmup]
     )
@@ -161,6 +168,13 @@ class SPMDTrainEngine(TrainEngine):
             # of the params) — the ZeRO "shard optimizer state" property for
             # free.
             self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        if cfg.attn_impl == "flash" and jax.default_backend() != "cpu":
+            # probe the splash block edge once per process so the fast
+            # long-context path is the default, not an env-var opt-in
+            # (round-3 driver capture silently lost 5x on the opt-in)
+            from areal_tpu.ops import flash as flash_ops
+
+            self._splash_block = flash_ops.probe_block_size()
         n = count_params(self.params)
         logger.info(
             f"initialized {mc.family} model: {n/1e6:.1f}M params on mesh "
@@ -351,9 +365,8 @@ class SPMDTrainEngine(TrainEngine):
                 cparams = jax.tree_util.tree_map(
                     lambda p: p.astype(compute_dtype), params
                 )
-                logits, router_aux = model_apply(
-                    cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=remat, attend_fn=attend,
+                logits, router_aux = packed_forward(
+                    cparams, mc, arrays, remat=remat, attend_fn=attend,
                     return_router_loss=True,
                 )
                 loss, stats = loss_fn(logits, arrays)
@@ -424,29 +437,38 @@ class SPMDTrainEngine(TrainEngine):
         loss_weight_fn: Callable,
     ) -> Dict[str, float]:
         assert self.optimizer is not None, "no optimizer configured"
+        t_start = time.perf_counter()
         mbs = data_utils.split_padded_batch_into_mb_list(
             input_, self.config.mb_spec.max_tokens_per_mb,
             min_n_mbs=self.config.mb_spec.n_mbs,
         )
-        grad_fn = self._get_grad_fn(
-            loss_fn, loss_weight_fn, self._flash_window(input_)
-        )
+        window = self._flash_window(input_)
+        grad_fn = self._get_grad_fn(loss_fn, loss_weight_fn, window)
         grad_accum = self._zero_grads()
         pad_to = self._mb_pad_to(mbs.mbs)
         losses, weights, all_stats = [], [], []
+        pack_s, grad_call_s = 0.0, []
         for mb in mbs.mbs:
+            t0 = time.perf_counter()
             _, arrays = self._pack_for_device(mb, pad_to=pad_to)
+            t1 = time.perf_counter()
+            pack_s += t1 - t0
             grad_accum, loss, stats, w = grad_fn(self.params, grad_accum, arrays)
+            # wall time of the (async) dispatch: a multi-second outlier on
+            # one call = that call traced/compiled a fresh program
+            grad_call_s.append(round(time.perf_counter() - t1, 3))
             losses.append(loss)
             weights.append(w)
             all_stats.append(stats)
         total_w = functools.reduce(lambda a, b: a + b, weights)
         apply_fn = self._get_apply_fn()
+        t_apply = time.perf_counter()
         self.params, self.opt_state, grad_norm, ok = apply_fn(
             self.params, self.opt_state, grad_accum, total_w
         )
         lr = float(self.lr_schedule(self.step_count))  # lr applied this step
         self.step_count += 1
+        t_fetch = time.perf_counter()
         # ONE packed host fetch for every scalar this step produced — each
         # separate float() is a full device round-trip
         stat_keys = sorted(all_stats[0])
@@ -470,6 +492,19 @@ class SPMDTrainEngine(TrainEngine):
         }
         for j, k in enumerate(stat_keys):
             out[k] = float((h_stats[:, j] * h_weights).sum() / h_total_w)
+        t_end = time.perf_counter()
+        # diagnostics for bench/driver post-hoc analysis: where did this
+        # step's wall time go, and did any dispatch compile?
+        self.last_timing = {
+            "total_s": round(t_end - t_start, 3),
+            "pack_s": round(pack_s, 3),
+            "grad_dispatch_s": grad_call_s,
+            "apply_fetch_s": round(t_end - t_apply, 3),
+            "fetch_s": round(t_end - t_fetch, 3),
+            "n_mbs": n_mb,
+            "pad_to": pad_to,
+            "window": window,
+        }
         return out
 
     def eval_batch(
@@ -490,9 +525,8 @@ class SPMDTrainEngine(TrainEngine):
                 cparams = jax.tree_util.tree_map(
                     lambda p: p.astype(compute_dtype), params
                 )
-                logits = model_apply(
-                    cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=False, attend_fn=attend,
+                logits = packed_forward(
+                    cparams, mc, arrays, remat=False, attend_fn=attend,
                 )
                 loss, stats = loss_fn(logits, arrays)
                 return loss, stats, loss_weight_fn(arrays).astype(jnp.float32)
@@ -548,9 +582,8 @@ class SPMDTrainEngine(TrainEngine):
                 cparams = jax.tree_util.tree_map(
                     lambda p: p.astype(compute_dtype), params
                 )
-                logits = model_apply(
-                    cparams, mc, arrays["tokens"], arrays["segment_ids"],
-                    arrays["positions"], remat=False, attend_fn=attend,
+                logits = packed_forward(
+                    cparams, mc, arrays, remat=False, attend_fn=attend,
                 )
                 return hook(logits, arrays)
 

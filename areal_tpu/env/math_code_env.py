@@ -8,18 +8,46 @@ equivalence or code execution), and the episode is done.
 Query metadata decides the verifier per reset:
   {"task": "math", "answer": "..."}         → reward/math_parser
   {"task": "code", "tests": [...], ...}     → reward/code_verifier
+
+``verifier_addrs`` (or env AREAL_TPU_VERIFIER_ADDRS, comma-separated)
+routes verification to a remote pool (reward/verifier_service — the
+reference's FUNCTIONCALL_SERVICE_DOMAIN mode, functioncall/base/call.py:21)
+so interpreters never run on the trainer host.
 """
 
 import asyncio
-from typing import Any, Dict, Tuple
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 from areal_tpu.api.env_api import Env
 
+# dedicated executor for REMOTE verification waits: asyncio's default
+# executor caps at ~32 threads, which would bottleneck a large verifier
+# pool (each wait just blocks on HTTP, so threads are cheap)
+_REMOTE_POOL = ThreadPoolExecutor(max_workers=128, thread_name_prefix="verif")
+
 
 class MathCodeSingleStepEnv(Env):
-    def __init__(self, timeout_s: float = 15.0):
+    def __init__(
+        self,
+        timeout_s: float = 15.0,
+        verifier_addrs: Optional[Sequence[str]] = None,
+    ):
         self.timeout_s = timeout_s
         self._query: Dict[str, Any] = {}
+        addrs = verifier_addrs or [
+            a
+            for a in os.environ.get("AREAL_TPU_VERIFIER_ADDRS", "").split(",")
+            if a
+        ]
+        self._remote = None
+        if addrs:
+            from areal_tpu.reward.verifier_service import RemoteVerifier
+
+            # explicit remote mode: NEVER run interpreters on this host,
+            # even if the pool is down (score 0 + warning instead)
+            self._remote = RemoteVerifier(addrs, local_fallback=False)
 
     async def areset(self, **kwargs) -> Any:
         """kwargs = the query metadata (task, answer/tests, prompt...)."""
@@ -32,6 +60,26 @@ class MathCodeSingleStepEnv(Env):
         completion = str(action)
         task = self._query.get("task", "math")
         loop = asyncio.get_running_loop()
+        if self._remote is not None:
+            item = (
+                {
+                    "kind": "math",
+                    "completion": completion,
+                    "answer": str(self._query.get("answer", "")),
+                }
+                if task != "code"
+                else {
+                    "kind": "code",
+                    "completion": completion,
+                    "test_cases": self._query.get("test_cases"),
+                    "test_code": self._query.get("test_code"),
+                    "timeout": self.timeout_s,
+                }
+            )
+            reward = await loop.run_in_executor(
+                _REMOTE_POOL, lambda: self._remote.verify(item)
+            )
+            return None, float(reward), True, {"task": task}
         if task == "code":
             from areal_tpu.reward.code_verifier import code_reward_fn
 

@@ -1,0 +1,127 @@
+"""Offline code evaluation: extract candidate programs, execute against
+test cases, report pass@k.
+
+Role of the reference's evaluation/code_eval.py + python_executor.py +
+code_verifier/local_verify.py (the LiveCodeBench/codeforces instrument):
+completions carry fenced code blocks; the last syntactically-valid block is
+the candidate; it runs sandboxed against the problem's input/output or
+assert-style tests. Execution goes through reward/code_verifier (the SAME
+sandbox training rewards use) — locally, or via the remote verifier pool
+(reward/verifier_service) so eval never competes with a trainer host.
+"""
+
+import ast
+import re
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+_FENCE = re.compile(
+    r"(?i)```(?:python|py|cpp)?\s*\n?(.*?)\n?```", re.DOTALL
+)
+
+
+def extract_python_code(
+    text: str, min_length: int = 20, strict_syntax: bool = False
+) -> Optional[str]:
+    """Last fenced code block of at least ``min_length`` chars; with
+    ``strict_syntax`` blocks must parse as python (reference
+    code_eval.extract_python_code behavior: invalid blocks are skipped,
+    the LAST valid one wins)."""
+    valid = []
+    for block in _FENCE.findall(text):
+        code = block.strip()
+        if len(code) < min_length:
+            continue
+        if strict_syntax:
+            try:
+                ast.parse(code, mode="exec")
+            except (SyntaxError, IndentationError):
+                continue
+        valid.append(code)
+    return valid[-1] if valid else None
+
+
+def eval_code_completions(
+    items: Sequence[Dict[str, Any]],
+    completions: Sequence[Sequence[str]],
+    timeout: float = 10.0,
+    max_workers: int = 8,
+    verifier_addrs: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Score ``completions[i][j]`` (sample j for problem i) against
+    ``items[i]``'s tests; returns accuracy + pass@k + per-problem detail.
+
+    Each item carries ``test_cases`` (stdin/stdout dicts) and/or
+    ``test_code`` (assert block). ``verifier_addrs`` offloads execution to
+    a remote pool."""
+    import numpy as np
+
+    from areal_tpu.evaluation.eval_runner import _pass_at_k
+
+    remote = None
+    if verifier_addrs:
+        from areal_tpu.reward.verifier_service import RemoteVerifier
+
+        remote = RemoteVerifier(verifier_addrs)
+
+    def score_one(item: Dict[str, Any], completion: str) -> float:
+        # strict syntax: a trailing non-code fence must not shadow an
+        # earlier valid solution
+        code = extract_python_code(completion, strict_syntax=True) or (
+            completion if "def " in completion or "print(" in completion
+            else None
+        )
+        if code is None:
+            return 0.0
+        payload = {
+            "kind": "code",
+            "code": code,
+            "test_cases": item.get("test_cases"),
+            "test_code": item.get("test_code"),
+            "timeout": timeout,
+        }
+        if remote is not None:
+            return remote.verify(payload)
+        from areal_tpu.reward.code_verifier import verify_code
+
+        try:
+            return float(
+                verify_code(
+                    code,
+                    test_cases=item.get("test_cases"),
+                    test_code=item.get("test_code"),
+                    timeout=timeout,
+                )
+            )
+        except Exception:
+            return 0.0
+
+    jobs = [
+        (i, j, item, comp)
+        for i, (item, comps) in enumerate(zip(items, completions))
+        for j, comp in enumerate(comps)
+    ]
+    results: Dict[tuple, float] = {}
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futs = {
+            pool.submit(score_one, item, comp): (i, j)
+            for i, j, item, comp in jobs
+        }
+        for fut, (i, j) in futs.items():
+            results[(i, j)] = fut.result()
+
+    n_samples = max((len(c) for c in completions), default=0)
+    succ = np.zeros((len(items), n_samples))
+    for (i, j), r in results.items():
+        succ[i, j] = r > 0
+    return {
+        "n_problems": len(items),
+        "n_samples": n_samples,
+        "accuracy": float(succ.mean()) if succ.size else 0.0,
+        "pass_at_k": {
+            k: _pass_at_k(succ, k)
+            for k in (1, 2, 4, 8, 16)
+            if k <= n_samples
+        },
+        "per_problem": succ.tolist(),
+    }

@@ -179,6 +179,11 @@ def main(argv: Optional[List[str]] = None):
     p.add_argument("--max-new-tokens", type=int, default=1024)
     p.add_argument("--temperature", type=float, default=0.6)
     p.add_argument("--max-prompts", type=int, default=0)
+    p.add_argument(
+        "--verifier-addrs", default="",
+        help="remote verifier pool (reward/verifier_service) for code "
+        "execution off this host",
+    )
     p.add_argument("--out", default="")
     args = p.parse_args(argv)
 
@@ -196,9 +201,22 @@ def main(argv: Optional[List[str]] = None):
     if args.max_prompts:
         items = items[: args.max_prompts]
     if args.type == "code":
-        from areal_tpu.reward.code_verifier import code_reward_fn as reward
-    else:
+        if args.verifier_addrs:
+            from areal_tpu.reward.verifier_service import RemoteVerifier
+
+            reward = RemoteVerifier(
+                args.verifier_addrs.split(",")
+            ).code_reward_fn()
+        else:
+            from areal_tpu.reward.code_verifier import code_reward_fn as reward
+    elif args.type in ("gsm8k", "raw"):
         from areal_tpu.reward.math_parser import gsm8k_reward_fn as reward
+    else:
+        # dataset-aware math grading (math/math_500/minerva_math/mmlu_stem/
+        # sat_math/aqua/...: evaluation/math_eval.py conventions)
+        from areal_tpu.evaluation.math_eval import make_math_reward_fn
+
+        reward = make_math_reward_fn(args.type)
     engine = RemoteInferenceEngine(
         InferenceEngineConfig(experiment_name="eval", trial_name="offline")
     ).initialize(addrs=args.addrs.split(","))

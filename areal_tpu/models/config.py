@@ -9,7 +9,9 @@ embeddings, head_dim override, q/k norm) are fields, not subclasses.
 import dataclasses
 import json
 import os
-from typing import Optional
+from typing import Optional, Tuple
+
+from areal_tpu.models.vision import VisionConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +37,11 @@ class ModelConfig:
     norm_topk_prob: bool = True
     router_aux_loss_coef: float = 0.001
     moe_capacity_factor: float = 1.25
+    # --- VLM (vision tower + mrope; reference VLM path via HF Qwen2-VL,
+    # areal/engine/base_hf_engine.py pixel plumbing) ---
+    vision: Optional[VisionConfig] = None
+    mrope_sections: Optional[Tuple[int, ...]] = None
+    image_token_id: int = -1
 
     @property
     def q_dim(self) -> int:
@@ -58,7 +65,33 @@ class ModelConfig:
 # architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for
 # now. qwen2_moe (shared-expert variant) is rejected until shared experts
 # land; qwen3_moe/mixtral are the supported sparse families.
-_HF_FAMILIES = ("llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral")
+_HF_FAMILIES = (
+    "llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral", "qwen2_vl",
+)
+
+
+def _vision_from_hf(d: dict, lm_hidden: int) -> VisionConfig:
+    """Parse an HF qwen2_vl / qwen2_5_vl `vision_config` block. qwen2_vl
+    names the tower width `embed_dim` (with `hidden_size` = LM hidden);
+    qwen2_5_vl names it `hidden_size` (with `out_hidden_size`)."""
+    width = d.get("embed_dim") or d["hidden_size"]
+    out = d.get("out_hidden_size") or (
+        d.get("hidden_size") if d.get("embed_dim") else lm_hidden
+    )
+    inter = d.get("intermediate_size") or int(
+        width * d.get("mlp_ratio", 4)
+    )
+    return VisionConfig(
+        hidden_size=width,
+        depth=d.get("depth", 32),
+        num_heads=d.get("num_heads", 16),
+        intermediate_size=inter,
+        out_hidden_size=out,
+        patch_size=d.get("patch_size", 14),
+        temporal_patch_size=d.get("temporal_patch_size", 2),
+        spatial_merge_size=d.get("spatial_merge_size", 2),
+        in_channels=d.get("in_chans", d.get("in_channels", 3)),
+    )
 
 
 def from_hf_config(d: dict) -> ModelConfig:
@@ -71,6 +104,21 @@ def from_hf_config(d: dict) -> ModelConfig:
     hidden = d["hidden_size"]
     head_dim = d.get("head_dim") or hidden // num_heads
     num_experts = d.get("num_experts") or d.get("num_local_experts") or 0
+    vision = None
+    mrope_sections = None
+    image_token_id = -1
+    if model_type == "qwen2_vl":
+        vision = _vision_from_hf(d["vision_config"], hidden)
+        rs = d.get("rope_scaling") or {}
+        if rs.get("mrope_section"):
+            mrope_sections = tuple(rs["mrope_section"])
+        else:
+            # fallback must partition head_dim//2 EXACTLY; the HF default
+            # ratio is 1:1.5:1.5 ((16,24,24) for head_dim 128)
+            half = head_dim // 2
+            s = (half * 3) // 8
+            mrope_sections = (half - 2 * s, s, s)
+        image_token_id = d.get("image_token_id", 151655)
     return ModelConfig(
         vocab_size=d["vocab_size"],
         hidden_size=hidden,
@@ -83,9 +131,14 @@ def from_hf_config(d: dict) -> ModelConfig:
         rope_theta=d.get("rope_theta", 10000.0),
         rms_norm_eps=d.get("rms_norm_eps", 1e-6),
         tie_word_embeddings=d.get("tie_word_embeddings", False),
-        attention_bias=d.get("attention_bias", model_type == "qwen2"),
+        attention_bias=d.get(
+            "attention_bias", model_type in ("qwen2", "qwen2_vl")
+        ),
         use_qk_norm=(model_type in ("qwen3", "qwen3_moe")),
         family=model_type,
+        vision=vision,
+        mrope_sections=mrope_sections,
+        image_token_id=image_token_id,
         num_experts=num_experts,
         num_experts_per_tok=d.get(
             "num_experts_per_tok", d.get("top_k", 2)
@@ -102,6 +155,27 @@ def from_hf_config(d: dict) -> ModelConfig:
 def load_hf_config(path: str) -> ModelConfig:
     with open(os.path.join(path, "config.json")) as f:
         return from_hf_config(json.load(f))
+
+
+def tiny_vlm_config(vocab_size: int = 128) -> ModelConfig:
+    """Small qwen2_vl-shaped config for tests: 2-layer LM (head_dim 16,
+    mrope sections 4/2/2) over a 2-block vision tower (4px patches)."""
+    return dataclasses.replace(
+        tiny_config("qwen2", vocab_size=vocab_size),
+        family="qwen2_vl",
+        vision=VisionConfig(
+            hidden_size=32,
+            depth=2,
+            num_heads=2,
+            intermediate_size=64,
+            out_hidden_size=64,
+            patch_size=4,
+            temporal_patch_size=2,
+            spatial_merge_size=2,
+        ),
+        mrope_sections=(4, 2, 2),
+        image_token_id=vocab_size - 2,
+    )
 
 
 def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:

@@ -39,6 +39,33 @@ _LAYER_MAP = {
     "w_down": ("mlp.down_proj.weight", True),
 }
 
+# Vision tower (qwen2_vl): per-block weights stack along a leading depth
+# axis; the HF fused qkv Linear stays fused in our pytree.
+_VISION_BLOCK_MAP = {
+    # our key -> (hf suffix under visual.blocks.{i}., transpose?)
+    "norm1_w": ("norm1.weight", False),
+    "norm1_b": ("norm1.bias", False),
+    "norm2_w": ("norm2.weight", False),
+    "norm2_b": ("norm2.bias", False),
+    "wqkv": ("attn.qkv.weight", True),
+    "bqkv": ("attn.qkv.bias", False),
+    "wo": ("attn.proj.weight", True),
+    "bo": ("attn.proj.bias", False),
+    "w_fc1": ("mlp.fc1.weight", True),
+    "b_fc1": ("mlp.fc1.bias", False),
+    "w_fc2": ("mlp.fc2.weight", True),
+    "b_fc2": ("mlp.fc2.bias", False),
+}
+_VISION_TOP_MAP = {
+    # our key -> (hf name under visual., transpose?)
+    "ln_q_w": ("merger.ln_q.weight", False),
+    "ln_q_b": ("merger.ln_q.bias", False),
+    "w_merge1": ("merger.mlp.0.weight", True),
+    "b_merge1": ("merger.mlp.0.bias", False),
+    "w_merge2": ("merger.mlp.2.weight", True),
+    "b_merge2": ("merger.mlp.2.bias", False),
+}
+
 # MoE families: per-expert FFN weights stack along a leading expert axis.
 # our key -> (hf suffix template with {e}, transpose?)
 _MOE_MAPS = {
@@ -141,6 +168,28 @@ def load_params(
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(g("lm_head.weight").T, dtype=dtype)
+    if cfg.vision is not None:
+        vc = cfg.vision
+        blocks: Dict[str, np.ndarray] = {}
+        for our_key, (suffix, transpose) in _VISION_BLOCK_MAP.items():
+            if f"visual.blocks.0.{suffix}" not in reader:
+                continue
+            per = [g(f"visual.blocks.{i}.{suffix}") for i in range(vc.depth)]
+            blocks[our_key] = jnp.asarray(
+                np.stack([w.T if transpose else w for w in per]), dtype=dtype
+            )
+        vis: Params = {"blocks": blocks}
+        # conv3d patch embed [H, C, T, P, P] -> flattened linear [Dp, H]
+        pw = g("visual.patch_embed.proj.weight")
+        vis["patch_embed"] = jnp.asarray(
+            pw.reshape(pw.shape[0], -1).T, dtype=dtype
+        )
+        for our_key, (name, transpose) in _VISION_TOP_MAP.items():
+            if f"visual.{name}" not in reader:
+                continue
+            w = g(f"visual.{name}")
+            vis[our_key] = jnp.asarray(w.T if transpose else w, dtype=dtype)
+        params["vision"] = vis
     return params
 
 
@@ -191,6 +240,30 @@ def save_params(
                     tensors[f"model.layers.{i}.{tmpl}"] = (
                         w.T.copy() if transpose else w.copy()
                     )
+    if cfg.vision is not None and "vision" in params:
+        vc = cfg.vision
+        vis = params["vision"]
+        pw = as_np32(vis["patch_embed"]).T  # [H, Dp]
+        tensors["visual.patch_embed.proj.weight"] = np.ascontiguousarray(
+            pw.reshape(
+                vc.hidden_size, vc.in_channels, vc.temporal_patch_size,
+                vc.patch_size, vc.patch_size,
+            )
+        )
+        for our_key, (suffix, transpose) in _VISION_BLOCK_MAP.items():
+            if our_key not in vis["blocks"]:
+                continue
+            stacked = as_np32(vis["blocks"][our_key])
+            for i in range(vc.depth):
+                w = stacked[i]
+                tensors[f"visual.blocks.{i}.{suffix}"] = (
+                    w.T.copy() if transpose else w.copy()
+                )
+        for our_key, (name, transpose) in _VISION_TOP_MAP.items():
+            if our_key not in vis:
+                continue
+            w = as_np32(vis[our_key])
+            tensors[f"visual.{name}"] = w.T.copy() if transpose else w.copy()
     save_file(tensors, os.path.join(path, "model.safetensors"))
     if hf_config_dict is None:
         hf_config_dict = default_hf_config_dict(cfg)
@@ -221,7 +294,30 @@ def default_hf_config_dict(cfg: ModelConfig) -> dict:
             "mistral": ["MistralForCausalLM"],
             "qwen3_moe": ["Qwen3MoeForCausalLM"],
             "mixtral": ["MixtralForCausalLM"],
+            "qwen2_vl": ["Qwen2VLForConditionalGeneration"],
         }.get(cfg.family, ["LlamaForCausalLM"]),
+        **(
+            {
+                "vision_config": {
+                    "embed_dim": cfg.vision.hidden_size,
+                    "hidden_size": cfg.vision.out_hidden_size,
+                    "depth": cfg.vision.depth,
+                    "num_heads": cfg.vision.num_heads,
+                    "intermediate_size": cfg.vision.intermediate_size,
+                    "patch_size": cfg.vision.patch_size,
+                    "temporal_patch_size": cfg.vision.temporal_patch_size,
+                    "spatial_merge_size": cfg.vision.spatial_merge_size,
+                    "in_chans": cfg.vision.in_channels,
+                },
+                "rope_scaling": {
+                    "type": "mrope",
+                    "mrope_section": list(cfg.mrope_sections or ()),
+                },
+                "image_token_id": cfg.image_token_id,
+            }
+            if cfg.vision is not None
+            else {}
+        ),
         **(
             {
                 "num_experts": cfg.num_experts,

@@ -25,6 +25,7 @@ import numpy as np
 
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.basic import (
+    apply_mrope,
     apply_rope,
     rms_norm,
     rope_frequencies,
@@ -91,6 +92,12 @@ def init_params(
         params["lm_head"] = nrm(
             jax.random.fold_in(rng, 99), (D, cfg.vocab_size), std
         )
+    if cfg.vision is not None:
+        from areal_tpu.models import vision as vision_lib
+
+        params["vision"] = vision_lib.init_vision_params(
+            cfg.vision, jax.random.fold_in(rng, 7), dtype=dtype
+        )
     return params
 
 
@@ -134,6 +141,10 @@ def param_logical_axes(cfg: ModelConfig, value_head: bool = False) -> Params:
         axes["value_head"] = ("embed", None)
     elif not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+    if cfg.vision is not None:
+        from areal_tpu.models import vision as vision_lib
+
+        axes["vision"] = vision_lib.vision_logical_axes(cfg.vision)
     return axes
 
 
@@ -165,8 +176,12 @@ def _layer_body(
     if cfg.use_qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    q = apply_rope(q, positions, cos, sin)
-    k = apply_rope(k, positions, cos, sin)
+    if positions.ndim == 3:  # [B, T, 3] multimodal (t, h, w) positions
+        q = apply_mrope(q, positions, cos, sin, cfg.mrope_sections)
+        k = apply_mrope(k, positions, cos, sin, cfg.mrope_sections)
+    else:
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
     if attend_fn is None:
         attn = segment_attention(q, k, v, segment_ids, causal=True)
     else:  # explicit SP kernel (ring / ulysses shard_map)
@@ -188,10 +203,12 @@ def apply(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, T] int32
     segment_ids: jnp.ndarray,  # [B, T] int32; 0 = padding
-    positions: jnp.ndarray,  # [B, T] int32; restart per sequence
+    positions: jnp.ndarray,  # [B, T] int32 (or [B, T, 3] mrope)
     remat: bool = True,
     attend_fn: Optional[Any] = None,
     return_router_loss: bool = False,
+    mm_embeds: Optional[jnp.ndarray] = None,  # [B, N, D] vision embeds
+    mm_index: Optional[jnp.ndarray] = None,  # [B, T] int32; -1 = text
 ):
     """Forward to logits [B, T, vocab] (fp32); with
     ``return_router_loss=True`` returns (logits, mean per-layer MoE
@@ -200,11 +217,23 @@ def apply(
     `attend_fn(q, k, v, segment_ids)` overrides the attention kernel (e.g.
     ring / Ulysses shard_map from ops/ring_attention.py); default is the
     XLA segment-masked kernel with GSPMD-propagated sharding.
+
+    ``mm_embeds``/``mm_index`` splice vision embeds into the token stream:
+    position t takes mm_embeds[b, mm_index[b, t]] when mm_index >= 0
+    (image-pad tokens), else its text embedding — differentiable through
+    the vision tower (reference: HF VLM inputs_embeds masked-scatter).
     """
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     x = params["embedding"][tokens]
+    if mm_embeds is not None and mm_index is not None:
+        gathered = jnp.take_along_axis(
+            mm_embeds,
+            jnp.clip(mm_index, 0)[..., None].astype(jnp.int32),
+            axis=1,
+        ).astype(x.dtype)
+        x = jnp.where(mm_index[..., None] >= 0, gathered, x)
 
     def body(carry, lp):
         out, aux = _layer_body(

@@ -1,0 +1,371 @@
+"""Qwen2-VL-style vision tower + multimodal plumbing, TPU-first.
+
+Role of the reference's VLM path (areal/workflow/vision_rlvr.py feeding HF
+Qwen2-VL through areal/engine/base_hf_engine.py's pixel/position plumbing,
+and the Ulysses image-embed patch areal/models/transformers/ulyssess_patch.py:103):
+a vision transformer encodes image patches, a 2x2 spatial merger projects
+them into the LM's hidden space, and the LM consumes them at image-token
+positions with 3D "mrope" (temporal/height/width) rotary positions.
+
+TPU-first redesign, not a torch translation:
+
+- The tower is a functional pytree with per-block weights **stacked on a
+  leading depth axis** traversed by `lax.scan` — one compiled block body
+  regardless of depth, same as the text stack (models/transformer.py).
+- Patches of ALL images in a sequence run as ONE packed stream with
+  per-image segment ids; cross-image isolation is the same segment-mask
+  formulation the text stack uses for packed varlen attention
+  (full/bidirectional within an image, nothing across images). No python
+  loop over images, no dynamic shapes.
+- All ragged bookkeeping (patch positions, merge grouping, mrope position
+  ids, image-token ordinals) is computed **on host in numpy** at data-prep
+  time and shipped as static-shaped integer arrays; the jitted graph only
+  gathers.
+
+Weight-layout parity targets HF `Qwen2VLForConditionalGeneration`
+(LayerNorm + QuickGELU blocks, fused qkv, head_dim//2 rotary over
+height/width): checkpoints round-trip through models/hf_io.py. The
+HF processor's patch ordering (each spatial_merge_size^2 block of patches
+contiguous) is preserved, so spatial merging is a plain reshape.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.ops.basic import rms_norm, segment_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    hidden_size: int
+    depth: int
+    num_heads: int
+    intermediate_size: int
+    out_hidden_size: int  # the LM hidden size the merger projects into
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    in_channels: int = 3
+    norm_type: str = "layer"  # "layer" (qwen2_vl) | "rms"
+    act: str = "quick_gelu"  # "quick_gelu" (qwen2_vl) | "silu"
+    rope_theta: float = 10000.0
+    eps: float = 1e-6
+
+    @property
+    def patch_dim(self) -> int:
+        return (
+            self.in_channels
+            * self.temporal_patch_size
+            * self.patch_size
+            * self.patch_size
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def merge_factor(self) -> int:
+        return self.spatial_merge_size * self.spatial_merge_size
+
+
+# --------------------------------------------------------------------------
+# Init / sharding
+# --------------------------------------------------------------------------
+def init_vision_params(
+    cfg: VisionConfig, rng: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    L, H, M = cfg.depth, cfg.hidden_size, cfg.intermediate_size
+    keys = jax.random.split(rng, 8)
+    std = 0.02
+
+    def nrm(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            dtype
+        )
+
+    blocks = {
+        "norm1_w": jnp.ones((L, H), dtype),
+        "norm2_w": jnp.ones((L, H), dtype),
+        "wqkv": nrm(keys[0], (L, H, 3 * H)),
+        "bqkv": jnp.zeros((L, 3 * H), dtype),
+        "wo": nrm(keys[1], (L, H, H)),
+        "bo": jnp.zeros((L, H), dtype),
+        "w_fc1": nrm(keys[2], (L, H, M)),
+        "b_fc1": jnp.zeros((L, M), dtype),
+        "w_fc2": nrm(keys[3], (L, M, H)),
+        "b_fc2": jnp.zeros((L, H), dtype),
+    }
+    if cfg.norm_type == "layer":
+        blocks["norm1_b"] = jnp.zeros((L, H), dtype)
+        blocks["norm2_b"] = jnp.zeros((L, H), dtype)
+    m2 = cfg.merge_factor
+    params: Params = {
+        "patch_embed": nrm(keys[4], (cfg.patch_dim, H)),
+        "blocks": blocks,
+        "ln_q_w": jnp.ones((H,), dtype),
+        "w_merge1": nrm(keys[5], (m2 * H, m2 * H)),
+        "b_merge1": jnp.zeros((m2 * H,), dtype),
+        "w_merge2": nrm(keys[6], (m2 * H, cfg.out_hidden_size)),
+        "b_merge2": jnp.zeros((cfg.out_hidden_size,), dtype),
+    }
+    if cfg.norm_type == "layer":
+        params["ln_q_b"] = jnp.zeros((H,), dtype)
+    return params
+
+
+def vision_logical_axes(cfg: VisionConfig) -> Params:
+    blocks = {
+        "norm1_w": ("layer", None),
+        "norm2_w": ("layer", None),
+        "wqkv": ("layer", "embed", "heads"),
+        "bqkv": ("layer", "heads"),
+        "wo": ("layer", "heads", "embed"),
+        "bo": ("layer", None),
+        "w_fc1": ("layer", "embed", "mlp"),
+        "b_fc1": ("layer", "mlp"),
+        "w_fc2": ("layer", "mlp", "embed"),
+        "b_fc2": ("layer", None),
+    }
+    if cfg.norm_type == "layer":
+        blocks["norm1_b"] = ("layer", None)
+        blocks["norm2_b"] = ("layer", None)
+    axes: Params = {
+        "patch_embed": (None, "embed"),
+        "blocks": blocks,
+        "ln_q_w": (None,),
+        "w_merge1": ("embed", "mlp"),
+        "b_merge1": ("mlp",),
+        "w_merge2": ("mlp", "embed"),
+        "b_merge2": (None,),
+    }
+    if cfg.norm_type == "layer":
+        axes["ln_q_b"] = (None,)
+    return axes
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+def _norm(x, w, b, norm_type: str, eps: float):
+    if norm_type == "rms":
+        return rms_norm(x, w, eps)
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps)
+    out = out * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return out.astype(dt)
+
+
+def _act(x, act: str):
+    if act == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    if act == "silu":
+        return jax.nn.silu(x)
+    # exact (erf) gelu: HF's merger uses nn.GELU(); jax's default tanh
+    # approximation would drift every merged embed vs HF checkpoints
+    return jax.nn.gelu(x, approximate=False)
+
+
+def _vision_rope(x, pos_h, pos_w, cos_t, sin_t):
+    """Rotate [B, N, Hh, D] by 2D patch positions: the first D/4 rotary
+    frequencies index by height, the next D/4 by width (HF
+    Qwen2VL VisionRotaryEmbedding layout, rotate-half pairing)."""
+    dtype = x.dtype
+    c = jnp.concatenate(
+        [cos_t[pos_h], cos_t[pos_w]], axis=-1
+    ).astype(jnp.float32)[..., None, :]  # [B, N, 1, D/2]
+    s = jnp.concatenate(
+        [sin_t[pos_h], sin_t[pos_w]], axis=-1
+    ).astype(jnp.float32)[..., None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def vision_apply(
+    params: Params,
+    cfg: VisionConfig,
+    pixels: jnp.ndarray,  # [B, N, patch_dim] — HF-processor patch vectors
+    seg: jnp.ndarray,  # [B, N] int32 per-image segment ids; 0 = padding
+    pos_h: jnp.ndarray,  # [B, N] int32 patch row within its image
+    pos_w: jnp.ndarray,  # [B, N] int32 patch column within its image
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Encode packed patch streams to merged LM-space embeds
+    [B, N // merge_factor, out_hidden_size]. Padding patches (seg 0)
+    produce zero embeds."""
+    b, n, _ = pixels.shape
+    hh, hd = cfg.num_heads, cfg.head_dim
+    x = pixels.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+    # rotary tables over head_dim//4 frequencies (h and w each take half
+    # of the head_dim//2 rotary channels)
+    quarter = hd // 4
+    inv = 1.0 / (
+        cfg.rope_theta
+        ** (jnp.arange(0, quarter, dtype=jnp.float32) / quarter)
+    )
+    max_pos = 4096  # patches per image side bound (14px patches: 57k px)
+    t = jnp.arange(max_pos, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    cos_t, sin_t = jnp.cos(freqs), jnp.sin(freqs)
+
+    def body(carry, lp):
+        h = _norm(
+            carry, lp["norm1_w"], lp.get("norm1_b"), cfg.norm_type, cfg.eps
+        )
+        qkv = h @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, n, hh, hd)
+        k = k.reshape(b, n, hh, hd)
+        v = v.reshape(b, n, hh, hd)
+        q = _vision_rope(q, pos_h, pos_w, cos_t, sin_t)
+        k = _vision_rope(k, pos_h, pos_w, cos_t, sin_t)
+        # full (bidirectional) attention within each image, none across —
+        # the packed-stream formulation with causal=False
+        attn = segment_attention(q, k, v, seg, causal=False)
+        carry = carry + attn.reshape(b, n, cfg.hidden_size) @ lp["wo"] + lp["bo"]
+        h = _norm(
+            carry, lp["norm2_w"], lp.get("norm2_b"), cfg.norm_type, cfg.eps
+        )
+        ffn = _act(h @ lp["w_fc1"] + lp["b_fc1"], cfg.act)
+        carry = carry + ffn @ lp["w_fc2"] + lp["b_fc2"]
+        return carry, ()
+
+    if remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _norm(
+        x, params["ln_q_w"], params.get("ln_q_b"), cfg.norm_type, cfg.eps
+    )
+    m2 = cfg.merge_factor
+    merged = x.reshape(b, n // m2, m2 * cfg.hidden_size)
+    merged = _act(merged @ params["w_merge1"] + params["b_merge1"], "gelu")
+    merged = merged @ params["w_merge2"] + params["b_merge2"]
+    # zero padded groups (the HF patch order keeps merge groups within one
+    # image, so a group's validity is its first patch's segment id)
+    valid = seg.reshape(b, n // m2, m2)[:, :, 0] > 0
+    return jnp.where(valid[..., None], merged, 0.0)
+
+
+# --------------------------------------------------------------------------
+# Host-side meta builders (numpy — data-prep time, never traced)
+# --------------------------------------------------------------------------
+def build_patch_meta(
+    grid_thw: Sequence[Sequence[int]],
+    max_patches: int,
+    merge: int = 2,
+) -> Dict[str, np.ndarray]:
+    """Per-sequence patch bookkeeping for ``vision_apply``.
+
+    ``grid_thw`` lists each image's (temporal, height, width) patch grid
+    (HF processor convention). Patch order matches the HF processor: every
+    ``merge x merge`` spatial block contiguous, blocks in (t, h-block,
+    w-block) raster order. Returns vis_seg / vis_pos_h / vis_pos_w, each
+    [max_patches] int32 (zero-padded).
+    """
+    segs, hs, ws = [], [], []
+    for img_idx, (t, h, w) in enumerate(grid_thw):
+        hb, wb = h // merge, w // merge
+        for tt in range(t):
+            for hi in range(hb):
+                for wi in range(wb):
+                    for mi in range(merge):
+                        for mj in range(merge):
+                            segs.append(img_idx + 1)
+                            hs.append(hi * merge + mi)
+                            ws.append(wi * merge + mj)
+    n = len(segs)
+    if n > max_patches:
+        raise ValueError(f"{n} patches > budget {max_patches}")
+    out = {
+        "vis_seg": np.zeros(max_patches, np.int32),
+        "vis_pos_h": np.zeros(max_patches, np.int32),
+        "vis_pos_w": np.zeros(max_patches, np.int32),
+    }
+    out["vis_seg"][:n] = segs
+    out["vis_pos_h"][:n] = hs
+    out["vis_pos_w"][:n] = ws
+    return out
+
+
+def mrope_positions(
+    input_ids: Sequence[int],
+    image_token_id: int,
+    grid_thw: Sequence[Sequence[int]],
+    merge: int = 2,
+) -> np.ndarray:
+    """3D (t, h, w) rotary position ids, [L, 3] int32 — the HF
+    `get_rope_index` scheme: text advances all three dims together; an
+    image block spans (t, h/merge, w/merge) index space starting at the
+    running offset; the next text position resumes after the block's max.
+    """
+    ids = np.asarray(input_ids)
+    L = len(ids)
+    pos = np.zeros((L, 3), np.int32)
+    nxt = 0
+    img_i = 0
+    i = 0
+    while i < L:
+        if ids[i] == image_token_id and img_i < len(grid_thw):
+            t, h, w = grid_thw[img_i]
+            hb, wb = h // merge, w // merge
+            n_tok = t * hb * wb
+            ti = np.repeat(np.arange(t), hb * wb)
+            hi = np.tile(np.repeat(np.arange(hb), wb), t)
+            wi = np.tile(np.arange(wb), t * hb)
+            pos[i : i + n_tok, 0] = nxt + ti
+            pos[i : i + n_tok, 1] = nxt + hi
+            pos[i : i + n_tok, 2] = nxt + wi
+            nxt = nxt + max(t, hb, wb)
+            img_i += 1
+            i += n_tok
+        else:
+            pos[i] = nxt
+            nxt += 1
+            i += 1
+    return pos
+
+
+def build_mm_rows(
+    prompt_ids: Sequence[int],
+    output_len: int,
+    image_token_id: int,
+    grid_thw: Sequence[Sequence[int]],
+    merge: int = 2,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(mrope_pos [L, 3], mm_index [L]) for a prompt + text completion:
+    completion tokens are text, continuing from the prompt's max position
+    (the HF convention: generation resumes at max(position) + 1)."""
+    plen = len(prompt_ids)
+    L = plen + output_len
+    pos = np.zeros((L, 3), np.int32)
+    idx = np.full(L, -1, np.int32)
+    ppos = mrope_positions(prompt_ids, image_token_id, grid_thw, merge)
+    pos[:plen] = ppos
+    nxt = int(ppos.max()) + 1 if plen else 0
+    pos[plen:] = (nxt + np.arange(output_len, dtype=np.int32))[:, None]
+    idx[:plen] = mm_token_index(prompt_ids, image_token_id)
+    return pos, idx
+
+
+def mm_token_index(
+    input_ids: Sequence[int], image_token_id: int
+) -> np.ndarray:
+    """Per-token ordinal among the sequence's image tokens (−1 for text),
+    [L] int32 — the gather index (scaled by the per-sequence merged-patch
+    budget at model time) that scatters merged vision embeds into the
+    token stream."""
+    ids = np.asarray(input_ids)
+    is_img = ids == image_token_id
+    idx = np.where(is_img, np.cumsum(is_img) - 1, -1)
+    return idx.astype(np.int32)

@@ -11,6 +11,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -46,6 +47,33 @@ def apply_rope(
     dtype = x.dtype
     c = cos[positions].astype(jnp.float32)[..., None, :]  # [..., T, 1, D/2]
     s = sin[positions].astype(jnp.float32)[..., None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def apply_mrope(
+    x: jnp.ndarray,  # [B, T, H, D]
+    positions3: jnp.ndarray,  # [B, T, 3] int32 (t, h, w) position ids
+    cos: jnp.ndarray,  # [max_len, D/2]
+    sin: jnp.ndarray,
+    sections: Tuple[int, ...],  # rotary channels per dim; sums to D/2
+) -> jnp.ndarray:
+    """Multimodal 3D rotary (Qwen2-VL "mrope"): rotary channel j uses the
+    temporal/height/width position stream its section assigns it (HF
+    `apply_multimodal_rotary_pos_emb` layout, rotate-half pairing). For
+    text-only tokens all three streams are equal and this reduces exactly
+    to `apply_rope`."""
+    dtype = x.dtype
+    sec = np.repeat(np.arange(len(sections)), sections)
+    onehot = jnp.asarray(
+        sec[None, :] == np.arange(len(sections))[:, None], jnp.float32
+    )  # [3, D/2]
+    c3 = cos[positions3].astype(jnp.float32)  # [B, T, 3, D/2]
+    s3 = sin[positions3].astype(jnp.float32)
+    c = jnp.einsum("btsd,sd->btd", c3, onehot)[..., None, :]
+    s = jnp.einsum("btsd,sd->btd", s3, onehot)[..., None, :]
     x = x.astype(jnp.float32)
     x1, x2 = jnp.split(x, 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)

@@ -25,15 +25,77 @@ from jax.experimental.pallas.ops.tpu.splash_attention import (
 )
 
 
+# Probed-safe splash block edge: None = not probed yet, 0 = big blocks
+# unavailable (scoped-VMEM limit not raised), else the largest edge that
+# compiled AND ran on this process's TPU backend.
+_PROBED_BLOCK: "int | None" = None
+
+
+def probe_block_size(max_block: int = 1024, probe_t: int = 2048) -> int:
+    """Find the largest splash block edge this backend can actually run.
+
+    Per-grid-step overhead dominates this stack's pallas kernels (~50us/step
+    measured), so at long contexts the kernel's small default blocks cost
+    5-6x: 1024-edge blocks cut a 16k fwd+bwd from 199ms to 35ms — but they
+    need the scoped-VMEM limit raised
+    (LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536, appended by
+    ``areal_tpu/__init__`` when it runs before jax backend init). Round 3
+    gated the big blocks behind an env var, which made the fast path silently
+    environment-dependent (the round-3 driver capture lost 5x on it); now the
+    choice is PROBED: compile+run a small fwd+bwd at each candidate edge and
+    keep the largest that works. Result is cached process-wide; call once
+    from engine init (TPU backends only — never inside a trace).
+    """
+    global _PROBED_BLOCK
+    if _PROBED_BLOCK is not None:
+        return _PROBED_BLOCK
+    override = os.environ.get("AREAL_TPU_SPLASH_BLOCK", "")
+    if override:
+        _PROBED_BLOCK = int(override)
+        return _PROBED_BLOCK
+    if jax.default_backend() == "cpu":
+        _PROBED_BLOCK = 0
+        return 0
+    import logging
+
+    log = logging.getLogger("areal_tpu.flash")
+    q = jnp.ones((1, probe_t, 4, 128), jnp.bfloat16)
+    k = jnp.ones((1, probe_t, 1, 128), jnp.bfloat16)
+    seg = jnp.ones((1, probe_t), jnp.int32)
+    b = max_block
+    while b >= 128:
+        prev, _PROBED_BLOCK = _PROBED_BLOCK, b
+        try:
+            out = jax.grad(
+                lambda q_: flash_segment_attention(q_, k, k, seg).sum()
+            )(q)
+            jax.block_until_ready(out)
+            # force a real fetch: block_until_ready can return early on
+            # queued-but-failed async work over the tunnel
+            float(jnp.asarray(out).sum())
+            log.info("splash block edge probed: %d", b)
+            return b
+        except Exception as e:  # noqa: BLE001 — mosaic raises various types
+            log.warning(
+                "splash block %d unavailable (%s: %s) — trying smaller",
+                b, type(e).__name__, str(e)[:200],
+            )
+            _PROBED_BLOCK = prev
+            _make_kernel.cache_clear()
+            b //= 2
+    _PROBED_BLOCK = 0
+    log.warning(
+        "large splash blocks unavailable — falling back to kernel defaults "
+        "(long-context attention will be ~5x slower; check "
+        "LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib forwarding)"
+    )
+    return 0
+
+
 def _block_size(t: int) -> int:
-    """Splash grid-block edge. Per-grid-step overhead dominates this
-    stack's pallas kernels (~50us/step measured), so at long contexts the
-    kernel's small default blocks cost 5-6x: 1024-edge blocks cut a 16k
-    fwd+bwd from 199ms to 35ms — but need the scoped-VMEM limit raised
-    (LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536), so the
-    bigger blocks are opt-in via AREAL_TPU_SPLASH_BLOCK (bench.py sets
-    both). The block must divide the sequence length."""
-    want = int(os.environ.get("AREAL_TPU_SPLASH_BLOCK", "0"))
+    """Largest probed-safe block edge that divides the sequence length
+    (>=128, else 0 = kernel defaults)."""
+    want = _PROBED_BLOCK or 0
     if want <= 0:
         return 0
     b = 1

@@ -1,0 +1,267 @@
+"""Remote verifier service: reward verification off the trainer host.
+
+Role of the reference's functioncall service (functioncall/base/call.py:21-24
+— `FUNCTIONCALL_SERVICE_DOMAIN` routes batched code/math verification to an
+HTTP pool so reward execution never competes with training for the host's
+CPUs): code RLVR spawns one interpreter per sample, and at 512 prompts x 16
+samples a local-subprocess verifier starves rollout. This module provides
+
+- ``serve_verifier`` / ``python -m areal_tpu.reward.verifier_service``:
+  a threaded HTTP service (kv_server plumbing style) exposing
+      POST /verify_code {code|completion, test_cases?, test_code?, timeout?}
+      POST /verify_math {completion, answer}
+      POST /batch      {items: [one of the above + kind]}
+      GET  /health
+  Each request runs through the same sandboxed verifiers training uses
+  (reward/code_verifier, reward/math_parser), bounded by a worker
+  semaphore so a burst cannot fork-bomb the verifier host.
+
+- ``RemoteVerifier``: round-robin client with retry and (optional) local
+  fallback, plus reward-fn factories with the workflow signature.
+
+The reward functions stay pure functions of (prompt, completion, meta) —
+swapping local for remote verification changes no training code
+(env/math_code_env.py and the RLVR workflows accept either).
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence
+
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("verifier_service")
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+def _verify_one(item: Dict[str, Any]) -> Dict[str, Any]:
+    kind = item.get("kind") or ("math" if "answer" in item else "code")
+    try:
+        if kind == "math":
+            from areal_tpu.reward.math_parser import process_results
+
+            reward = process_results(
+                str(item.get("completion", "")), str(item.get("answer", ""))
+            )
+        else:
+            from areal_tpu.reward.code_verifier import (
+                code_reward_fn,
+                verify_code,
+            )
+
+            if "code" in item:  # pre-extracted code
+                reward = float(
+                    verify_code(
+                        str(item["code"]),
+                        test_cases=item.get("test_cases"),
+                        test_code=item.get("test_code"),
+                        timeout=float(item.get("timeout", 5.0)),
+                        memory_mb=int(item.get("memory_mb", 512)),
+                    )
+                )
+            else:
+                reward = code_reward_fn(
+                    "",
+                    str(item.get("completion", "")),
+                    test_cases=item.get("test_cases"),
+                    test_code=item.get("test_code"),
+                    timeout=float(item.get("timeout", 5.0)),
+                    memory_mb=int(item.get("memory_mb", 512)),
+                )
+        return {"reward": float(reward)}
+    except Exception as e:  # verification must never 500 the pool
+        return {"reward": 0.0, "error": f"{type(e).__name__}: {e}"}
+
+
+def serve_verifier(
+    host: str = "0.0.0.0",
+    port: int = 0,
+    max_workers: int = 8,
+    background: bool = False,
+) -> ThreadingHTTPServer:
+    """Start the verifier HTTP service; returns the server (its
+    ``server_address`` carries the bound port)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    gate = threading.Semaphore(max_workers)
+    # batch items fan out over this pool (the sandbox work is
+    # subprocess-bound, so threads parallelize it fully); the semaphore
+    # still bounds TOTAL concurrent interpreters across all requests
+    pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    def run_gated(item):
+        with gate:
+            return _verify_one(item)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send({"status": "ok"})
+            else:
+                self._send({"error": "not found"}, 404)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError:
+                self._send({"error": "bad json"}, 400)
+                return
+            if self.path == "/batch":
+                items = payload.get("items", [])
+                out = list(pool.map(run_gated, items))
+                self._send({"results": out})
+            elif self.path in ("/verify_code", "/verify_math"):
+                payload.setdefault(
+                    "kind", "math" if self.path.endswith("math") else "code"
+                )
+                with gate:
+                    self._send(_verify_one(payload))
+            else:
+                self._send({"error": "not found"}, 404)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    if background:
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="verifier-http"
+        ).start()
+    else:
+        httpd.serve_forever()
+    return httpd
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class RemoteVerifier:
+    """Round-robin client over a verifier pool with per-address failover.
+
+    ``local_fallback=True`` degrades to in-host verification when the whole
+    pool is unreachable (the reference's local verifier mode)."""
+
+    def __init__(
+        self,
+        addrs: Sequence[str],
+        timeout: float = 60.0,
+        retries: int = 2,
+        local_fallback: bool = True,
+    ):
+        if not addrs:
+            raise ValueError("need at least one verifier address")
+        self.addrs = [
+            a if a.startswith("http") else f"http://{a}" for a in addrs
+        ]
+        self.timeout = timeout
+        self.retries = retries
+        self.local_fallback = local_fallback
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _next_addr(self) -> str:
+        with self._lock:
+            a = self.addrs[self._rr % len(self.addrs)]
+            self._rr += 1
+            return a
+
+    def _post(
+        self, path: str, payload: Dict[str, Any], timeout: Optional[float] = None
+    ) -> Optional[Dict]:
+        body = json.dumps(payload).encode()
+        for _ in range(self.retries * len(self.addrs)):
+            addr = self._next_addr()
+            try:
+                req = urllib.request.Request(
+                    addr + path,
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout
+                ) as r:
+                    return json.loads(r.read())
+            except Exception as e:
+                logger.warning("verifier %s failed: %s", addr, e)
+        return None
+
+    def verify(self, item: Dict[str, Any]) -> float:
+        out = self._post(
+            "/verify_math" if item.get("kind") == "math" else "/verify_code",
+            item,
+        )
+        if out is not None:
+            return float(out.get("reward", 0.0))
+        if self.local_fallback:
+            return float(_verify_one(item)["reward"])
+        return 0.0
+
+    def verify_batch(self, items: List[Dict[str, Any]]) -> List[float]:
+        # batch wall time scales with items / server parallelism: a fixed
+        # per-call timeout would expire mid-batch and re-run everything
+        per_item = max(
+            (float(it.get("timeout", 5.0)) for it in items), default=5.0
+        )
+        budget = self.timeout + per_item * max(1, len(items)) / 4.0
+        out = self._post("/batch", {"items": items}, timeout=budget)
+        if out is not None:
+            return [float(r.get("reward", 0.0)) for r in out["results"]]
+        if self.local_fallback:
+            return [float(_verify_one(it)["reward"]) for it in items]
+        return [0.0] * len(items)
+
+    # -- workflow-signature reward fns ---------------------------------
+    def math_reward_fn(self):
+        def fn(prompt, completion, prompt_ids, completion_ids,
+               answer: str = "", **kw) -> float:
+            return self.verify(
+                {"kind": "math", "completion": completion, "answer": answer}
+            )
+
+        return fn
+
+    def code_reward_fn(self):
+        def fn(prompt, completion, prompt_ids, completion_ids,
+               test_cases=None, test_code=None, timeout: float = 5.0,
+               **kw) -> float:
+            return self.verify(
+                {
+                    "kind": "code",
+                    "completion": completion,
+                    "test_cases": test_cases,
+                    "test_code": test_code,
+                    "timeout": timeout,
+                }
+            )
+
+        return fn
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8190)
+    p.add_argument("--max-workers", type=int, default=8)
+    args = p.parse_args()
+    logger.info("verifier service on %s:%d", args.host, args.port)
+    serve_verifier(args.host, args.port, args.max_workers)
+
+
+if __name__ == "__main__":
+    main()

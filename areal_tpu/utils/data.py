@@ -58,9 +58,11 @@ def pad_sequences_to_tensors(
 # data value: 'versions' uses -1 as the "padding / not generated" sentinel —
 # padding with 0 would masquerade as weight-version-0 tokens under any
 # staleness filter.
-_KEY_PAD_VALUES = {"versions": -1}
+_KEY_PAD_VALUES = {"versions": -1, "mm_index": -1}
 # per-sequence multimodal payloads: axis 1 is patches, not tokens
-_PER_SEQ_PAYLOAD_KEYS = {"pixel_values", "image_grid_thw"}
+_PER_SEQ_PAYLOAD_KEYS = {
+    "pixel_values", "image_grid_thw", "vis_seg", "vis_pos_h", "vis_pos_w",
+}
 
 
 def concat_padded_tensors(
@@ -131,7 +133,13 @@ def trim_batch(batch: Batch) -> Batch:
     out = {}
     for k, v in batch.items():
         v = np.asarray(v)
-        out[k] = v[:, :max_len] if v.ndim >= 2 and v.shape[1] >= max_len else v
+        out[k] = (
+            v[:, :max_len]
+            if k not in _PER_SEQ_PAYLOAD_KEYS
+            and v.ndim >= 2
+            and v.shape[1] >= max_len
+            else v
+        )
     return out
 
 
@@ -338,6 +346,7 @@ def pack_batch_rows(
         k
         for k, v in batch.items()
         if k not in ("input_ids", "attention_mask")
+        and k not in _PER_SEQ_PAYLOAD_KEYS
         and np.asarray(v).ndim >= 2
         and np.asarray(v).shape[:2] == mask.shape
     ]

@@ -7,9 +7,15 @@ images base64-encoded; the training rows carry the pixel tensors as
 `multi_modal_input` so the trainer can recompute logprobs through the
 vision tower.
 
-The serving/training model stack here is text-only so far — this workflow
-is the data-plane contract (requests, rows, rewards); a VLM model family
-plugs in underneath without touching it.
+Rows additionally carry the host-computed static-shape vision meta the
+qwen2_vl model family (models/vision.py) consumes: per-patch segment ids
+and 2D positions, per-token mrope position ids and image-token ordinals.
+The trainer recomputes logprobs THROUGH the vision tower from these.
+
+CAVEAT: the in-repo serving engine samples text-only so far — image-pad
+tokens embed as ordinary tokens during generation (the training side is
+fully image-conditioned). Until serving-side mm prefill lands, rollouts
+behave like the reference pointing vision workflows at a text-only server.
 """
 
 import asyncio
@@ -27,6 +33,10 @@ from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
 class VisionRLVRWorkflow(RLVRWorkflow):
+    # patch-count bucket quantum: rows pad pixel arrays up to a multiple so
+    # training shapes bucket instead of recompiling per image size
+    PATCH_BUCKET = 64
+
     def __init__(
         self,
         reward_fn,
@@ -35,6 +45,8 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         processor=None,
         enable_thinking: bool = False,
         dump_dir: Optional[str] = None,
+        image_token_id: Optional[int] = None,
+        spatial_merge_size: int = 2,
     ):
         super().__init__(
             reward_fn,
@@ -44,6 +56,25 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             dump_dir=dump_dir,
         )
         self.processor = processor
+        self.image_token_id = image_token_id
+        self.spatial_merge_size = spatial_merge_size
+
+    def _resolve_image_token_id(self):
+        if self.image_token_id is not None:
+            return self.image_token_id
+        for src in (self.processor, getattr(self.processor, "tokenizer", None)):
+            tok_id = getattr(src, "image_token_id", None)
+            if tok_id is not None:
+                self.image_token_id = int(tok_id)
+                return self.image_token_id
+        tok = getattr(self.processor, "tokenizer", None) or self.tokenizer
+        if tok is not None and hasattr(tok, "convert_tokens_to_ids"):
+            tid = tok.convert_tokens_to_ids("<|image_pad|>")
+            unk = getattr(tok, "unk_token_id", None)
+            if tid is not None and tid != unk:
+                self.image_token_id = int(tid)
+                return self.image_token_id
+        return None
 
     async def arun_episode(
         self, engine, data: Dict[str, Any]
@@ -121,6 +152,23 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         )
         rows = []
         plen = len(prompt_ids)
+        # static-shape vision meta for the qwen2_vl train path: patch
+        # bookkeeping + per-token mrope/ordinal arrays (models/vision.py)
+        vis_meta = None
+        if pixel_values is not None and image_grid_thw is not None:
+            from areal_tpu.models import vision as vision_lib
+
+            pv = np.asarray(pixel_values, np.float32)
+            grids = [tuple(int(x) for x in g) for g in
+                     np.asarray(image_grid_thw).reshape(-1, 3)]
+            q = self.PATCH_BUCKET
+            p_pad = max(q, -(-pv.shape[0] // q) * q)
+            vis_meta = vision_lib.build_patch_meta(
+                grids, p_pad, merge=self.spatial_merge_size
+            )
+            if pv.shape[0] < p_pad:
+                pv = np.pad(pv, ((0, p_pad - pv.shape[0]), (0, 0)))
+            vis_meta["pixel_values"] = pv
         for r, reward in zip(resps, rewards):
             seq = prompt_ids + r.output_tokens
             L = len(seq)
@@ -138,12 +186,32 @@ class VisionRLVRWorkflow(RLVRWorkflow):
                 ),
                 "rewards": np.asarray([reward], np.float32),
             }
-            if pixel_values is not None:
-                # per-sequence multimodal payload (reference vision_rlvr
-                # rows carry pixel_values/image_grid_thw)
-                row["pixel_values"] = np.asarray(pixel_values)[None]
-                if image_grid_thw is not None:
-                    row["image_grid_thw"] = np.asarray(image_grid_thw)[None]
+            if vis_meta is not None:
+                img_id = self._resolve_image_token_id()
+                if img_id is None:
+                    # pixels without a known image token id cannot be
+                    # trained through the tower — refuse silently-wrong
+                    # text-only training
+                    raise ValueError(
+                        "VisionRLVRWorkflow received pixel_values but no "
+                        "image_token_id (pass image_token_id=..., or a "
+                        "processor whose tokenizer defines one)"
+                    )
+                from areal_tpu.models import vision as vision_lib
+
+                grids = [tuple(int(x) for x in g) for g in
+                         np.asarray(image_grid_thw).reshape(-1, 3)]
+                mrope_pos, mm_idx = vision_lib.build_mm_rows(
+                    prompt_ids, r.output_len, img_id, grids,
+                    merge=self.spatial_merge_size,
+                )
+                row["mrope_pos"] = mrope_pos[None]
+                row["mm_index"] = mm_idx[None]
+                for k, v in vis_meta.items():
+                    row[k] = v[None]
+                row["image_grid_thw"] = np.asarray(image_grid_thw).reshape(
+                    1, -1, 3
+                )
             rows.append(row)
         if self.dump_dir is not None:
             self._dump(engine, prompt_str, resps, rewards)

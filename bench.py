@@ -3,17 +3,24 @@
 Measures the reference's headline quantity — *effective training throughput*:
 tokens consumed by the trainer divided by end-to-end step time, where a step
 is rollout (in-process paged generation engine, continuous batching) →
-behavior logp → advantage computation → decoupled-PPO update
-(benchmark/verl_v0_3_0_post1_76084d3/README.md conventions: only
-trainer-consumed tokens count).
+behavior logp → advantage computation → decoupled-PPO update → weight push
+back into the serving engine (benchmark/verl_v0_3_0_post1_76084d3/README.md
+conventions: only trainer-consumed tokens count).
+
+The HEADLINE number is the *overlapped* async loop — generation for step N+1
+runs in the continuous-batching engine while step N trains, and each update
+streams new weights into the server mid-generation (the reference's
+interruptible-rollout architecture, areal/api/workflow_api.py:288-317).
+Serial steps are also measured and reported in ``extra`` so the overlap gain
+is auditable. All phases report per-step wall breakdowns plus JAX
+compile-event counts so a slow run is diagnosable post-hoc (the round-3
+driver capture was 5x off the rerun with no way to tell why).
 
 Model: Qwen2-0.5B geometry, random init, bf16. Main workload: 128 samples
 (16 prompts × 8 — GRPO grouping exercises sibling page sharing), 128-token
 prompts, 2048 new tokens, max_model_len 16384 over an OVERSUBSCRIBED paged
-KV pool (the engine preempts transparently under pool pressure — the
-round-2 verdict's defining AReaL workload). A capacity phase first runs
-64 concurrent 4096-token generations to demonstrate the long-generation
-serving the old contiguous cache could not hold, with HBM accounting.
+KV pool. A capacity phase first runs 64 concurrent 4096-token generations
+with HBM accounting.
 
 ``vs_baseline`` derivation: AReaL v0.3 reports 1000 async GRPO steps of
 512 prompts × 16 samples in 14.8 h on 128 H800s for the 1.5B model
@@ -29,19 +36,19 @@ Prints exactly one JSON line:
 
 import json
 import os
+import statistics
 import time
 
 import numpy as np
 
 # BEFORE jax initializes: raise the scoped-VMEM limit (forwarded by the
-# compile service) and opt into the big splash blocks it enables — a 5.7x
-# long-context attention win (see ops/flash._block_size)
+# compile service) — required for the large splash blocks that
+# ops/flash.probe_block_size will verify at engine init
 _flag = "--xla_tpu_scoped_vmem_limit_kib=65536"
 if _flag not in os.environ.get("LIBTPU_INIT_ARGS", ""):
     os.environ["LIBTPU_INIT_ARGS"] = (
         os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _flag
     ).strip()
-os.environ.setdefault("AREAL_TPU_SPLASH_BLOCK", "1024")
 
 BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE = 2520.0
 
@@ -49,6 +56,23 @@ BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE = 2520.0
 def main():
     import jax
     import jax.numpy as jnp
+
+    # count backend compilations: a measured step that compiles is a
+    # methodology bug, and the counter proves (or rules out) it post-hoc
+    compile_events = {"count": 0, "secs": 0.0}
+
+    def _on_event(event: str, duration: float, **kw):
+        if "compil" in event:
+            compile_events["count"] += 1
+            compile_events["secs"] += duration
+
+    try:
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:
+        pass
+
+    def compile_snap():
+        return dict(compile_events)
 
     from areal_tpu.api.cli_args import (
         JaxGenConfig,
@@ -126,9 +150,9 @@ def main():
         return prompts, futs
 
     # --- capacity phase: 64 concurrent 4096-token generations at
-    # max_model_len 16384 (the long-generation workload the round-2
-    # contiguous cache could not hold: 64 x 16384 slots would need 12.9 GB
-    # of HBM; the paged pool holds the ACTUAL footprint) ---
+    # max_model_len 16384 (the long-generation workload a contiguous cache
+    # could not hold: 64 x 16384 slots would need 12.9 GB of HBM; the
+    # paged pool holds the ACTUAL footprint) ---
     _, futs = submit_batch(8, 8, prompt_len, 4096)  # warm compile path
     [f.result(timeout=3600) for f in futs]
     m0 = gen.metrics()
@@ -181,11 +205,7 @@ def main():
     )
     actor = PPOActor(pcfg, trainer)
 
-    def one_step():
-        t0 = time.perf_counter()
-        prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
-        results = [f.result(timeout=3600) for f in futs]
-        rollout_done = time.perf_counter()
+    def to_train_batch(prompts, results):
         batches = []
         for prompt, r in zip(prompts, results):
             full = prompt + r["output_ids"]
@@ -208,13 +228,24 @@ def main():
                     "rewards": np.asarray([float(olen % 2)], np.float32),
                 }
             )
-        batch = data_utils.concat_padded_tensors(batches)
+        return data_utils.concat_padded_tensors(batches)
+
+    def train_on(prompts, results):
+        batch = to_train_batch(prompts, results)
         out = actor.compute_advantages(dict(batch))
-        stats = actor.ppo_update(out)
-        step_time = time.perf_counter() - t0
+        actor.ppo_update(out)
         tokens = int(batch["attention_mask"].sum())
-        seq_lens = [len(p) + len(r["output_ids"]) for p, r in zip(prompts, results)]
-        return step_time, rollout_done - t0, tokens, seq_lens, stats
+        lens = [len(p) + len(r["output_ids"]) for p, r in zip(prompts, results)]
+        return tokens, lens
+
+    def push_weights(version):
+        # bf16 serving copy of the f32 master weights, swapped into the
+        # server mid-generation (interruptible decoding keeps going; token
+        # versions record the swap point)
+        serving = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), trainer.params
+        )
+        gen.update_weights_from_tensors(serving, version=version)
 
     # round-2-comparable SHORT workload (256-token gens) for cross-round
     # trend tracking — measured before the main workload warms longer
@@ -233,23 +264,47 @@ def main():
     st, sdt = short_step()
     short_gen_tokens_per_sec = (st - n_samples * prompt_len) / sdt
 
-    # warmup (compiles prefill/decode/sample/grad/apply/forward programs)
-    one_step()
-    gen_before = gen.metrics()
-    # measured steps
-    n_steps = 2
-    times, rtimes, toks, all_lens = [], [], [], []
-    for _ in range(n_steps):
-        step_time, rollout_time, tokens, seq_lens, stats = one_step()
-        times.append(step_time)
-        rtimes.append(rollout_time)
-        toks.append(tokens)
-        all_lens.extend(seq_lens)
-    gen_after = gen.metrics()
-    eff_tokens_per_sec = sum(toks) / sum(times)
-    samples_per_sec = (n_steps * n_samples) / sum(times)
+    # --- warmup: one full serial step + one weight push (compiles
+    # prefill/decode/sample/logp/grad/apply/push programs) ---
+    prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
+    results = [f.result(timeout=3600) for f in futs]
+    train_on(prompts, results)
+    push_weights(version=0)
+    warm_compiles = compile_snap()
 
-    # --- measured MFU (executed matmul flops / elapsed / chip peak) ---
+    # --- serial measurement (rollout -> train, no overlap) ---
+    n_serial = 3
+    serial_steps = []
+    gen_before = gen.metrics()
+    for _ in range(n_serial):
+        c0 = compile_snap()
+        t0 = time.perf_counter()
+        prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
+        results = [f.result(timeout=3600) for f in futs]
+        t_roll = time.perf_counter()
+        tokens, lens = train_on(prompts, results)
+        t_end = time.perf_counter()
+        c1 = compile_snap()
+        serial_steps.append(
+            {
+                "step_s": round(t_end - t0, 3),
+                "rollout_s": round(t_roll - t0, 3),
+                "train_s": round(t_end - t_roll, 3),
+                "tokens": tokens,
+                "avg_len": round(float(np.mean(lens)), 1),
+                "compiles": c1["count"] - c0["count"],
+                "compile_s": round(c1["secs"] - c0["secs"], 1),
+                "train_timing": getattr(trainer, "last_timing", None),
+            }
+        )
+    gen_after = gen.metrics()
+    serial_tok_per_s = [s["tokens"] / s["step_s"] for s in serial_steps]
+    serial_median = statistics.median(serial_tok_per_s)
+
+    # --- MFU accounting over the serial phase (same flops model as r3) ---
+    all_lens_flat = []
+    for s in serial_steps:
+        all_lens_flat.extend([s["avg_len"]] * n_samples)
     prompt_toks = (
         gen_after["total_prompt_tokens"] - gen_before["total_prompt_tokens"]
     )
@@ -262,78 +317,176 @@ def main():
         - gen_before["total_generated_tokens"]
     )
     prefilled = max(0, prompt_toks - cached_toks)
-    # average decode context: full prompt + half the (linearly growing) gen
-    avg_ctx = prompt_len + (float(np.mean(all_lens)) - prompt_len) / 2.0
+    avg_ctx = prompt_len + (float(np.mean(all_lens_flat)) - prompt_len) / 2.0
     rollout_flops = flops_util.prefill_flops(
         model_cfg, [prompt_len] * max(1, prefilled // prompt_len)
     ) + flops_util.decode_flops(model_cfg, gen_toks, avg_ctx)
-    # ppo path: 1 train fwd+bwd + 2 forward-only logp passes (behavior
-    # recompute + proximal) over the packed batch
     train_flops = flops_util.train_step_flops(
-        model_cfg, all_lens, n_forward_only=2
+        model_cfg, all_lens_flat, n_forward_only=2
     )
-    train_time = sum(times) - sum(rtimes)
+    sum_roll = sum(s["rollout_s"] for s in serial_steps)
+    sum_train = sum(s["train_s"] for s in serial_steps)
+    sum_step = sum(s["step_s"] for s in serial_steps)
     peak = flops_util.device_peak_flops(jax.devices()[0].device_kind)
+
+    # --- overlapped async loop (HEADLINE): submit N+1, train N, push
+    # weights, collect N+1 — generation overlaps training and the weight
+    # swap lands mid-generation (interruptible rollout) ---
+    n_overlap = 5
+    overlap_steps = []
+    staleness_counts = {}
+    prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
+    results = [f.result(timeout=3600) for f in futs]
+    for i in range(n_overlap):
+        c0 = compile_snap()
+        t0 = time.perf_counter()
+        nxt_prompts, nxt_futs = submit_batch(
+            n_prompts, group_size, prompt_len, max_new
+        )
+        t_sub = time.perf_counter()
+        tokens, lens = train_on(prompts, results)
+        t_train = time.perf_counter()
+        push_weights(version=i + 1)
+        t_push = time.perf_counter()
+        nxt_results = [f.result(timeout=3600) for f in nxt_futs]
+        t_end = time.perf_counter()
+        c1 = compile_snap()
+        # offpolicyness: trainer version at consumption minus the version
+        # that generated each token (the swap lands mid-sequence)
+        for r in nxt_results:
+            vs = np.asarray(r["output_versions"])
+            lag = (i + 1) - vs
+            for v, c in zip(*np.unique(lag, return_counts=True)):
+                staleness_counts[int(v)] = staleness_counts.get(int(v), 0) + int(c)
+        overlap_steps.append(
+            {
+                "step_s": round(t_end - t0, 3),
+                "train_s": round(t_train - t_sub, 3),
+                "push_s": round(t_push - t_train, 3),
+                "wait_s": round(t_end - t_push, 3),
+                "tokens": tokens,
+                "compiles": c1["count"] - c0["count"],
+                "compile_s": round(c1["secs"] - c0["secs"], 1),
+            }
+        )
+        prompts, results = nxt_prompts, nxt_results
+    overlap_tok_per_s = [s["tokens"] / s["step_s"] for s in overlap_steps]
+    overlap_median = statistics.median(overlap_tok_per_s)
+
+    from areal_tpu.ops import flash as flash_ops
+
     extra = {
-        "samples_per_sec": round(samples_per_sec, 3),
-        "step_time_s": round(sum(times) / n_steps, 3),
-        "rollout_time_s": round(sum(rtimes) / n_steps, 3),
-        "train_time_s": round(train_time / n_steps, 3),
-        "rollout_frac": round(sum(rtimes) / sum(times), 3),
-        "tokens_per_step": int(sum(toks) / n_steps),
-        "avg_seq_len": round(float(np.mean(all_lens)), 1),
-        "gen_tokens_per_sec": round(gen_toks / sum(rtimes), 1),
+        "samples_per_sec": round(
+            n_samples
+            / statistics.median([s["step_s"] for s in overlap_steps]), 3,
+        ),
+        "step_time_s": round(
+            statistics.median([s["step_s"] for s in overlap_steps]), 3
+        ),
+        "serial_step_time_s": round(
+            statistics.median([s["step_s"] for s in serial_steps]), 3
+        ),
+        "rollout_time_s": round(sum_roll / n_serial, 3),
+        "train_time_s": round(sum_train / n_serial, 3),
+        "overlap_gain": round(
+            overlap_median / serial_median, 3
+        ),
+        "serial_tokens_per_sec": round(serial_median, 1),
+        "tokens_per_step": int(
+            sum(s["tokens"] for s in overlap_steps) / n_overlap
+        ),
+        "avg_seq_len": round(float(np.mean(all_lens_flat)), 1),
+        "gen_tokens_per_sec": round(gen_toks / sum_roll, 1),
         "cached_prompt_tokens": int(cached_toks),
         "preemptions": int(
             gen_after["total_preemptions"] - gen_before["total_preemptions"]
         ),
         "short_gen_tokens_per_sec": round(short_gen_tokens_per_sec, 1),
         "device": jax.devices()[0].device_kind,
+        "splash_block": flash_ops._PROBED_BLOCK,
+        "warmup_compiles": warm_compiles["count"],
+        "warmup_compile_s": round(warm_compiles["secs"], 1),
+        "per_step_serial": serial_steps,
+        "per_step_overlap": overlap_steps,
+        "staleness_token_counts": staleness_counts,
     }
     extra.update(cap_stats)
-    if peak:
-        extra["mfu_rollout"] = round(rollout_flops / sum(rtimes) / peak, 4)
-        extra["mfu_train"] = round(train_flops / max(train_time, 1e-9) / peak, 4)
-        extra["mfu_e2e"] = round(
-            (rollout_flops + train_flops) / sum(times) / peak, 4
-        )
-    # --- long-context training proof: one 16k packed-context train step
-    # (2×8k sequences) with the block-sparse splash kernel + remat ---
-    t_long = 16384
-    lens_long = [8192, 8192]
-    long_batch = {
-        "input_ids": rng.integers(
-            1, model_cfg.vocab_size, size=(2, t_long // 2)
-        ).astype(np.int32),
-        "attention_mask": np.ones((2, t_long // 2), np.bool_),
-        "loss_mask": np.ones((2, t_long // 2), np.int32),
-    }
-    from areal_tpu.engine.sft.lm_engine import sft_loss_fn, sft_loss_weight_fn
+    # checkpoint partial results (stderr) — a failure in a later phase must
+    # not lose the measured phases (round-3 lesson)
+    import sys
 
-    trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)  # compile
-    t0 = time.perf_counter()
-    trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)
-    long_dt = time.perf_counter() - t0
-    extra["long_ctx_tokens_per_sec"] = round(t_long / long_dt, 1)
+    print(
+        "PARTIAL " + json.dumps({"value": round(overlap_median, 2), **extra}),
+        file=sys.stderr,
+        flush=True,
+    )
     if peak:
-        extra["long_ctx_mfu"] = round(
-            flops_util.train_step_flops(model_cfg, lens_long, 0)
-            / long_dt
+        extra["mfu_rollout"] = round(rollout_flops / sum_roll / peak, 4)
+        extra["mfu_train"] = round(
+            train_flops / max(sum_train, 1e-9) / peak, 4
+        )
+        extra["mfu_e2e"] = round(
+            (rollout_flops + train_flops) / sum_step / peak, 4
+        )
+        # overlapped effective MFU: total useful flops per overlapped second
+        extra["mfu_overlap"] = round(
+            (rollout_flops + train_flops)
+            / n_serial
+            * n_overlap
+            / sum(s["step_s"] for s in overlap_steps)
             / peak,
             4,
         )
 
+    # --- long-context training proof: ONE 24k-token sequence per train
+    # step (the boba 24k recipe's flagship shape) with the splash kernel +
+    # remat; mb cap raised so the sequence is not split. The serving engine
+    # is stopped first: its params + KV pool (~4.5 GB) plus the 24k fp32
+    # logits would exceed HBM ---
+    gen.stop()
+    try:
+        t_long = 24576
+        lens_long = [t_long]
+        long_batch = {
+            "input_ids": rng.integers(
+                1, model_cfg.vocab_size, size=(1, t_long)
+            ).astype(np.int32),
+            "attention_mask": np.ones((1, t_long), np.bool_),
+            "loss_mask": np.ones((1, t_long), np.int32),
+        }
+        trainer.config.mb_spec.max_tokens_per_mb = t_long
+        from areal_tpu.engine.sft.lm_engine import (
+            sft_loss_fn,
+            sft_loss_weight_fn,
+        )
+
+        trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)
+        t0 = time.perf_counter()
+        trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)
+        long_dt = time.perf_counter() - t0
+        extra["ctx24k_tokens_per_sec"] = round(t_long / long_dt, 1)
+        if peak:
+            extra["ctx24k_mfu"] = round(
+                flops_util.train_step_flops(model_cfg, lens_long, 0)
+                / long_dt
+                / peak,
+                4,
+            )
+    except Exception as e:  # report, don't lose the measured phases
+        extra["ctx24k_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
     result = {
         "metric": "grpo_effective_tokens_per_sec_per_device",
-        "value": round(eff_tokens_per_sec, 2),
-        "unit": "tokens/s (Qwen2-0.5B shape, 2k-token gens, rollout+logp+update, 1 chip)",
+        "value": round(overlap_median, 2),
+        "unit": (
+            "tokens/s (Qwen2-0.5B shape, 2k-token gens, async overlapped "
+            "rollout+logp+update+weight-push, 1 chip)"
+        ),
         "vs_baseline": round(
-            eff_tokens_per_sec / BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE,
-            4,
+            overlap_median / BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE, 4
         ),
         "extra": extra,
     }
-    gen.stop()
     print(json.dumps(result))
 
 

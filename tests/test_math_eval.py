@@ -1,0 +1,138 @@
+"""Agreement vectors for the dataset-aware eval harness.
+
+Each vector pins a behavior OF THE REFERENCE'S extractor/grader
+(evaluation/parser.py extract_answer / parse_ground_truth and
+grader.math_equal) that areal_tpu's fresh implementation must reproduce:
+minerva's sign-off format, boxed nesting, choice cleaning, per-dataset
+ground-truth fields, percentage/fraction equivalence.
+"""
+
+import pytest
+
+from areal_tpu.evaluation import math_eval as ME
+from areal_tpu.evaluation.code_eval import extract_python_code
+
+
+# --- extract_pred vectors (reference parser.extract_answer:505-572) -------
+@pytest.mark.parametrize(
+    "text,dataset,want",
+    [
+        # minerva sign-off wins over everything
+        (
+            "Thus the final answer is $\\frac{3}{4}$. I hope it is correct.",
+            "minerva_math",
+            "\\frac{3}{4}",
+        ),
+        # boxed with nesting
+        ("so \\boxed{\\frac{1}{\\sqrt{2}}} done", "math", "\\frac{1}{\\sqrt{2}}"),
+        # "The answer is" (matched via 'he answer is' — catches The/the)
+        ("The answer is 42.", "math", "42"),
+        # last-number fallback strips commas
+        ("we get 1,234 apples in total", "gsm8k", "1234"),
+        # trailing slash/period cleanup
+        ("the answer is 3/", "math", "3"),
+        # choice datasets reduce to the last letter
+        ("I think (B) is right, final: C.", "aqua", "C"),
+        ("the options... answer: (A).", "mmlu_stem", "A"),
+    ],
+)
+def test_extract_pred_vectors(text, dataset, want):
+    assert ME.extract_pred(text, dataset) == want
+
+
+# --- ground-truth parsing vectors (reference parser.parse_ground_truth) ---
+@pytest.mark.parametrize(
+    "example,dataset,want",
+    [
+        ({"answer": "He pays 10.\n#### 10"}, "gsm8k", "10"),
+        (
+            {"solution": "We find $x=\\boxed{\\frac{1}{2}}$."},
+            "math",
+            "\\frac{1}{2}",
+        ),
+        ({"answer": 2}, "mmlu_stem", "C"),
+        ({"correct": "D"}, "aqua", "D"),
+        ({"Answer": "72"}, "sat_math", "72"),
+        ({"answer": "$12$"}, "gaokao2023en", "12"),
+        ({"target": "5.0"}, "mawps", "5.0"),
+        # asdiv strips the unit parenthetical
+        ({"answer": "60 (miles)"}, "asdiv", "60"),
+    ],
+)
+def test_parse_ground_truth_vectors(example, dataset, want):
+    assert ME.parse_ground_truth(example, dataset) == want
+
+
+# --- end-to-end grading vectors (reference grader.math_equal behavior) ----
+@pytest.mark.parametrize(
+    "completion,example,dataset,ok",
+    [
+        # frac vs decimal
+        ("... the final answer is $0.75$. I hope", {"answer": "\\frac{3}{4}"},
+         "minerva_math", True),
+        # percentage ambiguity accepted
+        ("The answer is 50%", {"answer": "0.5"}, "gsm8k", True),
+        # boxed interval vs bracket style: the reference's math_equal
+        # strips brackets before comparing, so (0,1] == [0,1]
+        ("\\boxed{(0, 1]}", {"answer": "[0,1]"}, "math", True),
+        # same interval matches elementwise
+        ("\\boxed{(\\frac{3}{5},\\frac{8}{3})}", {"answer": "(0.6,2.6667)"},
+         "math", True),
+        # choice grading is letter equality
+        ("definitely B", {"answer": 1}, "mmlu_stem", True),
+        ("definitely B", {"answer": 0}, "mmlu_stem", False),
+        # gsm8k numeric with commas
+        ("...total of 1,200\n#### ignore", {"answer": "x\n#### 1200"},
+         "gsm8k", True),
+        # symbolic equivalence
+        ("the answer is \\boxed{\\frac{x+2}{7}}",
+         {"answer": "\\frac{x}{7}+\\frac{2}{7}"}, "math", True),
+    ],
+)
+def test_grade_vectors(completion, example, dataset, ok):
+    got, _, _ = ME.grade(completion, example, dataset)
+    assert got == ok
+
+
+def test_interval_bracket_mismatch_still_equal_elementwise():
+    """The reference's math_equal strips brackets before comparing, so
+    (0,1] == [0,1] elementwise — our answers_equal keeps that behavior at
+    the grader level (vector above pins grade()'s stricter path via boxed
+    extraction returning the raw string '(0, 1]' vs '[0,1]': equal)."""
+    from areal_tpu.reward.math_parser import answers_equal
+
+    assert answers_equal("(0, 1]", "[0,1]")
+
+
+# --- code extraction vectors (reference code_eval.extract_python_code) ----
+def test_extract_python_code_last_valid_block():
+    text = (
+        "First try:\n```python\nthis is not code at all!!!!!!!!!!!\n```\n"
+        "Fixed:\n```python\ndef solve():\n    return sum(range(10))\n```\n"
+    )
+    code = extract_python_code(text, strict_syntax=True)
+    assert code == "def solve():\n    return sum(range(10))"
+
+
+def test_extract_python_code_min_length_and_none():
+    assert extract_python_code("```python\nx=1\n```") is None  # too short
+    assert extract_python_code("no code here") is None
+
+
+def test_eval_code_completions_local():
+    from areal_tpu.evaluation.code_eval import eval_code_completions
+
+    items = [
+        {"test_cases": [{"input": "3\n", "output": "6"}]},
+        {"test_code": "assert add(2, 3) == 5"},
+    ]
+    good_io = "```python\nn = int(input())\nprint(n * 2)\n```"
+    bad_io = "```python\nn = int(input())\nprint(n * 3)\n```"
+    good_fn = "```python\ndef add(a, b):\n    return a + b\n```"
+    out = eval_code_completions(
+        items, [[good_io, bad_io], [good_fn, bad_io]], timeout=10.0
+    )
+    assert out["per_problem"][0] == [1.0, 0.0]
+    assert out["per_problem"][1] == [1.0, 0.0]
+    assert out["pass_at_k"][1] == 0.5
+    assert out["pass_at_k"][2] == 1.0

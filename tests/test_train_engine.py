@@ -93,8 +93,10 @@ def test_sharded_matches_single_device():
     np.testing.assert_allclose(r1["loss"], r8["loss"], rtol=1e-4)
     p1 = jax.device_get(eng1.params)
     p8 = jax.device_get(eng8.params)
+    # step 0 runs at full lr: adam's first step is sign(g)-like, so
+    # reduction-order noise on near-zero grads shifts updates by O(lr·rel)
     jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-4),
         p1, p8,
     )
 
@@ -132,8 +134,9 @@ def test_sharded_attn_impl_matches_single_device(impl):
     np.testing.assert_allclose(r1["loss"], r2["loss"], rtol=1e-4)
     p1 = jax.device_get(eng1.params)
     p2 = jax.device_get(eng2.params)
+    # see test_sharded_matches_single_device on the first-adam-step noise
     jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-4),
         p1, p2,
     )
 
