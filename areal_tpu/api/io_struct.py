@@ -161,6 +161,10 @@ class RolloutStat:
     accepted: int = 0
     running: int = 0
     rejected: int = 0
+    # groups dropped by a consumer-side group_filter (DAPO dynamic
+    # sampling); dropped groups release staleness-gate budget so the
+    # pipeline backfills them with fresh generations
+    filtered: int = 0
 
 
 _COUNTER = itertools.count()

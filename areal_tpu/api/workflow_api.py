@@ -48,20 +48,22 @@ class RolloutWorkflow(abc.ABC):
 
 
 class _WorkItem:
-    __slots__ = ("data", "workflow", "create_time")
+    __slots__ = ("data", "workflow", "create_time", "uid")
 
     def __init__(self, data, workflow):
         self.data = data
         self.workflow = workflow
         self.create_time = time.monotonic_ns()
+        self.uid = data_utils.sample_uid(data)
 
 
 class _ResultItem:
-    __slots__ = ("batch", "create_time")
+    __slots__ = ("batch", "create_time", "uid")
 
-    def __init__(self, batch, create_time):
+    def __init__(self, batch, create_time, uid=""):
         self.batch = batch
         self.create_time = create_time
+        self.uid = uid
 
 
 class WorkflowExecutor:
@@ -77,6 +79,12 @@ class WorkflowExecutor:
         # queue would let put() block the asyncio loop thread
         self.output_queue: "queue.Queue[_ResultItem]" = queue.Queue()
         self.rollout_stat = RolloutStat()
+        # uids of dataset items whose episode results were CONSUMED (pulled
+        # into a returned batch) — recover persists these so a resumed run
+        # never trains one twice (reference master_worker.py:121-128);
+        # submitted-but-unconsumed items are deliberately NOT here: their
+        # rollouts are lost on crash and must be re-generated
+        self.consumed_uids: List[str] = []
         self._lock = threading.Lock()
         self._exiting = threading.Event()
         self._paused = threading.Event()
@@ -127,11 +135,22 @@ class WorkflowExecutor:
             self.rollout_stat.submitted += 1
 
     def wait(
-        self, count: int, timeout: Optional[float] = None
+        self,
+        count: int,
+        timeout: Optional[float] = None,
+        group_filter: Optional[Callable[[Dict[str, np.ndarray]], bool]] = None,
+        refill_fn: Optional[Callable[[int], None]] = None,
     ) -> Dict[str, np.ndarray]:
         """Block until `count` accepted results; returns one concatenated
         padded batch sorted by creation time then shuffled (reference
-        workflow_api.py:225-274)."""
+        workflow_api.py:225-274).
+
+        ``group_filter(batch) -> keep?`` implements DAPO dynamic sampling
+        (reference areal/engine/ppo/actor.py dynamic_sampling, done here at
+        the SOURCE): a dropped episode is un-counted from ``accepted`` so
+        the staleness gate reopens and the pipeline generates a replacement
+        — the batch is backfilled with useful groups instead of silently
+        shrinking."""
         start = time.monotonic()
         timeout = timeout or self.config.request_timeout
         results: List[_ResultItem] = []
@@ -150,23 +169,60 @@ class WorkflowExecutor:
                 item = self.output_queue.get(timeout=min(0.05, remain))
             except queue.Empty:
                 continue
+            if group_filter is not None and not group_filter(item.batch):
+                with self._lock:
+                    self.rollout_stat.accepted -= 1
+                    self.rollout_stat.filtered += 1
+                if refill_fn is not None:
+                    # synchronous callers have no pipeline topping them up
+                    # — ask for a replacement episode per dropped group
+                    refill_fn(1)
+                continue
             results.append(item)
         results.sort(key=lambda r: r.create_time)
         random.shuffle(results)
+        with self._lock:
+            self.consumed_uids.extend(r.uid for r in results if r.uid)
         return data_utils.concat_padded_tensors([r.batch for r in results])
 
+    def drain_consumed_uids(self) -> List[str]:
+        """Consumed-sample uids since the last drain (recover bookkeeping)."""
+        with self._lock:
+            out, self.consumed_uids = self.consumed_uids, []
+            return out
+
     def rollout_batch(
-        self, data: List[Dict[str, Any]], workflow: RolloutWorkflow
+        self,
+        data: List[Dict[str, Any]],
+        workflow: RolloutWorkflow,
+        group_filter: Optional[Callable] = None,
     ) -> Dict[str, np.ndarray]:
-        """Synchronous batch rollout: submit all, wait all."""
+        """Synchronous batch rollout: submit all, wait all. With a
+        ``group_filter``, dropped groups are backfilled by resubmitting
+        prompts (round-robin over ``data``) until ``len(data)`` useful
+        groups exist — the synchronous caller has no prepare_batch
+        pipeline to top it up."""
+        import itertools
+
         for item in data:
             self.submit(item, workflow)
-        return self.wait(count=len(data))
+        refill = None
+        if group_filter is not None and data:
+            cyc = itertools.cycle(data)
+
+            def refill(n: int):
+                for _ in range(n):
+                    self.submit(next(cyc), workflow)
+
+        return self.wait(
+            count=len(data), group_filter=group_filter, refill_fn=refill
+        )
 
     def prepare_batch(
         self,
         dataloader,
         workflow: RolloutWorkflow,
+        group_filter: Optional[Callable] = None,
     ) -> Dict[str, np.ndarray]:
         """Overlap submission with waiting: keep the pipeline full under the
         capacity gate, return as soon as one consumer batch is ready
@@ -187,7 +243,8 @@ class WorkflowExecutor:
                     self.submit(item, workflow)
             try:
                 return self.wait(
-                    count=self.config.consumer_batch_size, timeout=1
+                    count=self.config.consumer_batch_size, timeout=1,
+                    group_filter=group_filter,
                 )
             except TimeoutError:
                 continue
@@ -251,11 +308,22 @@ class WorkflowExecutor:
                 self.rollout_stat.rejected += 1
                 return
             self.rollout_stat.accepted += 1
-        self.output_queue.put_nowait(_ResultItem(batch, item.create_time))
+        self.output_queue.put_nowait(
+            _ResultItem(batch, item.create_time, uid=item.uid)
+        )
         if self.config.enable_rollout_tracing:
             logger.info(
                 f"episode done (accepted={self.rollout_stat.accepted})"
             )
+
+
+def zero_signal_filter(batch: Dict[str, np.ndarray]) -> bool:
+    """The canonical DAPO group filter: keep an episode's group only if
+    its rewards are not all identical (all-same rewards normalize to zero
+    advantage — pure gradient noise). Pass as ``group_filter=`` to
+    prepare_batch/rollout_batch/wait."""
+    r = np.asarray(batch.get("rewards", ())).reshape(-1)
+    return bool(r.size <= 1 or (r != r.flat[0]).any())
 
 
 def cycle_dataloader(dataloader):

@@ -129,6 +129,15 @@ class StatefulDataLoader:
 
     One ``__iter__`` pass yields the REMAINDER of the current epoch (so a
     resumed run continues where it left off); callers loop epochs.
+
+    Used-data exclusion (reference realhf/base/recover.py +
+    master_worker.py:121-128): ``mark_used(uids)`` records CONSUMED
+    samples; after a resume that restored a non-empty used set, iteration
+    restarts the epoch from the top and skips exactly those samples — so
+    nothing is trained twice AND submitted-but-unconsumed items (whose
+    in-flight rollouts died with the crash) are re-yielded rather than
+    silently dropped by a submit-cursor restore. The set clears at each
+    epoch boundary.
     """
 
     def __init__(
@@ -149,6 +158,9 @@ class StatefulDataLoader:
         self.collate_fn = collate_fn or (lambda x: x)
         self._epoch = 0
         self._batch_idx = 0  # batches already yielded in the current epoch
+        self._used: set = set()  # consumed-sample uids (current epoch)
+        self._yielded_epoch: set = set()  # uids yielded this epoch
+        self._scan_from_start = False  # resume mode: re-scan + skip used
 
     def __len__(self) -> int:
         n = len(self.dataset) // self.batch_size
@@ -170,21 +182,56 @@ class StatefulDataLoader:
             random.Random(self.seed + self._epoch).shuffle(order)
         return order
 
+    def mark_used(self, uids) -> None:
+        """Record consumed samples. Only uids yielded in the CURRENT epoch
+        count: a straggler consumed from a previous epoch refers to that
+        epoch's visit — marking it here would wrongly block its legitimate
+        re-visit this epoch (each epoch trains every sample once)."""
+        self._used.update(u for u in uids if u in self._yielded_epoch)
+
+    def _uid(self, item) -> str:
+        from areal_tpu.utils.data import sample_uid
+
+        return sample_uid(item)
+
     def __iter__(self) -> Iterator[Any]:
         order = self._order()
         nb = len(self)
-        for b in range(self._batch_idx, nb):
+        start = 0 if self._scan_from_start else self._batch_idx
+        self._scan_from_start = False
+        for b in range(start, nb):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
             if not idx:
                 continue
-            self._batch_idx = b + 1
-            yield self.collate_fn([self.dataset[i] for i in idx])
+            self._batch_idx = max(self._batch_idx, b + 1)
+            items = [self.dataset[i] for i in idx]
+            uids = [self._uid(it) for it in items]
+            if self._used:
+                keep = [u not in self._used for u in uids]
+                items = [it for it, k in zip(items, keep) if k]
+                uids = [u for u, k in zip(uids, keep) if k]
+                if not items:
+                    continue
+            self._yielded_epoch.update(uids)
+            yield self.collate_fn(items)
         self._epoch += 1
         self._batch_idx = 0
+        self._used.clear()
+        self._yielded_epoch.clear()
 
-    def state_dict(self) -> Dict[str, int]:
-        return {"epoch": self._epoch, "batch_idx": self._batch_idx}
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "epoch": self._epoch,
+            "batch_idx": self._batch_idx,
+            "used": sorted(self._used),
+            "yielded": sorted(self._yielded_epoch),
+        }
 
-    def load_state_dict(self, state: Dict[str, int]):
+    def load_state_dict(self, state: Dict[str, Any]):
         self._epoch = int(state["epoch"])
         self._batch_idx = int(state["batch_idx"])
+        self._used = set(state.get("used", ()))
+        self._yielded_epoch = set(state.get("yielded", ()))
+        # a restored used set means async items past the consume point may
+        # be unconsumed: re-scan the epoch and skip exactly the used ones
+        self._scan_from_start = bool(self._used)

@@ -160,14 +160,22 @@ class LocalSyncInferenceEngine(InferenceEngine):
     def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
         self.workflow_executor.submit(data, workflow)
 
-    def wait(self, count: int, timeout: Optional[float] = None):
-        return self.workflow_executor.wait(count, timeout=timeout)
+    def wait(self, count: int, timeout: Optional[float] = None,
+             group_filter=None):
+        return self.workflow_executor.wait(
+            count, timeout=timeout, group_filter=group_filter
+        )
 
-    def rollout_batch(self, data: List[Dict[str, Any]], workflow):
-        return self.workflow_executor.rollout_batch(data, workflow)
+    def rollout_batch(self, data: List[Dict[str, Any]], workflow,
+                      group_filter=None):
+        return self.workflow_executor.rollout_batch(
+            data, workflow, group_filter=group_filter
+        )
 
-    def prepare_batch(self, dataloader, workflow):
-        return self.workflow_executor.prepare_batch(dataloader, workflow)
+    def prepare_batch(self, dataloader, workflow, group_filter=None):
+        return self.workflow_executor.prepare_batch(
+            dataloader, workflow, group_filter=group_filter
+        )
 
     def pause(self):
         self.workflow_executor.pause()

@@ -28,6 +28,8 @@ def packed_forward(
     remat: bool = True,
     attend_fn: Optional[Any] = None,
     return_router_loss: bool = False,
+    return_hidden: bool = False,
+    act_sharding: Optional[Any] = None,
 ):
     """``transformer.apply`` over engine-packed arrays (tokens /
     segment_ids / positions / t_* / s_*), with the vision tower spliced in
@@ -71,5 +73,7 @@ def packed_forward(
         remat=remat,
         attend_fn=attend_fn,
         return_router_loss=return_router_loss,
+        return_hidden=return_hidden,
+        act_sharding=act_sharding,
         **kwargs,
     )

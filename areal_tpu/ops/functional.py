@@ -15,12 +15,20 @@ import jax.numpy as jnp
 
 
 def gather_logprobs(
-    logits: jnp.ndarray,  # [..., T, V] (fp32 recommended)
+    logits,  # [..., T, V] array (fp32 recommended) or ChunkedLogits
     labels: jnp.ndarray,  # [..., T] int
     temperature: float = 1.0,
 ) -> jnp.ndarray:
     """Log p(labels) under temperature-scaled logits (reference
-    utils/functional.py:29 `gather_logprobs`)."""
+    utils/functional.py:29 `gather_logprobs`). A lazy ``ChunkedLogits``
+    view dispatches to the memory-bounded chunked kernel — [T, V] is
+    never materialized."""
+    from areal_tpu.ops.chunked_head import ChunkedLogits, chunked_gather_logprobs
+
+    if isinstance(logits, ChunkedLogits):
+        return chunked_gather_logprobs(
+            logits.hidden, logits.head, labels, temperature=temperature
+        )
     if temperature != 1.0:
         logits = logits / temperature
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -31,11 +39,18 @@ def gather_logprobs(
 
 
 def gather_logprobs_entropy(
-    logits: jnp.ndarray,
+    logits,
     labels: jnp.ndarray,
     temperature: float = 1.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(logprobs, entropy) in one pass (reference utils/functional.py:54)."""
+    from areal_tpu.ops.chunked_head import ChunkedLogits, chunked_gather_logprobs
+
+    if isinstance(logits, ChunkedLogits):
+        return chunked_gather_logprobs(
+            logits.hidden, logits.head, labels,
+            temperature=temperature, with_entropy=True,
+        )
     if temperature != 1.0:
         logits = logits / temperature
     logp_full = jax.nn.log_softmax(logits, axis=-1)

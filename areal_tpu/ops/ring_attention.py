@@ -33,27 +33,81 @@ from areal_tpu.ops.basic import segment_attention
 NEG_INF = -2.3819763e38
 
 
-def _block_attend(q, k, v, mask):
-    """Unnormalized block attention: returns (scores_max, exp-sum, weighted
-    values) for online-softmax merging. q [B,tq,H,D]; k/v [B,tk,Hkv,D]."""
-    hq, hkv = q.shape[2], k.shape[2]
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    s = jnp.where(mask[:, None, :, :], s, NEG_INF)
-    m = jnp.max(s, axis=-1)  # [B, H, tq]
-    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
-    m_safe = jnp.maximum(m, -1e30)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(mask[:, None, :, :], p, 0.0)
-    l = jnp.sum(p, axis=-1)  # [B, H, tq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m_safe, l, o
+def _block_attend(
+    q, k, v, seg_q, seg_k, q_pos, kv_pos, causal, kv_chunk=1024
+):
+    """Unnormalized block attention for online-softmax merging: returns
+    (scores_max [B,H,tq], exp-sum [B,H,tq], weighted values [B,tq,H,D]).
+
+    Memory-bounded: the KV block is scanned in ``kv_chunk`` slices with a
+    running (m, l, o) — the [t_local, t_shard] logits tensor the round-3
+    version materialized never exists, and GQA uses the grouped einsum
+    instead of repeating KV heads (the flash-kernel memory profile, in
+    XLA, inside the ring step).
+
+    NOTE: the inner scan mirrors ops/blockwise_attention.kv_step but
+    returns UNNORMALIZED (m, l, o) with -1e30 max-clamping so blocks can
+    merge across ring steps (blockwise normalizes + zero-masks at the
+    end, which would lose the merge state). A numerics change in either
+    must be mirrored; tests/test_ring_attention.py::
+    test_block_attend_matches_blockwise pins them together."""
+    b, tq, hq, d = q.shape
+    tk, hkv = k.shape[1], k.shape[2]
+    rep = hq // hkv
+    scale = d**-0.5
+    ck = min(kv_chunk, tk)
+    while tk % ck:
+        ck //= 2
+    nk = tk // ck
+    qg = (q.astype(jnp.float32) * scale).reshape(b, tq, hkv, rep, d)
+    kr = k.astype(jnp.float32).reshape(b, nk, ck, hkv, d)
+    vr = v.astype(jnp.float32).reshape(b, nk, ck, hkv, d)
+    skr = seg_k.reshape(b, nk, ck)
+    kpr = kv_pos.reshape(nk, ck)
+
+    def step(carry, inp):
+        m, l, o = carry
+        kc, vc, sk, kp = inp
+        s = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, kc,
+            preferred_element_type=jnp.float32,
+        )  # [B, Hkv, rep, tq, ck]
+        mask = (seg_q[:, :, None] == sk[:, None, :]) & (
+            seg_q[:, :, None] > 0
+        )
+        if causal:
+            mask = mask & (kp[None, None, :] <= q_pos[None, :, None])
+        s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        m_safe = jnp.maximum(m_new, -1e30)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(mask[:, None, None, :, :], p, 0.0)
+        corr = jnp.exp(jnp.maximum(m, -1e30) - m_safe)
+        l = l * corr + p.sum(-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bgrqk,bkgd->bgrqd", p, vc,
+            preferred_element_type=jnp.float32,
+        )
+        return (jnp.maximum(m_new, -1e30), l, o), None
+
+    m0 = jnp.full((b, hkv, rep, tq), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, hkv, rep, tq), jnp.float32)
+    o0 = jnp.zeros((b, hkv, rep, tq, d), jnp.float32)
+    (m, l, o), _ = jax.lax.scan(
+        jax.checkpoint(step, prevent_cse=False),
+        (m0, l0, o0),
+        (
+            kr.swapaxes(0, 1),
+            vr.swapaxes(0, 1),
+            skr.swapaxes(0, 1),
+            kpr,
+        ),
+    )
+    # head h = g * rep + r, matching the [B,tq,Hq,D] reshape convention
+    m_flat = m.reshape(b, hq, tq)
+    l_flat = l.reshape(b, hq, tq)
+    o_flat = o.transpose(0, 3, 1, 2, 4).reshape(b, tq, hq, d)
+    return m_flat, l_flat, o_flat
 
 
 def ring_segment_attention(
@@ -94,12 +148,9 @@ def ring_segment_attention(
     src = idx
     for step in range(sp):
         kv_pos = src * t + jnp.arange(t)
-        mask = (segment_ids[:, :, None] == seg_cur[:, None, :]) & (
-            segment_ids[:, :, None] > 0
+        blk = _block_attend(
+            q, k_cur, v_cur, segment_ids, seg_cur, q_pos, kv_pos, causal
         )
-        if causal:
-            mask = mask & (kv_pos[None, None, :] <= q_pos[None, :, None])
-        blk = _block_attend(q, k_cur, v_cur, mask)
         m_acc, l_acc, o_acc = merge((m_acc, l_acc, o_acc), blk)
         if step + 1 < sp:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -141,7 +192,16 @@ def ulysses_segment_attention(
 
     qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
     seg_full = jax.lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
-    out = segment_attention(qg, kg, vg, seg_full, causal=causal)
+    if qg.shape[1] >= 4096:
+        # long context: bound attention memory to O(T·chunk) — the naive
+        # kernel's [T, T] logits would dominate the shard's HBM
+        from areal_tpu.ops.blockwise_attention import (
+            blockwise_segment_attention,
+        )
+
+        out = blockwise_segment_attention(qg, kg, vg, seg_full, causal=causal)
+    else:
+        out = segment_attention(qg, kg, vg, seg_full, causal=causal)
     return a2a_bwd(out)
 
 

@@ -1,0 +1,276 @@
+"""AOT memory-feasibility analysis: does a model's train step FIT?
+
+Role of the reference's allocation planning (areal/api/alloc_mode.py:253-320
+scaling guidance + the 7B/32B recipe tables in its blogs): before buying a
+slice, lower the REAL training program — full GRPO grad accumulation with
+remat + the adam update — against a virtual device mesh and read XLA's
+buffer-assignment analysis. No weights are materialized (pure
+``jax.eval_shape`` + AOT ``lower().compile()``), so a 7B×16-device plan
+compiles on a laptop CPU in minutes.
+
+The numbers are XLA's per-device buffer assignment for the CPU backend;
+TPU layouts differ slightly (lane padding), so treat them as a ~5%-accurate
+feasibility bound, not a byte-exact HBM plan.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from areal_tpu.api.cli_args import ParallelismConfig
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.models.forward import packed_forward
+from areal_tpu.models.transformer import init_params, param_logical_axes
+from areal_tpu.parallel import mesh as mesh_lib
+from areal_tpu.parallel import sharding as sharding_lib
+
+
+def _sds_tree(shapes, shardings):
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        shardings,
+    )
+
+
+def _mem(compiled) -> Dict[str, float]:
+    ma = compiled.memory_analysis()
+    out = {}
+    for k in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+        "peak_memory_in_bytes",
+    ):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k.replace("_in_bytes", "_gb")] = round(v / 1e9, 3)
+    return out
+
+
+def grpo_step_memory(
+    model_cfg: ModelConfig,
+    parallel: ParallelismConfig,
+    bucket: int = 16384,
+    seqs_per_row: int = 8,
+    param_dtype=jnp.float32,
+    compute_dtype=jnp.bfloat16,
+    hbm_limit_gb: float = 16.0,
+) -> Dict[str, Any]:
+    """AOT-lower the decoupled-GRPO grad step + adam apply for the given
+    mesh factoring; returns per-device memory numbers + a fits verdict.
+
+    The grad program is the engine's real shape: packed [rows, bucket]
+    streams, remat'd scanned layers, chunked LM head, decoupled PPO loss
+    (behavior + proximal logprobs), f32 grad accumulation with donation.
+    """
+    mesh = mesh_lib.make_mesh(parallel)
+    logical = param_logical_axes(model_cfg)
+    param_sh = sharding_lib.tree_shardings(mesh, logical)
+    params_shape = jax.eval_shape(
+        lambda: init_params(
+            model_cfg, jax.random.PRNGKey(0), dtype=param_dtype
+        )
+    )
+    params_sds = _sds_tree(params_shape, param_sh)
+    accum_shape = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32), params_shape
+    )
+    accum_sds = _sds_tree(accum_shape, param_sh)
+
+    rows = (
+        getattr(parallel, "dcn_data_parallel_size", 1)
+        * parallel.data_parallel_size
+        * parallel.fsdp_parallel_size
+    )
+    bsh = sharding_lib.batch_sharding(mesh)
+    row_sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(("data", "fsdp"))
+    )
+
+    def tok(dtype=jnp.int32, extra=()):
+        return jax.ShapeDtypeStruct((rows, bucket) + extra, dtype, sharding=bsh)
+
+    arrays_sds = {
+        "tokens": tok(),
+        "segment_ids": tok(),
+        "positions": tok(),
+        "t_loss_mask": tok(),
+        "t_logprobs": tok(jnp.float32),
+        "t_prox_logp": tok(jnp.float32),
+        "t_advantages": tok(jnp.float32),
+        "s_rewards": jax.ShapeDtypeStruct(
+            (rows, seqs_per_row), jnp.float32, sharding=row_sh
+        ),
+    }
+
+    from areal_tpu.engine.spmd_engine import target_aligned_logprobs
+    from areal_tpu.ops.functional import ppo_actor_loss_fn
+
+    # memory-faithful attention: the TPU path runs the splash kernel
+    # (O(T·block) live memory); AOT-lowering the naive XLA kernel would
+    # report the [T, T] logits it materializes. The blockwise XLA kernel
+    # has the splash kernel's memory profile with identical numerics.
+    from areal_tpu.ops.blockwise_attention import blockwise_segment_attention
+
+    act_sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(("data", "fsdp"), "seq", None)
+    )
+
+    def fwd_loss(params, arrays):
+        cparams = jax.tree_util.tree_map(
+            lambda p: p.astype(compute_dtype), params
+        )
+        logits = packed_forward(
+            cparams, model_cfg, arrays, remat=True, return_hidden=True,
+            attend_fn=blockwise_segment_attention, act_sharding=act_sh,
+        )
+        newlogp = target_aligned_logprobs(logits, arrays)
+        loss, _ = ppo_actor_loss_fn(
+            logprobs=newlogp,
+            old_logprobs=arrays["t_logprobs"],
+            advantages=arrays["t_advantages"],
+            eps_clip=0.2,
+            loss_mask=arrays["t_loss_mask"] > 0,
+            proximal_logprobs=arrays["t_prox_logp"],
+            behav_imp_weight_cap=5.0,
+        )
+        w = jnp.maximum(
+            arrays["t_loss_mask"].astype(jnp.float32).sum(), 1.0
+        )
+        return loss * w
+
+    def grad_step(params, grad_accum, arrays):
+        grads = jax.grad(fwd_loss)(params, arrays)
+        return jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), grad_accum, grads
+        )
+
+    grad_compiled = (
+        jax.jit(grad_step, donate_argnums=(1,))
+        .lower(params_sds, accum_sds, arrays_sds)
+        .compile()
+    )
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(learning_rate=1e-5, mu_dtype=jnp.float32),
+    )
+    opt_shape = jax.eval_shape(optimizer.init, params_sds)
+    # optimizer moments take their param's sharding (elementwise maps of
+    # the params) — attach it where shapes match so the argument-size
+    # number reflects the real ZeRO layout
+    flat_param_sh = {
+        s.shape: sh
+        for s, sh in zip(
+            jax.tree_util.tree_leaves(params_shape),
+            jax.tree_util.tree_leaves(param_sh),
+        )
+    }
+    opt_sds = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=flat_param_sh.get(s.shape)
+        ),
+        opt_shape,
+    )
+
+    def apply_step(params, opt_state, grad_accum, total_w):
+        grads = jax.tree_util.tree_map(lambda g: g / total_w, grad_accum)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = jax.tree_util.tree_map(
+            lambda n, o: n.astype(o.dtype), new_params, params
+        )
+        return new_params, new_opt
+
+    apply_compiled = (
+        jax.jit(apply_step, donate_argnums=(0, 1, 2))
+        .lower(
+            params_sds,
+            opt_sds,
+            accum_sds,
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+        .compile()
+    )
+
+    n_params = sum(
+        int(np.prod(s.shape))
+        for s in jax.tree_util.tree_leaves(params_shape)
+    )
+    n_dev = mesh.devices.size
+
+    def live_gb(mem: Dict[str, float]) -> float:
+        # CPU-backend peak_memory is unreliable (reports < temp); the
+        # defensible per-device bound is every live buffer class:
+        # arguments + outputs + temps, minus donated aliases
+        return round(
+            mem.get("argument_size_gb", 0.0)
+            + mem.get("output_size_gb", 0.0)
+            + mem.get("temp_size_gb", 0.0)
+            - mem.get("alias_size_gb", 0.0),
+            3,
+        )
+
+    grad_mem = _mem(grad_compiled)
+    apply_mem = _mem(apply_compiled)
+    grad_mem["live_gb"] = live_gb(grad_mem)
+    apply_mem["live_gb"] = live_gb(apply_mem)
+    worst = max(grad_mem["live_gb"], apply_mem["live_gb"])
+    return {
+        "model_params_m": round(n_params / 1e6, 1),
+        "mesh": {
+            k: int(v)
+            for k, v in zip(mesh.axis_names, mesh.devices.shape)
+            if v > 1
+        },
+        "n_devices": n_dev,
+        "bucket_tokens_per_row": bucket,
+        "grad_step": grad_mem,
+        "apply_step": apply_mem,
+        "peak_per_device_gb": worst,
+        "hbm_limit_gb": hbm_limit_gb,
+        "fits": bool(worst > 0 and worst <= hbm_limit_gb),
+    }
+
+
+def qwen2_7b_config() -> ModelConfig:
+    """Qwen2-7B geometry (the BASELINE north-star model on v5e-16)."""
+    return ModelConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        max_position_embeddings=32768,
+        rope_theta=1e6,
+        tie_word_embeddings=False,
+        attention_bias=True,
+        family="qwen2",
+    )
+
+
+def qwen2_1p5b_config() -> ModelConfig:
+    """Qwen2-1.5B geometry (the async-RL 1.5B recipe)."""
+    return ModelConfig(
+        vocab_size=151936,
+        hidden_size=1536,
+        intermediate_size=8960,
+        num_layers=28,
+        num_heads=12,
+        num_kv_heads=2,
+        head_dim=128,
+        max_position_embeddings=32768,
+        rope_theta=1e6,
+        tie_word_embeddings=True,
+        attention_bias=True,
+        family="qwen2",
+    )

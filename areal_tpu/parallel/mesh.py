@@ -29,14 +29,51 @@ from areal_tpu.api.cli_args import ParallelismConfig
 MESH_AXES = ("data", "fsdp", "seq", "expert", "tensor")
 
 
+def _slice_id(d) -> int:
+    """Slice index of a device: real TPU slices expose ``slice_index``;
+    single-slice/CPU backends fall back to 0."""
+    return int(getattr(d, "slice_index", 0) or 0)
+
+
+def _hybrid_device_order(
+    devices: Sequence[jax.Device], n_slices: int
+) -> Sequence[jax.Device]:
+    """Order devices so the LEADING mesh positions stride across slices:
+    with the data axis outermost, only data-parallel collectives (grad
+    psum once per step) cross the slow DCN links; fsdp/seq/tensor/expert
+    collectives stay within one slice's ICI. This is the scaling-book /
+    MaxText hybrid-mesh recipe (dcn data parallelism between slices), the
+    TPU answer to the reference's cross-node recipes (its 32B runs span
+    nodes with NCCL PP+DP; here the mesh factoring does it)."""
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(_slice_id(d), []).append(d)
+    if len(by_slice) < n_slices:
+        raise ValueError(
+            f"dcn_data_parallel_size={n_slices} but only "
+            f"{len(by_slice)} slice(s) visible"
+        )
+    groups = [by_slice[s] for s in sorted(by_slice)][:n_slices]
+    per = min(len(g) for g in groups)
+    # slice-major: [slice0 chips..., slice1 chips...] so reshaping with
+    # data outermost puts each slice's chips contiguous on inner axes
+    out = []
+    for g in groups:
+        out.extend(g[:per])
+    return out
+
+
 def make_mesh(
     parallel: ParallelismConfig,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
+    n_slices = getattr(parallel, "dcn_data_parallel_size", 1) or 1
+    if n_slices > 1:
+        devices = _hybrid_device_order(devices, n_slices)
     shape = (
-        parallel.data_parallel_size,
+        n_slices * parallel.data_parallel_size,
         parallel.fsdp_parallel_size,
         parallel.seq_parallel_size,
         getattr(parallel, "expert_parallel_size", 1),

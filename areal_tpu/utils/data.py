@@ -114,6 +114,35 @@ def concat_padded_tensors(
     return out
 
 
+def sample_uid(item: Any) -> str:
+    """Stable id of one dataset item for used-data tracking (reference
+    realhf/base/recover.py hashes consumed samples so a resumed run never
+    trains one twice). Prefers an explicit id field; otherwise hashes a
+    canonical JSON view of the item (arrays → bytes)."""
+    import hashlib
+    import json as _json
+
+    if isinstance(item, dict):
+        for k in ("qid", "uid", "id", "task_id", "query_id"):
+            if item.get(k) is not None:
+                return f"{k}:{item[k]}"
+
+    def norm(v):
+        if isinstance(v, np.ndarray):
+            return ["<nd>", v.shape, str(v.dtype),
+                    hashlib.blake2b(v.tobytes(), digest_size=8).hexdigest()]
+        if isinstance(v, dict):
+            return {str(k): norm(x) for k, x in sorted(v.items())}
+        if isinstance(v, (list, tuple)):
+            return [norm(x) for x in v]
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            return v
+        return repr(v)
+
+    blob = _json.dumps(norm(item), sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
 def batch_select(batch: Batch, indices: Sequence[int]) -> Batch:
     idx = np.asarray(indices, dtype=np.int64)
     return {k: np.asarray(v)[idx] for k, v in batch.items()}

@@ -84,6 +84,17 @@ class RecoverHandler:
         if not force and not self.freq_ctl.check(epochs=0, steps=1):
             return False
         os.makedirs(self.recover_root, exist_ok=True)
+        # used-data exclusion: fold the executor's consumed-sample uids
+        # into the dataloader's used set BEFORE snapshotting it, so a
+        # resumed run skips exactly the trained samples
+        # (reference master_worker.py:121-128)
+        executor = getattr(inference_engine, "workflow_executor", None)
+        if (
+            executor is not None
+            and dataloader is not None
+            and hasattr(dataloader, "mark_used")
+        ):
+            dataloader.mark_used(executor.drain_consumed_uids())
         info = RecoverInfo(
             last_step_info=step_info,
             saver_state=saver.state_dict() if saver else {},

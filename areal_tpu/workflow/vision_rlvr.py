@@ -186,6 +186,11 @@ class VisionRLVRWorkflow(RLVRWorkflow):
                 ),
                 "rewards": np.asarray([reward], np.float32),
             }
+            if pixel_values is not None and vis_meta is None:
+                # no patch grid: ship the raw pixel payload only (the
+                # pre-VLM data contract — trainer models without a vision
+                # tower ignore it)
+                row["pixel_values"] = np.asarray(pixel_values)[None]
             if vis_meta is not None:
                 img_id = self._resolve_image_token_id()
                 if img_id is None:

@@ -221,6 +221,45 @@ def main(argv=None):
         )
         return report.accuracy
 
+    import re as _re
+
+    def _diagnostics(batch, items):
+        """Per-step curve diagnostics: sampling entropy proxy (mean
+        behavior NLL per completion token), completion well-formedness,
+        and GREEDY accuracy on the SAME train prompts — separating "the
+        policy got worse" from "temperature-1 sampling got noisier"
+        (the round-3 falling-train-reward question)."""
+        lm = np.asarray(batch["loss_mask"]) > 0
+        lp = np.asarray(batch["logprobs"])
+        mean_nll = float(-(lp[lm]).mean()) if lm.any() else 0.0
+        ids = np.asarray(batch["input_ids"])
+        wellformed = 0
+        lens = []
+        for i in range(ids.shape[0]):
+            comp = tok.decode(ids[i][lm[i]].tolist())
+            lens.append(len(comp))
+            if _re.fullmatch(r"-?\d+#", comp):
+                wellformed += 1
+        greedy_hits = 0
+        for it in items:
+            out = rollout.engine.generate(
+                {
+                    "input_ids": it["input_ids"],
+                    "sampling_params": {
+                        "max_new_tokens": 8, "greedy": True,
+                        "stop_token_ids": [STOP_ID],
+                    },
+                }
+            )
+            comp = tok.decode(out["output_ids"])
+            greedy_hits += process_results(comp, it["answer"]) > 0
+        return {
+            "behavior_nll": round(mean_nll, 4),  # rises = noisier sampling
+            "frac_wellformed": round(wellformed / max(ids.shape[0], 1), 3),
+            "mean_completion_len": round(float(np.mean(lens)), 2),
+            "greedy_train_acc": round(greedy_hits / max(len(items), 1), 3),
+        }
+
     stats_path = os.path.join(args.out, "stats.jsonl")
     meta = WeightUpdateMeta(type=WeightUpdateMethod.DEVICE, model_version=0)
     with open(stats_path, "w") as f:
@@ -234,6 +273,7 @@ def main(argv=None):
             ]
             batch = rollout.rollout_batch(items, workflow)
             batch = actor.compute_advantages(dict(batch))
+            diag = _diagnostics(batch, items)
             train_stats = actor.ppo_update(batch)
             rollout.pause()
             new_version = engine.get_version() + 1
@@ -249,9 +289,9 @@ def main(argv=None):
                 "loss": float(train_stats[0]["loss"]),
                 "grad_norm": float(train_stats[0]["grad_norm"]),
                 "step_time_s": round(time.time() - t0, 2),
+                **diag,
+                "eval_accuracy": evaluate(),
             }
-            if step % 5 == 0 or step == args.grpo_steps - 1:
-                rec["eval_accuracy"] = evaluate()
             f.write(json.dumps(rec) + "\n")
             f.flush()
             print(f"[grpo] {rec}", flush=True)

@@ -1,0 +1,145 @@
+"""Ray launcher logic against a stub ray client (ray is not in this
+image): placement groups, bundle pinning, array submit, wait/cancel."""
+
+import types
+
+import pytest
+
+from areal_tpu.launcher.ray import RayLauncher
+
+
+class _Future:
+    def __init__(self, fid, result):
+        self.fid = fid
+        self._result = result
+        self.cancelled = False
+
+
+class _PG:
+    def __init__(self, bundles, strategy):
+        self.bundles = bundles
+        self.strategy = strategy
+        self.removed = False
+
+    def ready(self):
+        return _Future("pg-ready", None)
+
+
+class _PGStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index,
+                 placement_group_capture_child_tasks):
+        self.pg = placement_group
+        self.bundle_index = placement_group_bundle_index
+
+
+class _StubRay:
+    """Just enough of ray's surface for the launcher: remote tasks run
+    eagerly, futures resolve immediately."""
+
+    def __init__(self):
+        self.submitted = []  # (opts, fn, args, kwargs)
+        self.cancelled = []
+
+        strategies = types.SimpleNamespace(
+            PlacementGroupSchedulingStrategy=_PGStrategy
+        )
+        self.util = types.SimpleNamespace(
+            placement_group=lambda bundles, strategy: _PG(bundles, strategy),
+            remove_placement_group=self._remove_pg,
+            scheduling_strategies=strategies,
+        )
+        self._removed_pgs = []
+
+    def _remove_pg(self, pg):
+        pg.removed = True
+        self._removed_pgs.append(pg)
+
+    def is_initialized(self):
+        return True
+
+    def remote(self, **opts):
+        stub = self
+
+        def deco(fn):
+            class _Remote:
+                @staticmethod
+                def remote(*args, **kwargs):
+                    fut = _Future(len(stub.submitted), fn(*args, **kwargs))
+                    stub.submitted.append((opts, fn, args, kwargs, fut))
+                    return fut
+
+            return _Remote
+
+        return deco
+
+    def get(self, fut, timeout=None):
+        return fut._result
+
+    def wait(self, futures, num_returns=1, timeout=None):
+        return futures[:num_returns], futures[num_returns:]
+
+    def cancel(self, fut, force=False):
+        fut.cancelled = True
+        self.cancelled.append(fut)
+
+
+@pytest.fixture()
+def launcher():
+    stub = _StubRay()
+    return RayLauncher("exp", "t0", "/tmp", client=stub), stub
+
+
+def test_submit_resources_and_env(launcher):
+    lau, stub = launcher
+    lau.submit(
+        "trainer", lambda x: x * 2, args=(21,), cpus=4, mem_mb=2048,
+        tpus=8, env_vars={"A": "1"},
+    )
+    opts, _, args, _, fut = stub.submitted[0]
+    assert opts["num_cpus"] == 4
+    assert opts["memory"] == 2048 * 1024 * 1024
+    assert opts["resources"] == {"TPU": 8}
+    assert opts["runtime_env"]["env_vars"] == {"A": "1"}
+    assert fut._result == 42
+
+
+def test_placement_group_bundle_pinning(launcher):
+    lau, stub = launcher
+    lau.create_placement_group(
+        "servers", [{"TPU": 4}] * 3, strategy="STRICT_SPREAD"
+    )
+    lau.submit_array(
+        "gen", lambda: "ok", count=3, placement_group="servers", tpus=4
+    )
+    assert len(stub.submitted) == 3
+    for i, (opts, *_rest) in enumerate(stub.submitted):
+        strat = opts["scheduling_strategy"]
+        assert strat.bundle_index == i
+        assert strat.pg.strategy == "STRICT_SPREAD"
+    assert set(lau.jobs) == {"gen:0", "gen:1", "gen:2"}
+
+
+def test_wait_and_stop_all(launcher):
+    lau, stub = launcher
+    lau.create_placement_group("pg", [{"CPU": 1}])
+    lau.submit("a", lambda: 1)
+    lau.submit("b", lambda: 2)
+    results = lau.wait()
+    assert results == {"a": 1, "b": 2}
+    lau.submit("c", lambda: 3)
+    lau.stop_all()
+    assert stub.cancelled and not lau.jobs
+    assert all(pg.removed for pg in stub._removed_pgs)
+
+
+def test_missing_ray_is_a_clear_error(monkeypatch):
+    import areal_tpu.launcher.ray as rmod
+
+    def boom():
+        raise RuntimeError(
+            "RayLauncher needs the `ray` package, which is not installed. "
+        )
+
+    monkeypatch.setattr(rmod, "_ray", boom)
+    with pytest.raises(RuntimeError, match="ray"):
+        RayLauncher("e", "t", "/tmp")

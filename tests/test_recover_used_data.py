@@ -1,0 +1,89 @@
+"""Recover used-data exclusion: after a kill-and-resume, no consumed
+sample trains twice and submitted-but-unconsumed samples are re-yielded
+(reference realhf/base/recover.py + master_worker.py:121-128)."""
+
+import numpy as np
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
+from areal_tpu.dataset import StatefulDataLoader
+from areal_tpu.utils.data import sample_uid
+
+
+def _items(n):
+    return [{"qid": f"q{i}", "input_ids": [i, i + 1]} for i in range(n)]
+
+
+def test_sample_uid_stability():
+    a = {"qid": "x", "input_ids": [1, 2]}
+    assert sample_uid(a) == "qid:x"
+    b = {"input_ids": [1, 2], "arr": np.arange(4)}
+    c = {"arr": np.arange(4), "input_ids": [1, 2]}  # key order irrelevant
+    assert sample_uid(b) == sample_uid(c)
+    assert sample_uid(b) != sample_uid({"input_ids": [1, 3]})
+
+
+class _StubEngine:
+    def get_version(self):
+        return 0
+
+
+class _EchoWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        L = 4
+        return {
+            "input_ids": np.asarray([data["input_ids"] + [0] * 2], np.int32),
+            "attention_mask": np.ones((1, L), np.bool_),
+            "rewards": np.asarray([1.0], np.float32),
+            "qid_tag": np.asarray([int(data["qid"][1:])], np.int32),
+        }
+
+
+def test_kill_and_resume_trains_nothing_twice(tmp_path):
+    items = _items(10)
+    loader = StatefulDataLoader(items, batch_size=2, shuffle=True, seed=3)
+
+    cfg = InferenceEngineConfig(
+        experiment_name="rec", trial_name="t0",
+        consumer_batch_size=2, max_concurrent_rollouts=8,
+        max_head_offpolicyness=8, request_timeout=60,
+    )
+    ex = WorkflowExecutor(cfg, _StubEngine()).initialize()
+    try:
+        it = iter(loader)
+        # async pipeline: SUBMIT three dataloader batches (6 samples)...
+        submitted = []
+        for _ in range(3):
+            batch_items = next(it)
+            for item in batch_items:
+                ex.submit(item, _EchoWorkflow())
+                submitted.append(item["qid"])
+        # ...but CONSUME only two consumer batches (4 samples)
+        consumed_tags = []
+        for _ in range(2):
+            out = ex.wait(count=2)
+            consumed_tags.extend(np.asarray(out["qid_tag"]).tolist())
+        assert len(consumed_tags) == 4
+
+        # --- crash here: recover folds consumed uids into the loader and
+        # snapshots its state (mirrors RecoverHandler.dump wiring) ---
+        loader.mark_used(ex.drain_consumed_uids())
+        state = loader.state_dict()
+    finally:
+        ex.destroy()
+
+    # --- resume in a "new process": fresh loader, restored state ---
+    loader2 = StatefulDataLoader(items, batch_size=2, shuffle=True, seed=3)
+    loader2.load_state_dict(state)
+    resumed = [it["qid"] for batch in loader2 for it in batch]
+
+    consumed_qids = {f"q{t}" for t in consumed_tags}
+    # nothing consumed is ever yielded again
+    assert not (set(resumed) & consumed_qids), (resumed, consumed_qids)
+    # every UNconsumed sample (including submitted-but-unconsumed whose
+    # rollouts died with the crash) IS yielded
+    all_qids = {it["qid"] for it in items}
+    assert set(resumed) == all_qids - consumed_qids
+    # next epoch starts clean: every sample eligible again
+    second_epoch = [it["qid"] for batch in loader2 for it in batch]
+    assert set(second_epoch) == all_qids
