@@ -26,6 +26,10 @@ class ModelRequest:
     # VLM inputs: base64-encoded images interleaved with image tokens in
     # input_ids (reference io_struct.py ModelRequest.image_data)
     image_data: List[str] = dataclasses.field(default_factory=list)
+    # processed multimodal payload for the in-repo engine's mm prefill:
+    # pixel_values / vis_seg / vis_pos_h / vis_pos_w / mm_index /
+    # mrope_pos (+ optional rope_delta); see inference/engine._Request.mm
+    mm: Optional[Dict[str, Any]] = None
     metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

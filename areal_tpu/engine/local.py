@@ -92,10 +92,14 @@ class LocalSyncInferenceEngine(InferenceEngine):
             stop_reason not in ("stop", "length")
             and len(accumulated) < gconfig.max_new_tokens
         ):
+            payload_extra = (
+                {"mm": req.mm} if getattr(req, "mm", None) is not None else {}
+            )
             fut = self.engine.submit(
                 {
                     "rid": req.rid,
                     "input_ids": list(req.input_ids) + accumulated,
+                    **payload_extra,
                     "sampling_params": {
                         "max_new_tokens": gconfig.max_new_tokens
                         - len(accumulated),

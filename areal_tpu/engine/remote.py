@@ -174,6 +174,27 @@ class RemoteInferenceEngine(InferenceEngine):
             }
             if req.image_data:
                 payload["image_data"] = list(req.image_data)
+            if req.mm is not None:
+                # JSON-safe multimodal payload. The big float32 patch
+                # tensor goes as ONE base64 blob (nested JSON lists would
+                # be ~8x the bytes and dominate request parsing); the
+                # small int meta arrays stay as lists.
+                import base64 as _b64
+                import numpy as _np
+
+                mm_json = {}
+                for k, v in req.mm.items():
+                    if k == "pixel_values":
+                        arr = _np.asarray(v, _np.float32)
+                        mm_json["pixel_values_b64"] = _b64.b64encode(
+                            arr.tobytes()
+                        ).decode()
+                        mm_json["pixel_values_shape"] = list(arr.shape)
+                    else:
+                        mm_json[k] = (
+                            v.tolist() if hasattr(v, "tolist") else v
+                        )
+                payload["mm"] = mm_json
             payload["sampling_params"].update(
                 {
                     "min_new_tokens": max(

@@ -76,6 +76,28 @@ class _Request:
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     preemptions: int = 0
+    # multimodal payload (VLM serving): pixel_values [P, Dp],
+    # vis_seg/vis_pos_h/vis_pos_w [P], mm_index [plen] (-1 = text),
+    # mrope_pos [plen, 3]; rope_delta shifts decode rope positions
+    # (mrope compresses image blocks, so text positions lag cache length)
+    mm: Optional[Dict[str, np.ndarray]] = None
+    rope_delta: int = 0
+    _mm_key: Optional[bytes] = None
+
+    @property
+    def mm_key(self) -> bytes:
+        """Identity of the visual inputs: GRPO sibling grouping and page
+        sharing must distinguish same-token prompts with different
+        pixels."""
+        if self.mm is None:
+            return b""
+        if self._mm_key is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=12)
+            h.update(np.ascontiguousarray(self.mm["pixel_values"]).tobytes())
+            self._mm_key = h.digest()
+        return self._mm_key
 
     @property
     def all_tokens(self) -> List[int]:
@@ -92,8 +114,46 @@ class _Request:
         return self.min_new_tokens - len(self.output_ids)
 
 
+_MM_KEYS = (
+    "pixel_values", "vis_seg", "vis_pos_h", "vis_pos_w", "mm_index",
+    "mrope_pos",
+)
+_MM_DTYPES = {"pixel_values": np.float32, "mrope_pos": np.int32}
+
+
 def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
     sp = payload.get("sampling_params", {})
+    mm = None
+    rope_delta = 0
+    if payload.get("mm"):
+        raw = dict(payload["mm"])
+        if "pixel_values_b64" in raw:
+            # binary transport (remote client): base64 float32 + shape
+            import base64
+
+            raw["pixel_values"] = np.frombuffer(
+                base64.b64decode(raw.pop("pixel_values_b64")), np.float32
+            ).reshape(raw.pop("pixel_values_shape"))
+        required = ("pixel_values", "vis_seg", "vis_pos_h", "vis_pos_w",
+                    "mm_index")
+        missing = [k for k in required if k not in raw]
+        if missing:
+            # reject on the CALLER thread — a KeyError later inside the
+            # engine loop would kill serving for every request
+            raise ValueError(f"mm payload missing keys: {missing}")
+        mm = {
+            k: np.asarray(raw[k], _MM_DTYPES.get(k, np.int32))
+            for k in _MM_KEYS
+            if k in raw
+        }
+        if "rope_delta" in raw:
+            rope_delta = int(raw["rope_delta"])
+        elif "mrope_pos" in mm and len(mm["mrope_pos"]):
+            # text token at sequence index i has rope position i + delta
+            # (mrope compresses each image block to max(t, h/m, w/m) slots)
+            rope_delta = int(mm["mrope_pos"].max()) + 1 - len(
+                mm["mrope_pos"]
+            )
     return _Request(
         rid=payload.get("rid", f"req-{time.time_ns()}"),
         input_ids=list(payload["input_ids"]),
@@ -105,6 +165,8 @@ def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
         greedy=bool(sp.get("greedy", False)),
         stop_token_ids=list(sp.get("stop_token_ids", [])),
         future=fut,
+        mm=mm,
+        rope_delta=rope_delta,
     )
 
 
@@ -263,6 +325,12 @@ class GenerationEngine:
         # device-resident cached length per slot: decode chunk N+1 can
         # dispatch before chunk N's results reach the host
         self._lens_dev = jnp.zeros(s, jnp.int32)
+        # VLM slots: mrope text positions lag the cache index by a
+        # per-request constant; tracked per slot, passed to decode only
+        # when some active slot is multimodal (text-only serving keeps
+        # its compiled programs)
+        self._rope_delta_dev = jnp.zeros(s, jnp.int32)
+        self._slot_mm = np.zeros(s, bool)
         # per-slot last (partial) pool row — lets merges avoid reading the
         # pool (see model_runner.init_last_rows)
         from areal_tpu.inference.model_runner import init_last_rows
@@ -284,7 +352,7 @@ class GenerationEngine:
             for attr in (
                 "_cur_tokens", "_active_dev", "_temp_dev", "_top_p_dev",
                 "_top_k_dev", "_greedy_dev", "_remaining", "_no_stop",
-                "_stop_tokens", "_lens_dev",
+                "_stop_tokens", "_lens_dev", "_rope_delta_dev",
             ):
                 setattr(
                     self, attr,
@@ -561,6 +629,14 @@ class GenerationEngine:
         device considers active)."""
         pages = self._slot_pages.pop(slot, [])
         cached = int(self._cached_len[slot])
+        if self._slot_mm[slot]:
+            # pixel-conditioned KV must not enter the token-keyed prefix
+            # registry (a text request with the same tokens would claim it)
+            park_tokens = None
+            self._slot_mm[slot] = False
+            # a later text request reusing this slot may be admitted while
+            # the delta scatter is gated off — never leave a stale shift
+            self._rope_delta_dev = self._rope_delta_dev.at[slot].set(0)
         self._active_dev = self._active_dev.at[slot].set(False)
         # the device-side length must be zeroed too: a stale length with a
         # reset table row would make the next decode dispatch DMA pages at
@@ -638,13 +714,26 @@ class GenerationEngine:
         ):
             return False
         self._pending_since = None
+        # --- one modality per wave: mm waves carry an embeds tensor the
+        # text prefill program doesn't, so mixing would recompile ---
+        later: List[_Request] = []
+        if self.model_config.vision is not None and any(
+            r.mm is not None for r in self._pending
+        ):
+            kind_mm = self._pending[0].mm is not None
+            later = [
+                r for r in self._pending if (r.mm is not None) != kind_mm
+            ]
+            self._pending = [
+                r for r in self._pending if (r.mm is not None) == kind_mm
+            ]
         # --- select: group identical prompts; <= wave unique prompts,
         # total admitted <= free slots ---
         groups: Dict[tuple, List[_Request]] = {}
         rest: List[_Request] = []
         budget = len(self._free_slots)
         for req in self._pending:
-            key = tuple(req.all_tokens)
+            key = (tuple(req.all_tokens), req.mm_key)
             if budget > 0 and key in groups:
                 groups[key].append(req)
                 budget -= 1
@@ -653,7 +742,7 @@ class GenerationEngine:
                 budget -= 1
             else:
                 rest.append(req)
-        self._pending = rest
+        self._pending = rest + later
         if not groups:
             return False
 
@@ -668,7 +757,11 @@ class GenerationEngine:
         admitted_groups: List[List[_Request]] = []
         for rep, group in zip(reps, groups.values()):
             prompt = rep.all_tokens
-            shared, off = self.registry.claim(self.pm, prompt)
+            if rep.mm is not None:
+                # pixel-conditioned KV: no token-keyed prefix reuse
+                shared, off = [], 0
+            else:
+                shared, off = self.registry.claim(self.pm, prompt)
             need = -(-len(prompt) // bs) - len(shared)
             fresh = self._alloc_pages(need)
             if fresh is None:
@@ -732,6 +825,56 @@ class GenerationEngine:
         row_slots = np.zeros(n_rows, np.int32)
         for i, slot in enumerate(rep_slots):
             row_slots[i] = slot
+        # --- VLM wave: splice vision embeds once, build mrope positions
+        # (offsets are 0 for mm rows — no prefix reuse — so the suffix IS
+        # the full prompt + any accumulated text) ---
+        pf_embeds = pf_pos3 = None
+        if (
+            self.model_config.vision is not None
+            and any(g[0].mm is not None for g in admitted_groups)
+        ):
+            vc = self.model_config.vision
+            p_pad = data_utils.next_bucket_size(
+                max(
+                    g[0].mm["pixel_values"].shape[0]
+                    for g in admitted_groups
+                    if g[0].mm is not None
+                ),
+                64,
+            )
+            pix = np.zeros((n_rows, p_pad, vc.patch_dim), np.float32)
+            seg = np.zeros((n_rows, p_pad), np.int32)
+            ph = np.zeros((n_rows, p_pad), np.int32)
+            pw = np.zeros((n_rows, p_pad), np.int32)
+            ords = np.full((n_rows, tp), -1, np.int32)
+            pos3 = np.zeros((n_rows, tp, 3), np.int32)
+            for i, group in enumerate(admitted_groups):
+                mm = group[0].mm
+                if mm is None:
+                    continue
+                p_n = mm["pixel_values"].shape[0]
+                pix[i, :p_n] = mm["pixel_values"]
+                seg[i, :p_n] = mm["vis_seg"][:p_n]
+                ph[i, :p_n] = mm["vis_pos_h"][:p_n]
+                pw[i, :p_n] = mm["vis_pos_w"][:p_n]
+                L = min(len(group[0].all_tokens), tp)
+                n_ord = min(len(mm["mm_index"]), L)
+                ords[i, :n_ord] = mm["mm_index"][:n_ord]
+                mp = mm.get("mrope_pos")
+                n_p = min(len(mp), L) if mp is not None else 0
+                if n_p:
+                    pos3[i, :n_p] = mp[:n_p]
+                if n_p < L:  # accumulated text continues at idx + delta
+                    ext = np.arange(n_p, L, dtype=np.int32) + np.int32(
+                        group[0].rope_delta
+                    )
+                    pos3[i, n_p:L] = ext[:, None]
+            pf_embeds = model_runner.mm_prompt_embeds(
+                self.params, self.model_config, jnp.asarray(tokens),
+                jnp.asarray(pix), jnp.asarray(seg), jnp.asarray(ph),
+                jnp.asarray(pw), jnp.asarray(ords),
+            )
+            pf_pos3 = jnp.asarray(pos3)
         self.cache, wave_logits, pf_last = model_runner.prefill_batch(
             self.params, self.model_config, self.cache,
             jnp.asarray(tokens), jnp.asarray(row_offsets),
@@ -739,6 +882,8 @@ class GenerationEngine:
             prefix_bound=pf_prefix_bound,
             last_rows=self._last_rows,
             slot_ids=jnp.asarray(row_slots),
+            embeds=pf_embeds,
+            pos3=pf_pos3,
         )
 
         # --- sibling fan-out: share full prompt pages, copy the partial
@@ -787,6 +932,7 @@ class GenerationEngine:
         # --- batched per-slot state update (one scatter per state array) ---
         n = len(admitted)
         slots_np = np.zeros(n, np.int32)
+        deltas = np.zeros(n, np.int32)
         temps = np.zeros(n, np.float32)
         top_ps = np.zeros(n, np.float32)
         top_ks = np.zeros(n, np.int32)
@@ -809,6 +955,7 @@ class GenerationEngine:
             # device-side budget starts at allowed − 1
             remainings[j] = min(req.budget_left, m - plen) - 1
             no_stops[j] = req.min_left - 1
+            deltas[j] = req.rope_delta
             ids = np.asarray(req.stop_token_ids[:8], np.int32)
             stops[j, : len(ids)] = ids
         sl = jnp.asarray(slots_np)
@@ -821,6 +968,10 @@ class GenerationEngine:
         self._remaining = self._remaining.at[sl].set(jnp.asarray(remainings))
         self._no_stop = self._no_stop.at[sl].set(jnp.asarray(no_stops))
         self._stop_tokens = self._stop_tokens.at[sl].set(jnp.asarray(stops))
+        if any(self._slot_mm) or deltas.any():
+            self._rope_delta_dev = self._rope_delta_dev.at[sl].set(
+                jnp.asarray(deltas)
+            )
 
         # --- last-row state for every admitted slot (siblings share the
         # representative's prefill row content) ---
@@ -867,6 +1018,7 @@ class GenerationEngine:
         self._cached_len[slot] = cached
         self._tables[slot] = self.cache_config.num_pages
         self._tables[slot, : len(pages)] = pages
+        self._slot_mm[slot] = req.mm is not None
 
     # ------------------------------------------------------------------
     # Decode
@@ -991,6 +1143,9 @@ class GenerationEngine:
             ppcb=self.config.pages_per_compute_block,
             spb=self.config.slots_per_block,
             last_rows=self._last_rows,
+            rope_delta=(
+                self._rope_delta_dev if self._slot_mm.any() else None
+            ),
         )
         self._cur_tokens = toks[-1]
         self._active_dev = active_after

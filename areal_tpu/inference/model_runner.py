@@ -305,6 +305,8 @@ def prefill_forward(
     true_lens: jnp.ndarray,  # [N] int32 suffix lengths (0 = padding row)
     tables: jnp.ndarray,  # [N, PPS] logical pages covering offset+Tp
     prefix_bound: int = 0,  # static: gathered window >= max(offsets), 0 = none
+    embeds: Optional[jnp.ndarray] = None,  # [N, Tp, D] input embeddings
+    pos3: Optional[jnp.ndarray] = None,  # [N, Tp, 3] mrope positions
 ):
     """One batched READ-ONLY forward over N prompt suffixes; returns
     (logits [N, V] fp32, k_sfx, v_sfx [L, N, Tp, Hkv, D]) — the caller
@@ -327,7 +329,20 @@ def prefill_forward(
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
-    x = params["embedding"][tokens]  # [N, Tp, D]
+    if embeds is not None:
+        # VLM path: image-token embeddings were spliced at admission
+        # (mm_prompt_embeds); no second lookup
+        x = embeds.astype(params["embedding"].dtype)
+    else:
+        x = params["embedding"][tokens]  # [N, Tp, D]
+
+    def _rope(t):  # [N, Tp, H, D]
+        if pos3 is not None and cfg.mrope_sections:
+            from areal_tpu.ops.basic import apply_mrope
+
+            return apply_mrope(t, pos3, cos, sin, cfg.mrope_sections)
+        return apply_rope(t, pos, cos, sin)
+
     scale = cfg.head_dim**-0.5
     g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
@@ -377,8 +392,8 @@ def prefill_forward(
         lp, li = xs
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _project_qkv(cfg, lp, h)  # [N, Tp, H*, Dh]
-        q = apply_rope(q, pos, cos, sin)
-        k = apply_rope(k, pos, cos, sin)
+        q = _rope(q)
+        k = _rope(k)
         kz = jnp.where(valid_q[..., None, None], k, 0)
         vz = jnp.where(valid_q[..., None, None], v, 0)
         qg = q.reshape(n, tp, g, rep, cfg.head_dim)
@@ -467,18 +482,48 @@ def prefill_batch(
     prefix_bound: int = 0,
     last_rows: Optional[Dict[str, jnp.ndarray]] = None,
     slot_ids: Optional[jnp.ndarray] = None,
+    embeds: Optional[jnp.ndarray] = None,
+    pos3: Optional[jnp.ndarray] = None,
 ):
     """Read-only forward + write-only merge (two dispatches).
     Returns (cache, logits, new_last_rows [L, N, Hkv, FD])."""
     logits, k_sfx, v_sfx = prefill_forward(
         params, cfg, cache, tokens, offsets, true_lens, tables,
-        prefix_bound=prefix_bound,
+        prefix_bound=prefix_bound, embeds=embeds, pos3=pos3,
     )
     cache, new_last = merge_tokens(
         cache, tables, offsets, true_lens, k_sfx, v_sfx,
         last_rows=last_rows, slot_ids=slot_ids,
     )
     return cache, logits, new_last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def mm_prompt_embeds(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [N, Tp] int32 prompt tokens (padded)
+    pixels: jnp.ndarray,  # [N, P, patch_dim]
+    vis_seg: jnp.ndarray,  # [N, P]
+    vis_pos_h: jnp.ndarray,  # [N, P]
+    vis_pos_w: jnp.ndarray,  # [N, P]
+    ordinals: jnp.ndarray,  # [N, Tp] merged-patch ordinal; -1 = text
+) -> jnp.ndarray:
+    """Prompt embeddings with vision embeds spliced at image-pad tokens —
+    computed ONCE at admission; prefill consumes the result instead of a
+    token lookup (the serving analog of models/forward.packed_forward's
+    training-side splice)."""
+    from areal_tpu.models import vision as vision_lib
+
+    x = params["embedding"][tokens]
+    emb = vision_lib.vision_apply(
+        params["vision"], cfg.vision, pixels, vis_seg, vis_pos_h,
+        vis_pos_w, remat=False,
+    )  # [N, Pm, D]
+    gathered = jnp.take_along_axis(
+        emb, jnp.clip(ordinals, 0)[..., None].astype(jnp.int32), axis=1
+    ).astype(x.dtype)
+    return jnp.where(ordinals[..., None] >= 0, gathered, x)
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))
@@ -538,9 +583,14 @@ def _decode_core(
     ppcb: int,
     spb: int,
     topk_bound: int,
+    rope_delta: Optional[jnp.ndarray] = None,  # [S] mrope text-position shift
 ):
     """Shared body of decode_multi / decode_step. When sample_args is None,
-    runs exactly one step and returns the logits instead of sampling."""
+    runs exactly one step and returns the logits instead of sampling.
+
+    ``rope_delta`` shifts ROPE positions only (VLM mrope compresses image
+    blocks, so a text token's rotary position lags its cache index by a
+    per-request constant); attention windows still use cache lengths."""
     s = tables.shape[0]
     d = cfg.head_dim
     nl, hkv, num_pages, prow, fd = cache["k"].shape
@@ -562,6 +612,8 @@ def _decode_core(
         out as scan ys, and ONE bulk scatter per step appends them."""
         x = params["embedding"][tokens]  # [S, D]
         pos = pos0 + clen
+        if rope_delta is not None:
+            pos = jnp.maximum(pos + rope_delta, 0)
         counts = clen + 1  # the just-written self token is visible
         ci = jnp.where(active, clen, steps)
 
@@ -665,6 +717,7 @@ def _decode_multi_forward(
     attn_impl: str = "jnp",
     ppcb: int = 4,
     spb: int = 8,
+    rope_delta: Optional[jnp.ndarray] = None,
 ):
     """`steps` fused decode+sample iterations in ONE dispatch with stop
     handling on device (see module doc). Host contract: tables cover
@@ -676,7 +729,7 @@ def _decode_multi_forward(
         params, cfg, cache, tables, pos0, tokens, active, key,
         (temperature, top_p, top_k, greedy),
         (remaining, no_stop_before, stop_tokens),
-        steps, attn_impl, ppcb, spb, topk_bound,
+        steps, attn_impl, ppcb, spb, topk_bound, rope_delta=rope_delta,
     )
 
 
@@ -702,6 +755,7 @@ def decode_multi(
     ppcb: int = 1,
     spb: int = 16,
     last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+    rope_delta: Optional[jnp.ndarray] = None,
 ):
     """`steps` fused decode+sample iterations: one READ-ONLY forward
     dispatch + one WRITE-ONLY merge dispatch (reading and writing the
@@ -722,6 +776,7 @@ def decode_multi(
         params, cfg, cache, tables, pos0, tokens, active, remaining,
         no_stop_before, stop_tokens, key, temperature, top_p, top_k,
         greedy, steps, topk_bound, attn_impl, ppcb, spb,
+        rope_delta=rope_delta,
     )
     cache, new_last = merge_tokens(
         cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows
@@ -738,11 +793,11 @@ def decode_multi(
 )
 def _decode_step_forward(
     params, cfg, cache, tables, pos0, tokens, active,
-    attn_impl="jnp", ppcb=1, spb=16,
+    attn_impl="jnp", ppcb=1, spb=16, rope_delta=None,
 ):
     return _decode_core(
         params, cfg, cache, tables, pos0, tokens, active, None, None, None,
-        1, attn_impl, ppcb, spb, 0,
+        1, attn_impl, ppcb, spb, 0, rope_delta=rope_delta,
     )
 
 
@@ -758,6 +813,7 @@ def decode_step(
     ppcb: int = 1,
     spb: int = 16,
     last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+    rope_delta: Optional[jnp.ndarray] = None,
 ):
     """Single decode step for all slots (read-only forward + write-only
     merge); returns (cache, logits [S, V], new_last_rows). Callers MUST
@@ -765,7 +821,7 @@ def decode_step(
     first row when pos0 isn't row-aligned)."""
     logits, kbuf, vbuf, clen = _decode_step_forward(
         params, cfg, cache, tables, pos0, tokens, active, attn_impl,
-        ppcb, spb,
+        ppcb, spb, rope_delta=rope_delta,
     )
     cache, new_last = merge_tokens(
         cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows

@@ -12,10 +12,11 @@ qwen2_vl model family (models/vision.py) consumes: per-patch segment ids
 and 2D positions, per-token mrope position ids and image-token ordinals.
 The trainer recomputes logprobs THROUGH the vision tower from these.
 
-CAVEAT: the in-repo serving engine samples text-only so far — image-pad
-tokens embed as ordinary tokens during generation (the training side is
-fully image-conditioned). Until serving-side mm prefill lands, rollouts
-behave like the reference pointing vision workflows at a text-only server.
+Serving is image-conditioned end to end: requests carry the processed mm
+payload (pixel patches + meta), the engine splices vision embeds at
+admission (inference/model_runner.mm_prompt_embeds), prefill uses mrope
+positions, and decode shifts rope by the per-request mrope delta — so
+behavior logprobs match the trainer's through-the-tower recompute.
 """
 
 import asyncio
@@ -112,10 +113,42 @@ class VisionRLVRWorkflow(RLVRWorkflow):
 
         n = self.gconfig.n_samples
         byte_images = image2base64(images) if images else []
+        # processed mm payload so the in-repo engine serves
+        # image-CONDITIONED generations (pixels reach prefill through
+        # mm_prompt_embeds; mrope positions + decode rope delta included)
+        mm_payload = None
+        if pixel_values is not None and image_grid_thw is not None:
+            img_id = self._resolve_image_token_id()
+            if img_id is not None:
+                from areal_tpu.models import vision as vision_lib
+
+                pv = np.asarray(pixel_values, np.float32)
+                grids = [tuple(int(x) for x in g) for g in
+                         np.asarray(image_grid_thw).reshape(-1, 3)]
+                q = self.PATCH_BUCKET
+                p_pad = max(q, -(-pv.shape[0] // q) * q)
+                meta = vision_lib.build_patch_meta(
+                    grids, p_pad, merge=self.spatial_merge_size
+                )
+                if pv.shape[0] < p_pad:
+                    pv = np.pad(pv, ((0, p_pad - pv.shape[0]), (0, 0)))
+                mrope_pos, mm_idx = vision_lib.build_mm_rows(
+                    prompt_ids, 0, img_id, grids,
+                    merge=self.spatial_merge_size,
+                )
+                mm_payload = {
+                    "pixel_values": pv,
+                    "vis_seg": meta["vis_seg"],
+                    "vis_pos_h": meta["vis_pos_h"],
+                    "vis_pos_w": meta["vis_pos_w"],
+                    "mm_index": mm_idx,
+                    "mrope_pos": mrope_pos,
+                }
         req_template = ModelRequest(
             input_ids=prompt_ids,
             gconfig=self.gconfig.new(n_samples=1),
             image_data=byte_images,
+            mm=mm_payload,
         )
         resps = await asyncio.gather(
             *[
