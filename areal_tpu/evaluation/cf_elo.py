@@ -1,0 +1,101 @@
+"""Codeforces-Elo rating estimation from per-problem outcomes.
+
+Role of the reference's evaluation/cf_elo_caculator.py (the instrument
+behind its "Codeforces rating" claims): given a model's pass/fail results
+on problems with known difficulty ratings, estimate the Elo rating whose
+predicted solve probabilities best explain the outcomes. Fresh
+implementation of the standard model: P(solve | rating r, difficulty d) =
+1 / (1 + 10^((d - r) / 400)); the estimate maximizes the Bernoulli
+log-likelihood over r (golden-section on the concave log-likelihood), with
+a percentile helper against a user-supplied rating distribution.
+"""
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def solve_probability(rating: float, difficulty: float) -> float:
+    """Elo win probability of a `rating` player against a `difficulty`
+    problem."""
+    return 1.0 / (1.0 + 10 ** ((difficulty - rating) / 400.0))
+
+
+def log_likelihood(
+    rating: float, outcomes: Sequence[Tuple[float, bool]]
+) -> float:
+    ll = 0.0
+    for difficulty, solved in outcomes:
+        p = min(max(solve_probability(rating, difficulty), 1e-12), 1 - 1e-12)
+        ll += math.log(p) if solved else math.log(1.0 - p)
+    return ll
+
+
+def estimate_elo(
+    outcomes: Sequence[Tuple[float, bool]],
+    lo: float = 0.0,
+    hi: float = 4000.0,
+    tol: float = 0.5,
+) -> float:
+    """Maximum-likelihood Elo for (difficulty, solved) outcomes.
+
+    The log-likelihood is concave in the rating (sum of log-sigmoids of
+    affine functions), so golden-section search finds the global max. All
+    solved → hi; none solved → lo (the MLE diverges; callers should treat
+    the bounds as censoring)."""
+    outcomes = list(outcomes)
+    if not outcomes:
+        raise ValueError("need at least one outcome")
+    if all(s for _, s in outcomes):
+        return hi
+    if not any(s for _, s in outcomes):
+        return lo
+    phi = (math.sqrt(5.0) - 1.0) / 2.0
+    a, b = lo, hi
+    c = b - phi * (b - a)
+    d = a + phi * (b - a)
+    fc, fd = log_likelihood(c, outcomes), log_likelihood(d, outcomes)
+    while b - a > tol:
+        if fc >= fd:
+            b, d, fd = d, c, fc
+            c = b - phi * (b - a)
+            fc = log_likelihood(c, outcomes)
+        else:
+            a, c, fc = c, d, fd
+            d = a + phi * (b - a)
+            fd = log_likelihood(d, outcomes)
+    return (a + b) / 2.0
+
+
+def elo_report(
+    problems: Sequence[Dict],
+    rating_key: str = "rating",
+    solved_key: str = "solved",
+    human_ratings: Optional[Sequence[float]] = None,
+) -> Dict:
+    """Aggregate per-problem results into an Elo estimate (+ optional
+    percentile against a human rating sample)."""
+    outcomes = [
+        (float(p[rating_key]), bool(p[solved_key]))
+        for p in problems
+        if p.get(rating_key) is not None
+    ]
+    rating = estimate_elo(outcomes)
+    out = {
+        "elo": round(rating, 1),
+        "n_problems": len(outcomes),
+        "n_solved": sum(1 for _, s in outcomes if s),
+        "solve_rate": round(
+            sum(1 for _, s in outcomes if s) / max(len(outcomes), 1), 4
+        ),
+    }
+    if human_ratings:
+        below = sum(1 for r in human_ratings if r < rating)
+        out["percentile"] = round(100.0 * below / len(human_ratings), 1)
+    return out
+
+
+def expected_solves(
+    rating: float, difficulties: Sequence[float]
+) -> float:
+    """Expected number of solves at a rating (sanity/calibration check)."""
+    return sum(solve_probability(rating, d) for d in difficulties)

@@ -37,6 +37,10 @@ class ModelConfig:
     norm_topk_prob: bool = True
     router_aux_loss_coef: float = 0.001
     moe_capacity_factor: float = 1.25
+    # qwen2_moe-style shared expert: a dense SiLU-gated FFN of this size
+    # runs on EVERY token alongside the routed experts, scaled by a
+    # sigmoid gate (0 = no shared expert)
+    shared_expert_size: int = 0
     # --- VLM (vision tower + mrope; reference VLM path via HF Qwen2-VL,
     # areal/engine/base_hf_engine.py pixel plumbing) ---
     vision: Optional[VisionConfig] = None
@@ -63,10 +67,11 @@ class ModelConfig:
 # Supported HF `model_type`s (all share the llama-style decoder block:
 # RMSNorm + SiLU-gated MLP + rotary GQA attention). gemma/gpt2 need
 # architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for
-# now. qwen2_moe (shared-expert variant) is rejected until shared experts
-# land; qwen3_moe/mixtral are the supported sparse families.
+# now. qwen3_moe/mixtral are expert-only sparse; qwen2_moe adds the
+# shared expert + sigmoid gate.
 _HF_FAMILIES = (
-    "llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral", "qwen2_vl",
+    "llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral",
+    "qwen2_vl", "qwen2_moe",
 )
 
 
@@ -104,6 +109,13 @@ def from_hf_config(d: dict) -> ModelConfig:
     hidden = d["hidden_size"]
     head_dim = d.get("head_dim") or hidden // num_heads
     num_experts = d.get("num_experts") or d.get("num_local_experts") or 0
+    if model_type == "qwen2_moe":
+        # scanned layers need uniform structure: every layer sparse
+        if d.get("mlp_only_layers") or d.get("decoder_sparse_step", 1) != 1:
+            raise ValueError(
+                "qwen2_moe with mlp_only_layers / decoder_sparse_step != 1 "
+                "is unsupported (non-uniform layers break the scanned stack)"
+            )
     vision = None
     mrope_sections = None
     image_token_id = -1
@@ -132,7 +144,8 @@ def from_hf_config(d: dict) -> ModelConfig:
         rms_norm_eps=d.get("rms_norm_eps", 1e-6),
         tie_word_embeddings=d.get("tie_word_embeddings", False),
         attention_bias=d.get(
-            "attention_bias", model_type in ("qwen2", "qwen2_vl")
+            "attention_bias",
+            model_type in ("qwen2", "qwen2_vl", "qwen2_moe"),
         ),
         use_qk_norm=(model_type in ("qwen3", "qwen3_moe")),
         family=model_type,
@@ -145,9 +158,18 @@ def from_hf_config(d: dict) -> ModelConfig:
         ),
         moe_intermediate_size=d.get("moe_intermediate_size", 0),
         # HF Mixtral renormalizes top-k routing weights unconditionally
-        # and qwen3_moe's config ships norm_topk_prob=true — True is the
-        # correct default for every supported MoE family
-        norm_topk_prob=d.get("norm_topk_prob", True),
+        # and qwen3_moe's config ships norm_topk_prob=true; qwen2_moe
+        # ships FALSE (unnormalized top-k + shared expert)
+        norm_topk_prob=d.get(
+            "norm_topk_prob", model_type != "qwen2_moe"
+        ),
+        # HF Qwen2MoeConfig defaults the shared expert to 5632 and always
+        # builds it — a missing key must not silently drop the expert
+        shared_expert_size=(
+            d.get("shared_expert_intermediate_size", 5632)
+            if model_type == "qwen2_moe"
+            else 0
+        ),
         router_aux_loss_coef=d.get("router_aux_loss_coef", 0.001),
     )
 
@@ -180,7 +202,7 @@ def tiny_vlm_config(vocab_size: int = 128) -> ModelConfig:
 
 def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
     """Small config for tests."""
-    moe = family in ("qwen3_moe", "mixtral")
+    moe = family in ("qwen3_moe", "mixtral", "qwen2_moe")
     return ModelConfig(
         vocab_size=vocab_size,
         hidden_size=64,
@@ -193,11 +215,12 @@ def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
         rope_theta=10000.0,
         rms_norm_eps=1e-6,
         tie_word_embeddings=False,
-        attention_bias=(family == "qwen2"),
+        attention_bias=(family in ("qwen2", "qwen2_moe")),
         use_qk_norm=(family in ("qwen3", "qwen3_moe")),
         family=family,
         num_experts=4 if moe else 0,
         num_experts_per_tok=2,
         moe_intermediate_size=32 if moe else 0,
-        norm_topk_prob=True,
+        norm_topk_prob=(family != "qwen2_moe"),
+        shared_expert_size=48 if family == "qwen2_moe" else 0,
     )

@@ -75,6 +75,16 @@ _MOE_MAPS = {
         "w_up": ("mlp.experts.{e}.up_proj.weight", True),
         "w_down": ("mlp.experts.{e}.down_proj.weight", True),
     },
+    "qwen2_moe": {
+        "w_router": ("mlp.gate.weight", True),
+        "w_gate": ("mlp.experts.{e}.gate_proj.weight", True),
+        "w_up": ("mlp.experts.{e}.up_proj.weight", True),
+        "w_down": ("mlp.experts.{e}.down_proj.weight", True),
+        "w_shared_gate": ("mlp.shared_expert.gate_proj.weight", True),
+        "w_shared_up": ("mlp.shared_expert.up_proj.weight", True),
+        "w_shared_down": ("mlp.shared_expert.down_proj.weight", True),
+        "w_shared_router": ("mlp.shared_expert_gate.weight", True),
+    },
     "mixtral": {
         "w_router": ("block_sparse_moe.gate.weight", True),
         "w_gate": ("block_sparse_moe.experts.{e}.w1.weight", True),
@@ -227,6 +237,8 @@ def save_params(
             )
     if moe_map:
         for our_key, (tmpl, transpose) in moe_map.items():
+            if our_key not in params["layers"]:
+                continue
             stacked = as_np32(params["layers"][our_key])
             for i in range(cfg.num_layers):
                 if "{e}" in tmpl:
@@ -293,6 +305,7 @@ def default_hf_config_dict(cfg: ModelConfig) -> dict:
             "qwen3": ["Qwen3ForCausalLM"],
             "mistral": ["MistralForCausalLM"],
             "qwen3_moe": ["Qwen3MoeForCausalLM"],
+            "qwen2_moe": ["Qwen2MoeForCausalLM"],
             "mixtral": ["MixtralForCausalLM"],
             "qwen2_vl": ["Qwen2VLForConditionalGeneration"],
         }.get(cfg.family, ["LlamaForCausalLM"]),
@@ -326,6 +339,16 @@ def default_hf_config_dict(cfg: ModelConfig) -> dict:
                 "moe_intermediate_size": cfg.expert_ffn_size,
                 "norm_topk_prob": cfg.norm_topk_prob,
                 "router_aux_loss_coef": cfg.router_aux_loss_coef,
+                **(
+                    {
+                        "shared_expert_intermediate_size":
+                            cfg.shared_expert_size,
+                        "decoder_sparse_step": 1,
+                        "mlp_only_layers": [],
+                    }
+                    if cfg.shared_expert_size
+                    else {}
+                ),
             }
             if cfg.is_moe
             else {}

@@ -67,6 +67,20 @@ def init_params(
         layers["w_gate"] = nrm(keys[4], (L, E, D, Fe), std)
         layers["w_up"] = nrm(keys[5], (L, E, D, Fe), std)
         layers["w_down"] = nrm(keys[6], (L, E, Fe, D), std)
+        if cfg.shared_expert_size:  # qwen2_moe shared expert + gate
+            Fs = cfg.shared_expert_size
+            layers["w_shared_gate"] = nrm(
+                jax.random.fold_in(rng, 31), (L, D, Fs), std
+            )
+            layers["w_shared_up"] = nrm(
+                jax.random.fold_in(rng, 32), (L, D, Fs), std
+            )
+            layers["w_shared_down"] = nrm(
+                jax.random.fold_in(rng, 33), (L, Fs, D), std
+            )
+            layers["w_shared_router"] = nrm(
+                jax.random.fold_in(rng, 34), (L, D, 1), std
+            )
     else:
         layers["w_gate"] = nrm(keys[4], (L, D, F), std)
         layers["w_up"] = nrm(keys[5], (L, D, F), std)
@@ -121,6 +135,11 @@ def param_logical_axes(cfg: ModelConfig, value_head: bool = False) -> Params:
         layers["w_gate"] = ("layer", "expert", "embed", "mlp")
         layers["w_up"] = ("layer", "expert", "embed", "mlp")
         layers["w_down"] = ("layer", "expert", "mlp", "embed")
+        if cfg.shared_expert_size:
+            layers["w_shared_gate"] = ("layer", "embed", "mlp")
+            layers["w_shared_up"] = ("layer", "embed", "mlp")
+            layers["w_shared_down"] = ("layer", "mlp", "embed")
+            layers["w_shared_router"] = ("layer", "embed", None)
     else:
         layers["w_gate"] = ("layer", "embed", "mlp")
         layers["w_up"] = ("layer", "embed", "mlp")
@@ -189,10 +208,15 @@ def _layer_body(
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        from areal_tpu.ops.moe import moe_ffn_from_params
+        from areal_tpu.ops.moe import (
+            moe_ffn_from_params,
+            shared_expert_from_params,
+        )
 
         # padding tokens (segment 0) must not consume expert capacity
         ffn, aux = moe_ffn_from_params(cfg, lp, h, valid=segment_ids > 0)
+        if cfg.shared_expert_size:
+            ffn = ffn + shared_expert_from_params(cfg, lp, h)
         return x + ffn, aux
     ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
     return x + ffn, jnp.zeros((), jnp.float32)

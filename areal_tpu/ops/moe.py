@@ -173,3 +173,16 @@ def moe_ffn_from_params(
         capacity_factor=cfg.moe_capacity_factor,
         valid=valid,
     )
+
+
+def shared_expert_from_params(cfg, lp: Dict, h: jnp.ndarray) -> jnp.ndarray:
+    """qwen2_moe shared expert: a dense SiLU-gated FFN on EVERY token,
+    scaled by a per-token sigmoid gate (HF Qwen2MoeSparseMoeBlock). One
+    implementation for both the training stack and the serving runner."""
+    shared = (
+        jax.nn.silu(h @ lp["w_shared_gate"]) * (h @ lp["w_shared_up"])
+    ) @ lp["w_shared_down"]
+    gate = jax.nn.sigmoid(
+        (h @ lp["w_shared_router"]).astype(jnp.float32)
+    ).astype(h.dtype)
+    return gate * shared

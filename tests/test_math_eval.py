@@ -136,3 +136,42 @@ def test_eval_code_completions_local():
     assert out["per_problem"][1] == [1.0, 0.0]
     assert out["pass_at_k"][1] == 0.5
     assert out["pass_at_k"][2] == 1.0
+
+
+# --- Codeforces-Elo estimation (reference cf_elo_caculator role) ----------
+def test_cf_elo_recovers_planted_rating():
+    import numpy as np
+
+    from areal_tpu.evaluation.cf_elo import (
+        elo_report,
+        estimate_elo,
+        solve_probability,
+    )
+
+    rng = np.random.default_rng(0)
+    true_r = 1700.0
+    diffs = rng.integers(800, 3000, size=400).astype(float)
+    outcomes = [
+        (d, bool(rng.random() < solve_probability(true_r, d))) for d in diffs
+    ]
+    est = estimate_elo(outcomes)
+    assert abs(est - true_r) < 120, est  # MLE within noise of the truth
+
+    report = elo_report(
+        [{"rating": d, "solved": s} for d, s in outcomes],
+        human_ratings=[1000, 1500, 1600, 1800, 2400],
+    )
+    assert abs(report["elo"] - est) < 1.0
+    assert report["n_problems"] == 400
+    assert report["percentile"] == 60.0  # 3 of 5 below ~1700
+
+
+def test_cf_elo_degenerate_outcomes():
+    from areal_tpu.evaluation.cf_elo import estimate_elo
+
+    assert estimate_elo([(1200.0, True), (1500.0, True)]) == 4000.0
+    assert estimate_elo([(1200.0, False)]) == 0.0
+    # monotone: solving harder sets implies a higher estimate
+    lo = estimate_elo([(1000.0, True), (1400.0, False), (1800.0, False)])
+    hi = estimate_elo([(1000.0, True), (1400.0, True), (1800.0, False)])
+    assert hi > lo

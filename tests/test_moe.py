@@ -290,3 +290,109 @@ def test_pipeline_parallel_rejected():
     assert pc.fsdp_parallel_size == 1 and pc.seq_parallel_size == 1
     with pytest.raises(AllocationValidationError, match="divide"):
         ParallelStrategy.from_str("d3e2").to_tpu_parallelism()
+
+
+class TestQwen2Moe:
+    """qwen2_moe: shared expert + sigmoid gate on top of routed experts
+    (HF Qwen2MoeSparseMoeBlock semantics)."""
+
+    def test_from_hf_config_and_rejection(self):
+        from areal_tpu.models.config import from_hf_config
+
+        d = {
+            "model_type": "qwen2_moe", "vocab_size": 128,
+            "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "num_experts": 4,
+            "num_experts_per_tok": 2, "moe_intermediate_size": 32,
+            "shared_expert_intermediate_size": 48,
+        }
+        cfg = from_hf_config(d)
+        assert cfg.is_moe and cfg.shared_expert_size == 48
+        assert cfg.norm_topk_prob is False  # qwen2_moe default
+        assert cfg.attention_bias
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="mlp_only_layers"):
+            from_hf_config({**d, "mlp_only_layers": [0]})
+
+    def test_shared_expert_contributes_and_trains(self):
+        import jax
+        import jax.numpy as jnp
+
+        from areal_tpu.api.cli_args import (
+            MicroBatchSpec,
+            OptimizerConfig,
+            ParallelismConfig,
+            TrainEngineConfig,
+        )
+        from areal_tpu.api.io_struct import FinetuneSpec
+        from areal_tpu.engine.sft.lm_engine import (
+            sft_loss_fn,
+            sft_loss_weight_fn,
+        )
+        from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.models.transformer import apply, init_params
+
+        cfg = tiny_config("qwen2_moe")
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        assert "w_shared_gate" in params["layers"]
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, 128, size=(1, 12)), jnp.int32)
+        seg = jnp.ones((1, 12), jnp.int32)
+        pos = jnp.arange(12)[None]
+        base = apply(params, cfg, toks, seg, pos, remat=False)
+        # zeroing the shared expert changes the logits: it really runs
+        p2 = jax.tree_util.tree_map(lambda x: x, params)
+        p2["layers"] = dict(p2["layers"])
+        p2["layers"]["w_shared_down"] = jnp.zeros_like(
+            p2["layers"]["w_shared_down"]
+        )
+        off = apply(p2, cfg, toks, seg, pos, remat=False)
+        assert float(jnp.abs(base - off).max()) > 1e-4
+
+        tcfg = TrainEngineConfig(
+            dtype="float32", param_dtype="float32",
+            gradient_checkpointing=False,
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=4096),
+            optimizer=OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0),
+            parallel=ParallelismConfig(),
+        )
+        eng = SPMDTrainEngine(tcfg)
+        eng.initialize(FinetuneSpec(1, 8, 2), model_config=cfg, seed=0)
+        before = jax.device_get(eng.params["layers"]["w_shared_gate"])
+        batch = {
+            "input_ids": rng.integers(0, 128, size=(2, 16)).astype(np.int64),
+            "attention_mask": np.ones((2, 16), np.bool_),
+            "loss_mask": np.ones((2, 16), np.int64),
+        }
+        stats = eng.train_batch(batch, sft_loss_fn, sft_loss_weight_fn)
+        assert stats["update_successful"] == 1.0
+        after = jax.device_get(eng.params["layers"]["w_shared_gate"])
+        assert np.abs(np.asarray(after) - np.asarray(before)).max() > 0
+
+    def test_hf_roundtrip(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from areal_tpu.models import hf_io
+        from areal_tpu.models.config import load_hf_config, tiny_config
+        from areal_tpu.models.transformer import apply, init_params
+
+        cfg = tiny_config("qwen2_moe")
+        params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+        path = str(tmp_path / "q2moe")
+        hf_io.save_params(params, cfg, path)
+        cfg2 = load_hf_config(path)
+        assert cfg2.shared_expert_size == cfg.shared_expert_size
+        loaded = hf_io.load_params(path, cfg2, dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, 128, size=(1, 10)), jnp.int32)
+        seg = jnp.ones((1, 10), jnp.int32)
+        pos = jnp.arange(10)[None]
+        a = apply(params, cfg, toks, seg, pos, remat=False)
+        b = apply(loaded, cfg2, toks, seg, pos, remat=False)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
