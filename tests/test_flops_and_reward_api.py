@@ -1,0 +1,101 @@
+"""Direct coverage for the FLOPs/MFU model (the quantity every bench and
+log anchors to) and the async reward wrapper."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.models.config import tiny_config
+from areal_tpu.utils import flops as F
+
+
+# --- FLOPs model ----------------------------------------------------------
+def test_matmul_weights_dense_exact():
+    cfg = tiny_config("qwen2")
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    per_layer = (
+        d * cfg.q_dim + 2 * d * cfg.kv_dim + cfg.q_dim * d + 3 * d * f
+    )
+    want = cfg.num_layers * per_layer + d * cfg.vocab_size
+    assert F.matmul_weights(cfg) == want
+
+
+def test_matmul_weights_moe_counts_active_experts_only():
+    cfg = tiny_config("qwen3_moe")
+    dense = F.matmul_weights(cfg, with_head=False)
+    d = cfg.hidden_size
+    ffn = d * cfg.num_experts + cfg.num_experts_per_tok * 3 * d * cfg.expert_ffn_size
+    attn = d * cfg.q_dim + 2 * d * cfg.kv_dim + cfg.q_dim * d
+    assert dense == cfg.num_layers * (attn + ffn)
+
+
+def test_train_and_decode_flop_identities():
+    cfg = tiny_config("qwen2")
+    lens = [100, 50]
+    fwd = F.forward_flops(cfg, lens)
+    # attention term is quadratic, projection linear in tokens
+    assert fwd == 2.0 * 150 * F.matmul_weights(cfg) + F.attn_flops(cfg, lens)
+    assert F.attn_flops(cfg, [100]) == pytest.approx(
+        2.0 * 100 * 100 * cfg.num_heads * cfg.head_dim * cfg.num_layers
+    )
+    # bwd = 2x fwd; each logp recompute adds one fwd
+    assert F.train_step_flops(cfg, lens, 0) == pytest.approx(3.0 * fwd)
+    assert F.train_step_flops(cfg, lens, 2) == pytest.approx(5.0 * fwd)
+    # decode flops grow linearly with context
+    d1 = F.decode_flops(cfg, 10, 100.0)
+    d2 = F.decode_flops(cfg, 10, 200.0)
+    assert d2 > d1
+    per_tok_ctx = 4.0 * cfg.num_heads * cfg.head_dim * cfg.num_layers
+    assert d2 - d1 == pytest.approx(10 * 100.0 * per_tok_ctx)
+
+
+def test_device_peak_table():
+    assert F.device_peak_flops("TPU v5 lite") == 197e12
+    assert F.device_peak_flops("TPU v5p chip") == 459e12
+    assert F.device_peak_flops("GPU H100") is None
+
+
+# --- AsyncRewardWrapper ---------------------------------------------------
+def test_async_reward_wrapper_offloads_blocking_fn():
+    calls = []
+
+    def slow_reward(prompt, completion, prompt_ids, completion_ids, **kw):
+        time.sleep(0.05)
+        calls.append(kw.get("answer"))
+        return 1.0 if completion == "yes" else 0.0
+
+    wrapped = AsyncRewardWrapper(slow_reward)
+
+    async def run():
+        t0 = time.monotonic()
+        # concurrent awaits overlap in the thread pool
+        out = await asyncio.gather(
+            *[
+                wrapped("p", "yes" if i % 2 == 0 else "no", [], [],
+                        answer=str(i))
+                for i in range(8)
+            ]
+        )
+        return out, time.monotonic() - t0
+
+    out, dt = asyncio.run(run())
+    assert out == [1.0, 0.0] * 4
+    assert len(calls) == 8
+    # 8 x 50ms serially would be 0.4s; pooled should be well under
+    assert dt < 0.35
+
+
+def test_async_reward_wrapper_propagates_errors():
+    def bad(*a, **k):
+        raise RuntimeError("verifier exploded")
+
+    wrapped = AsyncRewardWrapper(bad)
+
+    async def run():
+        with pytest.raises(RuntimeError, match="verifier exploded"):
+            await wrapped("p", "c", [], [])
+
+    asyncio.run(run())
