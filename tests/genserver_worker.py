@@ -1,0 +1,44 @@
+"""Subprocess worker: a generation HTTP server in its OWN process (the
+cross-process weight-update test's remote end). Prints "PORT <n>" when
+ready, serves until stdin closes."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from __graft_entry__ import _ensure_virtual_devices  # noqa: E402
+
+_ensure_virtual_devices(1)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from areal_tpu.api.cli_args import JaxGenConfig  # noqa: E402
+from areal_tpu.inference.engine import GenerationEngine  # noqa: E402
+from areal_tpu.inference.server import serve  # noqa: E402
+from areal_tpu.models.config import tiny_config  # noqa: E402
+from areal_tpu.models.transformer import init_params  # noqa: E402
+
+
+def main():
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", max_num_seqs=4, max_model_len=64,
+            prefill_chunk=16,
+        ),
+        model_config=cfg,
+        params=params,
+    ).start()
+    httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+    print(f"PORT {httpd.server_address[1]}", flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    httpd.shutdown()
+    eng.stop()
+
+
+if __name__ == "__main__":
+    main()
