@@ -58,13 +58,18 @@ def main():
     import jax.numpy as jnp
 
     # count backend compilations: a measured step that compiles is a
-    # methodology bug, and the counter proves (or rules out) it post-hoc
-    compile_events = {"count": 0, "secs": 0.0}
+    # methodology bug, and the counter proves (or rules out) it post-hoc.
+    # Traces are counted separately — they are cheap (~2 ms) and frequent,
+    # while each backend compile costs ~2 s on the remote compile service;
+    # lumping them (round-3's mistake) made the counts unreadable.
+    compile_events = {"count": 0, "secs": 0.0, "traces": 0}
 
     def _on_event(event: str, duration: float, **kw):
-        if "compil" in event:
+        if "backend_compile" in event:
             compile_events["count"] += 1
             compile_events["secs"] += duration
+        elif "compil" in event or "trace" in event:
+            compile_events["traces"] += 1
 
     try:
         jax.monitoring.register_event_duration_secs_listener(_on_event)
@@ -298,6 +303,7 @@ def main():
                 "avg_len": round(float(np.mean(lens)), 1),
                 "compiles": c1["count"] - c0["count"],
                 "compile_s": round(c1["secs"] - c0["secs"], 1),
+                "traces": c1["traces"] - c0["traces"],
                 "train_timing": getattr(trainer, "last_timing", None),
             }
         )
@@ -366,6 +372,7 @@ def main():
                 "tokens": tokens,
                 "compiles": c1["count"] - c0["count"],
                 "compile_s": round(c1["secs"] - c0["secs"], 1),
+                "traces": c1["traces"] - c0["traces"],
             }
         )
         prompts, results = nxt_prompts, nxt_results

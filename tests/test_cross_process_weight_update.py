@@ -5,43 +5,56 @@ trainer's weights (the true multi-host semantics of the reference's NCCL
 trainer->server broadcast, fsdp_engine.py:414-444 + sglang_remote.py:411)."""
 
 import os
+import queue
 import subprocess
 import sys
+import threading
 import time
 
-import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 
 @pytest.fixture(scope="module")
 def remote_server():
     worker = os.path.join(os.path.dirname(__file__), "genserver_worker.py")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.join(os.path.dirname(__file__), "..")]
-        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
-    )
     proc = subprocess.Popen(
         [sys.executable, worker, "0"],
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
-        env=env,
     )
+    # a reader thread drains stdout for the worker's whole life: readline
+    # with a timeout needs it anyway, and an undrained pipe would block
+    # the worker's logging mid-test once the buffer fills
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def drain():
+        for line in proc.stdout:
+            lines.put(line)
+
+    threading.Thread(target=drain, daemon=True).start()
+
     port = None
     deadline = time.monotonic() + 180
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if line.startswith("PORT "):
-            port = int(line.split()[1])
-            break
-        if proc.poll() is not None:
-            raise RuntimeError(f"server process died: {proc.stdout.read()}")
-    assert port is not None, "server never reported its port"
+    try:
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("server process died during startup")
+            try:
+                line = lines.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+                break
+        if port is None:
+            raise RuntimeError("server never reported its port")
+    except Exception:
+        proc.kill()
+        raise
     yield f"127.0.0.1:{port}"
     proc.stdin.close()
     try:
