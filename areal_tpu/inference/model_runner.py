@@ -34,7 +34,12 @@ import jax.numpy as jnp
 
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.models.transformer import Params
-from areal_tpu.ops.basic import apply_rope, rms_norm, rope_frequencies
+from areal_tpu.ops.basic import (
+    apply_rope,
+    hidden_act_fn,
+    rms_norm,
+    rope_frequencies,
+)
 from areal_tpu.ops.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_jnp,
@@ -82,11 +87,15 @@ def _mlp(
         if cfg.shared_expert_size:
             out = out + shared_expert_from_params(cfg, lp, h)
         return out
-    return (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    act = hidden_act_fn(cfg.hidden_act)
+    return (act(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
 
 
 def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(
+        x, params["final_norm"], cfg.rms_norm_eps,
+        add_unit_offset=cfg.norm_add_unit_offset,
+    )
     head = (
         params["embedding"].T if cfg.tie_word_embeddings else params["lm_head"]
     )
@@ -337,10 +346,13 @@ def prefill_forward(
     )
     if embeds is not None:
         # VLM path: image-token embeddings were spliced at admission
-        # (mm_prompt_embeds); no second lookup
+        # (mm_prompt_embeds applies any embedding scaling itself; scaling
+        # here would double-scale text rows and wrongly scale vision rows)
         x = embeds.astype(params["embedding"].dtype)
     else:
         x = params["embedding"][tokens]  # [N, Tp, D]
+        if cfg.scale_embeddings:  # gemma: sqrt(d)-scaled embeddings
+            x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
 
     def _rope(t):  # [N, Tp, H, D]
         if pos3 is not None and cfg.mrope_sections:
@@ -393,10 +405,12 @@ def prefill_forward(
     # causal within the in-flight suffix
     suffix_mask = (sidx[:, :, None] >= sidx[:, None, :]) & valid_q[:, None, :]
 
+    uo = cfg.norm_add_unit_offset
+
     def layer(carry, xs):
         x = carry
         lp, li = xs
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, add_unit_offset=uo)
         q, k, v = _project_qkv(cfg, lp, h)  # [N, Tp, H*, Dh]
         q = _rope(q)
         k = _rope(k)
@@ -464,7 +478,9 @@ def prefill_forward(
             )
         attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
         x = x + attn @ lp["wo"]
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        h2 = rms_norm(
+            x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
+        )
         x = x + _mlp(cfg, lp, h2, valid=valid_q)
         kv_dtype = cache["k"].dtype
         return x, (kz.astype(kv_dtype), vz.astype(kv_dtype))
@@ -522,6 +538,8 @@ def mm_prompt_embeds(
     from areal_tpu.models import vision as vision_lib
 
     x = params["embedding"][tokens]
+    if cfg.scale_embeddings:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
     emb = vision_lib.vision_apply(
         params["vision"], cfg.vision, pixels, vis_seg, vis_pos_h,
         vis_pos_w, remat=False,
@@ -617,15 +635,21 @@ def _decode_core(
         small [S, T] slice for the self-token, the per-layer K/V stack
         out as scan ys, and ONE bulk scatter per step appends them."""
         x = params["embedding"][tokens]  # [S, D]
+        if cfg.scale_embeddings:  # gemma
+            x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
         pos = pos0 + clen
         if rope_delta is not None:
             pos = jnp.maximum(pos + rope_delta, 0)
         counts = clen + 1  # the just-written self token is visible
         ci = jnp.where(active, clen, steps)
 
+        uo = cfg.norm_add_unit_offset
+
         def layer(x, xs):
             lp, li = xs
-            h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+            h = rms_norm(
+                x, lp["input_norm"], cfg.rms_norm_eps, add_unit_offset=uo
+            )
             q, k, v = _project_qkv(cfg, lp, h)  # q [S,Hq,D] k/v [S,Hkv,D]
             q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
@@ -639,7 +663,9 @@ def _decode_core(
                 counts, attn_impl, ppcb, spb,
             )
             x = x + attn.reshape(s, cfg.q_dim).astype(x.dtype) @ lp["wo"]
-            h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+            h2 = rms_norm(
+                x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
+            )
             x = x + _mlp(cfg, lp, h2, valid=active)
             return x, (k.astype(kv_dtype), v.astype(kv_dtype))
 

@@ -41,6 +41,11 @@ class ModelConfig:
     # runs on EVERY token alongside the routed experts, scaled by a
     # sigmoid gate (0 = no shared expert)
     shared_expert_size: int = 0
+    # --- gemma-family knobs (GeLU MLP, (1+w) RMSNorm, sqrt(d) embedding
+    # scaling); defaults are the llama/qwen conventions ---
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    norm_add_unit_offset: bool = False
+    scale_embeddings: bool = False
     # --- VLM (vision tower + mrope; reference VLM path via HF Qwen2-VL,
     # areal/engine/base_hf_engine.py pixel plumbing) ---
     vision: Optional[VisionConfig] = None
@@ -64,14 +69,15 @@ class ModelConfig:
         return self.moe_intermediate_size or self.intermediate_size
 
 
-# Supported HF `model_type`s (all share the llama-style decoder block:
-# RMSNorm + SiLU-gated MLP + rotary GQA attention). gemma/gpt2 need
-# architecture changes (GeLU, (1+w) norm, embed scaling) — rejected for
-# now. qwen3_moe/mixtral are expert-only sparse; qwen2_moe adds the
-# shared expert + sigmoid gate.
+# Supported HF `model_type`s. The llama-style decoder block (RMSNorm +
+# SiLU-gated MLP + rotary GQA attention) is the baseline; gemma layers on
+# GeLU(tanh), (1+w) norms and sqrt(d) embedding scaling via config knobs.
+# qwen3_moe/mixtral are expert-only sparse; qwen2_moe adds the shared
+# expert + sigmoid gate. gemma2/gpt2 remain out (interleaved local
+# attention / learned positions need architecture changes).
 _HF_FAMILIES = (
     "llama", "qwen2", "qwen3", "mistral", "qwen3_moe", "mixtral",
-    "qwen2_vl", "qwen2_moe",
+    "qwen2_vl", "qwen2_moe", "gemma",
 )
 
 
@@ -142,7 +148,9 @@ def from_hf_config(d: dict) -> ModelConfig:
         max_position_embeddings=d.get("max_position_embeddings", 32768),
         rope_theta=d.get("rope_theta", 10000.0),
         rms_norm_eps=d.get("rms_norm_eps", 1e-6),
-        tie_word_embeddings=d.get("tie_word_embeddings", False),
+        tie_word_embeddings=d.get(
+            "tie_word_embeddings", model_type == "gemma"
+        ),
         attention_bias=d.get(
             "attention_bias",
             model_type in ("qwen2", "qwen2_vl", "qwen2_moe"),
@@ -152,6 +160,16 @@ def from_hf_config(d: dict) -> ModelConfig:
         vision=vision,
         mrope_sections=mrope_sections,
         image_token_id=image_token_id,
+        # gemma: GeLU(tanh) MLP, (1+w) norms, sqrt(d)-scaled embeddings
+        hidden_act=(
+            "gelu_tanh"
+            if model_type == "gemma"
+            or d.get("hidden_act", d.get("hidden_activation", "silu"))
+            in ("gelu", "gelu_pytorch_tanh")
+            else "silu"
+        ),
+        norm_add_unit_offset=(model_type == "gemma"),
+        scale_embeddings=(model_type == "gemma"),
         num_experts=num_experts,
         num_experts_per_tok=d.get(
             "num_experts_per_tok", d.get("top_k", 2)
@@ -218,6 +236,9 @@ def tiny_config(family: str = "qwen2", vocab_size: int = 128) -> ModelConfig:
         attention_bias=(family in ("qwen2", "qwen2_moe")),
         use_qk_norm=(family in ("qwen3", "qwen3_moe")),
         family=family,
+        hidden_act="gelu_tanh" if family == "gemma" else "silu",
+        norm_add_unit_offset=(family == "gemma"),
+        scale_embeddings=(family == "gemma"),
         num_experts=4 if moe else 0,
         num_experts_per_tok=2,
         moe_intermediate_size=32 if moe else 0,

@@ -308,7 +308,14 @@ def default_hf_config_dict(cfg: ModelConfig) -> dict:
             "qwen2_moe": ["Qwen2MoeForCausalLM"],
             "mixtral": ["MixtralForCausalLM"],
             "qwen2_vl": ["Qwen2VLForConditionalGeneration"],
+            "gemma": ["GemmaForCausalLM"],
         }.get(cfg.family, ["LlamaForCausalLM"]),
+        **(
+            {"hidden_act": "gelu_pytorch_tanh",
+             "hidden_activation": "gelu_pytorch_tanh"}
+            if cfg.hidden_act == "gelu_tanh"
+            else {}
+        ),
         **(
             {
                 "vision_config": {

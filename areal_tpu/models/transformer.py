@@ -27,6 +27,7 @@ from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.basic import (
     apply_mrope,
     apply_rope,
+    hidden_act_fn,
     rms_norm,
     rope_frequencies,
     segment_attention,
@@ -53,9 +54,11 @@ def init_params(
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
     std = 0.02
+    # gemma norms scale by (1 + w): identity init is ZEROS there
+    norm_init = jnp.zeros if cfg.norm_add_unit_offset else jnp.ones
     layers = {
-        "input_norm": jnp.ones((L, D), dtype),
-        "post_attn_norm": jnp.ones((L, D), dtype),
+        "input_norm": norm_init((L, D), dtype),
+        "post_attn_norm": norm_init((L, D), dtype),
         "wq": nrm(keys[0], (L, D, Qd), std),
         "wk": nrm(keys[1], (L, D, KVd), std),
         "wv": nrm(keys[2], (L, D, KVd), std),
@@ -95,7 +98,7 @@ def init_params(
     params: Params = {
         "embedding": nrm(keys[7], (cfg.vocab_size, D), std),
         "layers": layers,
-        "final_norm": jnp.ones((D,), dtype),
+        "final_norm": norm_init((D,), dtype),
     }
     if value_head:
         # critics replace the LM head with the scalar head entirely
@@ -181,7 +184,8 @@ def _layer_body(
     attend_fn: Optional[Any] = None,
 ):
     b, t, d = x.shape
-    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    uo = cfg.norm_add_unit_offset
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, add_unit_offset=uo)
     q = h @ lp["wq"]
     k = h @ lp["wk"]
     v = h @ lp["wv"]
@@ -206,7 +210,9 @@ def _layer_body(
     else:  # explicit SP kernel (ring / ulysses shard_map)
         attn = attend_fn(q, k, v, segment_ids)
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
-    h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    h = rms_norm(
+        x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
+    )
     if cfg.is_moe:
         from areal_tpu.ops.moe import (
             moe_ffn_from_params,
@@ -218,7 +224,9 @@ def _layer_body(
         if cfg.shared_expert_size:
             ffn = ffn + shared_expert_from_params(cfg, lp, h)
         return x + ffn, aux
-    ffn = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    ffn = (
+        hidden_act_fn(cfg.hidden_act)(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    ) @ lp["w_down"]
     return x + ffn, jnp.zeros((), jnp.float32)
 
 
@@ -259,6 +267,8 @@ def apply(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )
     x = params["embedding"][tokens]
+    if cfg.scale_embeddings:  # gemma: sqrt(d)-scaled embeddings
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
     if mm_embeds is not None and mm_index is not None:
         gathered = jnp.take_along_axis(
             mm_embeds,
@@ -281,7 +291,10 @@ def apply(
     if remat:
         body = jax.checkpoint(body, prevent_cse=False)
     x, aux = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(
+        x, params["final_norm"], cfg.rms_norm_eps,
+        add_unit_offset=cfg.norm_add_unit_offset,
+    )
     if "value_head" in params:
         # critic: scalar head — "logits" [B, T, 1] (value per position);
         # tiny, never worth the lazy view

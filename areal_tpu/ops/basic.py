@@ -14,13 +14,32 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """RMSNorm in fp32 accumulation (reference impl/model/modules/rms.py)."""
+def hidden_act_fn(name: str):
+    """MLP gate activation by config name — ONE selection shared by the
+    training stack and the serving runner (divergence here would desync
+    train/serve forward passes silently)."""
+    if name == "gelu_tanh":  # gemma's gelu_pytorch_tanh
+        return lambda v: jax.nn.gelu(v, approximate=True)
+    return jax.nn.silu
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float,
+    add_unit_offset: bool = False,
+) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation (reference impl/model/modules/rms.py).
+    ``add_unit_offset`` is the gemma convention: scale by (1 + weight)
+    with weights initialized at zero."""
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if add_unit_offset:
+        w = 1.0 + w
+    return (x * w).astype(dtype)
 
 
 def rope_frequencies(
