@@ -11,14 +11,25 @@ are captured for the trainer.
 The `openai` package is not a dependency here — the response objects are
 lightweight dataclasses with the same attribute shape
 (`resp.choices[0].message.content`, `resp.usage`, `resp.id`), which is
-what agent code actually touches. Tool-call parsing is left to the agent
-(the reference's tool_call_parser is model-specific string surgery).
+what agent code actually touches.
+
+Tool calling (reference areal/experimental/openai/client.py `tool_call_parser`
++ tool_choice plumbing): pass OpenAI function schemas via ``tools=``; they are
+rendered into the prompt through the tokenizer's chat template when it
+supports a ``tools`` kwarg, else as a Hermes-style system block. Completions
+are scanned for ``<tool_call>{json}</tool_call>`` blocks (the qwen2/Hermes
+convention) and surface as ``message.tool_calls`` with
+``finish_reason == "tool_calls"``. The parser is pluggable
+(``tool_parser=``) because the convention is model-specific string surgery —
+exactly how the reference treats it.
 """
 
 import dataclasses
+import json
+import re
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -27,9 +38,52 @@ from areal_tpu.api.io_struct import ModelRequest
 
 
 @dataclasses.dataclass
+class ToolCallFunction:
+    name: str
+    arguments: str  # JSON-encoded argument object, as in the OpenAI API
+
+
+@dataclasses.dataclass
+class ToolCall:
+    id: str
+    function: ToolCallFunction
+    type: str = "function"
+
+
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+
+
+def hermes_tool_parser(text: str) -> List[ToolCall]:
+    """Parse ``<tool_call>{"name": ..., "arguments": {...}}</tool_call>``
+    blocks (qwen2/Hermes convention). Malformed JSON inside a block is
+    skipped — an agent loop must see either a valid call or plain text."""
+    calls = []
+    for m in _TOOL_CALL_RE.finditer(text):
+        try:
+            obj = json.loads(m.group(1))
+            name = obj["name"]
+        except (ValueError, KeyError, TypeError):
+            continue
+        args = obj.get("arguments", {})
+        calls.append(
+            ToolCall(
+                id=f"call_{uuid.uuid4().hex[:12]}",
+                function=ToolCallFunction(
+                    name=str(name),
+                    arguments=(
+                        args if isinstance(args, str) else json.dumps(args)
+                    ),
+                ),
+            )
+        )
+    return calls
+
+
+@dataclasses.dataclass
 class ChatMessage:
     role: str
     content: str
+    tool_calls: Optional[List[ToolCall]] = None
 
 
 @dataclasses.dataclass
@@ -103,22 +157,29 @@ class _ChatCompletions:
         temperature: Optional[float] = None,
         top_p: Optional[float] = None,
         stop: Optional[List[str]] = None,
+        tools: Optional[List[Dict[str, Any]]] = None,
+        tool_choice: Optional[str] = None,
         **unsupported: Any,
     ) -> ChatCompletion:
         # silently ignoring OpenAI params we don't implement would corrupt
         # agent loops written against the real API (n>1 returning one
-        # choice, stream=True returning a non-stream, tools never firing)
+        # choice, stream=True returning a non-stream)
         hard = {
             k: v
             for k, v in unsupported.items()
-            if k in ("n", "stream", "tools", "tool_choice", "functions")
+            if k in ("n", "stream", "functions")
             and v not in (None, False, 1, [])
         }
         if hard:
             raise NotImplementedError(
                 f"unsupported OpenAI parameters: {sorted(hard)} "
-                "(this client returns a single non-streamed completion "
-                "without tool execution)"
+                "(this client returns a single non-streamed completion)"
+            )
+        if tool_choice not in (None, "auto", "none"):
+            # "required"/forced-function would need constrained decoding
+            raise NotImplementedError(
+                f"tool_choice={tool_choice!r} unsupported (only 'auto'; "
+                "forced tool calls need constrained decoding)"
             )
         c = self._client
         base = c.gconfig
@@ -132,9 +193,52 @@ class _ChatCompletions:
             ),
             top_p=base.top_p if top_p is None else top_p,
         )
+        use_tools = bool(tools) and tool_choice != "none"
+        rendered = list(messages)
         input_ids = c.tokenizer.apply_chat_template(
-            list(messages), tokenize=True, add_generation_prompt=True
+            rendered, tokenize=True, add_generation_prompt=True
         )
+        if use_tools:
+            # HF chat templates for tool-capable models take tools= directly.
+            # A template that IGNORES the kwarg returns the same ids — the
+            # schemas would silently never reach the model — so verify the
+            # render changed, and otherwise inject a Hermes-style system
+            # block (the convention the default parser expects).
+            try:
+                with_tools = c.tokenizer.apply_chat_template(
+                    rendered,
+                    tokenize=True,
+                    add_generation_prompt=True,
+                    tools=list(tools),
+                )
+            except TypeError:
+                with_tools = input_ids
+            if list(with_tools) != list(input_ids):
+                input_ids = with_tools
+            else:
+                sys_block = (
+                    "You may call tools. Available tools (JSON schemas):\n"
+                    f"<tools>{json.dumps(list(tools))}</tools>\n"
+                    "To call one, emit exactly:\n"
+                    '<tool_call>{"name": <tool-name>, "arguments": '
+                    "<args-object>}</tool_call>"
+                )
+                if rendered and rendered[0].get("role") == "system":
+                    rendered = [
+                        {
+                            "role": "system",
+                            "content": rendered[0]["content"]
+                            + "\n\n"
+                            + sys_block,
+                        }
+                    ] + rendered[1:]
+                else:
+                    rendered = [
+                        {"role": "system", "content": sys_block}
+                    ] + rendered
+                input_ids = c.tokenizer.apply_chat_template(
+                    rendered, tokenize=True, add_generation_prompt=True
+                )
         if stop:
             stop_ids = []
             for s in stop if isinstance(stop, list) else [stop]:
@@ -158,14 +262,23 @@ class _ChatCompletions:
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
+        tool_calls = c.tool_parser(text) if use_tools else []
         completion = ChatCompletion(
             id=req.rid,
             choices=[
                 Choice(
                     index=0,
-                    message=ChatMessage(role="assistant", content=text),
+                    message=ChatMessage(
+                        role="assistant",
+                        content=text,
+                        tool_calls=tool_calls or None,
+                    ),
                     finish_reason=(
-                        "stop" if resp.stop_reason == "stop" else "length"
+                        "tool_calls"
+                        if tool_calls
+                        else (
+                            "stop" if resp.stop_reason == "stop" else "length"
+                        )
                     ),
                 )
             ],
@@ -201,10 +314,12 @@ class ArealOpenAI:
         engine,
         tokenizer,
         gconfig: Optional[GenerationHyperparameters] = None,
+        tool_parser: Callable[[str], List[ToolCall]] = hermes_tool_parser,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.gconfig = gconfig or GenerationHyperparameters()
+        self.tool_parser = tool_parser
         self._cache: Dict[str, CompletionWithTokenLogpReward] = {}
         self.chat = _Chat(self)
 

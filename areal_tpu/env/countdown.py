@@ -1,0 +1,194 @@
+"""Countdown arithmetic-game environment with a calculator tool.
+
+Role of reference examples/countdown/train.py + examples/countdown/
+countdown_utils (the runnable agentic workload: given a list of numbers and
+a target, produce an arithmetic expression using each number at most once
+that evaluates to the target; binary verifiable reward with format credit).
+Here the game is exposed the TPU-framework way: as a *tool-calling* episode
+— the agent calls ``eval_expression`` through the OpenAI-compatible client
+(api/openai_client.py), sees the computed value as a tool message, and
+submits via ``submit_expression``; the reward comes from the environment,
+not from parsing free text.
+
+Expression evaluation is AST-based (no ``eval``): only numeric literals,
++ - * /, unary minus, and parentheses are admitted, so model-authored
+expressions cannot execute code.
+"""
+
+import ast
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def safe_eval_arithmetic(expr: str) -> float:
+    """Evaluate an arithmetic expression via the AST; raises ValueError on
+    anything but numbers, + - * /, unary +/- and parentheses."""
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise ValueError(f"unparsable expression: {e}") from None
+
+    def ev(node: ast.AST) -> float:
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)
+        ):
+            return float(node.value)
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div)
+        ):
+            a, b = ev(node.left), ev(node.right)
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if b == 0:
+                raise ValueError("division by zero")
+            return a / b
+        if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.UAdd, ast.USub)
+        ):
+            v = ev(node.operand)
+            return v if isinstance(node.op, ast.UAdd) else -v
+        raise ValueError(f"disallowed syntax: {ast.dump(node)[:60]}")
+
+    return ev(tree)
+
+
+def expression_numbers(expr: str) -> List[float]:
+    """All numeric literals in the expression (multiset, for the
+    use-each-number-at-most-once rule)."""
+    tree = ast.parse(expr, mode="eval")
+    return [
+        float(n.value)
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Constant) and isinstance(n.value, (int, float))
+    ]
+
+
+def countdown_score(
+    expr: str, numbers: List[int], target: float
+) -> Tuple[float, str]:
+    """(reward, explanation). 1.0 = valid numbers and exact target;
+    0.1 = evaluates but wrong/illegal numbers (format credit, the
+    reference's rank-style partial credit); 0.0 = not evaluable."""
+    try:
+        value = safe_eval_arithmetic(expr)
+        used = expression_numbers(expr)
+    except ValueError as e:
+        return 0.0, str(e)
+    pool = list(numbers)
+    for u in used:
+        if u in pool:
+            pool.remove(u)
+        else:
+            return 0.1, f"number {u:g} not available (pool {numbers})"
+    if abs(value - target) < 1e-6:
+        return 1.0, "correct"
+    return 0.1, f"evaluates to {value:g}, target {target:g}"
+
+
+TOOL_SCHEMAS: List[Dict[str, Any]] = [
+    {
+        "type": "function",
+        "function": {
+            "name": "eval_expression",
+            "description": (
+                "Evaluate an arithmetic expression (numbers, + - * /, "
+                "parentheses) and return its value."
+            ),
+            "parameters": {
+                "type": "object",
+                "properties": {
+                    "expression": {"type": "string"},
+                },
+                "required": ["expression"],
+            },
+        },
+    },
+    {
+        "type": "function",
+        "function": {
+            "name": "submit_expression",
+            "description": (
+                "Submit the final expression that reaches the target. Ends "
+                "the episode."
+            ),
+            "parameters": {
+                "type": "object",
+                "properties": {
+                    "expression": {"type": "string"},
+                },
+                "required": ["expression"],
+            },
+        },
+    },
+]
+
+
+@dataclasses.dataclass
+class CountdownEnv:
+    """One countdown instance; tools are executed via :meth:`call`."""
+
+    numbers: List[int]
+    target: int
+    submitted: Optional[str] = None
+    reward: float = 0.0
+    detail: str = "no submission"
+
+    @property
+    def tools(self) -> List[Dict[str, Any]]:
+        return TOOL_SCHEMAS
+
+    def prompt(self) -> str:
+        return (
+            f"Using the numbers {self.numbers} (each at most once) and the "
+            f"operations + - * /, build an expression equal to "
+            f"{self.target}. You can check intermediate values with the "
+            "eval_expression tool; finish with submit_expression."
+        )
+
+    @property
+    def done(self) -> bool:
+        return self.submitted is not None
+
+    def call(self, name: str, arguments: str) -> str:
+        """Execute one parsed tool call; returns the tool-message content."""
+        try:
+            args = json.loads(arguments) if arguments else {}
+        except ValueError:
+            return "error: arguments are not valid JSON"
+        expr = str(args.get("expression", ""))
+        if name == "eval_expression":
+            try:
+                return f"{safe_eval_arithmetic(expr):g}"
+            except ValueError as e:
+                return f"error: {e}"
+        if name == "submit_expression":
+            self.submitted = expr
+            self.reward, self.detail = countdown_score(
+                expr, self.numbers, self.target
+            )
+            return f"submitted ({self.detail})"
+        return f"error: unknown tool {name!r}"
+
+
+def sample_instance(rng) -> "CountdownEnv":
+    """Solvable instance: compose the target from a random subset so a
+    perfect policy can always score 1.0."""
+    n = int(rng.integers(3, 5))
+    numbers = [int(rng.integers(1, 20)) for _ in range(n)]
+    target = numbers[0]
+    for x in numbers[1:]:
+        op = int(rng.integers(0, 3))
+        if op == 0:
+            target = target + x
+        elif op == 1:
+            target = target - x
+        else:
+            target = target * x
+    return CountdownEnv(numbers=numbers, target=int(target))

@@ -1,0 +1,115 @@
+"""Agentic tool-calling workflow over the OpenAI-compatible client.
+
+Role of reference examples/countdown/train.py + areal/experimental/openai/
+client.py:194-342: agent code written against ``client.chat.completions
+.create(..., tools=...)`` runs episodes against the framework's serving
+engine; every completion's tokens/logprobs/versions are cached, the final
+environment reward is attached to the last completion, and
+``export_completions(turn_discount)`` discounts it back through earlier
+turns — each turn becomes one training row.
+
+The workflow is generic over any environment object exposing
+``tools`` (OpenAI schemas), ``prompt()``, ``call(name, arguments) -> str``,
+``done`` and ``reward`` — see env/countdown.py for the shipped instance.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.openai_client import ArealOpenAI, hermes_tool_parser
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("AgenticToolWorkflow")
+
+
+class AgenticToolWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        env_factory: Callable[[Dict[str, Any]], Any],
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        max_tool_rounds: int = 4,
+        turn_discount: float = 0.9,
+        tool_parser=hermes_tool_parser,
+        system_prompt: Optional[str] = None,
+    ):
+        assert gconfig.n_samples == 1, (
+            "agentic episodes are single-trajectory; group sampling happens "
+            "at the prompt level"
+        )
+        self.env_factory = env_factory
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.max_tool_rounds = max_tool_rounds
+        self.turn_discount = turn_discount
+        self.tool_parser = tool_parser
+        self.system_prompt = system_prompt
+
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        env = self.env_factory(data)
+        client = ArealOpenAI(
+            engine,
+            self.tokenizer,
+            gconfig=self.gconfig,
+            tool_parser=self.tool_parser,
+        )
+        messages: List[Dict[str, str]] = []
+        if self.system_prompt:
+            messages.append({"role": "system", "content": self.system_prompt})
+        messages.append({"role": "user", "content": env.prompt()})
+        last_id = None
+        calls_per_turn: List[int] = []
+        for _ in range(self.max_tool_rounds):
+            resp = await client.chat.completions.create(
+                messages=messages, tools=env.tools, tool_choice="auto"
+            )
+            last_id = resp.id
+            choice = resp.choices[0]
+            messages.append(
+                {"role": "assistant", "content": choice.message.content}
+            )
+            calls_per_turn.append(0)
+            if choice.finish_reason != "tool_calls":
+                break
+            for tc in choice.message.tool_calls:
+                if env.done:
+                    # a submit ends the episode; a trailing call in the same
+                    # completion must not overwrite the recorded outcome
+                    break
+                result = env.call(tc.function.name, tc.function.arguments)
+                calls_per_turn[-1] += 1
+                messages.append(
+                    {
+                        "role": "tool",
+                        "content": f"{tc.function.name} -> {result}",
+                    }
+                )
+            if env.done:
+                break
+        if last_id is None:
+            return None
+        if not env.done:
+            logger.debug(
+                "episode exhausted %d rounds without submission",
+                self.max_tool_rounds,
+            )
+        client.set_reward(last_id, float(getattr(env, "reward", 0.0)))
+        rows = [
+            c.to_training_row()
+            for c in client.export_completions(self.turn_discount).values()
+        ]
+        batch = data_utils.concat_padded_tensors(rows)
+        # per-row stat: parsed tool calls executed for THAT completion
+        # (export order is creation order, i.e. turn order)
+        batch["tool_calls"] = np.asarray(
+            calls_per_turn[: len(rows)]
+            + [0] * max(0, len(rows) - len(calls_per_turn)),
+            np.int32,
+        )
+        return batch

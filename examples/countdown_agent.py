@@ -1,0 +1,243 @@
+"""Agentic countdown: tool-calling episodes that train through PPO.
+
+Role of reference examples/countdown/train.py + areal/experimental/openai/
+client.py: an agent plays the countdown game (reach a target from a list of
+numbers with + - * /) by CALLING TOOLS through the OpenAI-compatible client
+— ``eval_expression`` to check values, ``submit_expression`` to answer —
+against the real serving engine; each completion becomes a training row and
+the environment reward discounts back through the episode's turns
+(AgenticToolWorkflow → PPOActor).
+
+This sandbox has no network egress, so the script is self-contained: a
+word-level toy tokenizer whose vocabulary contains the tool-call markers as
+single tokens, and a small random-init qwen2-shaped model. A random policy
+emits ``<call>``/``<submit>`` markers often enough that real tool calls
+flow end-to-end (parse → execute → tool message → next turn → reward);
+with a real checkpoint + its HF tokenizer the same workflow uses the
+standard Hermes ``<tool_call>`` JSON convention instead
+(api/openai_client.hermes_tool_parser).
+
+Run:  python examples/countdown_agent.py [--steps 3]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+import uuid
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.openai_client import ToolCall, ToolCallFunction
+
+# word-level vocab; the tool markers are single tokens so a random policy
+# has ~1/V chance per step of opening a call
+WORDS = (
+    [str(d) for d in range(10)]
+    + list("+-*/()")
+    + ["<call>", "</call>", "<submit>", "</submit>", "<eos>", " ", "=", "?"]
+)
+
+
+class ToyToolTokenizer:
+    """Minimal tokenizer surface for ArealOpenAI: apply_chat_template /
+    encode / decode over a tiny word vocabulary (unknown chars dropped)."""
+
+    def __init__(self):
+        self.itos = {i + 1: w for i, w in enumerate(WORDS)}  # 0 = pad
+        self.stoi = {w: i for i, w in self.itos.items()}
+        self.vocab_size = len(WORDS) + 1
+        self.eos_token_id = self.stoi["<eos>"]
+
+    def encode(self, s, add_special_tokens=False):
+        ids, i = [], 0
+        while i < len(s):
+            for w in ("<call>", "</call>", "<submit>", "</submit>", "<eos>"):
+                if s.startswith(w, i):
+                    ids.append(self.stoi[w])
+                    i += len(w)
+                    break
+            else:
+                if s[i] in self.stoi:
+                    ids.append(self.stoi[s[i]])
+                i += 1
+        return ids
+
+    def decode(self, ids):
+        return "".join(self.itos.get(int(i), "") for i in ids)
+
+    def apply_chat_template(
+        self, messages, tokenize=True, add_generation_prompt=False, **kw
+    ):
+        text = "".join(f"{m['content']}<eos>" for m in messages)
+        return self.encode(text) if tokenize else text
+
+
+def toy_tool_parser(text):
+    """Tool-call convention matched to the toy vocabulary: an expression
+    between <call>...</call> evaluates, between <submit>...</submit>
+    submits (unclosed markers run to end of text)."""
+    calls = []
+    for marker, name in (
+        ("call", "eval_expression"),
+        ("submit", "submit_expression"),
+    ):
+        for m in re.finditer(
+            rf"<{marker}>(.*?)(?:</{marker}>|$)", text, re.DOTALL
+        ):
+            calls.append(
+                ToolCall(
+                    id=f"call_{uuid.uuid4().hex[:8]}",
+                    function=ToolCallFunction(
+                        name=name,
+                        arguments=json.dumps({"expression": m.group(1)}),
+                    ),
+                )
+            )
+    return calls
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--episodes-per-step", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=48)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        ParallelismConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        FinetuneSpec,
+        WeightUpdateMeta,
+        WeightUpdateMethod,
+    )
+    from areal_tpu.engine.local import LocalSyncInferenceEngine
+    from areal_tpu.engine.ppo.actor import PPOActor
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.env.countdown import CountdownEnv, sample_instance
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.workflow.agentic import AgenticToolWorkflow
+
+    tok = ToyToolTokenizer()
+    model_cfg = ModelConfig(
+        vocab_size=32,
+        hidden_size=128,
+        intermediate_size=384,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        max_position_embeddings=1024,
+        rope_theta=1e4,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        attention_bias=True,
+        family="qwen2",
+    )
+    assert tok.vocab_size <= model_cfg.vocab_size
+    pcfg = PPOActorConfig(
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=32768),
+        optimizer=OptimizerConfig(
+            lr=1e-5, warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+        ),
+        parallel=ParallelismConfig(),
+        group_size=1,  # agentic episodes yield variable rows; no group norm
+        ppo_n_minibatches=1,
+        group_reward_norm=False,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+        temperature=1.0,
+    )
+    engine = SPMDTrainEngine(pcfg)
+    engine.initialize(
+        ft_spec=FinetuneSpec(1, 1000, args.episodes_per_step),
+        model_config=model_cfg,
+        seed=0,
+    )
+    actor = PPOActor(pcfg, engine)
+
+    rollout = LocalSyncInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="countdown", trial_name="agent",
+            consumer_batch_size=args.episodes_per_step,
+        ),
+        JaxGenConfig(
+            dtype="float32",
+            max_num_seqs=16,
+            max_model_len=1024,
+            page_size=16,
+            prefill_chunk=64,
+            decode_chunk=8,
+            admit_wave=8,
+            kv_bucket=128,
+        ),
+        model_config=model_cfg,
+        params=jax.device_get(engine.params),
+    )
+    rollout.initialize(train_engine=engine)
+
+    gconfig = GenerationHyperparameters(
+        n_samples=1,
+        max_new_tokens=args.max_new_tokens,
+        temperature=1.0,
+        stop_token_ids=[tok.eos_token_id],
+    )
+    workflow = AgenticToolWorkflow(
+        env_factory=lambda data: CountdownEnv(
+            numbers=data["numbers"], target=data["target"]
+        ),
+        gconfig=gconfig,
+        tokenizer=tok,
+        max_tool_rounds=3,
+        turn_discount=0.9,
+        tool_parser=toy_tool_parser,
+    )
+
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        t0 = time.time()
+        items = []
+        for _ in range(args.episodes_per_step):
+            env = sample_instance(rng)
+            items.append({"numbers": env.numbers, "target": env.target})
+        batch = rollout.rollout_batch(items, workflow)
+        tool_calls = batch.pop("tool_calls", np.zeros(1))
+        adv = actor.compute_advantages(dict(batch))
+        stats = actor.ppo_update(adv)
+        rollout.pause()
+        v = engine.get_version() + 1
+        rollout.update_weights(
+            WeightUpdateMeta(type=WeightUpdateMethod.DEVICE, model_version=v)
+        ).result(timeout=600)
+        engine.set_version(v)
+        rollout.resume()
+        print(
+            f"[countdown] step {step}: rows={batch['input_ids'].shape[0]} "
+            f"tool_calls/turn={float(np.mean(tool_calls)):.2f} "
+            f"reward_mean={float(np.mean(batch['rewards'])):.3f} "
+            f"loss={stats[0]['loss']:.4f} ({time.time()-t0:.1f}s)",
+            flush=True,
+        )
+    rollout.destroy()
+
+
+if __name__ == "__main__":
+    main()
