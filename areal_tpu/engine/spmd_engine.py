@@ -388,7 +388,9 @@ class SPMDTrainEngine(TrainEngine):
                     lambda p: p.astype(compute_dtype), params
                 )
                 logits, router_aux = packed_forward(
-                    cparams, mc, arrays, remat=remat, attend_fn=attend,
+                    cparams, mc, arrays, remat=remat,
+                    remat_save_attn=self.config.remat_save_attn,
+                    attend_fn=attend,
                     return_router_loss=True, return_hidden=lazy_head,
                     act_sharding=act_sh,
                 )

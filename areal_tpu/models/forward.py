@@ -26,6 +26,7 @@ def packed_forward(
     cfg: ModelConfig,
     arrays: dict,
     remat: bool = True,
+    remat_save_attn: bool = True,
     attend_fn: Optional[Any] = None,
     return_router_loss: bool = False,
     return_hidden: bool = False,
@@ -71,6 +72,7 @@ def packed_forward(
         arrays["segment_ids"],
         positions,
         remat=remat,
+        remat_save_attn=remat_save_attn,
         attend_fn=attend_fn,
         return_router_loss=return_router_loss,
         return_hidden=return_hidden,

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import ad_checkpoint as _ad_checkpoint
 
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.basic import (
@@ -209,6 +210,10 @@ def _layer_body(
         attn = segment_attention(q, k, v, segment_ids, causal=True)
     else:  # explicit SP kernel (ring / ulysses shard_map)
         attn = attend_fn(q, k, v, segment_ids)
+    # named so a remat policy can SAVE attention outputs: recomputing the
+    # flash forward inside the backward costs ~14ms/layer at 24k (measured,
+    # tools/microbench_attn_v2.py) for [B,T,Hq,D] bf16 of storage
+    attn = _ad_checkpoint.checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
     h = rms_norm(
         x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
@@ -237,6 +242,7 @@ def apply(
     segment_ids: jnp.ndarray,  # [B, T] int32; 0 = padding
     positions: jnp.ndarray,  # [B, T] int32 (or [B, T, 3] mrope)
     remat: bool = True,
+    remat_save_attn: bool = True,
     attend_fn: Optional[Any] = None,
     return_router_loss: bool = False,
     mm_embeds: Optional[jnp.ndarray] = None,  # [B, N, D] vision embeds
@@ -289,7 +295,15 @@ def apply(
         return out, aux
 
     if remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+        # save_attn keeps each layer's attention output across the
+        # forward->backward boundary (skips the flash-kernel recompute);
+        # everything else still remats. Off for memory-tight AOT shapes.
+        policy = (
+            jax.checkpoint_policies.save_only_these_names("attn_out")
+            if remat_save_attn
+            else None
+        )
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, aux = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(
         x, params["final_norm"], cfg.rms_norm_eps,

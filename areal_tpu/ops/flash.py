@@ -31,7 +31,7 @@ from jax.experimental.pallas.ops.tpu.splash_attention import (
 _PROBED_BLOCK: "int | None" = None
 
 
-def probe_block_size(max_block: int = 1024, probe_t: int = 2048) -> int:
+def probe_block_size(max_block: int = 2048, probe_t: int = 2048) -> int:
     """Find the largest splash block edge this backend can actually run.
 
     Per-grid-step overhead dominates this stack's pallas kernels (~50us/step
@@ -122,13 +122,29 @@ def _make_kernel(t: int, rep: int, window: int):
         mask = _sm.MultiHeadMask([head for _ in range(rep)])
         b = _block_size(t)
         if b:
+            # round-5 measured recipe (tools/microbench_attn_v2.py on v5e,
+            # corrected for the ~40ms/iter tunnel timing floor):
+            # - block_kv_compute 512 beats full-edge (fwd 23ms -> 14ms at
+            #   24k: smaller inner compute tiles overlap the kv DMA)
+            # - the FUSED dq+dkv backward kernel at 2048-edge blocks is the
+            #   big win: grad 62ms -> 39ms at 24k (one data pass instead of
+            #   two; bwd matmuls contract over T so they do not pay the
+            #   head_dim-64 MXU lane tax the forward does)
             bs = _sk.BlockSizes(
-                block_q=b, block_kv=b, block_kv_compute=b,
-                block_q_dkv=b, block_kv_dkv=b, block_kv_dkv_compute=b,
-                block_q_dq=b, block_kv_dq=b,
+                block_q=b, block_kv=b, block_kv_compute=min(512, b),
+                block_q_dkv=b, block_kv_dkv=b,
+                block_kv_dkv_compute=min(512, b),
+                use_fused_bwd_kernel=True,
             )
-            return _sk.make_splash_mqa_single_device(mask, block_sizes=bs)
-        return _sk.make_splash_mqa_single_device(mask)
+            # residual_checkpoint_name marks out+logsumexp so a remat
+            # policy saving "attn_out" skips the forward-kernel recompute
+            # in the backward (models/transformer.apply remat_save_attn)
+            return _sk.make_splash_mqa_single_device(
+                mask, block_sizes=bs, residual_checkpoint_name="attn_out"
+            )
+        return _sk.make_splash_mqa_single_device(
+            mask, residual_checkpoint_name="attn_out"
+        )
 
 
 def flash_segment_attention(

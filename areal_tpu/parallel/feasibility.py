@@ -62,6 +62,7 @@ def grpo_step_memory(
     param_dtype=jnp.float32,
     compute_dtype=jnp.bfloat16,
     hbm_limit_gb: float = 16.0,
+    remat_save_attn: bool = True,
 ) -> Dict[str, Any]:
     """AOT-lower the decoupled-GRPO grad step + adam apply for the given
     mesh factoring; returns per-device memory numbers + a fits verdict.
@@ -69,6 +70,9 @@ def grpo_step_memory(
     The grad program is the engine's real shape: packed [rows, bucket]
     streams, remat'd scanned layers, chunked LM head, decoupled PPO loss
     (behavior + proximal logprobs), f32 grad accumulation with donation.
+    ``remat_save_attn`` mirrors TrainEngineConfig.remat_save_attn (default
+    True, like the engine) so the verdict prices the same remat policy the
+    real train step uses; pass False to price the memory-lean policy.
     """
     mesh = mesh_lib.make_mesh(parallel)
     logical = param_logical_axes(model_cfg)
@@ -128,7 +132,9 @@ def grpo_step_memory(
             lambda p: p.astype(compute_dtype), params
         )
         logits = packed_forward(
-            cparams, model_cfg, arrays, remat=True, return_hidden=True,
+            cparams, model_cfg, arrays, remat=True,
+            remat_save_attn=remat_save_attn,
+            return_hidden=True,
             attend_fn=blockwise_segment_attention, act_sharding=act_sh,
         )
         newlogp = target_aligned_logprobs(logits, arrays)

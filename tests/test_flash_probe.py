@@ -50,7 +50,7 @@ def test_fallback_cascade_on_compile_failure(monkeypatch):
 
     monkeypatch.setattr(F, "flash_segment_attention", fake_attention)
     assert F.probe_block_size() == 256
-    assert attempts == [1024, 512, 256]
+    assert attempts == [2048, 1024, 512, 256]  # r5: max edge raised to 2048
 
     # total failure: every candidate raises -> 0, loudly (log), no crash
     F._PROBED_BLOCK = None

@@ -1,0 +1,105 @@
+"""Standalone ctx24k train-phase probe (bench.py's final phase) + fused-bwd
+parity check, for kernel iteration without the full bench."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flag = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _flag not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _flag
+    ).strip()
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec, OptimizerConfig, ParallelismConfig, PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.engine.sft.lm_engine import sft_loss_fn, sft_loss_weight_fn
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.utils import flops as flops_util
+    from areal_tpu.ops import flash as flash_ops
+    from areal_tpu.ops.blockwise_attention import blockwise_segment_attention
+
+    # --- parity: fused-bwd splash grad vs XLA blockwise grad ---
+    T = 4096
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, T, 14, 64), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, T, 2, 64), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, T, 2, 64), jnp.bfloat16)
+    seg = jnp.ones((1, T), jnp.int32)
+    print("probed block:", flash_ops.probe_block_size(), flush=True)
+
+    def loss_splash(q_):
+        return flash_ops.flash_segment_attention(q_, k, v, seg).astype(
+            jnp.float32
+        ).sum()
+
+    def loss_ref(q_):
+        return blockwise_segment_attention(q_, k, v, seg).astype(
+            jnp.float32
+        ).sum()
+
+    g1 = jax.jit(jax.grad(loss_splash))(q)
+    g2 = jax.jit(jax.grad(loss_ref))(q)
+    err = float(
+        jnp.max(jnp.abs(g1.astype(jnp.float32) - g2.astype(jnp.float32)))
+    )
+    ref = float(jnp.max(jnp.abs(g2.astype(jnp.float32))))
+    print(f"fused-bwd dq max abs err {err:.4f} (ref max {ref:.2f})",
+          flush=True)
+    assert err < 0.12 * max(ref, 1.0), "fused bwd parity failed"
+
+    # --- ctx24k phase ---
+    model_cfg = ModelConfig(
+        vocab_size=32768, hidden_size=896, intermediate_size=4864,
+        num_layers=24, num_heads=14, num_kv_heads=2, head_dim=64,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_bias=True, family="qwen2",
+    )
+    pcfg = PPOActorConfig(
+        dtype="bfloat16", param_dtype="float32",
+        gradient_checkpointing=True, attn_impl="flash",
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=24576),
+        optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
+        parallel=ParallelismConfig(),
+    )
+    trainer = SPMDTrainEngine(pcfg)
+    trainer.initialize(
+        ft_spec=FinetuneSpec(1, 1024, 1), model_config=model_cfg
+    )
+    t_long = 24576
+    rng = np.random.default_rng(0)
+    long_batch = {
+        "input_ids": rng.integers(
+            1, model_cfg.vocab_size, size=(1, t_long)
+        ).astype(np.int32),
+        "attention_mask": np.ones((1, t_long), np.bool_),
+        "loss_mask": np.ones((1, t_long), np.int32),
+    }
+    trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)
+    peak = flops_util.device_peak_flops(jax.devices()[0].device_kind)
+    for i in range(3):
+        t0 = time.perf_counter()
+        trainer.train_batch(long_batch, sft_loss_fn, sft_loss_weight_fn)
+        dt = time.perf_counter() - t0
+        mfu = flops_util.train_step_flops(model_cfg, [t_long], 0) / dt / peak
+        print(
+            f"ctx24k step {i}: {dt:.3f}s  {t_long/dt:.1f} tok/s  "
+            f"mfu {mfu:.4f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
