@@ -87,11 +87,18 @@ class ParallelismConfig:
     # collectives stay on each slice's ICI (how meshes larger than one ICI
     # domain scale — the reference's multi-node 32B recipes' analog)
     dcn_data_parallel_size: int = 1
+    # cross-SLICE fsdp over DCN: the fsdp axis becomes (dcn_fsdp * fsdp)
+    # with the OUTER fsdp positions striding across slices — parameter and
+    # optimizer shards span slices, so a model too big for ONE slice's HBM
+    # (the 32B recipe) still fits, at the cost of fsdp all-gathers riding
+    # DCN. Prefer dcn_data when the model fits a slice.
+    dcn_fsdp_parallel_size: int = 1
 
     @property
     def world_size(self) -> int:
         return (
             self.dcn_data_parallel_size
+            * self.dcn_fsdp_parallel_size
             * self.data_parallel_size
             * self.fsdp_parallel_size
             * self.tensor_parallel_size

@@ -53,6 +53,7 @@ class RemoteInferenceEngine(InferenceEngine):
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
         self._session: Optional[aiohttp.ClientSession] = None
+        self._session_loop = None
 
     # ------------------------------------------------------------------
     def initialize(self, addrs: Optional[List[str]] = None):
@@ -141,11 +142,33 @@ class RemoteInferenceEngine(InferenceEngine):
             return addr
 
     async def _get_session(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
+        loop = asyncio.get_running_loop()
+        if (
+            self._session is None
+            or self._session.closed
+            # a session is bound to the loop it was created in; callers
+            # like evaluation/run_eval run several asyncio.run() sweeps
+            # against one engine, and reusing the first loop's session
+            # raises "Event loop is closed" in the second
+            or self._session_loop is not loop
+        ):
+            self._abandon_session()
             self._session = aiohttp.ClientSession(
                 connector=aiohttp.TCPConnector(limit=0)
             )
+            self._session_loop = loop
         return self._session
+
+    def _abandon_session(self) -> None:
+        """Best-effort socket close for a session whose owning loop is
+        gone (session.close() needs that loop); prevents leaking one
+        unlimited TCPConnector per asyncio.run sweep."""
+        old, self._session = self._session, None
+        if old is not None and not old.closed:
+            try:
+                old._connector._close()  # sync socket teardown
+            except Exception:
+                pass
 
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Interruptible generation loop (reference sglang_remote.py:121-249)."""

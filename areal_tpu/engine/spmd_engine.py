@@ -241,6 +241,7 @@ class SPMDTrainEngine(TrainEngine):
         p = self.config.parallel
         return (
             getattr(p, "dcn_data_parallel_size", 1)
+            * getattr(p, "dcn_fsdp_parallel_size", 1)
             * p.data_parallel_size
             * p.fsdp_parallel_size
         )

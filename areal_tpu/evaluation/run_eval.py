@@ -1,0 +1,207 @@
+"""Multi-dataset eval orchestration: the eval_and_aggregate analog.
+
+Role of reference evaluation/eval_and_aggregate.py + evaluation/data_loader.py
+(the instrument behind the published per-benchmark accuracy tables,
+blog/AReaL_v0_2.md:22-34): given a directory of `<dataset>.jsonl` files, fan
+each through the serving engine with the dataset's own extraction/grading
+conventions (evaluation/math_eval.py), and emit one aggregate table.
+
+    python -m areal_tpu.evaluation.run_eval \
+        --data-dir bench_data/ --addrs host:port --tokenizer-path <dir> \
+        --n-samples 4 --out results/
+
+Per-dataset conventions come from the FILENAME stem (gsm8k.jsonl -> gsm8k
+ground-truth/extraction rules; *code*.jsonl -> execution-based grading).
+The programmatic surface (`run_eval(engine, datasets, ...)`) takes
+pre-loaded items for embedding in training loops (Evaluator hook).
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.evaluation.eval_runner import EvalReport, evaluate_dataset
+
+CODE_DATASETS = ("code", "humaneval", "mbpp", "lcb", "livecodebench")
+
+
+def reward_fn_for(dataset: str) -> Callable:
+    """Grading convention for a dataset name (reference data_loader's
+    per-benchmark judge selection)."""
+    low = dataset.lower()
+    if any(t in low for t in CODE_DATASETS):
+        from areal_tpu.reward.code_verifier import code_reward_fn
+
+        return code_reward_fn
+    from areal_tpu.evaluation.math_eval import make_math_reward_fn
+
+    return make_math_reward_fn(low)
+
+
+def load_jsonl_dataset(
+    path: str, tokenizer, dataset: str, max_prompts: int = 0
+) -> List[Dict[str, Any]]:
+    """jsonl rows -> workflow items: tokenized prompt + grading fields
+    passed through (reference data_loader.load_data conventions: the
+    question field varies by benchmark)."""
+    items: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ex = json.loads(line)
+            q = (
+                ex.get("question")
+                or ex.get("problem")
+                or ex.get("Question")
+                or ex.get("prompt")
+                or ""
+            )
+            item = dict(ex)
+            item.pop("input_ids", None)
+            if getattr(tokenizer, "chat_template", None):
+                item["messages"] = [{"role": "user", "content": str(q)}]
+            else:
+                item["input_ids"] = tokenizer.encode(str(q))
+            items.append(item)
+            if max_prompts and len(items) >= max_prompts:
+                break
+    return items
+
+
+def run_eval(
+    engine,
+    datasets: Dict[str, List[Dict[str, Any]]],
+    gconfig: GenerationHyperparameters,
+    tokenizer=None,
+    reward_fns: Optional[Dict[str, Callable]] = None,
+    out_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Evaluate every dataset through `engine`; returns {dataset: report
+    dict} plus an 'average' row (unweighted mean accuracy, the reference
+    aggregate convention). Writes per-dataset rows + aggregate.json when
+    ``out_dir`` is given."""
+    reports: Dict[str, EvalReport] = {}
+    for name, items in datasets.items():
+        fn = (reward_fns or {}).get(name) or reward_fn_for(name)
+        reports[name] = evaluate_dataset(
+            engine, items, fn, gconfig, tokenizer=tokenizer
+        )
+    agg: Dict[str, Any] = {
+        name: r.to_dict() for name, r in reports.items()
+    }
+    accs = [r.accuracy for r in reports.values()]
+    agg["average"] = {
+        "n_datasets": len(reports),
+        "accuracy": sum(accs) / len(accs) if accs else 0.0,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        for name, r in reports.items():
+            with open(os.path.join(out_dir, f"{name}_rows.jsonl"), "w") as f:
+                for row in r.rows:
+                    f.write(json.dumps(row) + "\n")
+        with open(os.path.join(out_dir, "aggregate.json"), "w") as f:
+            json.dump(agg, f, indent=2)
+    return agg
+
+
+def format_table(agg: Dict[str, Any]) -> str:
+    """Fixed-width aggregate table (the eval_and_aggregate console
+    artifact)."""
+    names = [n for n in agg if n != "average"]
+    head = (
+        f"{'dataset':<16} {'n':>5} {'acc':>7} {'pass@k':>18} "
+        f"{'maj@k':>18} {'tok':>7} {'s':>7}"
+    )
+    lines = [head, "-" * len(head)]
+    for n in names:
+        r = agg[n]
+        pk = ",".join(
+            f"@{k}={v:.3f}" for k, v in sorted(
+                r.get("pass_at_k", {}).items(), key=lambda kv: int(kv[0])
+            )
+        )
+        mk = ",".join(
+            f"@{k}={v:.3f}" for k, v in sorted(
+                r.get("maj_at_k", {}).items(), key=lambda kv: int(kv[0])
+            )
+        )
+        lines.append(
+            f"{n:<16} {r['n_prompts']:>5} {r['accuracy']:>7.3f} "
+            f"{pk:>18} {mk:>18} {r['avg_gen_tokens']:>7.1f} "
+            f"{r['wall_seconds']:>7.1f}"
+        )
+    lines.append(
+        f"{'AVERAGE':<16} {'':>5} {agg['average']['accuracy']:>7.3f}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--data-dir", required=True,
+        help="directory of <dataset>.jsonl files (stem picks conventions)",
+    )
+    p.add_argument("--datasets", default="",
+                   help="comma list to restrict (default: all files)")
+    p.add_argument("--addrs", required=True)
+    p.add_argument("--tokenizer-path", required=True)
+    p.add_argument("--n-samples", type=int, default=1)
+    p.add_argument("--max-new-tokens", type=int, default=1024)
+    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--max-prompts", type=int, default=0)
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+
+    from transformers import AutoTokenizer
+
+    from areal_tpu.api.cli_args import InferenceEngineConfig
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+
+    tokenizer = AutoTokenizer.from_pretrained(args.tokenizer_path)
+    want = {d for d in args.datasets.split(",") if d}
+    datasets: Dict[str, List[Dict[str, Any]]] = {}
+    for fname in sorted(os.listdir(args.data_dir)):
+        if not fname.endswith(".jsonl"):
+            continue
+        stem = fname[: -len(".jsonl")]
+        if want and stem not in want:
+            continue
+        datasets[stem] = load_jsonl_dataset(
+            os.path.join(args.data_dir, fname), tokenizer, stem,
+            max_prompts=args.max_prompts,
+        )
+    if not datasets:
+        raise SystemExit(f"no .jsonl datasets found in {args.data_dir}")
+    engine = RemoteInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="eval", trial_name="run_eval",
+            consumer_batch_size=1, request_timeout=1800,
+        )
+    ).initialize(addrs=args.addrs.split(","))
+    try:
+        gconfig = GenerationHyperparameters(
+            n_samples=args.n_samples,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_p=0.95,
+        )
+        agg = run_eval(
+            engine, datasets, gconfig, tokenizer=tokenizer,
+            out_dir=args.out or None,
+        )
+    finally:
+        engine.destroy()
+    print(format_table(agg))
+    return agg
+
+
+if __name__ == "__main__":
+    main()

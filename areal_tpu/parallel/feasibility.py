@@ -90,6 +90,7 @@ def grpo_step_memory(
 
     rows = (
         getattr(parallel, "dcn_data_parallel_size", 1)
+        * getattr(parallel, "dcn_fsdp_parallel_size", 1)
         * parallel.data_parallel_size
         * parallel.fsdp_parallel_size
     )
@@ -238,6 +239,7 @@ def grpo_step_memory(
         },
         "n_devices": n_dev,
         "bucket_tokens_per_row": bucket,
+        "remat_save_attn": bool(remat_save_attn),
         "grad_step": grad_mem,
         "apply_step": apply_mem,
         "peak_per_device_gb": worst,
@@ -280,3 +282,129 @@ def qwen2_1p5b_config() -> ModelConfig:
         attention_bias=True,
         family="qwen2",
     )
+
+
+def qwen2_32b_config() -> ModelConfig:
+    """Qwen2.5-32B geometry — the reference's beyond-one-node recipe
+    (blog/AReaL_v0_3.md:17-29 trains 32B across nodes with Megatron PP;
+    here the answer is fsdp/tensor sharding that may SPAN slices via
+    dcn_fsdp_parallel_size)."""
+    return ModelConfig(
+        vocab_size=152064,
+        hidden_size=5120,
+        intermediate_size=27648,
+        num_layers=64,
+        num_heads=40,
+        num_kv_heads=8,
+        head_dim=128,
+        max_position_embeddings=32768,
+        rope_theta=1e6,
+        tie_word_embeddings=False,
+        attention_bias=True,
+        family="qwen2",
+    )
+
+
+MODEL_CONFIGS = {
+    "qwen2_7b": qwen2_7b_config,
+    "qwen2_1p5b": qwen2_1p5b_config,
+    "qwen2_32b": qwen2_32b_config,
+}
+
+
+def _parse_topo(spec: str) -> ParallelismConfig:
+    """'fsdp=32,tensor=2,dcn_fsdp=2' -> ParallelismConfig."""
+    kw = {}
+    names = {
+        "data": "data_parallel_size",
+        "fsdp": "fsdp_parallel_size",
+        "tensor": "tensor_parallel_size",
+        "seq": "seq_parallel_size",
+        "expert": "expert_parallel_size",
+        "dcn_data": "dcn_data_parallel_size",
+        "dcn_fsdp": "dcn_fsdp_parallel_size",
+    }
+    for part in spec.split(","):
+        k, v = part.split("=")
+        kw[names[k.strip()]] = int(v)
+    return ParallelismConfig(**kw)
+
+
+def main(argv=None):
+    """Topology sweep CLI (runs in a subprocess with its own virtual
+    device count):
+
+        XLA_FLAGS=--xla_force_host_platform_device_count=64 \\
+        JAX_PLATFORMS=cpu python -m areal_tpu.parallel.feasibility \\
+            --model qwen2_32b --bucket 4096 \\
+            --topo fsdp=64 --topo dcn_fsdp=2,fsdp=32
+
+    Prints one JSON line per topology: AOT_FEASIBILITY <name> {...}."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="qwen2_32b", choices=sorted(MODEL_CONFIGS))
+    p.add_argument("--bucket", type=int, default=4096)
+    p.add_argument("--seqs-per-row", type=int, default=8)
+    p.add_argument("--hbm-gb", type=float, default=16.0)
+    p.add_argument(
+        "--remat-save-attn",
+        action=argparse.BooleanOptionalAction,
+        default=True,  # engine parity: TrainEngineConfig.remat_save_attn
+        help="price the engine's default remat policy (saved attention "
+        "outputs); --no-remat-save-attn prices the memory-lean one",
+    )
+    p.add_argument("--topo", action="append", required=True)
+    p.add_argument(
+        "--devices", type=int, default=0,
+        help="provision this many VIRTUAL CPU devices (the environment may "
+        "pin a 1-chip TPU backend via sitecustomize; env vars alone are "
+        "ignored, so the live jax config is updated too)",
+    )
+    args = p.parse_args(argv)
+    import os
+
+    # virtual CPU devices have no slice_index: let multi-slice (dcn_*)
+    # topologies split them into contiguous virtual slices — this CLI is
+    # the AOT sweep tool, never a production launcher
+    os.environ["AREAL_TPU_VIRTUAL_SLICES"] = "1"
+    if args.devices:
+        from jax._src import xla_bridge
+
+        assert not xla_bridge.backends_are_initialized(), (
+            "backend already initialized; run the sweep in a fresh process"
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flag = "--xla_force_host_platform_device_count"
+        parts = [
+            q for q in os.environ.get("XLA_FLAGS", "").split()
+            if not q.startswith(f"{flag}=")
+        ]
+        os.environ["XLA_FLAGS"] = " ".join(
+            parts + [f"{flag}={args.devices}"]
+        )
+        jax.config.update("jax_platforms", "cpu")
+        assert len(jax.devices()) >= args.devices
+    cfg = MODEL_CONFIGS[args.model]()
+    out = {}
+    for spec in args.topo:
+        name = f"{args.model}[{spec}]r{args.bucket}"
+        try:
+            rep = grpo_step_memory(
+                cfg,
+                _parse_topo(spec),
+                bucket=args.bucket,
+                seqs_per_row=args.seqs_per_row,
+                hbm_limit_gb=args.hbm_gb,
+                remat_save_attn=args.remat_save_attn,
+            )
+        except Exception as e:  # record, keep sweeping
+            rep = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        out[name] = rep
+        print(f"AOT_FEASIBILITY {name} " + json.dumps(rep), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -44,13 +44,34 @@ def _hybrid_device_order(
     collectives stay within one slice's ICI. This is the scaling-book /
     MaxText hybrid-mesh recipe (dcn data parallelism between slices), the
     TPU answer to the reference's cross-node recipes (its 32B runs span
-    nodes with NCCL PP+DP; here the mesh factoring does it)."""
+    nodes with NCCL PP+DP; here the mesh factoring does it).
+
+    With AREAL_TPU_VIRTUAL_SLICES=1 on a CPU backend (the dryrun/AOT
+    feasibility mesh) devices carry no slice_index; contiguous equal
+    blocks stand in as virtual slices so multi-slice topologies can be
+    validated without a pod. Opt-in only: by default a single-slice
+    backend asked for a multi-slice mesh still fails loudly."""
+    import os
+
     by_slice: dict = {}
     for d in devices:
         by_slice.setdefault(_slice_id(d), []).append(d)
+    if (
+        len(by_slice) == 1
+        and n_slices > 1
+        and jax.default_backend() == "cpu"
+        and os.environ.get("AREAL_TPU_VIRTUAL_SLICES")
+    ):
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not split into {n_slices} "
+                "virtual slices"
+            )
+        per = len(devices) // n_slices
+        return list(devices)[: per * n_slices]
     if len(by_slice) < n_slices:
         raise ValueError(
-            f"dcn_data_parallel_size={n_slices} but only "
+            f"mesh spans {n_slices} slices but only "
             f"{len(by_slice)} slice(s) visible"
         )
     groups = [by_slice[s] for s in sorted(by_slice)][:n_slices]
@@ -69,12 +90,25 @@ def make_mesh(
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    n_slices = getattr(parallel, "dcn_data_parallel_size", 1) or 1
+    dcn_data = getattr(parallel, "dcn_data_parallel_size", 1) or 1
+    dcn_fsdp = getattr(parallel, "dcn_fsdp_parallel_size", 1) or 1
+    if dcn_fsdp > 1 and parallel.data_parallel_size > 1:
+        # within-slice data parallel under cross-slice fsdp would put the
+        # data axis (outermost) across slices, silently breaking the
+        # "fsdp spans DCN" layout — cross-slice data belongs to dcn_data
+        raise ValueError(
+            "dcn_fsdp_parallel_size>1 requires data_parallel_size=1 "
+            "(use dcn_data_parallel_size for cross-slice data parallelism)"
+        )
+    n_slices = dcn_data * dcn_fsdp
     if n_slices > 1:
         devices = _hybrid_device_order(devices, n_slices)
+    # dcn_fsdp: fsdp's OUTER positions stride slices (slice-major device
+    # order + data outermost), so parameter/optimizer shards span slices —
+    # the beyond-one-slice memory story for models like the 32B recipe
     shape = (
-        n_slices * parallel.data_parallel_size,
-        parallel.fsdp_parallel_size,
+        dcn_data * parallel.data_parallel_size,
+        dcn_fsdp * parallel.fsdp_parallel_size,
         parallel.seq_parallel_size,
         getattr(parallel, "expert_parallel_size", 1),
         parallel.tensor_parallel_size,

@@ -104,6 +104,44 @@ def test_interval_bracket_mismatch_still_equal_elementwise():
     assert answers_equal("(0, 1]", "[0,1]")
 
 
+@pytest.mark.parametrize(
+    "pred,truth,equal",
+    [
+        # --- percent (reference grader.parse_digits + the
+        # include_percentage [ref/100, ref, ref*100] acceptance) ---
+        ("50%", "0.5", True),
+        ("0.5", "50%", True),
+        ("150%", "1.5", True),
+        ("3%", "0.03", True),
+        ("0.5", "50", True),   # ref accepts reference/100
+        ("50", "0.5", True),   # ...and reference*100
+        ("50%", "0.4", False),
+        # --- fractions (not float()-parseable -> symbolic path) ---
+        ("3/4", "0.75", True),
+        ("1/3", "0.33333", True),
+        ("7/2", "3.5", True),
+        ("22/7", "3.14159", False),  # famously not pi, nor 22/7==3.14159
+        ("-1/2", "-0.5", True),
+        ("\\frac{3}{4}", "0.75", True),
+        # --- intervals / tuples (elementwise, bracket-insensitive:
+        # reference math_equal's "[a,b] vs [c,d]" + strip-brackets) ---
+        ("[0, 1]", "(0, 1)", True),
+        ("(1, 2]", "[1,2]", True),
+        ("[0, 2]", "[0, 1]", False),
+        ("(1, 2, 3)", "(1,2,3)", True),
+        ("[1/2, 1]", "[0.5, 1]", True),
+        ("[50%, 1]", "[0.5, 1]", True),
+        ("[1, 2]", "[1, 2, 3]", False),  # arity mismatch
+    ],
+)
+def test_percent_fraction_interval_vectors(pred, truth, equal):
+    """Agreement vectors for evaluation/grader.py:62-200's percent /
+    fraction / interval semantics (VERDICT r4 #6)."""
+    from areal_tpu.reward.math_parser import answers_equal
+
+    assert answers_equal(pred, truth) is equal
+
+
 # --- code extraction vectors (reference code_eval.extract_python_code) ----
 def test_extract_python_code_last_valid_block():
     text = (

@@ -52,6 +52,45 @@ def test_hybrid_mesh_requires_visible_slices():
         mesh_lib.make_mesh(par)  # CPU devices are all slice 0
 
 
+def test_dcn_fsdp_spans_slices(fake_two_slices):
+    """Beyond-one-slice memory: with dcn_fsdp the fsdp axis's OUTER
+    positions stride across slices, so parameter shards span DCN (the
+    32B-recipe layout) — and within-slice data parallelism under it is
+    rejected (it would silently put the data axis across slices)."""
+    half = fake_two_slices
+    par = ParallelismConfig(
+        fsdp_parallel_size=half, dcn_fsdp_parallel_size=2
+    )
+    mesh = mesh_lib.make_mesh(par)
+    assert mesh.devices.shape[1] == 2 * half  # widened fsdp axis
+    fs = mesh.devices.reshape(mesh.devices.shape[1])
+    assert all(mesh_lib._slice_id(d) == 0 for d in fs[:half])
+    assert all(mesh_lib._slice_id(d) == 1 for d in fs[half:])
+    with pytest.raises(ValueError, match="dcn_data"):
+        mesh_lib.make_mesh(
+            ParallelismConfig(
+                data_parallel_size=2,
+                fsdp_parallel_size=half // 2,
+                dcn_fsdp_parallel_size=2,
+            )
+        )
+
+
+def test_virtual_slices_opt_in(monkeypatch):
+    """CPU virtual slices (AOT feasibility sweeps) are opt-in; the default
+    stays loud when a multi-slice mesh is requested on one slice."""
+    par = ParallelismConfig(
+        fsdp_parallel_size=len(jax.devices()) // 2,
+        dcn_fsdp_parallel_size=2,
+    )
+    monkeypatch.setenv("AREAL_TPU_VIRTUAL_SLICES", "1")
+    mesh = mesh_lib.make_mesh(par)
+    assert mesh.devices.size == len(jax.devices())
+    monkeypatch.delenv("AREAL_TPU_VIRTUAL_SLICES")
+    with pytest.raises(ValueError, match="slice"):
+        mesh_lib.make_mesh(par)
+
+
 def test_train_step_matches_single_slice(fake_two_slices):
     from areal_tpu.engine.sft.lm_engine import sft_loss_fn, sft_loss_weight_fn
     from areal_tpu.engine.spmd_engine import SPMDTrainEngine

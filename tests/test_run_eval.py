@@ -1,0 +1,188 @@
+"""Multi-dataset eval pipeline (evaluation/run_eval, the eval_and_aggregate
+analog): one command sweeps >=3 jsonl benchmark files through a live
+generation server and emits the aggregate table; grading/aggregation logic
+pinned with a scripted engine."""
+
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.evaluation.run_eval import (
+    format_table,
+    load_jsonl_dataset,
+    reward_fn_for,
+    run_eval,
+)
+from tests.fixtures import make_tiny_tokenizer
+
+
+def _write_jsonl(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+class _ScriptedEngine:
+    """Echoes a per-prompt scripted completion (tokenized)."""
+
+    def __init__(self, tok, script):
+        self.tok = tok
+        self.script = dict(script)  # prompt-text -> completion text
+
+    def get_version(self):
+        return 0
+
+    async def agenerate(self, req):
+        prompt = self.tok.decode(req.input_ids)
+        out = None
+        for key, completion in self.script.items():
+            if key in prompt:
+                out = self.tok.encode(completion)
+                break
+        assert out is not None, f"unscripted prompt: {prompt!r}"
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=out,
+            output_logprobs=[-0.1] * len(out),
+            output_versions=[0] * len(out),
+            stop_reason="stop",
+        )
+
+
+def test_run_eval_aggregates_multiple_datasets(tmp_path):
+    tok = make_tiny_tokenizer(str(tmp_path / "tok"))
+    # three datasets with different grading conventions
+    gsm_items = [
+        {"input_ids": tok.encode("what is 2 + 2 ?"), "answer": "#### 4"},
+        {"input_ids": tok.encode("what is 3 + 3 ?"), "answer": "#### 7"},
+    ]
+    math_items = [
+        {"input_ids": tok.encode("compute 5 + 2"), "answer": "7"},
+    ]
+    sat_items = [
+        {"input_ids": tok.encode("the sum of a and b ?"), "answer": "B"},
+    ]
+    script = {
+        "2 + 2": "the answer is 4",    # correct (gsm8k: #### 4)
+        "3 + 3": "the answer is 5",    # wrong (truth 7)
+        "5 + 2": "the answer is 7",    # correct
+        "sum of a and b": "the answer is ( b )",  # correct choice B
+    }
+    eng = _ScriptedEngine(tok, script)
+    gconfig = GenerationHyperparameters(n_samples=1, max_new_tokens=16)
+    agg = run_eval(
+        eng,
+        {"gsm8k": gsm_items, "math": math_items, "sat_math": sat_items},
+        gconfig,
+        tokenizer=tok,
+        out_dir=str(tmp_path / "out"),
+    )
+    assert agg["gsm8k"]["accuracy"] == pytest.approx(0.5)
+    assert agg["math"]["accuracy"] == pytest.approx(1.0)
+    assert agg["sat_math"]["accuracy"] == pytest.approx(1.0)
+    assert agg["average"]["accuracy"] == pytest.approx((0.5 + 1 + 1) / 3)
+    assert agg["average"]["n_datasets"] == 3
+    # artifacts: aggregate.json + per-dataset rows
+    with open(tmp_path / "out" / "aggregate.json") as f:
+        disk = json.load(f)
+    assert disk["average"]["accuracy"] == pytest.approx(agg["average"]["accuracy"])
+    assert (tmp_path / "out" / "gsm8k_rows.jsonl").exists()
+    table = format_table(agg)
+    assert "gsm8k" in table and "AVERAGE" in table
+    assert "0.833" in table
+
+
+def test_reward_fn_selection():
+    from areal_tpu.reward.code_verifier import code_reward_fn
+
+    assert reward_fn_for("humaneval") is code_reward_fn
+    assert reward_fn_for("live_code_bench_v5") is code_reward_fn
+    # math datasets get dataset-bound graders
+    fn = reward_fn_for("gsm8k")
+    assert fn("p", "the answer is 4", [], [], answer="#### 4") == 1.0
+    assert fn("p", "the answer is 5", [], [], answer="#### 4") == 0.0
+
+
+def test_load_jsonl_dataset_fields(tmp_path):
+    tok = make_tiny_tokenizer(str(tmp_path / "tok2"))
+    path = str(tmp_path / "d" / "math.jsonl")
+    _write_jsonl(
+        path,
+        [
+            {"problem": "compute 1 + 1", "answer": "2", "level": "easy"},
+            {"question": "what is x ?", "answer": "x"},
+        ],
+    )
+    items = load_jsonl_dataset(path, tok, "math")
+    assert len(items) == 2
+    # grading fields pass through; prompts are rendered
+    assert items[0]["answer"] == "2" and items[0]["level"] == "easy"
+    assert ("messages" in items[0]) or ("input_ids" in items[0])
+
+
+def test_run_eval_cli_against_live_server(tmp_path):
+    """The VERDICT 'done' bar: ONE command evaluates >=3 dataset files
+    against a real serving engine and emits the aggregate table."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import serve
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.evaluation.run_eval import main
+
+    tok_dir = str(tmp_path / "tok3")
+    make_tiny_tokenizer(tok_dir)
+    data_dir = str(tmp_path / "bench")
+    for name in ("gsm8k", "math", "svamp"):
+        _write_jsonl(
+            os.path.join(data_dir, f"{name}.jsonl"),
+            [
+                {"question": f"what is {i} + {i} ?", "answer": str(2 * i)}
+                for i in range(2)
+            ],
+        )
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", max_num_seqs=8, max_model_len=64,
+            prefill_chunk=16, page_size=8, kv_bucket=16,
+        ),
+        model_config=cfg,
+        params=params,
+    ).start()
+    httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        agg = main(
+            [
+                "--data-dir", data_dir,
+                "--addrs", addr,
+                "--tokenizer-path", tok_dir,
+                "--n-samples", "1",
+                "--max-new-tokens", "8",
+                "--out", str(tmp_path / "res"),
+            ]
+        )
+    finally:
+        httpd.shutdown()
+        eng.stop()
+    assert set(agg) == {"gsm8k", "math", "svamp", "average"}
+    assert (tmp_path / "res" / "aggregate.json").exists()
+    # random tiny model: accuracy is whatever it is, but the pipeline
+    # must produce finite numbers and per-dataset rows
+    for name in ("gsm8k", "math", "svamp"):
+        assert 0.0 <= agg[name]["accuracy"] <= 1.0
+        assert agg[name]["n_prompts"] == 2

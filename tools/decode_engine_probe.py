@@ -1,0 +1,84 @@
+"""Ground-truth decode throughput via the real GenerationEngine at bench
+shapes, sweeping (decode_chunk, decode_pipeline) incl. the r4 outlier
+config. Reports per-round tok/s + preemptions so the catastrophic-round
+interaction (chunk=32/pipeline=2, r4 memory) is reproducible."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_flag = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _flag not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _flag
+    ).strip()
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.models.transformer import init_params
+
+    cfg = ModelConfig(
+        vocab_size=32768, hidden_size=896, intermediate_size=4864,
+        num_layers=24, num_heads=14, num_kv_heads=2, head_dim=64,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_bias=True, family="qwen2",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+
+    combos = [(64, 1), (32, 2), (64, 2), (32, 1), (128, 1)]
+    if len(sys.argv) > 1:
+        combos = [tuple(int(x) for x in a.split(","))
+                  for a in sys.argv[1:]]
+
+    for chunk, pipe in combos:
+        gen_cfg = JaxGenConfig(
+            dtype="bfloat16", max_num_seqs=128, max_model_len=16384,
+            page_size=256, num_pages=1280, prefill_chunk=128,
+            decode_chunk=chunk, decode_pipeline=pipe,
+            admit_wave=16, kv_bucket=2048,
+        )
+        eng = GenerationEngine(
+            gen_cfg, model_config=cfg, params=params
+        ).start()
+
+        def round_(mnew, n=128, plen=128):
+            futs = []
+            for _ in range(n):
+                p = rng.integers(1, cfg.vocab_size, size=plen).tolist()
+                futs.append(eng.submit({
+                    "input_ids": p,
+                    "sampling_params": {
+                        "max_new_tokens": mnew, "temperature": 1.0,
+                    },
+                }))
+            t0 = time.perf_counter()
+            rs = [f.result(timeout=3600) for f in futs]
+            dt = time.perf_counter() - t0
+            toks = sum(len(r["output_ids"]) for r in rs)
+            return toks / dt
+
+        round_(1024)  # warm all buckets
+        rates = [round_(1024) for _ in range(5)]
+        m = eng.metrics()
+        eng.stop()
+        med = sorted(rates)[2]
+        print(
+            f"chunk={chunk} pipe={pipe}: median {med:8.1f} tok/s  "
+            f"rounds {[f'{r:.0f}' for r in rates]}  "
+            f"preempt {m['total_preemptions']}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
