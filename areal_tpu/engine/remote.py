@@ -17,12 +17,31 @@ Role of reference areal/engine/sglang_remote.py (`RemoteSGLangEngine`):
 
 import asyncio
 import concurrent.futures
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import aiohttp
+
+
+def _abandon_session(s: "aiohttp.ClientSession") -> None:
+    """Close a session whose owning loop is gone: ``detach`` marks the
+    session closed (no "Unclosed client session" __del__ noise), then the
+    connector's sockets are torn down synchronously. The sync teardown is
+    aiohttp-private (``_close``) — the public ``close()`` is a coroutine
+    needing the dead loop — so failures are logged, not swallowed."""
+    try:
+        conn = s.connector
+        if not s.closed:
+            s.detach()
+        if conn is not None:
+            conn._close()
+    except Exception as e:  # noqa: BLE001
+        logging.getLogger("areal_tpu.remote").warning(
+            "could not tear down abandoned http session: %s", e
+        )
 import requests as _requests
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
@@ -52,8 +71,12 @@ class RemoteInferenceEngine(InferenceEngine):
         self._lock = threading.Lock()
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
-        self._session: Optional[aiohttp.ClientSession] = None
-        self._session_loop = None
+        # one session PER event loop: a session is bound to its creating
+        # loop, and this engine is legitimately driven from several (the
+        # WorkflowExecutor's background loop + per-sweep asyncio.run loops
+        # in evaluation/run_eval) — a single slot would make concurrent
+        # loops thrash/close each other's in-flight sockets
+        self._sessions: Dict[int, tuple] = {}  # id(loop) -> (loop, session)
 
     # ------------------------------------------------------------------
     def initialize(self, addrs: Optional[List[str]] = None):
@@ -83,12 +106,18 @@ class RemoteInferenceEngine(InferenceEngine):
         if self.workflow_executor is not None:
             self.workflow_executor.destroy()
         self.executor.shutdown(wait=False)
-        if self._session is not None and not self._session.closed:
-            try:  # best-effort: the owning loop is already gone
-                asyncio.run(self._session.close())
-            except RuntimeError:
-                pass
-            self._session = None
+        for _, (lp, s) in list(self._sessions.items()):
+            if s.closed:
+                continue
+            if not lp.is_closed():
+                try:  # close on the owning loop when it still runs
+                    fut = asyncio.run_coroutine_threadsafe(s.close(), lp)
+                    fut.result(timeout=5)
+                    continue
+                except Exception:
+                    pass
+            _abandon_session(s)
+        self._sessions.clear()
 
     def _health_check_all(self):
         deadline = time.monotonic() + self.config.setup_timeout
@@ -143,32 +172,20 @@ class RemoteInferenceEngine(InferenceEngine):
 
     async def _get_session(self) -> aiohttp.ClientSession:
         loop = asyncio.get_running_loop()
-        if (
-            self._session is None
-            or self._session.closed
-            # a session is bound to the loop it was created in; callers
-            # like evaluation/run_eval run several asyncio.run() sweeps
-            # against one engine, and reusing the first loop's session
-            # raises "Event loop is closed" in the second
-            or self._session_loop is not loop
-        ):
-            self._abandon_session()
-            self._session = aiohttp.ClientSession(
+        # reap sessions whose owning loop is gone (each asyncio.run sweep
+        # leaves one behind) so the map stays bounded by LIVE loops
+        for key, (lp, s) in list(self._sessions.items()):
+            if lp is not loop and lp.is_closed():
+                self._sessions.pop(key)
+                _abandon_session(s)
+        ent = self._sessions.get(id(loop))
+        if ent is None or ent[1].closed:
+            s = aiohttp.ClientSession(
                 connector=aiohttp.TCPConnector(limit=0)
             )
-            self._session_loop = loop
-        return self._session
-
-    def _abandon_session(self) -> None:
-        """Best-effort socket close for a session whose owning loop is
-        gone (session.close() needs that loop); prevents leaking one
-        unlimited TCPConnector per asyncio.run sweep."""
-        old, self._session = self._session, None
-        if old is not None and not old.closed:
-            try:
-                old._connector._close()  # sync socket teardown
-            except Exception:
-                pass
+            self._sessions[id(loop)] = (loop, s)
+            return s
+        return ent[1]
 
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Interruptible generation loop (reference sglang_remote.py:121-249)."""

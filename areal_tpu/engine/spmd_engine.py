@@ -81,6 +81,34 @@ def _lr_schedule(cfg, total_steps: int) -> optax.Schedule:
     )
 
 
+def _make_optimizer(opt, schedule) -> optax.GradientTransformation:
+    """OptimizerConfig.type dispatch (reference cli_args.py:140 `type`):
+    adamw (default; f32 moments = the reference's mixed-precision Adam) or
+    sgd (stateless — lets model sizes whose Adam moments exceed HBM, e.g.
+    the 1.5B bench anchor on one 16 GB chip, still take real steps)."""
+    if opt.type == "sgd":
+        return optax.chain(
+            optax.clip_by_global_norm(opt.gradient_clipping),
+            # decay is stateless — dropping Adam's moments to fit HBM is
+            # no reason to silently drop the configured regularizer
+            optax.add_decayed_weights(opt.weight_decay),
+            optax.sgd(learning_rate=schedule),
+        )
+    if opt.type != "adamw":
+        raise ValueError(f"unknown optimizer type {opt.type!r}")
+    return optax.chain(
+        optax.clip_by_global_norm(opt.gradient_clipping),
+        optax.adamw(
+            learning_rate=schedule,
+            b1=opt.beta1,
+            b2=opt.beta2,
+            eps=opt.eps,
+            weight_decay=opt.weight_decay,
+            mu_dtype=jnp.float32,
+        ),
+    )
+
+
 class SPMDTrainEngine(TrainEngine):
     """The TPU analog of FSDPEngine: one SPMD program over one mesh."""
 
@@ -152,16 +180,8 @@ class SPMDTrainEngine(TrainEngine):
         if cfg.optimizer is not None:
             total_steps = ft_spec.total_train_steps if ft_spec else 10000
             self.lr_schedule = _lr_schedule(cfg, total_steps)
-            self.optimizer = optax.chain(
-                optax.clip_by_global_norm(cfg.optimizer.gradient_clipping),
-                optax.adamw(
-                    learning_rate=self.lr_schedule,
-                    b1=cfg.optimizer.beta1,
-                    b2=cfg.optimizer.beta2,
-                    eps=cfg.optimizer.eps,
-                    weight_decay=cfg.optimizer.weight_decay,
-                    mu_dtype=jnp.float32,
-                ),
+            self.optimizer = _make_optimizer(
+                cfg.optimizer, self.lr_schedule
             )
             # jit without out_shardings: XLA's sharding propagation gives the
             # adam moments their params' shardings (they are elementwise maps
@@ -197,17 +217,7 @@ class SPMDTrainEngine(TrainEngine):
             self.lr_schedule = _lr_schedule(cfg, total_steps)
         finally:
             cfg.optimizer = old_opt
-        self.optimizer = optax.chain(
-            optax.clip_by_global_norm(opt_config.gradient_clipping),
-            optax.adamw(
-                learning_rate=self.lr_schedule,
-                b1=opt_config.beta1,
-                b2=opt_config.beta2,
-                eps=opt_config.eps,
-                weight_decay=opt_config.weight_decay,
-                mu_dtype=jnp.float32,
-            ),
-        )
+        self.optimizer = _make_optimizer(opt_config, self.lr_schedule)
         self.opt_state = jax.jit(self.optimizer.init)(self.params)
         self._jit_cache.clear()
 

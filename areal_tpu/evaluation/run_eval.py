@@ -60,8 +60,14 @@ def load_jsonl_dataset(
                 or ex.get("problem")
                 or ex.get("Question")
                 or ex.get("prompt")
-                or ""
             )
+            if not q:
+                # an unrecognized question field would silently evaluate
+                # empty prompts into a plausible-looking ~0 accuracy
+                raise ValueError(
+                    f"{path}: row {len(items)} has no question/problem/"
+                    f"prompt field (keys: {sorted(ex)})"
+                )
             item = dict(ex)
             item.pop("input_ids", None)
             if getattr(tokenizer, "chat_template", None):

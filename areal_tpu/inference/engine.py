@@ -1047,6 +1047,14 @@ class GenerationEngine:
             if shortfall == 0:
                 return True
             if shortfall > self.pm.n_free:
+                if self._inflight:
+                    # drain before evicting: deferred releases (blocked on
+                    # the in-flight pipeline) may cover the shortfall, and
+                    # evicting the registry now would destroy parked KV of
+                    # preempted requests — forcing full re-prefills with
+                    # fresh shape compiles (the r4 catastrophic-round
+                    # mechanism at decode_pipeline=2)
+                    return False
                 self.registry.evict(self.pm, shortfall)
             if shortfall <= self.pm.n_free:
                 for slot, n in grow:
@@ -1056,8 +1064,6 @@ class GenerationEngine:
                     self._tables[slot, len(sp) : len(sp) + n] = pages
                     sp.extend(pages)
                 return True
-            if self._inflight:
-                return False  # drain first, then evict/preempt
             if len(self._active) == 1:
                 # a lone request larger than the whole pool cannot be
                 # preempted into progress — truncate it

@@ -1,0 +1,80 @@
+"""Aggregate multi-seed north-star runs into a lift + confidence interval.
+
+Reads examples/northstar/stats*.jsonl (one file per seed, written by
+northstar_arith.py --seed N), computes per-seed eval-accuracy lift
+(mean of the last 5 evals minus the post-SFT eval at step -1) and a
+two-sided t-interval over seeds — the round-4 verdict asked for a lift
+whose CI excludes zero rather than a single-seed trend line.
+
+Run: python examples/northstar_aggregate.py [--dir examples/northstar]
+"""
+
+import argparse
+import glob
+import json
+import math
+import os
+
+# two-sided 97.5% t quantiles by degrees of freedom (no scipy in image)
+T975 = {1: 12.706, 2: 4.303, 3: 3.182, 4: 2.776, 5: 2.571, 6: 2.447}
+
+
+def load_run(path):
+    base = None
+    evals = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("step", 0) == -1:
+                base = rec["eval_accuracy"]
+            elif "eval_accuracy" in rec:
+                evals.append(rec["eval_accuracy"])
+    return base, evals
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="examples/northstar")
+    p.add_argument("--last-k", type=int, default=5)
+    args = p.parse_args(argv)
+    rows = []
+    for path in sorted(glob.glob(os.path.join(args.dir, "stats*.jsonl"))):
+        base, evals = load_run(path)
+        if base is None or len(evals) < args.last_k:
+            print(f"skipping {path}: no baseline eval or too few steps")
+            continue
+        final = sum(evals[-args.last_k:]) / args.last_k
+        rows.append(
+            {
+                "file": os.path.basename(path),
+                "post_sft": round(base, 4),
+                "final": round(final, 4),
+                "lift": round(final - base, 4),
+                "steps": len(evals),
+            }
+        )
+    for r in rows:
+        print(
+            f"{r['file']:24s} post-SFT {r['post_sft']:.3f} -> "
+            f"final(last{args.last_k}) {r['final']:.3f}  "
+            f"lift {r['lift']:+.3f}  ({r['steps']} steps)"
+        )
+    lifts = [r["lift"] for r in rows]
+    n = len(lifts)
+    if n < 2:
+        print("need >=2 seeds for a CI")
+        return rows, None
+    mean = sum(lifts) / n
+    sd = math.sqrt(sum((x - mean) ** 2 for x in lifts) / (n - 1))
+    half = T975[min(n - 1, max(T975))] * sd / math.sqrt(n)
+    lo, hi = mean - half, mean + half
+    print(
+        f"\nmean lift over {n} seeds: {mean:+.4f}  "
+        f"95% CI [{lo:+.4f}, {hi:+.4f}]  "
+        f"({'EXCLUDES zero' if lo > 0 or hi < 0 else 'includes zero'})"
+    )
+    return rows, (mean, lo, hi)
+
+
+if __name__ == "__main__":
+    main()

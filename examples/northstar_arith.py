@@ -65,6 +65,11 @@ def main(argv=None):
     p.add_argument("--grpo-steps", type=int, default=40)
     p.add_argument("--group-size", type=int, default=8)
     p.add_argument("--n-prompts", type=int, default=16)
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="init + data-order seed; the held-out eval set stays fixed "
+        "so accuracies are comparable across seeds (multi-seed CI, r5)",
+    )
     args = p.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
 
@@ -129,10 +134,10 @@ def main(argv=None):
     engine.initialize(
         ft_spec=FinetuneSpec(1, 10_000, args.n_prompts * args.group_size),
         model_config=model_cfg,
-        seed=0,
+        seed=args.seed,
     )
     actor = PPOActor(pcfg, engine)
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(args.seed)
 
     # ---------------- Phase 1: SFT warm start ----------------
     def sft_batch(n):
@@ -260,11 +265,21 @@ def main(argv=None):
             "greedy_train_acc": round(greedy_hits / max(len(items), 1), 3),
         }
 
-    stats_path = os.path.join(args.out, "stats.jsonl")
+    stats_path = os.path.join(
+        args.out,
+        "stats.jsonl" if args.seed == 0 else f"stats_seed{args.seed}.jsonl",
+    )
     meta = WeightUpdateMeta(type=WeightUpdateMethod.DEVICE, model_version=0)
     with open(stats_path, "w") as f:
         acc0 = evaluate()
         print(f"[grpo] eval accuracy after SFT: {acc0:.3f}", flush=True)
+        f.write(
+            json.dumps(
+                {"step": -1, "seed": args.seed, "eval_accuracy": acc0}
+            )
+            + "\n"
+        )
+        f.flush()
         for step in range(args.grpo_steps):
             t0 = time.time()
             items = [

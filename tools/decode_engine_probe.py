@@ -35,6 +35,7 @@ def main():
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
 
+    mnew = int(os.environ.get("PROBE_MAX_NEW", "1024"))
     combos = [(64, 1), (32, 2), (64, 2), (32, 1), (128, 1)]
     if len(sys.argv) > 1:
         combos = [tuple(int(x) for x in a.split(","))
@@ -67,8 +68,8 @@ def main():
             toks = sum(len(r["output_ids"]) for r in rs)
             return toks / dt
 
-        round_(1024)  # warm all buckets
-        rates = [round_(1024) for _ in range(5)]
+        round_(mnew)  # warm all buckets
+        rates = [round_(mnew) for _ in range(5)]
         m = eng.metrics()
         eng.stop()
         med = sorted(rates)[2]
