@@ -45,6 +45,8 @@ def main():
     ]
     mnew = int(os.environ.get("AB_MAX_NEW", "1024"))
     slots = int(os.environ.get("AB_SLOTS", "64"))
+    import gc
+
     for name, kw in variants:
         eng = GenerationEngine(
             JaxGenConfig(
@@ -55,25 +57,33 @@ def main():
             ),
             model_config=cfg, params=params,
         ).start()
+        try:
 
-        def round_():
-            futs = [
-                eng.submit({
-                    "input_ids": rng.integers(1, 32768, size=128).tolist(),
-                    "sampling_params": {
-                        "max_new_tokens": mnew, "temperature": 1.0,
-                    },
-                })
-                for _ in range(slots)
-            ]
-            t0 = time.perf_counter()
-            rs = [f.result(timeout=1800) for f in futs]
-            dt = time.perf_counter() - t0
-            return sum(len(r["output_ids"]) for r in rs) / dt
+            def round_():
+                futs = [
+                    eng.submit({
+                        "input_ids": rng.integers(
+                            1, 32768, size=128
+                        ).tolist(),
+                        "sampling_params": {
+                            "max_new_tokens": mnew, "temperature": 1.0,
+                        },
+                    })
+                    for _ in range(slots)
+                ]
+                t0 = time.perf_counter()
+                rs = [f.result(timeout=1800) for f in futs]
+                dt = time.perf_counter() - t0
+                return sum(len(r["output_ids"]) for r in rs) / dt
 
-        round_(); round_()  # two warmups (bucket ladder)
-        rates = [round_() for _ in range(3)]
-        eng.stop()
+            round_(); round_()  # two warmups (bucket ladder)
+            rates = [round_() for _ in range(3)]
+        finally:
+            eng.stop()
+            # the engine OBJECT pins its 4 GB pool + params; two variants'
+            # pools coexisting would skew (or OOM) the A/B
+            del eng
+            gc.collect()
         print(
             f"{name:32s} median {sorted(rates)[1]:8.0f} tok/s  "
             f"rounds {[f'{r:.0f}' for r in rates]}",
