@@ -459,6 +459,12 @@ def main():
     # is stopped first: its params + KV pool (~4.5 GB) plus the 24k fp32
     # logits would exceed HBM ---
     gen.stop()
+    # the engine OBJECT still pins its params + KV pool (~4.1 GB); the 24k
+    # phase with saved attention residuals (+1.0 GB) needs that HBM back
+    del gen
+    import gc
+
+    gc.collect()
     try:
         t_long = 24576
         lens_long = [t_long]
