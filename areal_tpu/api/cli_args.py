@@ -274,6 +274,11 @@ class JaxGenConfig:
     attn_impl: str = "auto"
     pages_per_compute_block: int = 4  # kernel flash-block size, in pages
     slots_per_block: int = 8  # kernel grid-step slot grouping
+    # KV pool row layout: "token_packed" (row = 128//D tokens of one head)
+    # or "head_merged" (row = all kv heads of 128//(Hkv*D) tokens — one
+    # DMA per page moves every head; needs Hkv*D | 128). r5: experimental
+    # opt-in pending on-chip A/B; "auto" currently means token_packed.
+    pool_layout: str = "auto"
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85
     enable_metrics: bool = True

@@ -53,7 +53,8 @@ class CacheConfig:
 
 
 def init_kv_pool(
-    cfg: ModelConfig, ccfg: CacheConfig, dtype=jnp.bfloat16
+    cfg: ModelConfig, ccfg: CacheConfig, dtype=jnp.bfloat16,
+    head_merge: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Packed page pool (see ops/paged_attention.py layout contract)."""
     shape = packed_pool_shape(
@@ -62,6 +63,7 @@ def init_kv_pool(
         ccfg.num_pages,
         ccfg.page_size,
         cfg.head_dim,
+        head_merge=head_merge,
     )
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

@@ -260,9 +260,35 @@ class GenerationEngine:
             page_size=bs,
             max_model_len=config.max_model_len,
         )
+        from areal_tpu.ops.paged_attention import can_head_merge
+
+        layout = getattr(config, "pool_layout", "auto")
+        if layout not in ("auto", "token_packed", "head_merged"):
+            raise ValueError(
+                f"pool_layout={layout!r}: expected auto | token_packed | "
+                "head_merged"
+            )
+        if layout == "head_merged":
+            if not can_head_merge(
+                model_config.num_kv_heads, model_config.head_dim
+            ):
+                raise ValueError(
+                    "pool_layout=head_merged needs Hkv*head_dim | 128 "
+                    f"(got {model_config.num_kv_heads}x"
+                    f"{model_config.head_dim})"
+                )
+            if self.mesh is not None:
+                # TP shards the pool's kv-head dim, which merged collapses
+                # — silently downgrading would make layout A/Bs bogus
+                raise ValueError(
+                    "pool_layout=head_merged is single-device only "
+                    "(tensor parallelism shards the pool's kv-head dim)"
+                )
+        self._head_merge = layout == "head_merged"
         if self.mesh is None:
             self.cache = init_kv_pool(
-                model_config, self.cache_config, self.dtype
+                model_config, self.cache_config, self.dtype,
+                head_merge=self._head_merge,
             )
         else:
             # allocate directly sharded — materializing on one device
@@ -334,12 +360,11 @@ class GenerationEngine:
         # per-slot last (partial) pool row — lets merges avoid reading the
         # pool (see model_runner.init_last_rows)
         from areal_tpu.inference.model_runner import init_last_rows
-        from areal_tpu.ops.paged_attention import pack_factor
 
-        fd = pack_factor(model_config.head_dim) * model_config.head_dim
+        # last-row buffers mirror the POOL's row layout
+        _, hkv_pool, _, _, lane = self.cache["k"].shape
         self._last_rows = init_last_rows(
-            model_config.num_layers, s, model_config.num_kv_heads, fd,
-            self.dtype,
+            model_config.num_layers, s, hkv_pool, lane, self.dtype
         )
         # pipelined decode: dispatched-but-unprocessed chunks, and page
         # releases deferred until the pipeline drains (an in-flight chunk

@@ -41,6 +41,7 @@ from areal_tpu.ops.basic import (
     rope_frequencies,
 )
 from areal_tpu.ops.paged_attention import (
+    layout_from_pool,
     paged_decode_attention,
     paged_decode_attention_jnp,
     unpacked_view,
@@ -288,10 +289,20 @@ def merge_tokens(
     slot_ids: Optional[jnp.ndarray] = None,
 ):
     """Two-dispatch merge: assemble rows (pure), then write-only DUS scan.
-    Returns (cache, new_last_rows [L, N, Hkv, FD])."""
+    Returns (cache, new_last_rows [L, N, Hkv_pool, LANE]).
+
+    A head-merged pool (hkv dim 1, all heads per 128-lane row) reuses the
+    same assembly machinery on kbuf viewed as [L, N, T, 1, Hkv*D] — a
+    free reshape, since [T, Hkv, D] is token-major — with the pack factor
+    counted in tokens-per-row."""
     nl, n, t, hkv, d = kbuf.shape
-    _, _, num_pages, prow, fd = cache["k"].shape
-    f = fd // d
+    _, hkv_pool, num_pages, prow, fd = cache["k"].shape
+    merged, f = layout_from_pool(cache["k"].shape, hkv, d)
+    if merged:
+        kbuf = kbuf.reshape(nl, n, t, 1, hkv * d)
+        vbuf = vbuf.reshape(nl, n, t, 1, hkv * d)
+        hkv = 1
+        d = kbuf.shape[-1]
     if last_rows is None:
         last_rows = init_last_rows(nl, n, hkv, fd, kbuf.dtype)
     if slot_ids is None:
@@ -334,8 +345,9 @@ def prefill_forward(
     """
     n, tp = tokens.shape
     d = cfg.head_dim
-    nl, hkv, num_pages, prow, fd = cache["k"].shape
-    f = fd // d
+    nl, hkv_pool, num_pages, prow, fd = cache["k"].shape
+    hkv = cfg.num_kv_heads
+    merged, f = layout_from_pool(cache["k"].shape, hkv, d)
     page_size = prow * f
     mb0 = prefix_bound
     sidx = jnp.arange(tp, dtype=jnp.int32)[None, :]
@@ -364,13 +376,12 @@ def prefill_forward(
     scale = cfg.head_dim**-0.5
     g, rep = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
 
-    krows_all = _rows_view(cache["k"])  # [L, Hkv, NP*prow, FD]
+    krows_all = _rows_view(cache["k"])  # [L, Hkv_pool, NP*prow, FD]
     vrows_all = _rows_view(cache["v"])
 
     if mb0 > 0:
         npg = -(-mb0 // page_size)  # window pages (offsets page-aligned)
         wr = npg * prow  # window rows
-        rpos = jnp.arange(wr, dtype=jnp.int32)[None, :] * f  # [1, WR]
         # page-run gather: one dynamic_slice per (row, page) — index-array
         # gathers serialize per index on TPU, DS runs at copy speed
         page_starts = (
@@ -379,27 +390,43 @@ def prefill_forward(
 
         def fetch(carry, st):
             win_k = jax.lax.dynamic_slice(
-                krows_all, (0, 0, st, 0), (nl, hkv, prow, fd)
+                krows_all, (0, 0, st, 0), (nl, hkv_pool, prow, fd)
             )
             win_v = jax.lax.dynamic_slice(
-                vrows_all, (0, 0, st, 0), (nl, hkv, prow, fd)
+                vrows_all, (0, 0, st, 0), (nl, hkv_pool, prow, fd)
             )
             return carry, (win_k, win_v)
 
         _, (wk_pages, wv_pages) = jax.lax.scan(fetch, 0, page_starts)
-        # [N*npg, L, Hkv, prow, FD] → [L, Hkv, N, WR, FD]
+        # [N*npg, L, Hkv_pool, prow, FD] → [L, Hkv_pool, N, WR, FD]
         def arrange(w):
-            w = w.reshape(n, npg, nl, hkv, prow, fd)
+            w = w.reshape(n, npg, nl, hkv_pool, prow, fd)
             return w.transpose(2, 3, 0, 1, 4, 5).reshape(
-                nl, hkv, n, wr, fd
+                nl, hkv_pool, n, wr, fd
             )
 
         win_k_all = arrange(wk_pages)
         win_v_all = arrange(wv_pages)
-        # per-half key masks: token at (row r, half h) has position r*f+h
+        fw = f  # lane halves per window row (token stride)
+        if merged:
+            # unpack the merged rows into per-head single-token rows ONCE
+            # (prefix windows are an admission-time path, not decode-hot):
+            # [L, 1, N, WR, tpr*Hkv*D] -> [L, Hkv, N, WR*tpr, D]
+            def unmerge(w):
+                y = w.reshape(nl, n, wr, f, hkv, d)
+                return y.transpose(0, 4, 1, 2, 3, 5).reshape(
+                    nl, hkv, n, wr * f, d
+                )
+
+            win_k_all = unmerge(win_k_all)
+            win_v_all = unmerge(win_v_all)
+            wr = wr * f
+            fw = 1
+        rpos = jnp.arange(wr, dtype=jnp.int32)[None, :] * fw  # [1, WR]
+        # per-half key masks: token at (row r, half h) has position r*fw+h
         half_masks = [
             (rpos + h < offsets[:, None])[:, None, None, None]  # [N,1,1,1,WR]
-            for h in range(f)
+            for h in range(fw)
         ]
 
     # causal within the in-flight suffix
@@ -438,7 +465,7 @@ def prefill_forward(
             )
             scs = []
             vhs = []
-            for hh in range(f):
+            for hh in range(fw):
                 wk = win_k[..., hh * d : (hh + 1) * d]  # [Hkv, N, WR, D]
                 vhs.append(win_v[..., hh * d : (hh + 1) * d])
                 sc_h = (
@@ -459,10 +486,10 @@ def prefill_forward(
             wr_n = vhs[0].shape[2]
             attn = jnp.einsum(
                 "ngrqk,nkgd->nqgrd",
-                probs[..., f * wr_n :].astype(vz.dtype), vz,
+                probs[..., fw * wr_n :].astype(vz.dtype), vz,
                 preferred_element_type=jnp.float32,
             )
-            for hh in range(f):
+            for hh in range(fw):
                 attn = attn + jnp.einsum(
                     "ngrqk,gnkd->nqgrd",
                     probs[..., hh * wr_n : (hh + 1) * wr_n].astype(
@@ -585,9 +612,11 @@ def _attend(
         return paged_decode_attention(
             q, cache["k"], cache["v"], li, pos0, tables, ck, cv, counts,
             pages_per_compute_block=ppcb, slots_per_block=spb,
+            num_kv_heads=cfg.num_kv_heads,
         )
     return paged_decode_attention_jnp(
-        q, cache["k"], cache["v"], li, pos0, tables, ck, cv, counts
+        q, cache["k"], cache["v"], li, pos0, tables, ck, cv, counts,
+        num_kv_heads=cfg.num_kv_heads,
     )
 
 
@@ -617,8 +646,12 @@ def _decode_core(
     per-request constant); attention windows still use cache lengths."""
     s = tables.shape[0]
     d = cfg.head_dim
-    nl, hkv, num_pages, prow, fd = cache["k"].shape
-    page_size = prow * fd // d
+    nl, hkv_pool, num_pages, prow, fd = cache["k"].shape
+    hkv = cfg.num_kv_heads
+    # tokens per pool row differ by layout (head-merged packs every head
+    # into the lane dim); page_size = rows * tokens-per-row either way
+    _, tpr = layout_from_pool(cache["k"].shape, hkv, d)
+    page_size = prow * tpr
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
     )

@@ -53,20 +53,80 @@ def pack_factor(head_dim: int) -> int:
     return 128 // head_dim
 
 
+def can_head_merge(num_kv_heads: int, head_dim: int) -> bool:
+    """Head-merged rows need every kv head of a token inside one 128-lane
+    row: Hkv*D must divide 128 (Hkv=2, D=64 — the qwen2-small family —
+    fills it exactly)."""
+    return (
+        head_dim < 128
+        and num_kv_heads * head_dim <= 128
+        and 128 % (num_kv_heads * head_dim) == 0
+    )
+
+
+def pool_layout(
+    num_kv_heads: int, head_dim: int, head_merge: bool
+):
+    """(hkv_pool, tokens_per_row, lane_width, merged) for a pool layout.
+
+    token_packed (default): row = ``128//D`` consecutive tokens of ONE
+    head — pool [L, Hkv, NP, BS//f, f*D].
+    head_merged (opt-in, r5): row = ``128//(Hkv*D)`` consecutive tokens ×
+    ALL kv heads — pool [L, 1, NP, BS//f', 128]. One DMA per page moves
+    every head (the decode kernel's per-(page, head) copy count halves
+    for Hkv=2), at identical bytes.
+    """
+    if head_merge:
+        if not can_head_merge(num_kv_heads, head_dim):
+            raise ValueError(
+                f"head_merge needs Hkv*D | 128, got {num_kv_heads}x{head_dim}"
+            )
+        group = num_kv_heads * head_dim
+        return 1, 128 // group, 128, True
+    f = pack_factor(head_dim)
+    return num_kv_heads, f, f * head_dim, False
+
+
 def packed_pool_shape(
     num_layers: int, num_kv_heads: int, num_pages: int, page_size: int,
-    head_dim: int,
+    head_dim: int, head_merge: bool = False,
 ) -> Tuple[int, int, int, int, int]:
-    f = pack_factor(head_dim)
-    assert page_size % f == 0
-    return (num_layers, num_kv_heads, num_pages, page_size // f, f * head_dim)
+    hkv_pool, tpr, lane, _ = pool_layout(num_kv_heads, head_dim, head_merge)
+    assert page_size % tpr == 0
+    return (num_layers, hkv_pool, num_pages, page_size // tpr, lane)
 
 
-def unpacked_view(pool: jnp.ndarray, head_dim: int) -> jnp.ndarray:
-    """[L, Hkv, NP, BS//f, f*D] → [L, Hkv, NP, BS, D] (free reshape)."""
-    nl, hkv, np_, rows, fd = pool.shape
-    f = fd // head_dim
-    return pool.reshape(nl, hkv, np_, rows * f, head_dim)
+def is_head_merged(pool: jnp.ndarray, num_kv_heads: int) -> bool:
+    """Layout detection from the pool's shape: the merged pool collapses
+    the kv-head dim to 1 while the model has >1 kv head."""
+    return pool.shape[1] == 1 and num_kv_heads > 1
+
+
+def layout_from_pool(
+    pool_shape, num_kv_heads: int, head_dim: int
+) -> Tuple[bool, int]:
+    """(merged, tokens_per_row) derived from a pool's shape — the ONE
+    place the merged-layout rule lives for consumers (merge, prefill,
+    decode, fallbacks)."""
+    _, hkv_pool, _, _, lane = pool_shape
+    merged = hkv_pool == 1 and num_kv_heads > 1
+    if merged:
+        return True, lane // (num_kv_heads * head_dim)
+    return False, lane // head_dim
+
+
+def unpacked_view(
+    pool: jnp.ndarray, head_dim: int, num_kv_heads: Optional[int] = None
+) -> jnp.ndarray:
+    """Logical [L, Hkv, NP, BS, D] token view of either pool layout
+    (free reshape for token_packed; one transpose for head_merged)."""
+    nl, hkv_pool, np_, rows, lane = pool.shape
+    if num_kv_heads is not None and is_head_merged(pool, num_kv_heads):
+        tpr = lane // (num_kv_heads * head_dim)
+        v = pool.reshape(nl, np_, rows * tpr, num_kv_heads, head_dim)
+        return v.transpose(0, 3, 1, 2, 4)
+    f = lane // head_dim
+    return pool.reshape(nl, hkv_pool, np_, rows * f, head_dim)
 
 
 def _group_q(q: jnp.ndarray, num_kv_heads: int) -> Tuple[jnp.ndarray, int]:
@@ -112,12 +172,16 @@ def _kernel(
     pack: int,  # tokens per 128-lane pool row (f)
     head_dim: int,
     has_chunk: bool,
+    merged: bool,  # head-merged rows: pool hkv dim is 1, heads in lanes
 ):
     grp = pl.program_id(0)
     li = layer_ref[0]
     bk = ppcb * page_size
     rows = bk // pack  # packed rows per compute block
     hkv = num_kv_heads
+    # DMA loops iterate the POOL's head dim (1 when merged: one copy per
+    # page moves every head); compute still maintains per-real-head state
+    hkv_dma = 1 if merged else hkv
 
     def slot_meta(s):
         b = grp * sb + s
@@ -142,7 +206,7 @@ def _kernel(
                     tables_flat_ref[b * pps + pidx],
                     k_hbm_ref.shape[2] - 1,
                 )
-                for h in range(hkv):
+                for h in range(hkv_dma):
                     pltpu.make_async_copy(
                         k_hbm_ref.at[li, h, page],
                         k_vmem.at[buf, s, h, j],
@@ -165,7 +229,7 @@ def _kernel(
                     tables_flat_ref[b * pps + pidx],
                     k_hbm_ref.shape[2] - 1,
                 )
-                for h in range(hkv):
+                for h in range(hkv_dma):
                     pltpu.make_async_copy(
                         k_hbm_ref.at[li, h, page],
                         k_vmem.at[buf, s, h, j],
@@ -232,6 +296,52 @@ def _kernel(
 
             @pl.when(i < nb_s)
             def _compute(s=s, i=i, buf=buf, length=length):
+                if merged:
+                    # one 128-lane buffer holds every head: lane group
+                    # l = fi*Hkv + h is (token i*bk + row*pack + fi,
+                    # head h). Per-head score/value segments accumulate
+                    # into that head's online-softmax state.
+                    lanes = pack * hkv * head_dim
+                    k = k_vmem[buf, s, 0].astype(jnp.float32).reshape(
+                        rows, lanes
+                    )
+                    v = v_vmem[buf, s, 0].astype(jnp.float32).reshape(
+                        rows, lanes
+                    )
+                    riota = None
+                    vrow = None
+                    qks = [[] for _ in range(hkv)]
+                    vs = [[] for _ in range(hkv)]
+                    for l in range(pack * hkv):
+                        fi, h = divmod(l, hkv)
+                        kg = k[:, l * head_dim : (l + 1) * head_dim]
+                        q = q_ref[s, h].astype(jnp.float32)  # [GP, D]
+                        qk_g = jax.lax.dot_general(
+                            q, kg, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        )  # [GP, rows]
+                        if riota is None:
+                            riota = jax.lax.broadcasted_iota(
+                                jnp.int32, qk_g.shape, 1
+                            )
+                            vrow = jax.lax.broadcasted_iota(
+                                jnp.int32, (rows, 1), 0
+                            )
+                        col = i * bk + riota * pack + fi
+                        qks[h].append(
+                            jnp.where(col < length, qk_g, NEG_INF)
+                        )
+                        vg = v[:, l * head_dim : (l + 1) * head_dim]
+                        vcol = i * bk + vrow * pack + fi
+                        vs[h].append(jnp.where(vcol < length, vg, 0.0))
+                    for h in range(hkv):
+                        qk = (
+                            jnp.concatenate(qks[h], axis=-1)
+                            if len(qks[h]) > 1
+                            else qks[h][0]
+                        )
+                        online_update(s, h, qk, vs[h])
+                    return
                 for h in range(hkv):
                     q = q_ref[s, h].astype(jnp.float32)  # [GP, D]
                     k = k_vmem[buf, s, h].astype(jnp.float32).reshape(
@@ -301,7 +411,8 @@ def _kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "pages_per_compute_block", "slots_per_block", "interpret"
+        "pages_per_compute_block", "slots_per_block", "interpret",
+        "num_kv_heads",
     ),
 )
 def paged_decode_attention(
@@ -318,6 +429,7 @@ def paged_decode_attention(
     pages_per_compute_block: int = 8,
     slots_per_block: int = 8,
     interpret: bool = False,
+    num_kv_heads: Optional[int] = None,  # required for head-merged pools
 ) -> jnp.ndarray:
     """out[s] = softmax-attention of q[s] over the slot's cached pages
     [0, lengths[s]) plus, when a chunk buffer is given, the in-flight chunk
@@ -326,10 +438,21 @@ def paged_decode_attention(
 
     ``slots_per_block`` slots share one grid step (per-step overhead is the
     dominant cost at serving shapes; DMA skip predicates keep ragged
-    batches cheap)."""
+    batches cheap). A head-merged pool (pool head dim 1 < num_kv_heads,
+    ops.paged_attention.pool_layout) halves the per-page DMA count."""
     s, hq, d = q.shape
-    nl, hkv, np_, prow, fd = k_pages.shape
-    f = fd // d
+    nl, hkv_pool, np_, prow, fd = k_pages.shape
+    if hkv_pool == 1 and hq > 1 and num_kv_heads is None:
+        # a [*, 1, ...] pool is ambiguous (true MQA vs head-merged) and
+        # guessing MQA on a merged pool returns finite GARBAGE — demand
+        # the caller say which
+        raise ValueError(
+            "pool has kv-head dim 1 with multi-head q: pass num_kv_heads "
+            "explicitly (1 for true MQA; the model's Hkv for a "
+            "head-merged pool)"
+        )
+    hkv = num_kv_heads or hkv_pool
+    merged, f = layout_from_pool(k_pages.shape, hkv, d)
     bs = prow * f
     sb = min(slots_per_block, s)
     while s % sb:
@@ -361,7 +484,9 @@ def paged_decode_attention(
         pack=f,
         head_dim=d,
         has_chunk=has_chunk,
+        merged=merged,
     )
+    hkv_vmem = 1 if merged else hkv
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -384,8 +509,8 @@ def paged_decode_attention(
                 (sb, hkv, gp, d), lambda b, *_: (b, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((2, sb, hkv, ppcb, prow, fd), k_pages.dtype),
-                pltpu.VMEM((2, sb, hkv, ppcb, prow, fd), v_pages.dtype),
+                pltpu.VMEM((2, sb, hkv_vmem, ppcb, prow, fd), k_pages.dtype),
+                pltpu.VMEM((2, sb, hkv_vmem, ppcb, prow, fd), v_pages.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.VMEM((sb, hkv, gp, d), jnp.float32),
@@ -422,6 +547,7 @@ def paged_decode_attention_jnp(
     chunk_k: Optional[jnp.ndarray] = None,  # [S, Hkv, T, D]
     chunk_v: Optional[jnp.ndarray] = None,
     chunk_counts: Optional[jnp.ndarray] = None,
+    num_kv_heads: Optional[int] = None,
     **_: object,
 ) -> jnp.ndarray:
     """Gather-based fallback with identical semantics (CPU / TP serving).
@@ -430,26 +556,59 @@ def paged_decode_attention_jnp(
     with trailing dim < 128 lanes would force a relaid full-pool copy on
     TPU), then splits lane-halves — key order is [half0 rows..., half1
     rows..., chunk], which softmax doesn't care about. ~3x the HBM
-    traffic of the kernel; correctness-first path.
+    traffic of the kernel; correctness-first path. Head-merged pools are
+    unpacked to the per-head view first (one extra relayout — fine for
+    the CPU/TP correctness path).
     """
     s, hq, d = q.shape
-    nl, hkv, np_, prow, fd = k_pages.shape
-    f = fd // d
-    bs = prow * f
-    rep = hq // hkv
+    nl, hkv_pool, np_, prow, fd = k_pages.shape
+    if hkv_pool == 1 and hq > 1 and num_kv_heads is None:
+        raise ValueError(
+            "pool has kv-head dim 1 with multi-head q: pass num_kv_heads "
+            "explicitly (1 for true MQA; the model's Hkv for a "
+            "head-merged pool)"
+        )
+    hkv = num_kv_heads or hkv_pool
     pps = tables.shape[1]
-    wr = pps * prow  # window rows
-    kl = jax.lax.dynamic_index_in_dim(
-        k_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0, keepdims=False
-    )
-    vl = jax.lax.dynamic_index_in_dim(
-        v_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0, keepdims=False
-    )
-    # flat row ids per slot: page-major row order
-    rflat = (tables[:, :, None] * prow + jnp.arange(prow)[None, None, :])
-    rflat = jnp.clip(rflat.reshape(s, wr), 0, np_ * prow - 1)
-    win_k = kl[:, rflat]  # [Hkv, S, WR, FD]
-    win_v = vl[:, rflat]
+    merged_, tpr = layout_from_pool(k_pages.shape, hkv, d)
+    if merged_:  # head-merged rows -> per-head token rows
+        kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
+        vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+
+        def unmerge(x):  # [1, NP, BS//tpr, 128] -> [Hkv, NP*BS, D]
+            y = x.reshape(np_, prow * tpr, hkv, d)
+            return y.transpose(2, 0, 1, 3).reshape(hkv, np_ * prow * tpr, d)
+
+        klh, vlh = unmerge(kl), unmerge(vl)
+        bs = prow * tpr
+        wr = pps * bs  # window rows are single tokens here
+        rflat = (
+            tables[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+        )
+        rflat = jnp.clip(rflat.reshape(s, wr), 0, np_ * bs - 1)
+        win_k = klh[:, rflat]  # [Hkv, S, WR, D]
+        win_v = vlh[:, rflat]
+        f = 1
+    else:
+        f = fd // d
+        bs = prow * f
+        wr = pps * prow  # window rows
+        kl = jax.lax.dynamic_index_in_dim(
+            k_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0,
+            keepdims=False,
+        )
+        vl = jax.lax.dynamic_index_in_dim(
+            v_pages.reshape(nl, hkv, np_ * prow, fd), layer, 0,
+            keepdims=False,
+        )
+        # flat row ids per slot: page-major row order
+        rflat = (
+            tables[:, :, None] * prow + jnp.arange(prow)[None, None, :]
+        )
+        rflat = jnp.clip(rflat.reshape(s, wr), 0, np_ * prow - 1)
+        win_k = kl[:, rflat]  # [Hkv, S, WR, FD]
+        win_v = vl[:, rflat]
+    rep = hq // hkv
     qg = q.reshape(s, hkv, rep, d)
     scale = d**-0.5
     rpos = jnp.arange(wr)[None, None, None, :] * f  # token pos of row start

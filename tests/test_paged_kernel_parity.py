@@ -111,3 +111,82 @@ def test_kernel_matches_jnp_bf16_sb1():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=3e-2, atol=3e-2,
     )
+
+
+# --------------------------------------------------------------- head-merged
+@pytest.mark.parametrize(
+    "head_dim,hq,hkv",
+    [(64, 4, 2), (16, 4, 2)],
+    ids=["merge_d64_tpr1", "merge_d16_tpr4"],
+)
+@pytest.mark.parametrize("with_chunk", [False, True], ids=["pages", "chunk"])
+def test_head_merged_layout_matches_token_packed(head_dim, hq, hkv, with_chunk):
+    """The head-merged pool (one 128-lane row = all kv heads of tpr
+    tokens; r5 opt-in pool_layout) must agree with the token-packed
+    ground truth through BOTH the interpret-mode kernel and the jnp
+    fallback."""
+    from areal_tpu.ops.paged_attention import pool_layout
+
+    rng = np.random.default_rng(3 + head_dim + with_chunk)
+    page_size = 16
+    num_pages = 32
+    nl = 2
+    lengths = [0, 5, 16, 29, 48, 7, 1, 33]
+    chunk_counts = [2, 0, 7, 1, 0, 3, 8, 4] if with_chunk else None
+    s = len(lengths)
+    k_tok = rng.standard_normal((nl, hkv, num_pages, page_size, head_dim))
+    v_tok = rng.standard_normal((nl, hkv, num_pages, page_size, head_dim))
+    # token-packed reference pool
+    shp = packed_pool_shape(nl, hkv, num_pages, page_size, head_dim)
+    kp_ref = jnp.asarray(k_tok.reshape(shp), jnp.float32)
+    vp_ref = jnp.asarray(v_tok.reshape(shp), jnp.float32)
+    # merged pool: [L, NP, BS, Hkv, D] token-major-then-head rows
+    _, tpr, lane, _ = pool_layout(hkv, head_dim, True)
+    mshape = packed_pool_shape(
+        nl, hkv, num_pages, page_size, head_dim, head_merge=True
+    )
+    km = jnp.asarray(
+        k_tok.transpose(0, 2, 3, 1, 4).reshape(mshape), jnp.float32
+    )
+    vm = jnp.asarray(
+        v_tok.transpose(0, 2, 3, 1, 4).reshape(mshape), jnp.float32
+    )
+    pps = max(-(-max(lengths) // page_size), 1) + 1
+    tables = jnp.asarray(
+        rng.permutation(num_pages)[: s * pps].reshape(s, pps), jnp.int32
+    )
+    q = jnp.asarray(rng.standard_normal((s, hq, head_dim)), jnp.float32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    kwargs = {}
+    if chunk_counts is not None:
+        kwargs["chunk_k"] = jnp.asarray(
+            rng.standard_normal((s, hkv, 8, head_dim)), jnp.float32
+        )
+        kwargs["chunk_v"] = jnp.asarray(
+            rng.standard_normal((s, hkv, 8, head_dim)), jnp.float32
+        )
+        kwargs["chunk_counts"] = jnp.asarray(chunk_counts, jnp.int32)
+    defined = np.asarray(lengths) > 0
+    if chunk_counts is not None:
+        defined |= np.asarray(chunk_counts) > 0
+    for layer in (0, 1):
+        want = paged_decode_attention_jnp(
+            q, kp_ref, vp_ref, jnp.int32(layer), lens, tables, **kwargs
+        )
+        got_jnp = paged_decode_attention_jnp(
+            q, km, vm, jnp.int32(layer), lens, tables,
+            num_kv_heads=hkv, **kwargs,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_jnp)[defined], np.asarray(want)[defined],
+            rtol=2e-5, atol=2e-5,
+        )
+        got_kernel = paged_decode_attention(
+            q, km, vm, jnp.int32(layer), lens, tables,
+            pages_per_compute_block=2, slots_per_block=4,
+            interpret=True, num_kv_heads=hkv, **kwargs,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_kernel)[defined], np.asarray(want)[defined],
+            rtol=2e-5, atol=2e-5,
+        )

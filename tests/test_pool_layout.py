@@ -1,0 +1,119 @@
+"""Head-merged KV pool layout (r5 opt-in): end-to-end serving equality.
+
+The merged layout (one 128-lane row carries every kv head of a token —
+half the per-page DMA count in the decode kernel) must be a pure layout
+change: greedy generations, prefix reuse, GRPO sibling admission, and
+preemption-resume behavior must match the token-packed default exactly
+on the f32 CPU path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+def _run(layout, prompts, mnew=12, **cfg_kw):
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", max_num_seqs=8, max_model_len=128,
+            page_size=8, prefill_chunk=16, decode_chunk=4, kv_bucket=32,
+            pool_layout=layout, **cfg_kw,
+        ),
+        model_config=cfg,
+        params=params,
+    ).start()
+    try:
+        futs = [
+            eng.submit(
+                {
+                    "input_ids": p,
+                    "sampling_params": {
+                        "max_new_tokens": mnew, "greedy": True,
+                    },
+                }
+            )
+            for p in prompts
+        ]
+        outs = [f.result(timeout=600)["output_ids"] for f in futs]
+        metrics = eng.metrics()
+    finally:
+        eng.stop()
+    return outs, metrics
+
+
+def test_head_merged_equals_token_packed_greedy():
+    rng = np.random.default_rng(0)
+    # unique prompts + a GRPO sibling pair (shared prefill + tail copy)
+    prompts = [rng.integers(1, 128, size=int(n)).tolist() for n in (5, 9, 13)]
+    prompts.append(list(prompts[0]))
+    a, _ = _run("token_packed", prompts)
+    b, _ = _run("head_merged", prompts)
+    assert a == b
+
+
+def test_head_merged_prefix_reuse_and_growth():
+    """Sequential submits exercise the registry claim path (offsets > 0 →
+    the prefill prefix-window attention) and page growth across pages."""
+    rng = np.random.default_rng(1)
+    base = rng.integers(1, 128, size=20).tolist()
+
+    def seq_run(layout):
+        cfg = tiny_config("qwen2")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=128,
+                page_size=8, prefill_chunk=16, decode_chunk=4,
+                kv_bucket=32, pool_layout=layout,
+            ),
+            model_config=cfg,
+            params=params,
+        ).start()
+        try:
+            r1 = eng.submit(
+                {
+                    "input_ids": base,
+                    "sampling_params": {"max_new_tokens": 10, "greedy": True},
+                }
+            ).result(timeout=600)
+            # same prompt again: claims the parked prefix (offset > 0)
+            r2 = eng.submit(
+                {
+                    "input_ids": base + r1["output_ids"][:4],
+                    "sampling_params": {"max_new_tokens": 10, "greedy": True},
+                }
+            ).result(timeout=600)
+            m = eng.metrics()
+        finally:
+            eng.stop()
+        return r1["output_ids"], r2["output_ids"], m
+
+    a1, a2, am = seq_run("token_packed")
+    b1, b2, bm = seq_run("head_merged")
+    assert a1 == b1 and a2 == b2
+    assert bm["total_cached_prompt_tokens"] > 0  # prefix reuse really fired
+    assert am["total_cached_prompt_tokens"] == bm["total_cached_prompt_tokens"]
+
+
+def test_head_merged_rejects_incompatible_geometry():
+    cfg = tiny_config("qwen2")
+    cfg = cfg.__class__(**{**cfg.__dict__, "head_dim": 48})
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="head_merged"):
+        GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=2, max_model_len=64,
+                page_size=8, pool_layout="head_merged",
+            ),
+            model_config=cfg,
+            params=params,
+        )
