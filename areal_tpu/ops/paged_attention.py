@@ -453,6 +453,13 @@ def paged_decode_attention(
         )
     hkv = num_kv_heads or hkv_pool
     merged, f = layout_from_pool(k_pages.shape, hkv, d)
+    if not merged and hkv != hkv_pool:
+        # a mismatched head count on a token-packed pool would DMA past
+        # the pool's head dim — finite garbage, not a shape error
+        raise ValueError(
+            f"num_kv_heads={hkv} contradicts token-packed pool head dim "
+            f"{hkv_pool}"
+        )
     bs = prow * f
     sb = min(slots_per_block, s)
     while s % sb:
@@ -571,6 +578,11 @@ def paged_decode_attention_jnp(
     hkv = num_kv_heads or hkv_pool
     pps = tables.shape[1]
     merged_, tpr = layout_from_pool(k_pages.shape, hkv, d)
+    if not merged_ and hkv != hkv_pool:
+        raise ValueError(
+            f"num_kv_heads={hkv} contradicts token-packed pool head dim "
+            f"{hkv_pool}"
+        )
     if merged_:  # head-merged rows -> per-head token rows
         kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
         vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)

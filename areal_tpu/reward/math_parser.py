@@ -116,6 +116,11 @@ def _fix_sqrt(s: str) -> str:
 def normalize_answer(ans: str) -> str:
     s = str(ans).strip().replace("\n", "")
     s = s.rstrip(".").strip()
+    if "\\boxed" in s:  # a raw \boxed{...} answer normalizes to its content
+        b = extract_boxed(s)
+        if b is not None:
+            s = b
+    s = s.replace("{,}", "")  # latex thousands separator: 5{,}905
     s = s.replace("\\!", "").replace("\\,", " ").replace("\\ ", " ")
     s = s.replace("\\left", "").replace("\\right", "")
     s = s.replace("^{\\circ}", "").replace("^\\circ", "")
@@ -147,8 +152,12 @@ def normalize_answer(ans: str) -> str:
     while prev != s:
         prev = s
         s = re.sub(r"(\d),(?=\d{3}(\D|$))", r"\1", s)
-    s = _fix_fracs(s)
-    s = _fix_sqrt(s)
+    # innermost-out: \frac{\sqrt{3}}{2} needs the sqrt's braces resolved
+    # before the frac pattern (brace-free args) can match, and vice versa
+    prev = None
+    while prev != s:
+        prev = s
+        s = _fix_sqrt(_fix_fracs(s))
     s = s.replace("\\pi", "pi").replace("\\infty", "oo").replace(
         "infinity", "oo"
     )

@@ -142,6 +142,28 @@ def test_percent_fraction_interval_vectors(pred, truth, equal):
     assert answers_equal(pred, truth) is equal
 
 
+@pytest.mark.parametrize(
+    "pred,truth",
+    [
+        ("5{,}905", "5905"),           # latex thousands separator
+        ("\\boxed{42}", "42"),         # raw boxed answer
+        ("\\boxed{\\frac{1}{2}}", "0.5"),
+        ("\\frac{\\sqrt{3}}{2}", "0.8660254"),  # nested latex (frac∘sqrt)
+        ("\\sqrt{\\frac{1}{4}}", "0.5"),        # nested latex (sqrt∘frac)
+        ("2\\sqrt{2}", "2.8284271"),
+        ("90^\\circ", "90"),
+        ("10\\text{ meters}", "10"),
+        ("0.5\\%", "0.005"),
+    ],
+)
+def test_latex_normalization_vectors(pred, truth):
+    """strip_string-grade latex robustness (reference grader.py vendored
+    latex2sympy coverage subset, r5)."""
+    from areal_tpu.reward.math_parser import answers_equal
+
+    assert answers_equal(pred, truth)
+
+
 # --- code extraction vectors (reference code_eval.extract_python_code) ----
 def test_extract_python_code_last_valid_block():
     text = (
