@@ -193,12 +193,18 @@ class _ChatCompletions:
             ),
             top_p=base.top_p if top_p is None else top_p,
         )
-        use_tools = bool(tools) and tool_choice != "none"
+        # the real OpenAI API renders tool schemas whenever `tools` is
+        # non-empty and uses tool_choice only to steer calling — so a
+        # multi-turn conversation that toggles tool_choice sees the SAME
+        # prompt prefix every turn (prompt-consistency + prefix-cache
+        # hits). Only the parser / finish_reason are gated on 'none'.
+        render_tools = bool(tools)
+        parse_tools = bool(tools) and tool_choice != "none"
         rendered = list(messages)
         input_ids = c.tokenizer.apply_chat_template(
             rendered, tokenize=True, add_generation_prompt=True
         )
-        if use_tools:
+        if render_tools:
             # HF chat templates for tool-capable models take tools= directly.
             # A template that IGNORES the kwarg returns the same ids — the
             # schemas would silently never reach the model — so verify the
@@ -262,7 +268,7 @@ class _ChatCompletions:
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
-        tool_calls = c.tool_parser(text) if use_tools else []
+        tool_calls = c.tool_parser(text) if parse_tools else []
         completion = ChatCompletion(
             id=req.rid,
             choices=[

@@ -29,15 +29,28 @@ import aiohttp
 def _abandon_session(s: "aiohttp.ClientSession") -> None:
     """Close a session whose owning loop is gone: ``detach`` marks the
     session closed (no "Unclosed client session" __del__ noise), then the
-    connector's sockets are torn down synchronously. The sync teardown is
-    aiohttp-private (``_close``) — the public ``close()`` is a coroutine
-    needing the dead loop — so failures are logged, not swallowed."""
+    connector's sockets are torn down. The synchronous teardown is
+    aiohttp-private (``BaseConnector._close`` — present in the pinned
+    aiohttp 3.x line, where the public ``close()`` is a coroutine needing
+    the dead loop); if a future aiohttp drops it, fall back to driving the
+    public ``close()`` on a throwaway loop. Failures are logged, not
+    swallowed."""
     try:
         conn = s.connector
         if not s.closed:
             s.detach()
-        if conn is not None:
+        if conn is None:
+            return
+        if hasattr(conn, "_close"):
             conn._close()
+        else:
+            result = conn.close()
+            if asyncio.iscoroutine(result):
+                loop = asyncio.new_event_loop()
+                try:
+                    loop.run_until_complete(result)
+                finally:
+                    loop.close()
     except Exception as e:  # noqa: BLE001
         logging.getLogger("areal_tpu.remote").warning(
             "could not tear down abandoned http session: %s", e

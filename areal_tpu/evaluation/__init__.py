@@ -1,6 +1,24 @@
-"""Offline evaluation harness (reference evaluation/: math_eval etc.)."""
+"""Offline evaluation harness (reference evaluation/: math_eval etc.).
+
+The grading subsystem lives here: ``grader`` (family-structured
+equivalence, the single source of truth shared with training rewards) and
+``extract`` (per-benchmark extraction conventions).
+"""
 
 from areal_tpu.evaluation.eval_runner import (  # noqa: F401
     EvalReport,
     evaluate_dataset,
+)
+from areal_tpu.evaluation.extract import (  # noqa: F401
+    CONVENTIONS,
+    convention_for,
+    extract_pred,
+    parse_ground_truth,
+    resolve_benchmark,
+)
+from areal_tpu.evaluation.grader import (  # noqa: F401
+    FAMILIES,
+    GradeResult,
+    answers_equal,
+    grade_answer,
 )

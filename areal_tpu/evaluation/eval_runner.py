@@ -64,17 +64,22 @@ def _pass_at_k(successes: np.ndarray, k: int) -> float:
     return float(np.mean(out)) if out else 0.0
 
 
-def _majority_correct(answers: List[str], truth: str) -> float:
+def _majority_correct(
+    answers: List[str], truth: str, equal: Optional[Callable] = None
+) -> float:
     """Majority voting over extracted answers (reference eval aggregation:
-    cluster equivalent answers, check the largest cluster against truth)."""
-    from areal_tpu.reward.math_parser import answers_equal
+    cluster equivalent answers, check the largest cluster against truth).
+    ``equal`` overrides the equivalence predicate so benchmark conventions
+    (e.g. keep-units grading) apply to clustering too."""
+    if equal is None:
+        from areal_tpu.reward.math_parser import answers_equal as equal
 
     clusters: List[List[str]] = []
     for a in answers:
         if a is None:
             continue
         for c in clusters:
-            if answers_equal(a, c[0]):
+            if equal(a, c[0]):
                 c.append(a)
                 break
         else:
@@ -82,7 +87,7 @@ def _majority_correct(answers: List[str], truth: str) -> float:
     if not clusters:
         return 0.0
     best = max(clusters, key=len)
-    return float(answers_equal(best[0], truth))
+    return float(equal(best[0], truth))
 
 
 def evaluate_dataset(
@@ -91,8 +96,16 @@ def evaluate_dataset(
     reward_fn: Callable,
     gconfig: GenerationHyperparameters,
     tokenizer=None,
+    benchmark: Optional[str] = None,
 ) -> EvalReport:
-    """Run the sweep against any InferenceEngine (`agenerate` contract)."""
+    """Run the sweep against any InferenceEngine (`agenerate` contract).
+
+    ``benchmark`` names an extraction convention from
+    evaluation/extract.py; when given, the maj@k clustering path extracts
+    answers with that benchmark's cascade (minerva sign-off, AIME
+    integers, choice letters, ...) instead of the generic reward-path
+    cascade, and ground truth is parsed with the benchmark's field rules.
+    """
     from areal_tpu.workflow.rlvr import RLVRWorkflow
 
     wf = RLVRWorkflow(reward_fn, gconfig, tokenizer=tokenizer)
@@ -122,27 +135,58 @@ def evaluate_dataset(
         }
         # maj@k needs the completion TEXTS: detokenize the loss-masked
         # region of each sample
-        if tokenizer is not None and item.get("answer") is not None:
-            from areal_tpu.reward.math_parser import extract_answer
+        from areal_tpu.evaluation.extract import (
+            convention_for,
+            extract_answer,
+            extract_pred,
+            parse_ground_truth,
+        )
 
+        truth = ""
+        if benchmark is not None:
+            # the benchmark's own field rules (solution/Answer/correct/
+            # final_answer/...), not just a literal "answer" key. A row
+            # whose fields don't fit the convention (e.g. an mmlu letter
+            # where an index is expected) must degrade to no-maj@k for
+            # that row, not abort the whole sweep
+            try:
+                truth = parse_ground_truth(item, benchmark)
+            except Exception:
+                truth = str(item.get("answer", "") or "")
+        elif item.get("answer") is not None:
+            truth = str(item["answer"])
+        # a gsm8k-formatted truth that survived convention parsing (the
+        # default convention passes rationale + "#### N" through) reduces
+        # to the final answer exactly like process_results does
+        if "####" in truth or "\\boxed" in truth:
+            truth = extract_answer(truth) or truth
+        if tokenizer is not None and truth:
             ids = np.asarray(out["input_ids"])
             lm = np.asarray(out["loss_mask"])
-            answers = [
-                extract_answer(
-                    tokenizer.decode(ids[i][lm[i] > 0].tolist())
-                )
+            texts = [
+                tokenizer.decode(ids[i][lm[i] > 0].tolist())
                 for i in range(ids.shape[0])
             ]
+            if benchmark is not None:
+                answers = [extract_pred(t, benchmark) for t in texts]
+                # grade maj@k clusters under the SAME convention the
+                # accuracy path uses (keep-units for minerva/carp)
+                conv = convention_for(benchmark)
+                from areal_tpu.evaluation.grader import (
+                    answers_equal as _ae,
+                )
+
+                def equal(a, b, _su=conv.strip_units):
+                    return _ae(a, b, strip_units=_su)
+
+            else:
+                answers = [extract_answer(t) for t in texts]
+                equal = None
             row["answers"] = answers
-            # GSM8K truth keeps its rationale + "#### N" tail — reduce it
-            # to the final answer exactly like process_results does
-            truth = str(item["answer"])
-            if "####" in truth or "\\boxed" in truth:
-                truth = extract_answer(truth) or truth
             for k in (1, 2, 4, 8, 16):
                 if k <= len(answers):
                     majorities.setdefault(k, []).append(
-                        _majority_correct(answers[:k], truth)
+                        _majority_correct(answers[:k], truth, equal=equal)
                     )
         rows.append(row)
     succ = np.asarray(successes)

@@ -1,0 +1,367 @@
+"""Per-benchmark answer extraction conventions.
+
+Role of the reference's evaluation/parser.py (769 LoC — the extraction half
+of the instrument behind every published AReaL quality table): turning a raw
+model completion into the one string the grader compares, with the cascade
+order each benchmark's completion format demands, plus per-benchmark
+ground-truth field conventions.
+
+Structure (fresh design, not a transliteration):
+
+* **Extraction primitives** — boxed / minerva sign-off / "the answer is" /
+  GSM8K ``####`` / choice letter / last number / last integer — each an
+  individually-testable function returning ``None`` for "not present".
+* **Conventions** — a :class:`Convention` per benchmark stem names the
+  primitive cascade, answer type, and whether units are stripped at grading
+  time.  ``CONVENTIONS`` ships ≥8 stems (gsm8k, math, minerva_math,
+  olympiadbench, aime24, amc23, sat_math, mmlu_stem) plus the long tail
+  the eval harness already graded (aqua, svamp, asdiv, mawps, tabmwp,
+  gaokao2023, carp_en, college_math).
+* **Stem resolution** — :func:`resolve_benchmark` maps eval-file stems
+  ("aime_2024", "math500", "olympiadbench_en") onto canonical conventions,
+  so ``run_eval.py``'s filename dispatch and training-side reward binding
+  agree on the rules.
+
+Equivalence checking lives in :mod:`areal_tpu.evaluation.grader`; this
+module only decides *what strings to compare*.
+"""
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Extraction primitives
+# ---------------------------------------------------------------------------
+
+_BOXED_RE = re.compile(r"\\boxed\s*\{")
+_GSM8K_RE = re.compile(r"####\s*([^\n]+)")
+_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:[eE][+-]?\d+)?")
+_LAST_NUMBER_RE = re.compile(r"-?\d*\.?\d+")
+_INTEGER_RE = re.compile(r"-?\d+")
+_CHOICE_RE = re.compile(r"\b([A-E])\b")
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    """Last ``\\boxed{...}`` contents, brace-balanced."""
+    out = None
+    for m in _BOXED_RE.finditer(text):
+        start = m.end()
+        depth = 1
+        for i in range(start, len(text)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    out = text[start:i]
+                    break
+    return out
+
+
+def extract_boxed_loose(text: str) -> Optional[str]:
+    """Boxed with a brace-less fallback: ``\\boxed 42$`` style (reference
+    parser tolerates it). None when no "boxed" marker at all."""
+    if "boxed" not in text:
+        return None
+    b = extract_boxed(text)
+    if b is not None:
+        return b
+    tail = text.split("boxed")[-1]
+    return tail.split("$")[0].strip()
+
+
+def extract_minerva(text: str) -> Optional[str]:
+    """Minerva's sign-off: ``final answer is $X$. I hope`` — outranks every
+    other marker when present (reference parser.extract_answer)."""
+    if "final answer is $" in text and "$. I hope" in text:
+        return text.split("final answer is $", 1)[1].split("$. I hope", 1)[0]
+    return None
+
+
+def extract_answer_is(text: str) -> Optional[str]:
+    """``The/the answer is ...`` (matched via the reference's 'he answer is'
+    sentinel so both capitalizations hit)."""
+    if "he answer is" in text:
+        return text.split("he answer is")[-1]
+    return None
+
+
+def extract_final_answer_is(text: str) -> Optional[str]:
+    if "final answer is" in text:
+        return text.split("final answer is")[-1]
+    return None
+
+
+def extract_hash_answer(text: str) -> Optional[str]:
+    """GSM8K's explicit ``#### N`` marker (last occurrence)."""
+    m = _GSM8K_RE.findall(text)
+    return m[-1].strip() if m else None
+
+
+def extract_last_number(text: str) -> Optional[str]:
+    """Last number in the text, thousands separators stripped. Returns ""
+    (not None) when no number exists — the cascade terminator."""
+    nums = _LAST_NUMBER_RE.findall(text.replace(",", ""))
+    return nums[-1] if nums else ""
+
+
+def extract_last_integer(text: str) -> Optional[str]:
+    """Last bare integer — AIME-style benchmarks whose answers are integers
+    in [0, 999]; a trailing decimal like "3.14" must not be truncated to
+    its fraction digits, so integers are taken from comma-stripped text
+    with decimals removed first."""
+    clean = re.sub(r"-?\d*\.\d+", " ", text.replace(",", ""))
+    ints = _INTEGER_RE.findall(clean)
+    return ints[-1] if ints else ""
+
+
+def clean_choice(pred: str) -> str:
+    """Reduce a free-text prediction to its last A–E letter (reference
+    grader.choice_answer_clean behavior)."""
+    pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
+    letters = _CHOICE_RE.findall(pred.upper())
+    if letters:
+        return letters[-1]
+    return pred.strip().strip(".").rstrip(".").rstrip("/")
+
+
+EXTRACTORS: Dict[str, Callable[[str], Optional[str]]] = {
+    "minerva": extract_minerva,
+    "boxed": extract_boxed_loose,
+    "answer_is": extract_answer_is,
+    "final_answer_is": extract_final_answer_is,
+    "hash": extract_hash_answer,
+    "last_number": extract_last_number,
+    "last_integer": extract_last_integer,
+}
+
+
+# ---------------------------------------------------------------------------
+# Generic reward-path extraction (training-time contract)
+# ---------------------------------------------------------------------------
+
+def extract_answer(text: str) -> Optional[str]:
+    """Final answer string from a completion: boxed > "final answer is"
+    > #### (GSM8K) > last number (reference extract_answer order). This is
+    the benchmark-agnostic cascade the reward path uses."""
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    # the explicit GSM8K marker outranks free-text "answer is" phrasing —
+    # a stray "the answer is <phrase>" in a rationale must not override it
+    got = extract_hash_answer(text)
+    if got is not None:
+        return got
+    m = re.findall(
+        r"(?:final answer|answer)\s*(?:is|:)\s*([^\n]+)", text,
+        re.IGNORECASE,
+    )
+    if m:
+        # keep decimals ("3.14") but cut at sentence boundaries (". ")
+        cand = m[-1].strip().split(". ")[0].rstrip(".").strip()
+        if cand:
+            return cand
+    nums = _NUMBER_RE.findall(text)
+    if nums:
+        return nums[-1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-benchmark conventions
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Convention:
+    """One benchmark's extraction rules.
+
+    ``cascade`` names EXTRACTORS entries tried in order (first non-None
+    wins). ``answer_type`` "choice" short-circuits to letter cleanup.
+    ``strip_units`` False keeps measurement words at grading time
+    (reference STRIP_EXCEPTIONS: minerva/carp answers carry units)."""
+
+    name: str
+    cascade: Tuple[str, ...] = (
+        "minerva", "boxed", "answer_is", "final_answer_is", "last_number",
+    )
+    answer_type: str = "free"  # free | choice | integer
+    strip_units: bool = True
+
+
+_MATH_CASCADE = (
+    "minerva", "boxed", "answer_is", "final_answer_is", "last_number",
+)
+
+CONVENTIONS: Dict[str, Convention] = {
+    c.name: c
+    for c in [
+        # NOTE: "####" is a gsm8k GROUND-TRUTH convention, not a completion
+        # convention — a completion quoting "#### <rationale>" must not
+        # shadow its last number (pinned by tests/test_math_eval.py)
+        Convention("gsm8k", cascade=_MATH_CASCADE),
+        Convention("math", cascade=_MATH_CASCADE),
+        Convention("minerva_math", cascade=_MATH_CASCADE,
+                   strip_units=False),
+        Convention("olympiadbench", cascade=(
+            "boxed", "answer_is", "final_answer_is", "last_number",
+        )),
+        Convention("aime24", cascade=(
+            "boxed", "answer_is", "final_answer_is", "last_integer",
+        ), answer_type="integer"),
+        Convention("amc23", cascade=(
+            "boxed", "answer_is", "final_answer_is", "last_number",
+        )),
+        Convention("sat_math", answer_type="choice"),
+        Convention("mmlu_stem", answer_type="choice"),
+        Convention("aqua", answer_type="choice"),
+        Convention("gaokao2023", answer_type="choice"),
+        Convention("svamp"),
+        Convention("asdiv"),
+        Convention("mawps"),
+        Convention("tabmwp"),
+        Convention("carp_en", strip_units=False),
+        Convention("college_math"),
+        Convention("gaokao2023en"),
+        Convention("default", cascade=_MATH_CASCADE),
+    ]
+}
+
+# filename-stem prefixes → canonical convention (checked in order; longest
+# reasonable prefix first so "math_500" does not shadow "mathqa"-style
+# stems added later)
+_STEM_RULES: List[Tuple[str, str]] = [
+    ("gsm", "gsm8k"),
+    ("minerva", "minerva_math"),
+    ("olympiad", "olympiadbench"),
+    ("aime", "aime24"),
+    ("amc", "amc23"),
+    ("sat", "sat_math"),
+    ("mmlu", "mmlu_stem"),
+    ("aqua", "aqua"),
+    ("gaokao2023en", "gaokao2023en"),
+    ("gaokao", "gaokao2023"),
+    ("svamp", "svamp"),
+    ("asdiv", "asdiv"),
+    ("mawps", "mawps"),
+    ("tabmwp", "tabmwp"),
+    ("carp", "carp_en"),
+    ("college", "college_math"),
+    ("math", "math"),  # math, math_500, math500 — after minerva/mmlu
+]
+
+
+def resolve_benchmark(stem: str) -> str:
+    """Canonical convention name for an eval-file stem. Exact names win;
+    otherwise prefix rules absorb year/split suffixes ("aime_2024",
+    "math500", "olympiadbench_en"). Unknown stems get the default MATH
+    cascade — the conservative generic rules."""
+    low = str(stem).strip().lower()
+    if low in CONVENTIONS:
+        return low
+    for prefix, name in _STEM_RULES:
+        if low.startswith(prefix):
+            return name
+    return "default"
+
+
+def convention_for(benchmark: str) -> Convention:
+    return CONVENTIONS[resolve_benchmark(benchmark)]
+
+
+def extract_pred(text: str, benchmark: str = "math") -> str:
+    """Final-answer candidate from a completion under ``benchmark``'s
+    convention (reference parser.extract_answer per-dataset order)."""
+    conv = convention_for(benchmark)
+    text = text.replace("ки", "")  # stray cyrillic the reference strips
+    if conv.answer_type == "choice":
+        return clean_choice(text)
+    pred: Optional[str] = None
+    for step in conv.cascade:
+        pred = EXTRACTORS[step](text)
+        if pred is not None:
+            break
+    pred = re.sub(r"\n\s*", "", (pred or "")).strip()
+    pred = pred.lstrip(":").strip()
+    pred = pred.rstrip(".").rstrip("/").strip()
+    return pred
+
+
+# ---------------------------------------------------------------------------
+# Per-benchmark ground truth
+# ---------------------------------------------------------------------------
+
+def parse_ground_truth(
+    example: Dict[str, Any], benchmark: str = "math"
+) -> str:
+    """Per-benchmark ground-truth answer (reference
+    parser.parse_ground_truth field conventions)."""
+    name = resolve_benchmark(benchmark)
+    if name in ("math", "minerva_math", "default"):
+        sol = example.get("solution") or example.get("answer") or ""
+        boxed = extract_boxed(str(sol))
+        return (boxed if boxed is not None else str(sol)).strip()
+    if name == "gsm8k":
+        ans = str(example.get("answer", ""))
+        return ans.split("####")[-1].strip() if "####" in ans else ans.strip()
+    if name == "olympiadbench":
+        # OlympiadBench rows carry `final_answer` as a one-element list of
+        # latex strings; fall back to answer/solution-boxed
+        fa = example.get("final_answer")
+        if isinstance(fa, (list, tuple)) and fa:
+            return str(fa[0]).replace("$", "").strip()
+        if fa:
+            return str(fa).replace("$", "").strip()
+        sol = example.get("solution") or example.get("answer") or ""
+        boxed = extract_boxed(str(sol))
+        return (boxed if boxed is not None else str(sol)).strip()
+    if name == "aime24":
+        # AIME answers are integers in [0, 999], often stored zero-padded
+        # ("068"); canonicalize so the grader's string path can hit
+        ans = str(example.get("answer", "")).strip().replace("$", "")
+        m = _INTEGER_RE.fullmatch(ans)
+        return str(int(ans)) if m else ans
+    if name == "amc23":
+        return str(example.get("answer", "")).replace("$", "").strip()
+    if name == "mmlu_stem":
+        return "ABCD"[int(example["answer"])]
+    if name == "sat_math":
+        return str(example.get("Answer", example.get("answer", ""))).strip()
+    if name == "aqua":
+        return str(example.get("correct", example.get("answer", ""))).strip()
+    if name == "svamp":
+        return str(example.get("Answer", example.get("answer", ""))).strip()
+    if name == "asdiv":
+        return re.sub(r"\(.*?\)", "", str(example.get("answer", ""))).strip()
+    if name == "mawps":
+        return str(example.get("target", example.get("answer", ""))).strip()
+    if name == "tabmwp":
+        ans = str(example.get("answer", ""))
+        if example.get("ans_type") in ("integer_number", "decimal_number"):
+            if "/" in ans:
+                num, den = ans.split("/")[:2]
+                return str(int(num) / int(den))
+            return str(float(ans.replace(",", "").replace("%", "e-2")))
+        return ans
+    # gaokao2023en / college_math / carp_en: the answer field, de-$'d
+    return str(example.get("answer", "")).replace("$", "").strip()
+
+
+__all__ = [
+    "Convention",
+    "CONVENTIONS",
+    "EXTRACTORS",
+    "clean_choice",
+    "convention_for",
+    "extract_answer",
+    "extract_answer_is",
+    "extract_boxed",
+    "extract_boxed_loose",
+    "extract_hash_answer",
+    "extract_last_integer",
+    "extract_last_number",
+    "extract_minerva",
+    "extract_pred",
+    "parse_ground_truth",
+    "resolve_benchmark",
+]

@@ -1,118 +1,104 @@
 """Dataset-aware math evaluation: extraction + ground-truth parsing.
 
 Role of the reference's evaluation/parser.py + grader.py + math_eval.py
-(the instrument behind its published quality numbers, blog/AReaL_v0_2.md):
-robust answer extraction handles dataset-specific completion formats
-(minerva's "final answer is $X$. I hope", boxed, "the answer is",
-multiple-choice letters, last-number fallback) and per-dataset ground-truth
-fields (gsm8k "#### N", MATH boxed solutions, mmlu answer indices...).
-Equivalence grading is reward/math_parser.answers_equal — the SAME cascade
-training rewards use, so eval accuracy measures the training-time success
-criterion. Behavior agreement with the reference's extractor/grader is
-pinned by vectors in tests/test_math_eval.py.
+(the instrument behind its published quality numbers, blog/AReaL_v0_2.md).
+Since the grading-subsystem refactor this module BINDS rather than
+implements:
+
+* extraction conventions → :mod:`areal_tpu.evaluation.extract`
+  (per-benchmark cascades + ground-truth field rules, ≥8 stems);
+* equivalence            → :mod:`areal_tpu.evaluation.grader`
+  (the family-structured cascade training rewards also use).
+
+Eval accuracy therefore measures exactly the training-time success
+criterion — one source of truth. Behavior agreement with the reference's
+extractor/grader is pinned by vectors in tests/test_math_eval.py (the
+sentinel strings there ARE the declared behavior spec).
 """
 
-import re
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
-from areal_tpu.reward.math_parser import (
-    answers_equal,
+from areal_tpu.evaluation.extract import (  # noqa: F401
+    CONVENTIONS,
+    clean_choice,
+    convention_for,
     extract_answer,
-    extract_boxed,
+    extract_pred,
+    parse_ground_truth,
+    resolve_benchmark,
 )
+from areal_tpu.evaluation.grader import answers_equal, grade_answer
 
-# datasets whose answers are choice letters (reference parser.py:507)
-MULTIPLE_CHOICE = {"mmlu_stem", "sat_math", "aqua", "gaokao2023"}
+# datasets whose answers are choice letters (reference parser.py:507) —
+# derived from the convention table so the two views cannot drift
+MULTIPLE_CHOICE = {
+    name
+    for name, conv in CONVENTIONS.items()
+    if conv.answer_type == "choice"
+}
 # datasets graded without unit stripping (reference STRIP_EXCEPTIONS)
-KEEP_UNITS = {"carp_en", "minerva_math"}
-
-_LAST_NUMBER = re.compile(r"-?\d*\.?\d+")
-_CHOICE = re.compile(r"\b([A-E])\b")
-
-
-def clean_choice(pred: str) -> str:
-    """Reduce a free-text prediction to its last A–E letter (reference
-    grader.choice_answer_clean behavior)."""
-    pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
-    letters = _CHOICE.findall(pred.upper())
-    if letters:
-        return letters[-1]
-    return pred.strip().strip(".").rstrip(".").rstrip("/")
+KEEP_UNITS = {
+    name
+    for name, conv in CONVENTIONS.items()
+    if not conv.strip_units
+}
 
 
-def extract_pred(text: str, dataset: str = "math") -> str:
-    """Final-answer candidate from a completion, dataset-aware
-    (reference parser.extract_answer order)."""
-    text = text.replace("ки", "")  # stray cyrillic the ref strips
-    if dataset in MULTIPLE_CHOICE:
-        return clean_choice(text)
-    pred: Optional[str] = None
-    if "final answer is $" in text and "$. I hope" in text:  # minerva
-        pred = text.split("final answer is $", 1)[1].split("$. I hope", 1)[0]
-    elif "boxed" in text:
-        pred = extract_boxed(text)
-        if pred is None:
-            # \boxed without braces: up to the closing $
-            tail = text.split("boxed")[-1]
-            pred = tail.split("$")[0].strip()
-    elif "he answer is" in text:  # The/the answer is
-        pred = text.split("he answer is")[-1]
-    elif "final answer is" in text:
-        pred = text.split("final answer is")[-1]
-    else:  # last number
-        nums = _LAST_NUMBER.findall(text.replace(",", ""))
-        pred = nums[-1] if nums else ""
-    pred = re.sub(r"\n\s*", "", (pred or "")).strip()
-    pred = pred.lstrip(":").strip()
-    pred = pred.rstrip(".").rstrip("/").strip()
-    return pred
-
-
-def parse_ground_truth(
-    example: Dict[str, Any], dataset: str = "math"
-) -> str:
-    """Per-dataset ground-truth answer (reference parser.parse_ground_truth
-    field conventions)."""
-    if dataset in ("math", "math_500", "minerva_math"):
-        sol = example.get("solution") or example.get("answer") or ""
-        boxed = extract_boxed(str(sol))
-        return (boxed if boxed is not None else str(sol)).strip()
-    if dataset == "gsm8k":
-        ans = str(example.get("answer", ""))
-        return ans.split("####")[-1].strip() if "####" in ans else ans.strip()
-    if dataset == "mmlu_stem":
-        return "ABCD"[int(example["answer"])]
-    if dataset == "sat_math":
-        return str(example.get("Answer", example.get("answer", ""))).strip()
-    if dataset == "aqua":
-        return str(example.get("correct", example.get("answer", ""))).strip()
-    if dataset == "svamp":
-        return str(example.get("Answer", example.get("answer", ""))).strip()
-    if dataset == "asdiv":
-        return re.sub(r"\(.*?\)", "", str(example.get("answer", ""))).strip()
-    if dataset == "mawps":
-        return str(example.get("target", example.get("answer", ""))).strip()
-    if dataset == "tabmwp":
-        ans = str(example.get("answer", ""))
-        if example.get("ans_type") in ("integer_number", "decimal_number"):
-            if "/" in ans:
-                num, den = ans.split("/")[:2]
-                return str(int(num) / int(den))
-            return str(float(ans.replace(",", "").replace("%", "e-2")))
-        return ans
-    # gaokao2023en / college_math / default: the answer field, de-$'d
-    return str(example.get("answer", "")).replace("$", "").strip()
+def _safe_truth(example: Dict[str, Any], dataset: str) -> str:
+    """Ground truth with graceful degradation: a row whose fields don't
+    fit the convention (e.g. an mmlu LETTER where an index is expected)
+    falls back to the raw answer field instead of raising — a reward fn
+    that throws kills a training episode, which is worse than grading
+    against the unconverted field."""
+    try:
+        return parse_ground_truth(example, dataset)
+    except Exception:
+        return str(example.get("answer", "") or "")
 
 
 def grade(
     completion: str, example: Dict[str, Any], dataset: str = "math"
 ) -> Tuple[bool, str, str]:
     """(correct, extracted_pred, ground_truth) for one completion."""
-    truth = parse_ground_truth(example, dataset)
+    conv = convention_for(dataset)
+    truth = _safe_truth(example, dataset)
     pred = extract_pred(completion, dataset)
-    if dataset in MULTIPLE_CHOICE:
+    if conv.answer_type == "choice":
         return clean_choice(pred) == clean_choice(truth), pred, truth
-    return bool(answers_equal(pred, truth)), pred, truth
+    ok = answers_equal(pred, truth, strip_units=conv.strip_units)
+    return bool(ok), pred, truth
+
+
+def grade_with_trace(
+    completion: str, example: Dict[str, Any], dataset: str = "math"
+):
+    """Debug view of :func:`grade`: returns (GradeResult, pred, truth) so a
+    miscounted reward can be audited down to the deciding family."""
+    conv = convention_for(dataset)
+    truth = _safe_truth(example, dataset)
+    pred = extract_pred(completion, dataset)
+    if conv.answer_type == "choice":
+        from areal_tpu.evaluation.grader import GradeResult
+
+        p, t = clean_choice(pred), clean_choice(truth)
+        return (
+            GradeResult(p == t, "choice", [f"choice letters {p!r} vs {t!r}"]),
+            pred,
+            truth,
+        )
+    return (
+        grade_answer(pred, truth, strip_units=conv.strip_units),
+        pred,
+        truth,
+    )
+
+
+# ground-truth fields forwarded from workflow items into the grading
+# example (RLVR passes every non-prompt item key through **kw)
+_GT_FIELDS = (
+    "answer", "Answer", "solution", "correct", "target", "final_answer",
+    "ans_type",
+)
 
 
 def make_math_reward_fn(dataset: str = "math"):
@@ -123,6 +109,9 @@ def make_math_reward_fn(dataset: str = "math"):
         example = {"answer": answer}
         if solution:
             example["solution"] = solution
+        for k in _GT_FIELDS:
+            if k in kw and kw[k] is not None:
+                example[k] = kw[k]
         ok, _, _ = grade(completion, example, dataset)
         return float(ok)
 
@@ -130,11 +119,13 @@ def make_math_reward_fn(dataset: str = "math"):
 
 
 __all__ = [
+    "KEEP_UNITS",
     "MULTIPLE_CHOICE",
     "clean_choice",
     "extract_pred",
     "parse_ground_truth",
     "grade",
+    "grade_with_trace",
     "make_math_reward_fn",
     "extract_answer",
 ]

@@ -31,15 +31,18 @@ CODE_DATASETS = ("code", "humaneval", "mbpp", "lcb", "livecodebench")
 
 def reward_fn_for(dataset: str) -> Callable:
     """Grading convention for a dataset name (reference data_loader's
-    per-benchmark judge selection)."""
+    per-benchmark judge selection). Math stems resolve through
+    evaluation/extract.py's convention table, so "aime_2024" /
+    "math500" / "olympiadbench_en" filenames land on the right cascade."""
     low = dataset.lower()
     if any(t in low for t in CODE_DATASETS):
         from areal_tpu.reward.code_verifier import code_reward_fn
 
         return code_reward_fn
+    from areal_tpu.evaluation.extract import resolve_benchmark
     from areal_tpu.evaluation.math_eval import make_math_reward_fn
 
-    return make_math_reward_fn(low)
+    return make_math_reward_fn(resolve_benchmark(low))
 
 
 def load_jsonl_dataset(
@@ -92,11 +95,20 @@ def run_eval(
     dict} plus an 'average' row (unweighted mean accuracy, the reference
     aggregate convention). Writes per-dataset rows + aggregate.json when
     ``out_dir`` is given."""
+    from areal_tpu.evaluation.extract import resolve_benchmark
+
     reports: Dict[str, EvalReport] = {}
     for name, items in datasets.items():
         fn = (reward_fns or {}).get(name) or reward_fn_for(name)
+        low = name.lower()
+        benchmark = (
+            None
+            if any(t in low for t in CODE_DATASETS)
+            else resolve_benchmark(low)
+        )
         reports[name] = evaluate_dataset(
-            engine, items, fn, gconfig, tokenizer=tokenizer
+            engine, items, fn, gconfig, tokenizer=tokenizer,
+            benchmark=benchmark,
         )
     agg: Dict[str, Any] = {
         name: r.to_dict() for name, r in reports.items()

@@ -439,7 +439,15 @@ def paged_decode_attention(
     ``slots_per_block`` slots share one grid step (per-step overhead is the
     dominant cost at serving shapes; DMA skip predicates keep ragged
     batches cheap). A head-merged pool (pool head dim 1 < num_kv_heads,
-    ops.paged_attention.pool_layout) halves the per-page DMA count."""
+    ops.paged_attention.pool_layout) halves the per-page DMA count.
+
+    .. note:: **True-MQA callers must pass** ``num_kv_heads=1``. Since the
+       head-merged layout landed, a pool with kv-head dim 1 under a
+       multi-head ``q`` is ambiguous (true MQA vs merged GQA heads) and
+       guessing wrong returns finite garbage — so the kernel raises
+       instead of defaulting. This is a breaking change relative to pre-r5
+       behavior for external tooling that called the kernel on MQA pools
+       without the kwarg; all in-repo callers pass it."""
     s, hq, d = q.shape
     nl, hkv_pool, np_, prow, fd = k_pages.shape
     if hkv_pool == 1 and hq > 1 and num_kv_heads is None:

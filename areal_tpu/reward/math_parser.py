@@ -3,426 +3,34 @@
 Role of reference areal/reward/math_parser.py (the ~870-line sympy-based
 answer-equivalence engine behind GSM8K/MATH GRPO rewards): extract the final
 answer from a model completion and decide equivalence against ground truth.
-Written fresh for this framework; the equivalence cascade reproduces the
-reference's observable behaviors (tests/test_math_parser.py holds vectors
-derived from reference `math_equal` semantics):
 
-1. normalized string equality (units, %, $, degree marks, \\text, matrix
-   envs, word numbers, `x=` prefixes, \\frac/sqrt canonicalization)
-2. multiple-choice letter cleanup (A–E)
-3. numeric equality at rel_tol=1e-4, with the percentage ambiguity the
-   reference accepts (x matches x/100 and 100·x)
-4. element-wise tuples/intervals/sets and pmatrix/bmatrix matrices
-5. single-equation equivalence (lhs-rhs difference, either sign)
-6. sympy symbolic equivalence (LaTeX parse via the lark backend, then
-   plain-expression parse), ``simplify(a-b)==0`` / ``.equals`` / N()
-   — every sympy call timeout-bounded so hostile outputs (9**9**9**9)
-   cannot stall the reward path.
+Since the grading-subsystem refactor this module is a thin binding over the
+ONE shared grading instrument:
+
+* extraction  → :mod:`areal_tpu.evaluation.extract` (generic reward-path
+  cascade: boxed > #### > "answer is" > last number);
+* equivalence → :mod:`areal_tpu.evaluation.grader` (family-structured
+  cascade: exact / choice / numeric-with-percent-ambiguity / interval /
+  matrix / equation / timeout-bounded sympy symbolic).
+
+Training rewards and offline eval (``evaluation/math_eval.py``) therefore
+grade IDENTICALLY — a grading fix cannot diverge between the reward channel
+and the published eval table. The equivalence behaviors pinned by
+tests/test_math_parser.py (vectors derived from reference ``math_equal``
+semantics) are the grader's contract; this module re-exports the API that
+reward-side callers and tests import.
 """
 
-import re
-from typing import List, Optional
-
-_BOXED_RE = re.compile(r"\\boxed\s*\{")
-_GSM8K_RE = re.compile(r"####\s*([^\n]+)")
-_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:[eE][+-]?\d+)?")
-_CHOICE_RE = re.compile(r"\b([A-E])\b")
-
-_WORD_NUMBERS = {
-    "zero": "0", "one": "1", "two": "2", "three": "3", "four": "4",
-    "five": "5", "six": "6", "seven": "7", "eight": "8", "nine": "9",
-    "ten": "10", "eleven": "11", "twelve": "12",
-}
-
-# measurement words stripped from answers ("5 cm" == "5"); the reference
-# carries a much longer unit list — these cover the GSM8K/MATH datasets
-# NOTE: no bare single letters (an "m" could be algebra, not meters) and
-# no words that double as operators ("times")
-_UNITS = (
-    "degrees?|cm|km|mm|meters?|inch(?:es)?|feet|foot|ft|miles?|mph|"
-    "hours?|hrs?|minutes?|mins?|seconds?|secs?|days?|weeks?|months?|"
-    "years?|dollars?|cents?|bucks?|points?|units?|square|cubic|percent|"
-    "people|students?|apples?|oranges?|ways?"
+from areal_tpu.evaluation.extract import (  # noqa: F401
+    extract_answer,
+    extract_boxed,
 )
-_UNIT_RE = re.compile(r"(^|[\s\d])(" + _UNITS + r")($|\W)")
-
-
-def extract_boxed(text: str) -> Optional[str]:
-    """Last \\boxed{...} contents, brace-balanced."""
-    out = None
-    for m in _BOXED_RE.finditer(text):
-        start = m.end()
-        depth = 1
-        for i in range(start, len(text)):
-            if text[i] == "{":
-                depth += 1
-            elif text[i] == "}":
-                depth -= 1
-                if depth == 0:
-                    out = text[start:i]
-                    break
-    return out
-
-
-def extract_answer(text: str) -> Optional[str]:
-    """Final answer string from a completion: boxed > "final answer is"
-    > #### (GSM8K) > last number (reference extract_answer order)."""
-    boxed = extract_boxed(text)
-    if boxed is not None:
-        return boxed.strip()
-    # the explicit GSM8K marker outranks free-text "answer is" phrasing —
-    # a stray "the answer is <phrase>" in a rationale must not override it
-    m = _GSM8K_RE.findall(text)
-    if m:
-        return m[-1].strip()
-    m = re.findall(
-        r"(?:final answer|answer)\s*(?:is|:)\s*([^\n]+)", text,
-        re.IGNORECASE,
-    )
-    if m:
-        # keep decimals ("3.14") but cut at sentence boundaries (". ")
-        cand = m[-1].strip().split(". ")[0].rstrip(".").strip()
-        if cand:
-            return cand
-    nums = _NUMBER_RE.findall(text)
-    if nums:
-        return nums[-1]
-    return None
-
-
-def _fix_fracs(s: str) -> str:
-    """\\frac12, \\frac1{72}, \\frac{a}2 → (1)/(2) style; nested braces
-    handled by repeated innermost substitution."""
-    s = s.replace("\\tfrac", "\\frac").replace("\\dfrac", "\\frac")
-    # brace-less arguments first: \frac12 / \frac1{72} / \frac{a}2
-    s = re.sub(r"\\frac(\d)(\d)", r"\\frac{\1}{\2}", s)
-    s = re.sub(r"\\frac(\d)\{", r"\\frac{\1}{", s)
-    s = re.sub(r"\\frac\{([^{}]+)\}(\d)", r"\\frac{\1}{\2}", s)
-    pat = re.compile(r"\\frac\{([^{}]+)\}\{([^{}]+)\}")
-    while True:
-        s2 = pat.sub(r"((\1)/(\2))", s)
-        if s2 == s:
-            return s
-        s = s2
-
-
-def _fix_sqrt(s: str) -> str:
-    s = re.sub(r"\\sqrt\[(\d+)\]\{([^{}]+)\}", r"((\2)**(1/\1))", s)
-    s = re.sub(r"\\sqrt\s*(\d+)", r"sqrt(\1)", s)
-    s = re.sub(r"\\sqrt\{([^{}]+)\}", r"sqrt(\1)", s)
-    return s.replace("\\sqrt", "sqrt")
-
-
-def normalize_answer(ans: str) -> str:
-    s = str(ans).strip().replace("\n", "")
-    s = s.rstrip(".").strip()
-    if "\\boxed" in s:  # a raw \boxed{...} answer normalizes to its content
-        b = extract_boxed(s)
-        if b is not None:
-            s = b
-    s = s.replace("{,}", "")  # latex thousands separator: 5{,}905
-    s = s.replace("\\!", "").replace("\\,", " ").replace("\\ ", " ")
-    s = s.replace("\\left", "").replace("\\right", "")
-    s = s.replace("^{\\circ}", "").replace("^\\circ", "")
-    s = s.replace("\\$", "").replace("$", "")
-    s = s.replace("\\%", "").replace("%", "")
-    s = s.replace("\\(", "").replace("\\)", "")
-    # matrix env canonicalization (array/bmatrix → pmatrix)
-    s = re.sub(r"\\begin\{array\}\{[^}]*\}", r"\\begin{pmatrix}", s)
-    s = s.replace("\\end{array}", "\\end{pmatrix}")
-    s = s.replace("bmatrix", "pmatrix")
-    s = re.sub(r"\\text\s*\{([^{}]*)\}", r"\1", s)
-    s = re.sub(r"\\mbox\s*\{[^{}]*\}", "", s)
-    s = s.replace("\\mathbf", "").replace("\\mathrm", "")
-    # strip "x=" / "k =" style prefixes (single short lhs)
-    if s.count("=") == 1 and len(s.split("=")[0].strip()) <= 2:
-        s = s.split("=")[1]
-    # word numbers ("two" → "2") for bare word answers
-    low = s.strip().lower()
-    if low in _WORD_NUMBERS:
-        return _WORD_NUMBERS[low]
-    # units
-    prev = None
-    while prev != s:
-        prev = s
-        s = _UNIT_RE.sub(r"\1\3", s)
-    # thousands separators only — "1,234" → "1234" but "(1, 2)" keeps its
-    # tuple comma
-    prev = None
-    while prev != s:
-        prev = s
-        s = re.sub(r"(\d),(?=\d{3}(\D|$))", r"\1", s)
-    # innermost-out: \frac{\sqrt{3}}{2} needs the sqrt's braces resolved
-    # before the frac pattern (brace-free args) can match, and vice versa
-    prev = None
-    while prev != s:
-        prev = s
-        s = _fix_sqrt(_fix_fracs(s))
-    s = s.replace("\\pi", "pi").replace("\\infty", "oo").replace(
-        "infinity", "oo"
-    )
-    s = s.replace("\\cdot", "*").replace("\\times", "*").replace(
-        "\\div", "/"
-    )
-    s = s.replace("^{", "**{").replace("^", "**")
-    s = s.replace("{", "(").replace("}", ")")
-    # bare a/b (no parens) stays as-is; "2 1/2" mixed number → (2+1/2)
-    m = re.fullmatch(r"\s*(-?\d+)\s+(\d+)\s*/\s*(\d+)\s*", s)
-    if m:
-        sign = "-" if m.group(1).startswith("-") else "+"
-        s = f"({m.group(1)}{sign}({m.group(2)})/({m.group(3)}))"
-    s = re.sub(r"\s+", " ", s).strip()
-    s = s.rstrip(". ").lstrip()
-    # "0." prefixes
-    if s.startswith("."):
-        s = "0" + s
-    # trailing ".000"
-    s = re.sub(r"(\d+)\.0+$", r"\1", s)
-    s = re.sub(r"(\d+)\.0+([^\d])", r"\1\2", s)
-    return s.strip()
-
-
-# ---------------------------------------------------------------------------
-# sympy workers (timeout-bounded)
-# ---------------------------------------------------------------------------
-# sympy can blow up on pathological model outputs (e.g. 9**9**9**9); all
-# sympy work runs in a DAEMON thread with a wall-clock timeout (daemon so a
-# stuck worker can never block interpreter exit). Abandoned hostile threads
-# leak until they finish; a live counter bounds them — past the bound,
-# symbolic checks fail fast to False rather than stalling the reward path.
-import threading as _threading
-
-_SYMPY_TIMEOUT_S = 3.0
-_MAX_STUCK_THREADS = 16
-_stuck_lock = _threading.Lock()
-_stuck_count = 0
-
-
-def _hostile(s: str) -> bool:
-    """Cheap pre-filter for expressions whose EVALUATION cannot be
-    interrupted by a thread timeout (a giant integer pow is one CPython
-    bytecode — it never releases the GIL, so the only safe defense is to
-    refuse it up front; the reference pays a subprocess per check for the
-    same reason)."""
-    if len(s) > 300:
-        return True
-    if s.count("**") >= 3:
-        return True
-    for m in re.finditer(r"\*\*\s*\(?\s*-?(\d+)", s):
-        if len(m.group(1)) > 4:  # exponent >= 10^4
-            return True
-    if re.search(r"\d{40,}", s):  # absurdly long literals
-        return True
-    return False
-
-
-def _with_timeout(fn, *args):
-    global _stuck_count
-    with _stuck_lock:
-        if _stuck_count >= _MAX_STUCK_THREADS:
-            return None
-    box = {}
-    state = {"abandoned": False, "finished": False}
-
-    def run():
-        global _stuck_count
-        try:
-            box["r"] = fn(*args)
-        except Exception:
-            box["r"] = None
-        finally:
-            with _stuck_lock:
-                state["finished"] = True
-                if state["abandoned"]:  # un-count ourselves
-                    _stuck_count -= 1
-
-    th = _threading.Thread(target=run, daemon=True, name="sympy-eval")
-    th.start()
-    th.join(timeout=_SYMPY_TIMEOUT_S)
-    with _stuck_lock:
-        if not state["finished"]:
-            state["abandoned"] = True
-            _stuck_count += 1
-            return None
-    return box.get("r")
-
-
-def _parse_sym(s: str):
-    """Parse a (normalized) answer into a sympy object: plain expression
-    first, then LaTeX via the lark backend (reference tries parse_latex /
-    parse_expr / latex2sympy in order)."""
-    import sympy
-    from sympy.parsing.sympy_parser import (
-        implicit_multiplication_application,
-        parse_expr,
-        standard_transformations,
-    )
-
-    transforms = standard_transformations + (
-        implicit_multiplication_application,
-    )
-    for attempt in (
-        lambda: parse_expr(s, evaluate=True, transformations=transforms),
-        lambda: sympy.parsing.latex.parse_latex(s, backend="lark"),
-        lambda: sympy.sympify(s),
-    ):
-        try:
-            out = attempt()
-            if out is not None:
-                return out
-        except Exception:
-            continue
-    return None
-
-
-def _sympy_equal(a: str, b: str) -> bool:
-    if _hostile(a) or _hostile(b):
-        return False
-
-    def work():
-        import sympy
-
-        ea, eb = _parse_sym(a), _parse_sym(b)
-        if ea is None or eb is None:
-            return False
-        try:
-            if ea == eb or str(ea) == str(eb):
-                return True
-        except Exception:
-            pass
-        try:
-            if ea.equals(eb) or sympy.simplify(ea - eb) == 0:
-                return True
-        except Exception:
-            pass
-        try:
-            # equation forms: |lhs-rhs| agree
-            if abs(ea.lhs - ea.rhs).equals(abs(eb.lhs - eb.rhs)):
-                return True
-        except Exception:
-            pass
-        try:
-            return _isclose(float(sympy.N(ea)), float(sympy.N(eb)))
-        except Exception:
-            return False
-
-    return bool(_with_timeout(work))
-
-
-def _numeric_value(s: str) -> Optional[float]:
-    """Float value of a possibly-symbolic expression."""
-    try:
-        return float(s)
-    except (ValueError, TypeError):
-        pass
-    if s.endswith("\\"):
-        s = s[:-1]
-    if _hostile(s):
-        return None
-
-    def work():
-        import sympy
-
-        v = _parse_sym(s)
-        if v is not None and getattr(v, "is_number", False):
-            return float(sympy.N(v))
-        return None
-
-    return _with_timeout(work)
-
-
-def _isclose(a: float, b: float, rel_tol: float = 1e-4) -> bool:
-    from math import isclose
-
-    return isclose(a, b, rel_tol=rel_tol)
-
-
-def _split_elements(s: str) -> Optional[List[str]]:
-    """Top-level comma split of a bracketed tuple/interval/set."""
-    if len(s) < 2 or s[0] not in "([" or s[-1] not in ")]":
-        return None
-    inner = s[1:-1]
-    parts, depth, cur = [], 0, []
-    for ch in inner:
-        if ch in "([{":
-            depth += 1
-        elif ch in ")]}":
-            depth -= 1
-        if ch == "," and depth == 0:
-            parts.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    parts.append("".join(cur))
-    return [p.strip() for p in parts] if len(parts) > 1 else None
-
-
-def _matrix_rows(s: str) -> Optional[List[List[str]]]:
-    m = re.fullmatch(
-        r"\\begin\(pmatrix\)(.*)\\end\(pmatrix\)", s
-    ) or re.fullmatch(r"\\begin\{pmatrix\}(.*)\\end\{pmatrix\}", s)
-    if not m:
-        return None
-    rows = [r.strip() for r in m.group(1).split("\\\\") if r.strip()]
-    return [[c.strip() for c in r.split("&")] for r in rows]
-
-
-def answers_equal(pred: str, truth: str, rel_tol: float = 1e-4) -> bool:
-    """Equivalence cascade (see module doc)."""
-    if pred is None or truth is None:
-        return False
-    if str(pred).strip().lower() == str(truth).strip().lower():
-        return True
-    p, t = normalize_answer(pred), normalize_answer(truth)
-    if not p or not t:
-        return False
-    if p.lower() == t.lower():
-        return True
-    # multiple choice: reference accepts "(B)" / "B." / "answer B" for "B"
-    # (case-sensitive — uppercasing the completion would turn the article
-    # "a" into choice A)
-    if t in "ABCDE" and len(t) == 1:
-        letters = _CHOICE_RE.findall(str(pred))
-        if letters and letters[-1] == t:
-            return True
-    # numeric (with the reference's percentage ambiguity)
-    fp, ft = _numeric_value(p), _numeric_value(t)
-    if fp is not None and ft is not None:
-        for target in (ft, ft / 100.0, ft * 100.0):
-            if target == 0:
-                if abs(fp) < rel_tol:
-                    return True
-            elif _isclose(fp, target, rel_tol):
-                return True
-        return False
-    # tuples / intervals / sets: element-wise. Bracket style is IGNORED
-    # ((0,1] == [0,1]) — matching the reference, which strips brackets
-    # before comparing (math_equal's "deal with [], (), {}" block)
-    pe, te = _split_elements(p), _split_elements(t)
-    if pe is not None and te is not None:
-        if len(pe) != len(te):
-            return False
-        return all(answers_equal(a, b, rel_tol) for a, b in zip(pe, te))
-    # matrices: element-wise
-    pm, tm = _matrix_rows(p), _matrix_rows(t)
-    if pm is not None and tm is not None:
-        if [len(r) for r in pm] != [len(r) for r in tm]:
-            return False
-        return all(
-            answers_equal(a, b, rel_tol)
-            for ra, rb in zip(pm, tm)
-            for a, b in zip(ra, rb)
-        )
-    # single equations on both sides
-    if p.count("=") == 1 and t.count("=") == 1:
-        pl, pr = p.split("=")
-        tl, tr = t.split("=")
-        if _sympy_equal(f"({pl})-({pr})", f"({tl})-({tr})") or _sympy_equal(
-            f"-(({pl})-({pr}))", f"({tl})-({tr})"
-        ):
-            return True
-    # symbolic
-    return _sympy_equal(p, t)
+from areal_tpu.evaluation.grader import (  # noqa: F401
+    GradeResult,
+    answers_equal,
+    grade_answer,
+    normalize_answer,
+)
 
 
 def process_results(completion: str, truth: str) -> float:
@@ -444,3 +52,15 @@ def gsm8k_reward_fn(
     """Reward function signature the RLVR workflow expects
     (reference examples/math/gsm8k_grpo.py gsm8k_reward_fn)."""
     return process_results(completion, answer)
+
+
+__all__ = [
+    "GradeResult",
+    "answers_equal",
+    "extract_answer",
+    "extract_boxed",
+    "grade_answer",
+    "gsm8k_reward_fn",
+    "normalize_answer",
+    "process_results",
+]

@@ -84,10 +84,20 @@ class AgenticToolWorkflow(RolloutWorkflow):
                     break
                 result = env.call(tc.function.name, tc.function.arguments)
                 calls_per_turn[-1] += 1
+                # real chat templates (qwen2/Hermes) expect structured tool
+                # messages — tool_call_id + name let the template pair the
+                # result with its call. A template-less tokenizer (the toy
+                # path) gets the Hermes <tool_response> wrapping inlined,
+                # since nothing downstream would add it.
+                content = f"{tc.function.name} -> {result}"
+                if not getattr(self.tokenizer, "chat_template", None):
+                    content = f"<tool_response>\n{content}\n</tool_response>"
                 messages.append(
                     {
                         "role": "tool",
-                        "content": f"{tc.function.name} -> {result}",
+                        "tool_call_id": tc.id,
+                        "name": tc.function.name,
+                        "content": content,
                     }
                 )
             if env.done:

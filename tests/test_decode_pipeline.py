@@ -1,0 +1,144 @@
+"""Regression: preemption × in-flight decode pipeline (decode_pipeline=2).
+
+The r4 catastrophic-outlier mechanism: under pool pressure with a pipelined
+decode loop, an in-flight chunk may still write to a victim's pages, and
+evicting the prefix registry mid-pipeline would destroy parked KV of
+preempted requests — forcing full re-prefills with fresh shape compiles.
+The fix (inference/engine.py `_ensure_decode_pages`: drain-before-evict —
+return False while ``self._inflight`` is non-empty instead of evicting)
+landed in r5 with zero tests at the pipeline depth that triggered it; this
+file is that test.
+
+Correctness bar: greedy outputs under pressure + pipeline depth 2 must be
+token-identical to an uncontended engine at the same weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from areal_tpu.api.cli_args import JaxGenConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def engine_factory():
+    engines = []
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    def make(**kw):
+        kw.setdefault("page_size", 8)
+        kw.setdefault("max_num_seqs", 8)
+        gcfg = JaxGenConfig(
+            dtype="float32", max_model_len=64, prefill_chunk=16, **kw,
+        )
+        eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+        engines.append(eng)
+        return eng
+
+    yield make
+    for e in engines:
+        e.stop()
+
+
+def test_pipelined_decode_matches_unpipelined(engine_factory):
+    """Sanity floor: depth-2 pipelining alone (no pressure) is
+    output-invariant vs the depth-1 default."""
+    eng2 = engine_factory(decode_pipeline=2, decode_chunk=4, admit_wave=1)
+    eng1 = engine_factory(decode_pipeline=1, decode_chunk=4, admit_wave=1)
+    for seed in range(3):
+        prompt = [(seed * 7 + i) % 90 + 1 for i in range(8)]
+        req = {
+            "input_ids": prompt,
+            "sampling_params": {"max_new_tokens": 16, "greedy": True},
+        }
+        assert (
+            eng2.generate(req)["output_ids"]
+            == eng1.generate(req)["output_ids"]
+        )
+
+
+def test_preemption_under_inflight_pipeline(engine_factory):
+    """The r4 outlier shape: oversubscribed pool, decode_pipeline=2, a
+    cohort whose page demand outgrows the pool mid-decode. The engine must
+    (a) finish every request at full length, (b) produce outputs identical
+    to an uncontended run, and (c) actually have exercised the preemption
+    path (else the test guards nothing)."""
+    eng = engine_factory(
+        decode_pipeline=2,
+        decode_chunk=4,
+        prefix_reuse_min=8,
+        num_pages=12,
+        max_num_seqs=4,
+        admit_wave=4,
+    )
+    prompts = [[i + 1] * 8 for i in range(4)]
+    futs = [
+        eng.submit(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 24, "greedy": True},
+            }
+        )
+        for p in prompts
+    ]
+    outs = [f.result(timeout=300) for f in futs]
+    for o in outs:
+        assert len(o["output_ids"]) == 24
+    m = eng.metrics()
+    assert m["total_preemptions"] > 0, (
+        "pool was not actually oversubscribed — the regression path "
+        "(preemption while chunks are in flight) never ran"
+    )
+    # reference: uncontended engine, same weights, no pipelining
+    ref_eng = engine_factory(decode_pipeline=1, admit_wave=1)
+    for p, o in zip(prompts, outs):
+        ref = ref_eng.generate(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 24, "greedy": True},
+            }
+        )
+        assert ref["output_ids"] == o["output_ids"], (
+            "preemption under an in-flight pipeline corrupted decoding"
+        )
+
+
+def test_pipeline_drain_before_evict_preserves_parked_kv(engine_factory):
+    """Interleaved long generations at depth 2 over a pool that cannot
+    hold them all: preempted requests park their KV in the prefix
+    registry; the drain-before-evict rule must keep those pages alive so
+    resumes are exact. Greedy equality across an interleaved cohort pins
+    it end to end."""
+    eng = engine_factory(
+        decode_pipeline=2,
+        decode_chunk=4,
+        prefix_reuse_min=8,
+        num_pages=10,
+        max_num_seqs=3,
+        admit_wave=3,
+    )
+    prompts = [[10 * (i + 1) + 1] * 8 for i in range(3)]
+    futs = [
+        eng.submit(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 28, "greedy": True},
+            }
+        )
+        for p in prompts
+    ]
+    outs = [f.result(timeout=300) for f in futs]
+    ref_eng = engine_factory(decode_pipeline=1, admit_wave=1)
+    for p, o in zip(prompts, outs):
+        assert len(o["output_ids"]) == 28
+        ref = ref_eng.generate(
+            {
+                "input_ids": p,
+                "sampling_params": {"max_new_tokens": 28, "greedy": True},
+            }
+        )
+        assert ref["output_ids"] == o["output_ids"]

@@ -1,0 +1,354 @@
+"""Per-benchmark extraction conventions (evaluation/extract.py).
+
+Pins: stem resolution for run_eval's filename dispatch, the extraction
+cascade per benchmark (≥8 stems), ground-truth field rules, and the
+end-to-end reward-fn dispatch those conventions feed.
+"""
+
+import pytest
+
+from areal_tpu.evaluation.extract import (
+    CONVENTIONS,
+    clean_choice,
+    convention_for,
+    extract_boxed_loose,
+    extract_hash_answer,
+    extract_last_integer,
+    extract_last_number,
+    extract_minerva,
+    extract_pred,
+    parse_ground_truth,
+    resolve_benchmark,
+)
+
+
+# --- stem resolution (run_eval filename dispatch) --------------------------
+@pytest.mark.parametrize(
+    "stem,want",
+    [
+        ("gsm8k", "gsm8k"),
+        ("gsm8k_test", "gsm8k"),
+        ("math", "math"),
+        ("math_500", "math"),
+        ("math500", "math"),
+        ("minerva_math", "minerva_math"),
+        ("olympiadbench", "olympiadbench"),
+        ("olympiadbench_en", "olympiadbench"),
+        ("aime24", "aime24"),
+        ("aime_2024", "aime24"),
+        ("aime25", "aime24"),
+        ("amc23", "amc23"),
+        ("amc_2023", "amc23"),
+        ("sat_math", "sat_math"),
+        ("mmlu_stem", "mmlu_stem"),
+        ("aqua", "aqua"),
+        ("gaokao2023en", "gaokao2023en"),
+        ("tabmwp", "tabmwp"),
+        ("something_new", "default"),
+    ],
+)
+def test_resolve_benchmark(stem, want):
+    assert resolve_benchmark(stem) == want
+
+
+def test_convention_table_breadth():
+    """The acceptance bar: ≥8 benchmark stems with explicit conventions."""
+    required = {
+        "gsm8k", "math", "minerva_math", "olympiadbench", "aime24",
+        "amc23", "sat_math", "mmlu_stem",
+    }
+    assert required <= set(CONVENTIONS)
+    for name in required:
+        conv = convention_for(name)
+        assert conv.answer_type in ("free", "choice", "integer")
+
+
+# --- extraction primitives -------------------------------------------------
+def test_primitives():
+    assert extract_boxed_loose(r"so \boxed{\frac{1}{2}} done") == r"\frac{1}{2}"
+    assert extract_boxed_loose(r"thus boxed 42$ end") == "42"
+    assert extract_boxed_loose("no box") is None
+    assert extract_minerva("final answer is $7$. I hope it is correct") == "7"
+    assert extract_minerva("the answer is 7") is None
+    assert extract_hash_answer("steps #### 42") == "42"
+    assert extract_hash_answer("steps") is None
+    assert extract_last_number("we get 1,234 then 5") == "5"
+    assert extract_last_number("nothing here") == ""
+    assert extract_last_integer("ratio 3.14 then n = 204") == "204"
+    assert extract_last_integer("answer is 3.14") == ""
+
+
+# --- per-stem completion extraction (≥8 stems) -----------------------------
+@pytest.mark.parametrize(
+    "text,stem,want",
+    [
+        # gsm8k: answer-is phrasing and last-number fallback
+        ("adding up, the answer is 42.", "gsm8k", "42"),
+        ("we get 1,234 apples in total", "gsm8k", "1234"),
+        # math: boxed outranks prose
+        (r"so the answer is 9... wait, \boxed{\frac{1}{2}}", "math",
+         r"\frac{1}{2}"),
+        ("The answer is 42.", "math", "42"),
+        # minerva: sign-off outranks everything
+        ("Thus the final answer is $\\frac{3}{4}$. I hope it is correct.",
+         "minerva_math", "\\frac{3}{4}"),
+        # olympiadbench: boxed-first
+        (r"Therefore \boxed{(0, 1]} is the range", "olympiadbench",
+         "(0, 1]"),
+        ("hence the answer is $2\\sqrt{3}$", "olympiadbench",
+         "$2\\sqrt{3}$"),
+        # aime: integers only — a stray decimal must not win
+        (r"so p+q = \boxed{204}", "aime24", "204"),
+        ("the ratio is 3.5 so the total is 68", "aime24", "68"),
+        ("the answer is 068", "aime24", "068"),
+        # amc: numeric
+        (r"giving \boxed{5.5}", "amc23", "5.5"),
+        ("so we need 11/2 which is 5.5", "amc23", "5.5"),
+        # choice benchmarks reduce to the last letter
+        ("I think (B) is right, final: C.", "sat_math", "C"),
+        ("the options... answer: (A).", "mmlu_stem", "A"),
+        ("definitely option D", "mmlu_stem", "D"),
+    ],
+)
+def test_extract_pred_per_stem(text, stem, want):
+    assert extract_pred(text, stem) == want
+
+
+# --- ground-truth conventions ----------------------------------------------
+@pytest.mark.parametrize(
+    "example,stem,want",
+    [
+        ({"answer": "He pays 10.\n#### 10"}, "gsm8k", "10"),
+        ({"solution": "We find $x=\\boxed{\\frac{1}{2}}$."}, "math",
+         "\\frac{1}{2}"),
+        ({"solution": "thus \\boxed{12}"}, "minerva_math", "12"),
+        # olympiadbench carries final_answer as a list of latex strings
+        ({"final_answer": ["$\\frac{3}{4}$"]}, "olympiadbench",
+         "\\frac{3}{4}"),
+        ({"final_answer": "27"}, "olympiadbench", "27"),
+        ({"solution": "so \\boxed{27}"}, "olympiadbench", "27"),
+        # aime: zero-padded integers canonicalize
+        ({"answer": "068"}, "aime24", "68"),
+        ({"answer": 204}, "aime24", "204"),
+        ({"answer": "$\\frac{7}{2}$"}, "amc23", "\\frac{7}{2}"),
+        ({"answer": 2}, "mmlu_stem", "C"),
+        ({"Answer": "72"}, "sat_math", "72"),
+        ({"correct": "D"}, "aqua", "D"),
+        ({"answer": "$12$"}, "gaokao2023en", "12"),
+        ({"target": "5.0"}, "mawps", "5.0"),
+        ({"answer": "60 (miles)"}, "asdiv", "60"),
+    ],
+)
+def test_parse_ground_truth_per_stem(example, stem, want):
+    assert parse_ground_truth(example, stem) == want
+
+
+# --- stem-resolved aliases end to end --------------------------------------
+def test_aliased_stem_uses_same_convention():
+    text = "Thus the final answer is $\\frac{3}{4}$. I hope it is correct."
+    assert extract_pred(text, "minerva_math") == extract_pred(
+        text, "minerva_math_test"
+    )
+    assert parse_ground_truth({"answer": "068"}, "aime_2024") == "68"
+
+
+# --- run_eval dispatch -----------------------------------------------------
+def test_reward_fn_dispatch_across_stems():
+    from areal_tpu.evaluation.run_eval import reward_fn_for
+
+    # gsm8k convention: #### ground truth + answer-is extraction
+    fn = reward_fn_for("gsm8k")
+    assert fn("p", "the answer is 4", [], [], answer="#### 4") == 1.0
+    assert fn("p", "the answer is 5", [], [], answer="#### 4") == 0.0
+
+    # aime via a year-suffixed filename stem: integer extraction + padded
+    # ground truth
+    fn = reward_fn_for("aime_2024")
+    assert fn("p", r"so \boxed{68}", [], [], answer="068") == 1.0
+    assert fn("p", "the total is 67", [], [], answer="068") == 0.0
+
+    # olympiadbench: final_answer list field passes through **kw
+    fn = reward_fn_for("olympiadbench")
+    assert fn(
+        "p", r"hence \boxed{\frac{3}{4}}", [], [],
+        final_answer=["$0.75$"],
+    ) == 1.0
+
+    # choice stems grade letter equality
+    fn = reward_fn_for("mmlu_stem")
+    assert fn("p", "definitely B", [], [], answer=1) == 1.0
+    assert fn("p", "definitely B", [], [], answer=0) == 0.0
+
+    fn = reward_fn_for("sat_math")
+    assert fn("p", "the answer is ( b )", [], [], Answer="B") == 1.0
+
+    # amc: numeric tolerance
+    fn = reward_fn_for("amc23")
+    assert fn("p", "we get 5.5", [], [], answer="11/2") == 1.0
+
+    # minerva: keep-units grading (unit is part of the answer)
+    fn = reward_fn_for("minerva_math")
+    assert fn(
+        "p", "final answer is $10$. I hope it is correct", [], [],
+        answer="10",
+    ) == 1.0
+
+
+def test_maj_at_k_uses_benchmark_extraction(tmp_path):
+    """evaluate_dataset(benchmark=...) clusters maj@k on the benchmark's
+    cascade: an AIME completion whose last number is a decimal must
+    cluster on the integer."""
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.evaluation.eval_runner import evaluate_dataset
+    from areal_tpu.evaluation.run_eval import reward_fn_for
+
+    class _CharTok:
+        """Char-level round-trip so completions survive detokenization."""
+
+        chat_template = None
+
+        def encode(self, s, add_special_tokens=False):
+            return [ord(c) for c in s]
+
+        def decode(self, ids):
+            return "".join(chr(int(i)) for i in ids)
+
+    tok = _CharTok()
+
+    class _Engine:
+        def get_version(self):
+            return 0
+
+        async def agenerate(self, req):
+            out = tok.encode("the ratio is 3.5 so the total is 68")
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+            )
+
+    items = [{"input_ids": tok.encode("q one"), "answer": "068"}]
+    report = evaluate_dataset(
+        _Engine(), items, reward_fn_for("aime24"),
+        GenerationHyperparameters(n_samples=2, max_new_tokens=16),
+        tokenizer=tok, benchmark="aime24",
+    )
+    assert report.accuracy == 1.0
+    assert report.maj_at_k[1] == 1.0
+    # the clustered answers are the INTEGER 68, not the decimal 3.5
+    assert report.rows[0]["answers"] == ["68", "68"]
+
+
+def test_majority_correct_respects_keep_units():
+    """maj@k clustering must grade under the benchmark's convention: for
+    KEEP_UNITS stems, '5 km' and '5 cm' are different answers."""
+    from areal_tpu.evaluation.eval_runner import _majority_correct
+    from areal_tpu.evaluation.grader import answers_equal
+
+    def keep_units_equal(a, b):
+        return answers_equal(a, b, strip_units=False)
+
+    # default grading strips units → counted equal (the wrong call for
+    # minerva); keep-units grading keeps them distinct
+    assert _majority_correct(["5 km"], "5 cm") == 1.0
+    assert _majority_correct(["5 km"], "5 cm", equal=keep_units_equal) == 0.0
+    assert _majority_correct(["5 cm"], "5 cm", equal=keep_units_equal) == 1.0
+
+
+def test_maj_at_k_survives_convention_mismatched_rows():
+    """A row whose fields don't fit the convention (an mmlu letter where
+    an index is expected) must not abort the sweep — it degrades to
+    grading the raw answer field."""
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.evaluation.eval_runner import evaluate_dataset
+    from areal_tpu.evaluation.run_eval import reward_fn_for
+
+    class _CharTok:
+        chat_template = None
+
+        def encode(self, s, add_special_tokens=False):
+            return [ord(c) for c in s]
+
+        def decode(self, ids):
+            return "".join(chr(int(i)) for i in ids)
+
+    class _Engine:
+        def get_version(self):
+            return 0
+
+        async def agenerate(self, req):
+            out = [ord(c) for c in "definitely B"]
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+            )
+
+    # mmlu convention expects an integer index, but this file stores the
+    # LETTER — parse_ground_truth raises int('B'); the runner must catch
+    # it and still produce a report (and the letter still grades right)
+    tok = _CharTok()
+    items = [{"input_ids": tok.encode("q"), "answer": "B"}]
+    report = evaluate_dataset(
+        _Engine(), items, reward_fn_for("mmlu_stem"),
+        GenerationHyperparameters(n_samples=1, max_new_tokens=16),
+        tokenizer=tok, benchmark="mmlu_stem",
+    )
+    assert report.n_prompts == 1
+    assert report.maj_at_k[1] == 1.0  # raw-answer fallback still grades
+
+
+def test_maj_at_k_default_convention_reduces_hash_truth():
+    """An unknown stem falls to the default convention; a gsm8k-formatted
+    truth ('rationale #### 42') must still reduce to '42' for maj@k."""
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.evaluation.eval_runner import evaluate_dataset
+
+    class _CharTok:
+        chat_template = None
+
+        def encode(self, s, add_special_tokens=False):
+            return [ord(c) for c in s]
+
+        def decode(self, ids):
+            return "".join(chr(int(i)) for i in ids)
+
+    class _Engine:
+        def get_version(self):
+            return 0
+
+        async def agenerate(self, req):
+            out = [ord(c) for c in "the answer is 42"]
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1] * len(out),
+                output_versions=[0] * len(out),
+                stop_reason="stop",
+            )
+
+    tok = _CharTok()
+    items = [
+        {"input_ids": tok.encode("q"), "answer": "long rationale #### 42"}
+    ]
+    report = evaluate_dataset(
+        _Engine(), items,
+        lambda *a, **k: 1.0,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=16),
+        tokenizer=tok, benchmark="grade_school_math",  # → default
+    )
+    assert report.rows[0]["answers"] == ["42"]
+    assert report.maj_at_k[1] == 1.0
+
+
+def test_clean_choice_behavior():
+    assert clean_choice("I pick (C).") == "C"
+    assert clean_choice("b") == "B"
+    assert clean_choice("no letters 42") == "no letters 42"
