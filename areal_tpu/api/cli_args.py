@@ -222,6 +222,11 @@ class InferenceEngineConfig:
     # tokens, so weight updates interleave at chunk boundaries even without
     # server-side aborts; 0 = request everything at once
     new_tokens_per_chunk: int = 0
+    # client-side request-lifecycle spans (submit→first-token→complete,
+    # weight-update pause windows)
+    tracing: "TracingConfig" = dataclasses.field(
+        default_factory=lambda: TracingConfig()
+    )
 
 
 @dataclasses.dataclass
@@ -282,6 +287,11 @@ class JaxGenConfig:
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85
     enable_metrics: bool = True
+    # engine-side request-lifecycle spans (queue-wait, prefill, decode,
+    # preemption, weight-update windows); drained over GET /trace
+    tracing: "TracingConfig" = dataclasses.field(
+        default_factory=lambda: TracingConfig()
+    )
     log_level: str = "info"
     host: str = "127.0.0.1"
     port: int = 0  # 0 = auto
@@ -312,12 +322,31 @@ class JaxGenConfig:
             args.append(f"--experiment-name={experiment_name}")
         if trial_name:
             args.append(f"--trial-name={trial_name}")
+        if config.tracing.enabled:
+            args.append("--trace")
         return args
 
 
 # --------------------------------------------------------------------------
 # Aux subsystems
 # --------------------------------------------------------------------------
+@dataclasses.dataclass
+class TracingConfig:
+    """Request-lifecycle span tracing (utils/tracing.py): per-rid spans
+    recorded by the inference engine / remote rollout controller, exported
+    as JSONL or Chrome trace-event JSON (Perfetto / chrome://tracing).
+    Disabled by default — the tracer is a strict no-op then (no per-token
+    allocations on the scheduler hot loop)."""
+
+    enabled: bool = False
+    # ring-buffer bound: oldest spans are dropped past this count, so a
+    # long-running server never grows without bound
+    max_spans: int = 100_000
+    # optional JSONL sink written by flush()/export helpers (empty = only
+    # in-memory draining via GET /trace or tracer.drain())
+    export_path: str = ""
+
+
 @dataclasses.dataclass
 class ProfilingConfig:
     """jax-profiler trace capture for selected steps (reference

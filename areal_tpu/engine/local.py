@@ -26,6 +26,7 @@ from areal_tpu.api.io_struct import (
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils import stats_tracker
 
 logger = logging_util.getLogger("LocalSyncInferenceEngine")
 
@@ -125,6 +126,19 @@ class LocalSyncInferenceEngine(InferenceEngine):
             stop_reason = result["meta_info"]["finish_reason"]["type"]
             if stop_reason == "abort":
                 await asyncio.sleep(self.config.pause_grace_period or 0.05)
+        if versions:
+            # generation-time staleness vs the trainer (same keys as the
+            # remote engine so dashboards don't care about deployment mode)
+            trainer_v = self.get_version()
+            lags = [trainer_v - v for v in versions]
+            now = time.monotonic()
+            stats_tracker.scalar(**{
+                "rollout/staleness_lag_mean": sum(lags) / len(lags),
+                "rollout/staleness_lag_max": float(max(lags)),
+                "rollout/ttft_s": ttft if ttft is not None else now - start,
+                "rollout/latency_s": now - start,
+                "rollout/output_tokens": float(len(accumulated)),
+            })
         return ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,
@@ -139,6 +153,7 @@ class LocalSyncInferenceEngine(InferenceEngine):
     def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
         """DEVICE path: hand the trainer's live params to the generator —
         the ICI/HBM analog of the reference's NCCL broadcast."""
+        t_pause = time.monotonic()
         self.engine.pause()
 
         def _do():
@@ -157,6 +172,9 @@ class LocalSyncInferenceEngine(InferenceEngine):
                 self.set_version(meta.model_version)
             finally:
                 self.engine.continue_generation()
+                stats_tracker.scalar(**{
+                    "rollout/pause_window_s": time.monotonic() - t_pause
+                })
 
         return self.executor.submit(_do)
 

@@ -143,7 +143,36 @@ class PPOActor:
             kl_reward=float(kl_rewards.sum(1).mean()),
             advantage=float((adv * loss_mask).sum() / max(loss_mask.sum(), 1)),
         )
+        self._record_staleness(data, loss_mask)
         return data
+
+    def _record_staleness(self, data: Batch, loss_mask: np.ndarray):
+        """Consumed-batch staleness histogram: how many weight versions
+        behind the trainer each token being trained on was generated
+        (the paper's η in practice — the distribution async rollout
+        actually delivered, not just the configured bound). Exported via
+        stats_tracker so StatsLogger.commit persists it per step."""
+        if "versions" not in data:
+            return
+        versions = np.asarray(data["versions"])
+        on = (loss_mask > 0) & (versions >= 0)
+        if not on.any():
+            return
+        lag = self.engine.get_version() - versions[on]
+        hist = {
+            f"staleness/lag{b}_frac": float((lag == b).mean())
+            for b in range(4)
+        }
+        hist["staleness/lag_ge4_frac"] = float((lag >= 4).mean())
+        stats_tracker.scalar(
+            **hist,
+            **{
+                "staleness/lag_mean": float(lag.mean()),
+                "staleness/lag_max": float(lag.max()),
+                "staleness/lag_min": float(lag.min()),
+                "staleness/n_tokens": float(lag.size),
+            },
+        )
 
     # ------------------------------------------------------------------
     def ppo_update(self, data: Batch) -> List[Dict[str, float]]:

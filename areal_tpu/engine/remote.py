@@ -67,7 +67,9 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
 from areal_tpu.utils import logging as logging_util, name_resolve, names
+from areal_tpu.utils import stats_tracker
 from areal_tpu.utils.http import arequest_with_retry
+from areal_tpu.utils.tracing import SpanTracer
 
 logger = logging_util.getLogger("RemoteInferenceEngine")
 
@@ -84,6 +86,10 @@ class RemoteInferenceEngine(InferenceEngine):
         self._lock = threading.Lock()
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
+        # client-side request lifecycle spans (submit → first-token →
+        # complete; weight-update pause windows) — no-op unless
+        # config.tracing.enabled
+        self.tracer = SpanTracer(getattr(config, "tracing", None))
         # one session PER event loop: a session is bound to its creating
         # loop, and this engine is legitimately driven from several (the
         # WorkflowExecutor's background loop + per-sweep asyncio.run loops
@@ -119,6 +125,7 @@ class RemoteInferenceEngine(InferenceEngine):
         if self.workflow_executor is not None:
             self.workflow_executor.destroy()
         self.executor.shutdown(wait=False)
+        self.tracer.flush()  # drain to TracingConfig.export_path if set
         for _, (lp, s) in list(self._sessions.items()):
             if s.closed:
                 continue
@@ -213,6 +220,8 @@ class RemoteInferenceEngine(InferenceEngine):
         versions: List[int] = []
         stop_reason = None
         ttft = None
+        n_calls = 0
+        n_aborts = 0
         chunk = self.config.new_tokens_per_chunk or 0
         while stop_reason not in ("stop", "length") and len(accumulated) < gconfig.max_new_tokens:
             server = self.choose_server(req.rid)
@@ -260,6 +269,7 @@ class RemoteInferenceEngine(InferenceEngine):
                     "stop_token_ids": gconfig.stop_token_ids,
                 }
             )
+            t_call = time.monotonic()
             result = await arequest_with_retry(
                 session,
                 f"http://{server}/generate",
@@ -267,6 +277,12 @@ class RemoteInferenceEngine(InferenceEngine):
                 max_retries=self.config.request_retries,
                 timeout=self.config.request_timeout,
             )
+            n_calls += 1
+            if self.tracer.enabled:
+                self.tracer.record(
+                    "generate_call", req.rid, t_call, time.monotonic(),
+                    server=server, new_tokens=len(result["output_ids"]),
+                )
             if ttft is None and result["output_ids"]:
                 ttft = time.monotonic() - start
             accumulated.extend(result["output_ids"])
@@ -285,9 +301,36 @@ class RemoteInferenceEngine(InferenceEngine):
             if stop_reason == "abort":
                 # server is in a weight-update window; brief backoff then
                 # resume with accumulated tokens
+                n_aborts += 1
                 await asyncio.sleep(self.config.pause_grace_period or 0.1)
         with self._lock:
             self._rid_to_address.pop(req.rid, None)
+        now = time.monotonic()
+        if self.tracer.enabled:
+            if ttft is not None:
+                self.tracer.record(
+                    "submit_to_first_token", req.rid, start, start + ttft,
+                )
+            self.tracer.record(
+                "rollout_request", req.rid, start, now,
+                output_tokens=len(accumulated),
+                stop_reason=stop_reason or "length",
+                n_calls=n_calls, n_aborts=n_aborts,
+            )
+        # generation-time staleness: how far each produced token already
+        # lags the trainer at COMPLETION time (the consumed-batch lag is
+        # measured again at train time, ppo/actor.compute_advantages)
+        if versions:
+            trainer_v = self.get_version()
+            lags = [trainer_v - v for v in versions]
+            stats_tracker.scalar(**{
+                "rollout/staleness_lag_mean": sum(lags) / len(lags),
+                "rollout/staleness_lag_max": float(max(lags)),
+                "rollout/ttft_s": ttft if ttft is not None else now - start,
+                "rollout/latency_s": now - start,
+                "rollout/output_tokens": float(len(accumulated)),
+                "rollout/aborts_per_request": float(n_aborts),
+            })
         return ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,
@@ -320,7 +363,18 @@ class RemoteInferenceEngine(InferenceEngine):
         # with `engine.upload_weights(meta)`, and streaming chunks into a
         # not-yet-paused server would swap weights mid-decode (round-2
         # advisor finding).
+        t_pause = time.monotonic()
         _pause_all()
+
+        def _record_pause_window():
+            # the full pause→transfer→resume window: rollout capacity the
+            # fleet lost to this weight update
+            dur = time.monotonic() - t_pause
+            self.tracer.record(
+                "weight_update_pause", "__controller__", t_pause,
+                t_pause + dur, model_version=meta.model_version,
+            )
+            stats_tracker.scalar(**{"rollout/pause_window_s": dur})
 
         if meta.type == WeightUpdateMethod.DEVICE:
 
@@ -358,6 +412,7 @@ class RemoteInferenceEngine(InferenceEngine):
                     self.set_version(meta.model_version)
                 finally:
                     self._resume_all_best_effort()
+                    _record_pause_window()
 
             return self.executor.submit(_do_device_update)
 
@@ -399,6 +454,7 @@ class RemoteInferenceEngine(InferenceEngine):
                 self.set_version(meta.model_version)
             finally:
                 self._resume_all_best_effort()
+                _record_pause_window()
 
         return self.executor.submit(_do_update)
 

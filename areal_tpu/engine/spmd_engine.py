@@ -541,6 +541,17 @@ class SPMDTrainEngine(TrainEngine):
             "pad_to": pad_to,
             "window": window,
         }
+        # same breakdown through the stats plane: StatsLogger.commit
+        # persists it per step alongside the rollout/staleness telemetry
+        from areal_tpu.utils import stats_tracker
+
+        stats_tracker.scalar(**{
+            "spmd/train_batch_s": t_end - t_start,
+            "spmd/pack_s": pack_s,
+            "spmd/grad_dispatch_s": float(sum(grad_call_s)),
+            "spmd/apply_fetch_s": t_end - t_apply,
+            "spmd/n_mbs": float(n_mb),
+        })
         return out
 
     def eval_batch(
@@ -799,11 +810,17 @@ class SPMDTrainEngine(TrainEngine):
         the AREAL_LLM_SERVER_ADDRS environment.
         """
         from areal_tpu.api.io_struct import WeightUpdateMethod
+        from areal_tpu.utils import stats_tracker
+
+        t_upload = time.perf_counter()
 
         if meta.type == WeightUpdateMethod.DISK:
             host = self._host_tree(self.params)  # collective: all ranks
             if jax.process_index() == 0:
                 hf_io.save_params(host, self.model_config, meta.path)
+            stats_tracker.scalar(**{
+                "spmd/upload_weights_s": time.perf_counter() - t_upload
+            })
             return
         import urllib.request
 
@@ -853,6 +870,9 @@ class SPMDTrainEngine(TrainEngine):
                 ]
                 for f in futs:
                     f.result()
+        stats_tracker.scalar(**{
+            "spmd/upload_weights_s": time.perf_counter() - t_upload
+        })
 
 
 def target_aligned_logprobs(

@@ -51,6 +51,7 @@ from areal_tpu.models.config import ModelConfig, load_hf_config
 from areal_tpu.models.transformer import Params
 from areal_tpu.utils import data as data_utils
 from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.tracing import SpanTracer
 
 logger = logging_util.getLogger("GenerationEngine")
 
@@ -394,6 +395,16 @@ class GenerationEngine:
         self.total_requests = 0
         self.total_aborted = 0
         self.total_preemptions = 0
+        # request-lifecycle spans (strict no-op unless config.tracing is
+        # enabled — the scheduler loop only ever pays an attribute read)
+        self.tracer = SpanTracer(getattr(config, "tracing", None))
+        # EWMA throughput gauges (tokens/s), updated by the loop thread
+        self._decode_tps = 0.0
+        self._prefill_tps = 0.0
+        self._last_decode_mark: Optional[float] = None
+        # pause-window bookkeeping: pause() stamps, continue_generation()
+        # records the span (the weight-update window the client sits out)
+        self._pause_start: Optional[float] = None
 
     def _place_params(self, params: Params) -> Params:
         """Host or device pytree → this engine's param placement."""
@@ -435,6 +446,9 @@ class GenerationEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # non-HTTP deployments: drain remaining spans to the configured
+        # JSONL sink (the server path drains via GET /trace instead)
+        self.tracer.flush()
 
     # ------------------------------------------------------------------
     # Public API (thread-safe)
@@ -468,12 +482,20 @@ class GenerationEngine:
     def pause(self):
         """Abort in-flight requests; stop admitting until continue."""
         done = Future()
+        if not self._paused.is_set():
+            self._pause_start = time.monotonic()
         self._paused.set()
         self._command_queue.put(("abort_all", None, done))
         done.result(timeout=60)
 
     def continue_generation(self):
         self._paused.clear()
+        t0, self._pause_start = self._pause_start, None
+        if t0 is not None:
+            self.tracer.record(
+                "pause_window", "__engine__", t0, time.monotonic(),
+                model_version=self.model_version,
+            )
 
     def update_weights_from_disk(self, path: str, version: Optional[int] = None):
         done = Future()
@@ -498,12 +520,19 @@ class GenerationEngine:
         return done.result(timeout=600)
 
     def metrics(self) -> Dict[str, float]:
+        num_pages = max(1, self.cache_config.num_pages)
         return dict(
             running_requests=len(self._active),
             queued_requests=self._admit_queue.qsize() + len(self._pending),
             free_slots=len(self._free_slots),
             free_pages=self.pm.n_free,
+            # fraction of the pool holding live KV (active slots + parked
+            # prefix-registry pages + the reserved trash page)
+            kv_page_utilization=1.0 - self.pm.n_free / num_pages,
             registry_entries=len(self.registry),
+            # EWMA throughput over recent dispatches (0 while idle-fresh)
+            decode_tokens_per_sec=round(self._decode_tps, 2),
+            prefill_tokens_per_sec=round(self._prefill_tps, 2),
             total_generated_tokens=self.total_generated_tokens,
             total_prompt_tokens=self.total_prompt_tokens,
             total_cached_prompt_tokens=self.total_cached_prompt_tokens,
@@ -512,6 +541,7 @@ class GenerationEngine:
             total_preemptions=self.total_preemptions,
             model_version=self.model_version,
             paused=float(self._paused.is_set()),
+            trace_spans=len(self.tracer) if self.tracer.enabled else 0,
         )
 
     # ------------------------------------------------------------------
@@ -524,6 +554,10 @@ class GenerationEngine:
                 did_work |= self._admit()
                 did_work |= self._decode()
             if not did_work:
+                # idle/pause gap: the decode-rate EWMA must not absorb it
+                # (the next chunk's dt would span the whole quiet period
+                # and crater the gauge)
+                self._last_decode_mark = None
                 time.sleep(0.001)
 
     def _drain_commands(self) -> bool:
@@ -534,6 +568,7 @@ class GenerationEngine:
             except queue.Empty:
                 return did
             did = True
+            t_cmd = time.monotonic()
             try:
                 # every command needs a quiesced device pipeline: aborts
                 # must not race in-flight chunks, and weight swaps would
@@ -611,6 +646,12 @@ class GenerationEngine:
                     done.set_result(self.model_version)
                 else:  # pragma: no cover
                     done.set_exception(ValueError(f"unknown command {cmd}"))
+                if cmd.startswith("update_weights"):
+                    self.tracer.record(
+                        "weight_update", "__engine__", t_cmd,
+                        time.monotonic(), cmd=cmd,
+                        model_version=self.model_version,
+                    )
             except Exception as e:  # surface errors to the caller
                 done.set_exception(e)
 
@@ -639,6 +680,9 @@ class GenerationEngine:
         req.slot = None
         req.preemptions += 1
         self.total_preemptions += 1
+        self.tracer.instant(
+            "preempt", req.rid, tokens_in=len(req.output_ids),
+        )
         self._pending.insert(0, req)
         logger.info(
             f"preempted {req.rid} ({len(req.output_ids)} tokens in) — "
@@ -900,6 +944,7 @@ class GenerationEngine:
                 jnp.asarray(pw), jnp.asarray(ords),
             )
             pf_pos3 = jnp.asarray(pos3)
+        t_pf_start = time.monotonic()
         self.cache, wave_logits, pf_last = model_runner.prefill_batch(
             self.params, self.model_config, self.cache,
             jnp.asarray(tokens), jnp.asarray(row_offsets),
@@ -1032,6 +1077,31 @@ class GenerationEngine:
             wave_logits.dtype,
         ).at[sl].set(wave_logits[rows])
         self._sample_and_append(full, only_slots=[int(x) for x in slots_np])
+        t_pf_end = time.monotonic()
+        pf_tokens = int(true_lens.sum())
+        if t_pf_end > t_pf_start:
+            # EWMA over waves: the dispatch wall time includes the logits
+            # fetch in _sample_and_append, so this is end-to-end prefill
+            # throughput as a client would see it
+            inst = pf_tokens / (t_pf_end - t_pf_start)
+            self._prefill_tps = (
+                inst if self._prefill_tps == 0.0
+                else 0.8 * self._prefill_tps + 0.2 * inst
+            )
+        if self.tracer.enabled:
+            for req, slot, row in admitted:
+                self.tracer.record(
+                    "queue_wait", req.rid, req.submit_time, t_pf_start,
+                    preemptions=req.preemptions,
+                )
+                self.tracer.record(
+                    "prefill", req.rid, t_pf_start, t_pf_end,
+                    slot=slot, wave_rows=len(rep_slots),
+                    # _sample_and_append already appended this wave's first
+                    # token, so the prefilled length is one shy of all_tokens
+                    prompt_tokens=len(req.all_tokens) - 1,
+                    cached_offset=int(offsets[row]),
+                )
         return True
 
     def _install(
@@ -1206,6 +1276,16 @@ class GenerationEngine:
         h_emitted = packed[2 * n : 3 * n].reshape(steps, s) > 0.5
         h_active = packed[3 * n : 3 * n + s] > 0.5
         now = time.monotonic()
+        n_emitted = int(h_emitted.sum())
+        if self._last_decode_mark is not None and n_emitted:
+            dt = now - self._last_decode_mark
+            if dt > 0:
+                inst = n_emitted / dt
+                self._decode_tps = (
+                    inst if self._decode_tps == 0.0
+                    else 0.8 * self._decode_tps + 0.2 * inst
+                )
+        self._last_decode_mark = now
         for slot, req in chunk["reqs"].items():
             if self._active.get(slot) is not req:
                 continue  # finished/preempted since dispatch
@@ -1295,6 +1375,20 @@ class GenerationEngine:
             ),
         )
         now = time.monotonic()
+        if self.tracer.enabled:
+            # decode covers first-token → finish; request is the full
+            # submit → finish lifecycle (what a client timeline wants)
+            self.tracer.record(
+                "decode", req.rid, req.first_token_time or now, now,
+                completion_tokens=len(req.output_ids), reason=reason,
+                preemptions=req.preemptions,
+            )
+            self.tracer.record(
+                "request", req.rid, req.submit_time, now,
+                prompt_tokens=len(req.input_ids),
+                completion_tokens=len(req.output_ids), reason=reason,
+                model_version=self.model_version,
+            )
         result = {
             "output_ids": req.output_ids,
             "output_logprobs": req.output_logprobs,

@@ -60,10 +60,16 @@ class RouterState:
         self._qid_server: Dict[str, str] = {}
         self._requests: Dict[str, int] = {a: 0 for a in addresses}
         self._tokens: Dict[str, float] = {a: 0.0 for a in addresses}
+        # rid/qid-affinity effectiveness: hits land a request back on the
+        # server holding its cached KV (the whole point of affinity) —
+        # the hit RATE is the sibling-dedup health signal on /metrics
+        self.sched_total = 0
+        self.sched_affinity_hits = 0
 
     # -- scheduling ----------------------------------------------------
     def schedule(self, meta: Dict) -> Dict:
         with self.lock:
+            self.sched_total += 1
             qid = str(meta.get("qid") or meta.get("rid") or "")
             prev = meta.get("previous_server")
             if (
@@ -72,9 +78,11 @@ class RouterState:
             ):
                 # sticky while the version is unchanged (interruptible
                 # resubmits reuse the server's cached prefix)
+                self.sched_affinity_hits += 1
                 return {"url": prev, "version": self.version}
             if qid and qid in self._qid_server:
                 addr = self._qid_server[qid]
+                self.sched_affinity_hits += 1
                 return {"url": addr, "version": self.version}
             if self.schedule_policy == "round_robin":
                 addr = self.addresses[self._rr % len(self.addresses)]
@@ -145,15 +153,32 @@ class RouterState:
         return {"success": True, "version": version, "servers": results}
 
     def metrics(self) -> str:
-        lines = []
+        from areal_tpu.utils.tracing import render_prometheus
+
         with self.lock:
-            lines += [
-                f"areal_tpu_router_version {self.version}",
-                f"areal_tpu_router_running {self.running}",
-                f"areal_tpu_router_accepted {self.accepted}",
-                f"areal_tpu_router_finished {self.finished}",
-                f"areal_tpu_router_servers {len(self.addresses)}",
-            ]
+            own = {
+                "version": self.version,
+                "running": self.running,
+                "accepted": self.accepted,
+                "finished": self.finished,
+                "servers": len(self.addresses),
+                "sched_total": self.sched_total,
+                "sched_affinity_hits": self.sched_affinity_hits,
+                "affinity_hit_rate": (
+                    self.sched_affinity_hits / self.sched_total
+                    if self.sched_total
+                    else 0.0
+                ),
+            }
+        lines = [
+            render_prometheus(
+                own, prefix="areal_tpu_router_",
+                types={
+                    "sched_total": "counter",
+                    "sched_affinity_hits": "counter",
+                },
+            ).rstrip("\n")
+        ]
         for addr in self.addresses:
             try:
                 req = urllib.request.Request(f"http://{addr}/metrics")
@@ -161,6 +186,8 @@ class RouterState:
                     body = r.read().decode()
                 tag = addr.replace(":", "_").replace(".", "_")
                 for line in body.strip().split("\n"):
+                    if not line or line.startswith("#"):
+                        continue  # per-server HELP/TYPE preambles
                     k, v = line.rsplit(" ", 1)
                     lines.append(f'{k}{{server="{tag}"}} {v}')
             except Exception as e:

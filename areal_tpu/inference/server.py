@@ -8,11 +8,17 @@ from areal/engine/sglang_remote.py + realhf/system/gserver_manager.py usage):
 Stdlib ThreadingHTTPServer (fastapi is intentionally not a dependency): one
 thread per in-flight request, each blocking on its engine Future; the device
 work all happens on the engine's single loop thread.
+
+Observability endpoints: ``GET /metrics`` serves the engine gauges and
+counters in Prometheus text-exposition format; ``GET /trace`` DRAINS the
+engine's span buffer as Chrome trace-event JSON (``?format=jsonl`` for the
+line format `tools/trace_report.py` consumes).
 """
 
 import argparse
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -20,8 +26,20 @@ from areal_tpu.api.cli_args import JaxGenConfig
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.utils import logging as logging_util, names, network
 from areal_tpu.utils import name_resolve
+from areal_tpu.utils.tracing import render_prometheus
 
 logger = logging_util.getLogger("GenServer")
+
+_METRIC_HELP = {
+    "running_requests": "requests currently holding a decode slot",
+    "queued_requests": "requests admitted but not yet running",
+    "kv_page_utilization": "fraction of the paged KV pool in use",
+    "decode_tokens_per_sec": "EWMA decode throughput",
+    "prefill_tokens_per_sec": "EWMA prefill throughput",
+    "total_preemptions": "requests preempted under pool pressure",
+    "model_version": "weight version currently being served",
+    "paused": "1 while generation is paused for a weight update",
+}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -45,11 +63,19 @@ class _Handler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length))
 
+    def _send_text(self, body: bytes, content_type: str):
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         eng = self.engine
-        if self.path == "/health":
+        url = urllib.parse.urlparse(self.path)
+        if url.path == "/health":
             self._send_json({"status": "ok"})
-        elif self.path == "/get_model_info":
+        elif url.path == "/get_model_info":
             self._send_json(
                 {
                     "model_version": eng.model_version,
@@ -57,17 +83,24 @@ class _Handler(BaseHTTPRequestHandler):
                     "max_model_len": eng.config.max_model_len,
                 }
             )
-        elif self.path == "/metrics":
-            m = eng.metrics()
-            lines = [
-                f"areal_tpu_gen_{k} {v}" for k, v in sorted(m.items())
-            ]
-            body = ("\n".join(lines) + "\n").encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        elif url.path == "/metrics":
+            body = render_prometheus(
+                eng.metrics(), prefix="areal_tpu_gen_",
+                help_text=_METRIC_HELP,
+            ).encode()
+            self._send_text(body, "text/plain; version=0.0.4")
+        elif url.path == "/trace":
+            # drains the engine's span buffer: a scraper polling /trace
+            # assembles the full timeline without unbounded server memory
+            q = urllib.parse.parse_qs(url.query)
+            spans = eng.tracer.drain()
+            if q.get("format", [""])[0] == "jsonl":
+                body = "".join(
+                    json.dumps(s.to_dict()) + "\n" for s in spans
+                ).encode()
+                self._send_text(body, "application/jsonl")
+            else:
+                self._send_json(eng.tracer.to_chrome_trace(spans))
         else:
             self._send_json({"error": f"unknown path {self.path}"}, 404)
 
@@ -148,6 +181,10 @@ def main(argv: Optional[list] = None):
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
     p.add_argument("--server-index", type=int, default=0)
+    p.add_argument(
+        "--trace", action="store_true",
+        help="record request-lifecycle spans (drain via GET /trace)",
+    )
     args = p.parse_args(argv)
     cfg = JaxGenConfig(
         model_path=args.model_path,
@@ -159,6 +196,7 @@ def main(argv: Optional[list] = None):
         host=args.host,
         port=args.port,
     )
+    cfg.tracing.enabled = args.trace
     engine = GenerationEngine(cfg).start()
     serve(
         engine,

@@ -19,6 +19,7 @@ AREAL_PROFILE_STEPS env ("3,4").
 """
 
 import contextlib
+import dataclasses
 import os
 from typing import Optional
 
@@ -40,7 +41,12 @@ class PhaseProfiler:
         env_steps = os.environ.get("AREAL_PROFILE_STEPS", "")
         if env_steps:
             try:
-                self.config = ProfilingConfig(
+                # MERGE the override into the existing config: rebuilding
+                # from scratch would silently drop every other field the
+                # YAML set (only enabled/steps belong to the env escape
+                # hatch)
+                self.config = dataclasses.replace(
+                    self.config,
                     enabled=True,
                     steps=[int(s) for s in env_steps.split(",") if s],
                 )

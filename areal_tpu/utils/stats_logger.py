@@ -7,6 +7,7 @@ are not available in this environment, so the durable sink is a JSONL file
 """
 
 import json
+import math
 import os
 import time
 from typing import Dict, Optional
@@ -14,6 +15,15 @@ from typing import Dict, Optional
 from areal_tpu.utils import logging
 
 logger = logging.getLogger("stats")
+
+
+def _json_safe(v) -> Optional[float]:
+    """Non-finite floats become null: json.dumps would otherwise emit the
+    bare ``NaN``/``Infinity`` tokens, which are NOT JSON — any strict
+    downstream parser (jq, pandas read_json, the bench tooling) dies on
+    the whole line."""
+    f = float(v)
+    return f if math.isfinite(f) else None
 
 
 class StatsLogger:
@@ -64,8 +74,10 @@ class StatsLogger:
 
     def commit(self, epoch: int, step: int, global_step: int, data: Dict[str, float]):
         record = dict(epoch=epoch, step=step, global_step=global_step, time=time.time() - self._start)
-        record.update({k: float(v) for k, v in data.items()})
-        self._jsonl.write(json.dumps(record) + "\n")
+        record.update({k: _json_safe(v) for k, v in data.items()})
+        # allow_nan=False: if a non-finite value ever sneaks past the
+        # sanitizer, fail HERE, not in every downstream parser
+        self._jsonl.write(json.dumps(record, allow_nan=False) + "\n")
         self._jsonl.flush()
         if self._tb is not None:
             for k, v in data.items():

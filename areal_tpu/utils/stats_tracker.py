@@ -32,6 +32,14 @@ class ReduceType(enum.Enum):
     SCALAR = "scalar"
 
 
+# per-key scalar bound: producers (rollout engines) record continuously,
+# but eval-only/bench runs may never export — without a cap the lists grow
+# for the life of the process. Train loops export every step, far below
+# this; past the cap the key collapses to its running mean (approximate,
+# but the alternative today is unbounded growth that nobody reads anyway).
+_MAX_SCALARS_PER_KEY = 65536
+
+
 def _to_np(x) -> np.ndarray:
     return np.asarray(x)
 
@@ -40,24 +48,36 @@ class DistributedStatsTracker:
     def __init__(self, name: str = ""):
         self._name = name
         self._lock = threading.Lock()
-        self._scope: List[str] = []
+        # THREAD-LOCAL scope stack: concurrent recorders (rollout threads,
+        # the train loop) each nest their own scopes — a shared list would
+        # interleave scope names into other threads' keys
+        self._tls = threading.local()
         self._denominators: Dict[str, List[np.ndarray]] = defaultdict(list)
         self._denom_of: Dict[str, str] = {}
         self._stats: Dict[str, List[np.ndarray]] = defaultdict(list)
         self._reduce_types: Dict[str, ReduceType] = {}
         self._scalars: Dict[str, List[float]] = defaultdict(list)
 
+    def _scope_stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
     def _key(self, key: str) -> str:
-        parts = [p for p in ([self._name] + self._scope + [key]) if p]
+        parts = [
+            p for p in ([self._name] + self._scope_stack() + [key]) if p
+        ]
         return "/".join(parts)
 
     @contextlib.contextmanager
     def scope(self, name: str):
-        self._scope.append(name)
+        stack = self._scope_stack()
+        stack.append(name)
         try:
             yield
         finally:
-            self._scope.pop()
+            stack.pop()
 
     @contextlib.contextmanager
     def record_timing(self, key: str):
@@ -83,7 +103,10 @@ class DistributedStatsTracker:
             for key, value in kwargs.items():
                 full = self._key(key)
                 self._reduce_types[full] = ReduceType.SCALAR
-                self._scalars[full].append(float(value))
+                vals = self._scalars[full]
+                if len(vals) >= _MAX_SCALARS_PER_KEY:
+                    self._scalars[full] = vals = [float(np.mean(vals))]
+                vals.append(float(value))
 
     def stat(
         self,

@@ -1,0 +1,265 @@
+"""Request-lifecycle span tracing + Prometheus text rendering.
+
+The observability core of the async-RL plane (role of the request-event
+logs Laminar/ROLL-Flash build their analyses on): a thread-safe,
+bounded-memory span recorder keyed by request id. Producers are the
+inference engine scheduler loop (queue-wait / prefill / decode /
+preemption / weight-update windows), the remote rollout controller
+(submit→first-token→complete, pause windows), and anything else that
+wants onto the same timeline.
+
+Design constraints, in order:
+
+1. **Disabled must be free.** The scheduler loop calls into the tracer
+   per admission wave and per finished request; `bench.py` showed the
+   loop is host-bound at high slot counts. So `span()` on a disabled
+   tracer returns a cached singleton (no generator, no Span allocation)
+   and `record()` returns before touching the lock.
+2. **Bounded memory.** Spans live in a `deque(maxlen=max_spans)`; a
+   long-running server drops the oldest and counts them (`dropped`).
+3. **Two export formats.** JSONL (one span per line — what
+   `tools/trace_report.py` consumes) and Chrome trace-event JSON
+   (loadable in Perfetto / chrome://tracing: one `ph:"X"` complete event
+   per span, rows grouped per rid via stable tids).
+
+Span times are `time.monotonic()` seconds; exports convert to the
+microseconds the trace-event format wants.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from areal_tpu.api.cli_args import TracingConfig
+
+
+class Span:
+    __slots__ = ("name", "rid", "t_start", "t_end", "attrs")
+
+    def __init__(
+        self,
+        name: str,
+        rid: str,
+        t_start: float,
+        t_end: float,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.rid = rid
+        self.t_start = t_start
+        self.t_end = t_end
+        self.attrs = attrs or {}
+
+    @property
+    def duration(self) -> float:
+        return self.t_end - self.t_start
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "rid": self.rid,
+            "ts": self.t_start,
+            "dur": self.duration,
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+    def __repr__(self):  # pragma: no cover
+        return (
+            f"Span({self.name!r}, rid={self.rid!r}, "
+            f"dur={self.duration * 1e3:.2f}ms)"
+        )
+
+
+class _NullSpanCtx:
+    """Shared do-nothing context manager for the disabled path — one
+    module-level instance, so `with tracer.span(...):` on the hot loop
+    allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullSpanCtx()
+
+
+class _LiveSpanCtx:
+    __slots__ = ("_tracer", "_name", "_rid", "_attrs", "_t0")
+
+    def __init__(self, tracer, name, rid, attrs):
+        self._tracer = tracer
+        self._name = name
+        self._rid = rid
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.record(
+            self._name, self._rid, self._t0, time.monotonic(),
+            **self._attrs,
+        )
+        return False
+
+
+class SpanTracer:
+    """Thread-safe bounded span recorder; strict no-op when disabled."""
+
+    def __init__(self, config: Optional[TracingConfig] = None):
+        self.config = config or TracingConfig()
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(
+            maxlen=max(1, self.config.max_spans)
+        )
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    # ------------------------------------------------------------------
+    # Recording
+    # ------------------------------------------------------------------
+    def record(
+        self, name: str, rid: str, t_start: float, t_end: float, **attrs
+    ) -> None:
+        """Append one finished span (times are time.monotonic seconds)."""
+        if not self.config.enabled:
+            return
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(Span(name, rid, t_start, t_end, attrs))
+
+    def instant(self, name: str, rid: str, **attrs) -> None:
+        """Zero-duration event (e.g. a preemption)."""
+        now = time.monotonic()
+        self.record(name, rid, now, now, **attrs)
+
+    def span(self, name: str, rid: str, **attrs):
+        """Context manager measuring its body. Disabled: returns a shared
+        null object — callers on hot paths pay one attribute read."""
+        if not self.config.enabled:
+            return _NULL_CTX
+        return _LiveSpanCtx(self, name, rid, attrs)
+
+    # ------------------------------------------------------------------
+    # Access / export
+    # ------------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def drain(self) -> List[Span]:
+        """Return all spans and clear the buffer (GET /trace semantics)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+            return out
+
+    def to_chrome_trace(
+        self, spans: Optional[Iterable[Span]] = None
+    ) -> Dict[str, Any]:
+        """Chrome trace-event JSON: every span is a complete ("X") event;
+        rids map to stable tids so Perfetto renders one row per request."""
+        if spans is None:
+            spans = self.snapshot()
+        tids: Dict[str, int] = {}
+        events = []
+        for s in spans:
+            tid = tids.setdefault(s.rid, len(tids) + 1)
+            events.append(
+                {
+                    "name": s.name,
+                    "cat": "areal_tpu",
+                    "ph": "X",
+                    "ts": s.t_start * 1e6,
+                    "dur": max(0.0, s.duration) * 1e6,
+                    "pid": 0,
+                    "tid": tid,
+                    "args": {"rid": s.rid, **s.attrs},
+                }
+            )
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": tid,
+                "args": {"name": rid},
+            }
+            for rid, tid in tids.items()
+        ]
+        return {"traceEvents": events + meta, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str, drain: bool = False) -> None:
+        spans = self.drain() if drain else self.snapshot()
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(spans), f)
+
+    def export_jsonl(self, path: str, drain: bool = False) -> None:
+        spans = self.drain() if drain else self.snapshot()
+        with open(path, "a") as f:
+            for s in spans:
+                f.write(json.dumps(s.to_dict()) + "\n")
+
+    def flush(self) -> None:
+        """Drain to config.export_path (JSONL) when one is set — owners
+        call this on shutdown so non-HTTP deployments still get a trace
+        file without polling /trace."""
+        if self.config.export_path:
+            self.export_jsonl(self.config.export_path, drain=True)
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition
+# --------------------------------------------------------------------------
+def _prom_type(name: str, types: Optional[Dict[str, str]]) -> str:
+    if types and name in types:
+        return types[name]
+    # monotonically increasing engine totals are counters; everything else
+    # is a point-in-time gauge
+    return "counter" if name.startswith("total_") else "gauge"
+
+
+def render_prometheus(
+    metrics: Dict[str, float],
+    prefix: str = "",
+    types: Optional[Dict[str, str]] = None,
+    help_text: Optional[Dict[str, str]] = None,
+) -> str:
+    """Render a flat metric dict in Prometheus text-exposition format
+    (# HELP / # TYPE preamble per metric, sorted by name)."""
+    lines: List[str] = []
+    for name in sorted(metrics):
+        full = f"{prefix}{name}"
+        if help_text and name in help_text:
+            lines.append(f"# HELP {full} {help_text[name]}")
+        lines.append(f"# TYPE {full} {_prom_type(name, types)}")
+        v = float(metrics[name])
+        # prometheus value spellings: NaN/+Inf/-Inf, integers without the
+        # trailing .0 noise
+        if v != v:
+            sv = "NaN"
+        elif v in (float("inf"), float("-inf")):
+            sv = "+Inf" if v > 0 else "-Inf"
+        elif v == int(v):
+            sv = str(int(v))
+        else:
+            sv = str(v)
+        lines.append(f"{full} {sv}")
+    return "\n".join(lines) + "\n"

@@ -357,6 +357,20 @@ def main():
             "warmup_compiles": warm_compiles["count"],
             "warmup_compile_s": round(warm_compiles["secs"], 1),
             "per_step": serial_steps,
+            # engine observability gauges at end of the serial phase (the
+            # same numbers GET /metrics exports in production)
+            "engine_metrics": {
+                k: gen_after[k]
+                for k in (
+                    "kv_page_utilization",
+                    "decode_tokens_per_sec",
+                    "prefill_tokens_per_sec",
+                    "total_preemptions",
+                    "total_cached_prompt_tokens",
+                    "model_version",
+                )
+                if k in gen_after
+            },
         },
     )
 

@@ -1,0 +1,254 @@
+"""End-to-end observability plane: engine request-lifecycle spans
+(queue-wait / prefill / decode / pause windows for a known rid), Chrome
+trace-event export, the server's Prometheus /metrics and /trace drain
+endpoints, the hot-loop no-op guard when tracing is off, and the
+consumed-batch staleness histogram landing in StatsLogger JSONL."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.cli_args import JaxGenConfig, TracingConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.server import serve
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+from areal_tpu.utils import tracing as tracing_util
+
+
+@pytest.fixture(scope="module")
+def traced_engine():
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gcfg = JaxGenConfig(
+        dtype="float32", max_num_seqs=4, max_model_len=64,
+        prefill_chunk=16,
+        tracing=TracingConfig(enabled=True, max_spans=10_000),
+    )
+    eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+    httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    yield eng, addr, cfg, params
+    httpd.shutdown()
+    eng.stop()
+
+
+def _generate(eng, rid, max_new=4):
+    return eng.generate(
+        {
+            "rid": rid,
+            "input_ids": [1, 2, 3, 4, 5],
+            "sampling_params": {"max_new_tokens": max_new},
+        }
+    )
+
+
+class TestEngineSpans:
+    def test_request_lifecycle_spans_for_known_rid(
+        self, traced_engine, tmp_path
+    ):
+        eng, _, _, params = traced_engine
+        eng.tracer.drain()  # isolate this test's timeline
+        out = _generate(eng, "rid-lifecycle", max_new=4)
+        assert len(out["output_ids"]) == 4
+        # weight-update window: pause → swap (device path) → continue
+        eng.pause()
+        eng.update_weights_from_tensors(params, version=1)
+        eng.continue_generation()
+
+        spans = eng.tracer.snapshot()
+        by_rid = {}
+        for s in spans:
+            by_rid.setdefault(s.rid, []).append(s.name)
+        assert {"queue_wait", "prefill", "decode", "request"} <= set(
+            by_rid["rid-lifecycle"]
+        )
+        assert "weight_update" in by_rid.get("__engine__", [])
+        assert "pause_window" in by_rid.get("__engine__", [])
+        # span ordering within the request lifecycle
+        named = {
+            s.name: s for s in spans if s.rid == "rid-lifecycle"
+        }
+        assert named["queue_wait"].t_end <= named["prefill"].t_start + 1e-6
+        assert named["prefill"].t_start <= named["decode"].t_start
+        assert named["request"].t_start <= named["queue_wait"].t_start + 1e-6
+        assert named["request"].attrs["completion_tokens"] == 4
+        assert named["prefill"].attrs["prompt_tokens"] == 5
+
+        # exported Chrome trace validates against the trace-event schema
+        path = str(tmp_path / "rollout_trace.json")
+        eng.tracer.export_chrome(path)
+        doc = json.load(open(path))
+        xevents = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xevents, "trace must contain complete events"
+        for e in xevents:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+            assert e["dur"] >= 0
+        names = {e["name"] for e in xevents}
+        assert {"queue_wait", "prefill", "decode", "pause_window"} <= names
+        eng.model_version = 0  # reset for fixture reuse
+
+    def test_throughput_and_utilization_gauges(self, traced_engine):
+        eng, _, _, _ = traced_engine
+        _generate(eng, "rid-gauges", max_new=8)
+        m = eng.metrics()
+        assert 0.0 <= m["kv_page_utilization"] <= 1.0
+        assert m["prefill_tokens_per_sec"] > 0
+        assert m["decode_tokens_per_sec"] >= 0
+        assert m["total_generated_tokens"] >= 8
+
+
+class TestServerEndpoints:
+    def test_metrics_prometheus_format(self, traced_engine):
+        eng, addr, _, _ = traced_engine
+        _generate(eng, "rid-metrics", max_new=2)
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=30
+        ) as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert "# TYPE areal_tpu_gen_running_requests gauge" in text
+        assert "# TYPE areal_tpu_gen_total_requests counter" in text
+        assert "# HELP areal_tpu_gen_kv_page_utilization" in text
+        for required in (
+            "areal_tpu_gen_running_requests",
+            "areal_tpu_gen_queued_requests",
+            "areal_tpu_gen_kv_page_utilization",
+            "areal_tpu_gen_decode_tokens_per_sec",
+            "areal_tpu_gen_prefill_tokens_per_sec",
+            "areal_tpu_gen_total_preemptions",
+            "areal_tpu_gen_model_version",
+        ):
+            assert any(
+                line.startswith(required + " ")
+                for line in text.splitlines()
+            ), f"missing sample line for {required}"
+
+    def test_trace_endpoint_drains(self, traced_engine):
+        eng, addr, _, _ = traced_engine
+        eng.tracer.drain()
+        _generate(eng, "rid-http-trace", max_new=2)
+        with urllib.request.urlopen(
+            f"http://{addr}/trace", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        rids = {
+            e["args"]["rid"]
+            for e in doc["traceEvents"]
+            if e["ph"] == "X"
+        }
+        assert "rid-http-trace" in rids
+        # the endpoint DRAINS: a second scrape starts empty
+        with urllib.request.urlopen(
+            f"http://{addr}/trace", timeout=30
+        ) as r:
+            doc2 = json.loads(r.read())
+        assert [
+            e for e in doc2["traceEvents"] if e["ph"] == "X"
+        ] == []
+
+    def test_trace_endpoint_jsonl(self, traced_engine):
+        eng, addr, _, _ = traced_engine
+        _generate(eng, "rid-jsonl", max_new=2)
+        with urllib.request.urlopen(
+            f"http://{addr}/trace?format=jsonl", timeout=30
+        ) as r:
+            lines = [
+                json.loads(x)
+                for x in r.read().decode().splitlines()
+                if x.strip()
+            ]
+        assert any(s["rid"] == "rid-jsonl" for s in lines)
+        assert all({"name", "rid", "ts", "dur"} <= set(s) for s in lines)
+
+
+class TestDisabledNoOp:
+    @pytest.fixture(scope="class")
+    def plain_engine(self):
+        cfg = tiny_config("qwen2")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+            ),
+            model_config=cfg, params=params,
+        ).start()
+        yield eng
+        eng.stop()
+
+    def test_no_spans_no_allocations(self, plain_engine):
+        eng = plain_engine
+        assert not eng.tracer.enabled
+        _generate(eng, "rid-off", max_new=4)
+        # nothing recorded anywhere on the scheduler path
+        assert len(eng.tracer) == 0
+        assert eng.metrics()["trace_spans"] == 0
+        # the hot-loop guard: span() hands back the module singleton, so
+        # per-token/per-chunk call sites allocate nothing
+        assert (
+            eng.tracer.span("decode", "r")
+            is tracing_util._NULL_CTX
+        )
+
+
+class TestStalenessInStatsLogger:
+    def test_histogram_lands_in_jsonl(self, tmp_path):
+        from areal_tpu.api.cli_args import PPOActorConfig
+        from areal_tpu.engine.ppo.actor import PPOActor
+        from areal_tpu.utils import stats_tracker
+        from areal_tpu.utils.stats_logger import StatsLogger
+
+        class _Trainer:  # only get_version is consulted
+            def get_version(self):
+                return 3
+
+        actor = PPOActor(PPOActorConfig(), _Trainer())
+        B, L, plen = 4, 12, 4
+        olen = L - plen
+        versions = np.full((B, L), -1, np.int32)
+        # consumed tokens generated at versions 3,3,2,1 → lags 0,0,1,2
+        for i, v in enumerate([3, 3, 2, 1]):
+            versions[i, plen:] = v
+        batch = {
+            "input_ids": np.ones((B, L), np.int32),
+            "attention_mask": np.ones((B, L), np.bool_),
+            "loss_mask": np.asarray(
+                [[0] * plen + [1] * olen] * B, np.int32
+            ),
+            "logprobs": np.zeros((B, L), np.float32),
+            "versions": versions,
+            "rewards": np.asarray([1.0, 0.0, 1.0, 0.0], np.float32),
+        }
+        stats_tracker.export_all()  # clear anything other tests left
+        actor.compute_advantages(dict(batch))
+        stats = stats_tracker.export_all()
+        assert stats["staleness/lag0_frac"] == pytest.approx(0.5)
+        assert stats["staleness/lag1_frac"] == pytest.approx(0.25)
+        assert stats["staleness/lag2_frac"] == pytest.approx(0.25)
+        assert stats["staleness/lag_mean"] == pytest.approx(0.75)
+        assert stats["staleness/lag_max"] == 2.0
+        assert stats["staleness/n_tokens"] == B * olen
+
+        # ...and a train-step commit persists it as parseable JSONL
+        slog = StatsLogger("obs", "t0", str(tmp_path))
+        slog.commit(0, 0, 0, stats)
+        slog.close()
+        line = open(
+            os.path.join(str(tmp_path), "obs", "t0", "stats.jsonl")
+        ).read().strip()
+        rec = json.loads(line)
+        assert rec["staleness/lag_mean"] == pytest.approx(0.75)
+        assert {
+            "staleness/lag0_frac", "staleness/lag1_frac",
+            "staleness/lag_ge4_frac", "staleness/lag_max",
+        } <= set(rec)

@@ -152,5 +152,26 @@ def test_metrics_aggregation(fleet):
     with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
         text = r.read().decode()
     assert "areal_tpu_router_version" in text
+    # router-level metrics carry the Prometheus TYPE preamble
+    assert "# TYPE areal_tpu_router_version gauge" in text
+    assert "# TYPE areal_tpu_router_sched_total counter" in text
     # one scraped line per server, tagged
     assert text.count('areal_tpu_gen_model_version{server="') == 3
+
+
+def test_affinity_hit_rate_metric(fleet):
+    servers, router, addr = fleet
+    # 1 miss (new qid) + 3 hits (same qid again, sticky resubmit, rid key)
+    first = _post(addr, "/schedule_request", {"qid": "qa"})
+    _post(addr, "/schedule_request", {"qid": "qa"})
+    _post(addr, "/schedule_request",
+          {"qid": "qb", "previous_server": first["url"],
+           "previous_version": 0})
+    _post(addr, "/schedule_request", {"rid": "qa"})
+    state = router.router_state
+    assert state.sched_total == 4
+    assert state.sched_affinity_hits == 3
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "areal_tpu_router_affinity_hit_rate 0.75" in text
+    assert "areal_tpu_router_sched_affinity_hits 3" in text

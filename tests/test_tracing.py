@@ -1,0 +1,226 @@
+"""SpanTracer unit behavior: recording, bounded memory, thread safety,
+no-op guarantees when disabled, Chrome trace-event export schema,
+Prometheus text rendering, and the trace_report summarizer."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.cli_args import TracingConfig
+from areal_tpu.utils import tracing
+from areal_tpu.utils.tracing import SpanTracer, render_prometheus
+
+
+def _enabled_tracer(max_spans: int = 1000) -> SpanTracer:
+    return SpanTracer(TracingConfig(enabled=True, max_spans=max_spans))
+
+
+class TestSpanTracer:
+    def test_record_and_drain(self):
+        t = _enabled_tracer()
+        t.record("prefill", "r1", 1.0, 1.5, slot=3)
+        with t.span("decode", "r1", tokens=7):
+            pass
+        t.instant("preempt", "r1")
+        assert len(t) == 3
+        spans = t.drain()
+        assert len(t) == 0  # drained
+        names = [s.name for s in spans]
+        assert names == ["prefill", "decode", "preempt"]
+        assert spans[0].duration == pytest.approx(0.5)
+        assert spans[0].attrs == {"slot": 3}
+        assert spans[2].duration == 0.0
+
+    def test_bounded_memory(self):
+        t = _enabled_tracer(max_spans=10)
+        for i in range(25):
+            t.record("s", f"r{i}", 0.0, 1.0)
+        assert len(t) == 10
+        assert t.dropped == 15
+        # oldest dropped, newest kept
+        assert t.snapshot()[-1].rid == "r24"
+
+    def test_disabled_is_noop(self):
+        t = SpanTracer(TracingConfig(enabled=False))
+        assert not t.enabled
+        # span() hands back ONE shared null object — the hot-loop guard:
+        # no generator, no Span, no dict is allocated per call
+        cm1 = t.span("decode", "r1", tokens=1)
+        cm2 = t.span("decode", "r2", tokens=2)
+        assert cm1 is cm2 is tracing._NULL_CTX
+        with cm1:
+            pass
+        t.record("x", "r", 0.0, 1.0)
+        t.instant("y", "r")
+        assert len(t) == 0
+        assert t.drain() == []
+
+    def test_default_config_is_disabled(self):
+        assert not SpanTracer().enabled
+
+    def test_thread_safety(self):
+        t = _enabled_tracer(max_spans=100_000)
+        n_threads, per = 8, 500
+
+        def work(i):
+            for j in range(per):
+                t.record("s", f"t{i}-{j}", 0.0, 1.0)
+
+        threads = [
+            threading.Thread(target=work, args=(i,))
+            for i in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(t) == n_threads * per
+
+    def test_span_ctx_measures_wall_time(self):
+        t = _enabled_tracer()
+        with t.span("sleepy", "r1"):
+            time.sleep(0.02)
+        (s,) = t.snapshot()
+        assert s.duration >= 0.015
+
+
+class TestChromeExport:
+    def test_schema(self, tmp_path):
+        t = _enabled_tracer()
+        t.record("queue_wait", "rid-A", 1.0, 1.1)
+        t.record("prefill", "rid-A", 1.1, 1.3, slot=0)
+        t.record("decode", "rid-B", 1.3, 2.0)
+        path = str(tmp_path / "trace.json")
+        t.export_chrome(path)
+        doc = json.load(open(path))
+        assert "traceEvents" in doc
+        xevents = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xevents) == 3
+        for e in xevents:
+            # required trace-event fields for a complete event
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+            assert isinstance(e["ts"], float)
+            assert e["dur"] >= 0
+            assert e["args"]["rid"] in ("rid-A", "rid-B")
+        # one row (tid) per rid, named via metadata events
+        tids = {e["args"]["rid"]: e["tid"] for e in xevents}
+        assert len(set(tids.values())) == 2
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in meta} == {"rid-A", "rid-B"}
+
+    def test_flush_to_export_path(self, tmp_path):
+        path = str(tmp_path / "sink.jsonl")
+        t = SpanTracer(
+            TracingConfig(enabled=True, export_path=path)
+        )
+        t.record("a", "r1", 0.0, 0.5)
+        t.flush()
+        assert len(t) == 0  # flush drains
+        t.record("b", "r2", 1.0, 1.5)
+        t.flush()  # appends
+        lines = [json.loads(x) for x in open(path) if x.strip()]
+        assert [s["name"] for s in lines] == ["a", "b"]
+        # no export_path configured → flush is a no-op
+        t2 = SpanTracer(TracingConfig(enabled=True))
+        t2.record("c", "r", 0.0, 1.0)
+        t2.flush()
+        assert len(t2) == 1
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        t = _enabled_tracer()
+        t.record("a", "r1", 0.0, 0.25, k="v")
+        path = str(tmp_path / "spans.jsonl")
+        t.export_jsonl(path, drain=True)
+        assert len(t) == 0
+        lines = [json.loads(x) for x in open(path) if x.strip()]
+        assert lines == [
+            {"name": "a", "rid": "r1", "ts": 0.0, "dur": 0.25,
+             "attrs": {"k": "v"}}
+        ]
+
+
+class TestRenderPrometheus:
+    def test_format(self):
+        text = render_prometheus(
+            {"running_requests": 3, "total_requests": 11,
+             "kv_page_utilization": 0.25},
+            prefix="areal_tpu_gen_",
+            help_text={"running_requests": "live requests"},
+        )
+        assert "# HELP areal_tpu_gen_running_requests live requests" in text
+        assert "# TYPE areal_tpu_gen_running_requests gauge" in text
+        assert "# TYPE areal_tpu_gen_total_requests counter" in text
+        assert "areal_tpu_gen_running_requests 3\n" in text
+        assert "areal_tpu_gen_kv_page_utilization 0.25" in text
+        assert text.endswith("\n")
+
+    def test_nonfinite_values(self):
+        text = render_prometheus(
+            {"a": float("nan"), "b": float("inf"), "c": float("-inf")}
+        )
+        assert "a NaN" in text and "b +Inf" in text and "c -Inf" in text
+
+    def test_type_override(self):
+        text = render_prometheus(
+            {"accepted": 5}, types={"accepted": "counter"}
+        )
+        assert "# TYPE accepted counter" in text
+
+
+class TestTraceReport:
+    def _write_synthetic(self, tmp_path):
+        t = _enabled_tracer()
+        for i in range(20):
+            t.record("queue_wait", f"r{i}", i * 1.0, i * 1.0 + 0.001 * i)
+            t.record("prefill", f"r{i}", i + 0.1, i + 0.15)
+            t.record("decode", f"r{i}", i + 0.15, i + 0.9)
+        t.record("pause_window", "__engine__", 5.0, 5.6)
+        path = str(tmp_path / "trace.jsonl")
+        t.export_jsonl(path)
+        return path, t
+
+    def test_summarize_jsonl(self, tmp_path):
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import trace_report
+
+        path, _ = self._write_synthetic(tmp_path)
+        spans = trace_report.load_spans(path)
+        summary = trace_report.summarize(spans)
+        assert set(summary) == {
+            "queue_wait", "prefill", "decode", "pause_window",
+        }
+        assert summary["decode"]["count"] == 20
+        assert summary["decode"]["p50"] == pytest.approx(0.75)
+        assert summary["pause_window"]["total"] == pytest.approx(0.6)
+        # p95 >= p50 always
+        for st in summary.values():
+            assert st["p95"] >= st["p50"]
+        table = trace_report.format_table(summary)
+        assert "queue_wait" in table and "p95_ms" in table
+
+    def test_chrome_input_and_cli_smoke(self, tmp_path, capsys):
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import trace_report
+
+        _, t = self._write_synthetic(tmp_path)
+        chrome = str(tmp_path / "trace.json")
+        t.export_chrome(chrome)
+        rc = trace_report.main(
+            [chrome, "--require", "queue_wait,prefill,decode"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "decode" in out
+        # a missing required phase fails the CI smoke check
+        rc = trace_report.main([chrome, "--require", "nonexistent_phase"])
+        assert rc == 1

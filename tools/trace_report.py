@@ -1,0 +1,124 @@
+"""Summarize a rollout trace into a per-phase latency table.
+
+Input: span JSONL (one ``{"name", "rid", "ts", "dur", ...}`` object per
+line — what ``SpanTracer.export_jsonl`` / ``GET /trace?format=jsonl``
+emit) or Chrome trace-event JSON (``{"traceEvents": [...]}`` — what
+``GET /trace`` / ``SpanTracer.export_chrome`` emit). Output: one row per
+span name with count / p50 / p95 / mean / max / total seconds, e.g.::
+
+    phase              count    p50_ms    p95_ms   mean_ms    max_ms  total_s
+    queue_wait            64      1.20     15.40      3.10     22.00    0.198
+    prefill               64     48.00     95.00     52.00    101.00    3.328
+    decode                64   1520.00   2210.00   1604.00   2350.00  102.656
+    pause_window           3    610.00    780.00    650.00    780.00    1.950
+
+Runs in CI as a smoke check against a synthetic trace
+(tests/test_tracing.py); on a real capture it is the first-look answer to
+"where did rollout wall time go" — queue wait vs prefill vs decode vs
+weight-update pauses.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List
+
+
+def load_spans(path: str) -> List[Dict[str, Any]]:
+    """Load spans from JSONL or Chrome trace-event JSON; returns dicts
+    with at least name / dur (seconds)."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"traceEvents"' in stripped[:200]:
+        doc = json.loads(text)
+        return [
+            {
+                "name": e["name"],
+                "rid": e.get("args", {}).get("rid", ""),
+                "ts": e.get("ts", 0.0) / 1e6,
+                "dur": e.get("dur", 0.0) / 1e6,
+            }
+            for e in doc.get("traceEvents", [])
+            if e.get("ph") == "X"
+        ]
+    spans = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        spans.append(json.loads(line))
+    return spans
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(spans: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per-name latency stats (durations in seconds in, seconds out)."""
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(float(s.get("dur", 0.0)))
+    out: Dict[str, Dict[str, float]] = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "p50": _percentile(durs, 0.50),
+            "p95": _percentile(durs, 0.95),
+            "mean": sum(durs) / len(durs),
+            "max": durs[-1],
+            "total": sum(durs),
+        }
+    return out
+
+
+def format_table(summary: Dict[str, Dict[str, float]]) -> str:
+    header = (
+        f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
+        f"{'mean_ms':>10}{'max_ms':>10}{'total_s':>9}"
+    )
+    rows = [header, "-" * len(header)]
+    for name, st in summary.items():
+        rows.append(
+            f"{name:<24}{st['count']:>7d}{st['p50'] * 1e3:>10.2f}"
+            f"{st['p95'] * 1e3:>10.2f}{st['mean'] * 1e3:>10.2f}"
+            f"{st['max'] * 1e3:>10.2f}{st['total']:>9.3f}"
+        )
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="span JSONL or Chrome trace JSON file")
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as JSON instead of a table",
+    )
+    p.add_argument(
+        "--require", default="",
+        help="comma-separated span names that MUST be present (CI smoke "
+        "check); exit 1 when any is missing",
+    )
+    args = p.parse_args(argv)
+    spans = load_spans(args.trace)
+    summary = summarize(spans)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_table(summary))
+    missing = [
+        n for n in args.require.split(",") if n and n not in summary
+    ]
+    if missing:
+        print(f"MISSING required phases: {missing}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
