@@ -249,6 +249,23 @@ class JaxGenConfig:
     # the host round-trip — essential over a driver tunnel, still worth a
     # dispatch latency on a local chip
     decode_pipeline: int = 1
+    # --- decode tail compaction (r6) ---
+    # dispatch decode over a pow2 bucket of ACTIVE slots instead of the
+    # full max_num_seqs slot array: during the straggler tail of a GRPO
+    # wave the fused scan, paged attention, and sampling stop paying for
+    # finished rows. Per-slot state is gathered into the compact row
+    # space before dispatch and scattered back after; sampling is keyed
+    # by SLOT id (not row position), so token streams are identical with
+    # compaction on or off. Single-device only (TP serving keeps the
+    # full-slot dispatch)
+    decode_compact: bool = True
+    # smallest compact row bucket — floors the recompile ladder (row
+    # shapes are pow2: min_rows, 2*min_rows, ..., max_num_seqs)
+    decode_compact_min_rows: int = 4
+    # consecutive chunks the active count must sit below the current
+    # bucket's shrink target before the bucket shrinks (growth is always
+    # immediate); damps recompile thrash when requests finish raggedly
+    decode_compact_hysteresis: int = 4
     # unique prompts prefilled in one batched dispatch (rows are padded to
     # this wave size so the program shape is static per bucket); identical
     # prompts (GRPO siblings) share one row + a KV line copy
@@ -281,9 +298,19 @@ class JaxGenConfig:
     slots_per_block: int = 8  # kernel grid-step slot grouping
     # KV pool row layout: "token_packed" (row = 128//D tokens of one head)
     # or "head_merged" (row = all kv heads of 128//(Hkv*D) tokens — one
-    # DMA per page moves every head; needs Hkv*D | 128). r5: experimental
-    # opt-in pending on-chip A/B; "auto" currently means token_packed.
+    # DMA per page moves every head; needs Hkv*D | 128). r6: "auto" now
+    # resolves to head_merged whenever the geometry allows it on a
+    # single-device engine (ops/paged_attention.resolve_pool_layout —
+    # parity-pinned in tests/test_pool_layout.py and
+    # tests/test_paged_kernel_parity.py); TP serving stays token_packed
+    # (the pool's kv-head dim is the TP shard axis).
     pool_layout: str = "auto"
+    # persistent XLA compilation cache directory ("" = disabled). The
+    # decode bucket ladder compiles O(100) programs on a cold engine
+    # (378 s of warmup in the r5 bench capture); a warm cache replays
+    # them from disk. Wired through the server CLI and launcher env
+    # (JAX_COMPILATION_CACHE_DIR) so subprocess servers share it.
+    compilation_cache_dir: str = ""
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85
     enable_metrics: bool = True
@@ -324,6 +351,10 @@ class JaxGenConfig:
             args.append(f"--trial-name={trial_name}")
         if config.tracing.enabled:
             args.append("--trace")
+        if config.compilation_cache_dir:
+            args.append(
+                f"--compilation-cache-dir={config.compilation_cache_dir}"
+            )
         return args
 
 

@@ -182,6 +182,12 @@ class GenerationEngine:
     ):
         self.config = config
         self.dtype = _DTYPES[config.dtype]
+        if getattr(config, "compilation_cache_dir", ""):
+            from areal_tpu.utils.compile_cache import (
+                enable_compilation_cache,
+            )
+
+            enable_compilation_cache(config.compilation_cache_dir)
         if model_config is None:
             model_config = load_hf_config(config.model_path)
         self.model_config = model_config
@@ -261,7 +267,10 @@ class GenerationEngine:
             page_size=bs,
             max_model_len=config.max_model_len,
         )
-        from areal_tpu.ops.paged_attention import can_head_merge
+        from areal_tpu.ops.paged_attention import (
+            can_head_merge,
+            resolve_pool_layout,
+        )
 
         layout = getattr(config, "pool_layout", "auto")
         if layout not in ("auto", "token_packed", "head_merged"):
@@ -269,6 +278,13 @@ class GenerationEngine:
                 f"pool_layout={layout!r}: expected auto | token_packed | "
                 "head_merged"
             )
+        # "auto" resolves to head_merged where the geometry + placement
+        # allow (the r6 default — built for the decode-DMA bottleneck and
+        # parity-pinned in tests/test_pool_layout.py)
+        layout = resolve_pool_layout(
+            layout, model_config.num_kv_heads, model_config.head_dim,
+            single_device=self.mesh is None,
+        )
         if layout == "head_merged":
             if not can_head_merge(
                 model_config.num_kv_heads, model_config.head_dim
@@ -339,7 +355,14 @@ class GenerationEngine:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         # device-resident decode state: the generation loop's only host
-        # traffic per step is ONE result fetch (tokens+logprobs)
+        # traffic per step is ONE result fetch (tokens+logprobs).
+        # INVARIANT (decode tail compaction): a new per-slot array must
+        # join _dispatch_chunk's `plain_attrs` gather spec (or its
+        # special-case block for non-1-D/conditional arrays), and its
+        # decode_multi-returned update must join the `updates` dict —
+        # otherwise compacted dispatches silently diverge from
+        # full-width ones. tests/test_decode_compaction.py pins parity
+        # for the current set.
         self._cur_tokens = jnp.zeros(s, jnp.int32)
         self._active_dev = jnp.zeros(s, bool)
         self._temp_dev = jnp.ones(s, jnp.float32)
@@ -372,6 +395,25 @@ class GenerationEngine:
         # may still write to a host-finished slot's pages)
         self._inflight: List[Dict[str, Any]] = []
         self._deferred_release: List[tuple] = []
+        # --- decode tail compaction (r6): dispatch over a pow2 bucket of
+        # ACTIVE slots. Single-device only: under TP the per-slot state
+        # is explicitly replicated on the mesh and the full-slot dispatch
+        # is kept. Sampling is slot-keyed (model_runner._sample_impl), so
+        # compaction never changes a request's token stream.
+        self._compact_enabled = (
+            bool(getattr(config, "decode_compact", True))
+            and self.mesh is None
+        )
+        self._compact_rows: Optional[int] = None  # current sticky bucket
+        self._compact_shrink_streak = 0
+        # occupancy accounting: how many rows each decode chunk paid for
+        # vs how many carried live requests (the compaction win, measured)
+        self.total_decode_chunks = 0
+        self.total_rows_dispatched = 0
+        self.total_rows_active = 0
+        self._decode_rows_dispatched = 0  # last chunk (gauge)
+        self._decode_rows_active = 0  # last chunk (gauge)
+        self.rows_dispatched_hist: Dict[int, int] = {}
         if self.mesh is not None:
             # small state must be explicitly replicated on the mesh so jit
             # doesn't mix committed single-device and sharded inputs
@@ -533,6 +575,18 @@ class GenerationEngine:
             # EWMA throughput over recent dispatches (0 while idle-fresh)
             decode_tokens_per_sec=round(self._decode_tps, 2),
             prefill_tokens_per_sec=round(self._prefill_tps, 2),
+            # decode tail compaction occupancy: rows the last chunk
+            # dispatched vs rows carrying live requests, plus lifetime
+            # totals (rows_active/rows_dispatched → mean occupancy)
+            decode_rows_dispatched=self._decode_rows_dispatched,
+            decode_rows_active=self._decode_rows_active,
+            total_decode_chunks=self.total_decode_chunks,
+            total_rows_dispatched=self.total_rows_dispatched,
+            total_rows_active=self.total_rows_active,
+            decode_occupancy=round(
+                self.total_rows_active
+                / max(1, self.total_rows_dispatched), 4
+            ),
             total_generated_tokens=self.total_generated_tokens,
             total_prompt_tokens=self.total_prompt_tokens,
             total_cached_prompt_tokens=self.total_cached_prompt_tokens,
@@ -1223,33 +1277,141 @@ class GenerationEngine:
             did = True
         return did
 
+    def _decode_rows_bucket(self, n_active: int) -> int:
+        """Pow2 row bucket for a compacted decode dispatch: grows
+        immediately (correctness — every active slot needs a row),
+        shrinks only after ``decode_compact_hysteresis`` consecutive
+        chunks below the current bucket (each distinct row count is its
+        own compiled program; ragged finishes must not thrash the
+        compile cache)."""
+        s = self.config.max_num_seqs
+        floor = max(1, self.config.decode_compact_min_rows)
+        target = max(n_active, floor)
+        target = min(1 << (target - 1).bit_length(), s)
+        cur = self._compact_rows
+        if cur is None or target > cur:
+            self._compact_rows = target
+            self._compact_shrink_streak = 0
+        elif target < cur:
+            self._compact_shrink_streak += 1
+            if self._compact_shrink_streak >= max(
+                1, self.config.decode_compact_hysteresis
+            ):
+                self._compact_rows = target
+                self._compact_shrink_streak = 0
+        else:
+            self._compact_shrink_streak = 0
+        return self._compact_rows
+
     def _dispatch_chunk(self, steps: int, margin: int):
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
         pps = self._pages_bound(margin)
-        tables_dev = jnp.asarray(self._tables[:, :pps])
+        s = self.config.max_num_seqs
+        slots = sorted(self._active)
+        n_active = len(slots)
+        rows = self._decode_rows_bucket(n_active) if self._compact_enabled else s
+        want_rope = bool(self._slot_mm.any())
+        # plain per-slot 1-D arrays: listed ONCE, gathered/aliased by the
+        # loop below. Arrays with extra semantics (active &valid, stops
+        # axis=0, lens zeroed on padding, rope conditional, last_rows) are
+        # handled explicitly after.
+        plain_attrs = (
+            "_cur_tokens", "_temp_dev", "_top_p_dev", "_top_k_dev",
+            "_greedy_dev", "_remaining", "_no_stop",
+        )
+        if rows >= s:
+            # full-width dispatch: row r IS slot r (the TP path, compact
+            # disabled, and what compaction degrades to at saturation)
+            rows = s
+            row_slots = np.arange(s, dtype=np.int32)
+            tables_dev = jnp.asarray(self._tables[:, :pps])
+            st = {a: getattr(self, a) for a in plain_attrs}
+            active = self._active_dev
+            stops, lens = self._stop_tokens, self._lens_dev
+            rope = self._rope_delta_dev if want_rope else None
+            slot_ids_dev = None  # identity — decode_multi default
+        else:
+            # compact dispatch: gather per-slot state into the row space.
+            # Padding rows carry slot id `s` — their gathers CLIP to slot
+            # s-1 but `valid` forces them inactive (no emission, no KV
+            # write), and the post-dispatch scatter DROPS them.
+            row_slots = np.full(rows, s, np.int32)
+            row_slots[:n_active] = slots
+            clipped = jnp.asarray(np.minimum(row_slots, s - 1))
+            valid = jnp.asarray(row_slots < s)
+            tables_np = np.full(
+                (rows, pps), self.cache_config.num_pages, np.int32
+            )
+            tables_np[:n_active] = self._tables[slots, :pps]
+            tables_dev = jnp.asarray(tables_np)
+            st = {
+                a: jnp.take(getattr(self, a), clipped)
+                for a in plain_attrs
+            }
+            active = jnp.take(self._active_dev, clipped) & valid
+            stops = jnp.take(self._stop_tokens, clipped, axis=0)
+            lens = jnp.where(valid, jnp.take(self._lens_dev, clipped), 0)
+            rope = (
+                jnp.take(self._rope_delta_dev, clipped) if want_rope
+                else None
+            )
+            slot_ids_dev = jnp.asarray(row_slots)
         (
             self.cache, toks, logps, emitted, active_after,
-            self._remaining, self._no_stop, self._lens_dev,
-            self._last_rows,
+            remaining_a, no_stop_a, lens_a, new_last,
         ) = model_runner.decode_multi(
             self.params, self.model_config, self.cache,
-            tables_dev, self._lens_dev,
-            self._cur_tokens, self._active_dev, self._remaining,
-            self._no_stop, self._stop_tokens, key,
-            self._temp_dev, self._top_p_dev, self._top_k_dev,
-            self._greedy_dev, steps=steps,
+            tables_dev, lens,
+            st["_cur_tokens"], active, st["_remaining"],
+            st["_no_stop"], stops, key,
+            st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
+            st["_greedy_dev"], steps=steps,
             topk_bound=self._sampling_mode(),
             attn_impl=self._attn_impl,
             ppcb=self.config.pages_per_compute_block,
             spb=self.config.slots_per_block,
             last_rows=self._last_rows,
-            rope_delta=(
-                self._rope_delta_dev if self._slot_mm.any() else None
-            ),
+            rope_delta=rope,
+            slot_ids=slot_ids_dev,
         )
-        self._cur_tokens = toks[-1]
-        self._active_dev = active_after
+        # updated per-slot state: ONE dict drives both the full-width
+        # assignment and the compact row→slot scatter (padding rows drop)
+        updates = {
+            "_cur_tokens": toks[-1],
+            "_active_dev": active_after,
+            "_remaining": remaining_a,
+            "_no_stop": no_stop_a,
+            "_lens_dev": lens_a,
+        }
+        if rows >= s:
+            for a, v in updates.items():
+                setattr(self, a, v)
+            self._last_rows = new_last
+        else:
+            scat = jnp.asarray(row_slots)
+            for a, v in updates.items():
+                setattr(
+                    self, a,
+                    getattr(self, a).at[scat].set(v, mode="drop"),
+                )
+            self._last_rows = {
+                k_: v_.at[:, scat].set(new_last[k_], mode="drop")
+                for k_, v_ in self._last_rows.items()
+            }
+        self.total_decode_chunks += 1
+        self.total_rows_dispatched += rows
+        self.total_rows_active += n_active
+        self._decode_rows_dispatched = rows
+        self._decode_rows_active = n_active
+        self.rows_dispatched_hist[rows] = (
+            self.rows_dispatched_hist.get(rows, 0) + 1
+        )
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "decode_chunk", "__engine__",
+                rows_dispatched=rows, rows_active=n_active, steps=steps,
+            )
         # ONE packed fetch per chunk (lazy: np.asarray in _process_chunk
         # blocks; until then the device crunches the next chunk)
         self._inflight.append(
@@ -1258,9 +1420,10 @@ class GenerationEngine:
                     toks, logps, emitted, active_after
                 ),
                 "steps": steps,
-                # dispatch-time slot→request snapshot: a slot finished and
-                # re-admitted between dispatch and processing must not
-                # absorb this chunk's stale results
+                # dispatch-time row→slot snapshot + slot→request snapshot:
+                # a slot finished and re-admitted between dispatch and
+                # processing must not absorb this chunk's stale results
+                "row_slots": row_slots,
                 "reqs": dict(self._active),
                 "version": self.model_version,
             }
@@ -1268,13 +1431,15 @@ class GenerationEngine:
 
     def _process_chunk(self, chunk: Dict[str, Any]):
         steps = chunk["steps"]
+        row_slots = chunk["row_slots"]
         s = self.config.max_num_seqs
+        r = len(row_slots)
         packed = np.asarray(chunk["packed"])  # blocks on the device here
-        n = steps * s
-        h_toks = packed[:n].reshape(steps, s).astype(np.int64)
-        h_logps = packed[n : 2 * n].reshape(steps, s)
-        h_emitted = packed[2 * n : 3 * n].reshape(steps, s) > 0.5
-        h_active = packed[3 * n : 3 * n + s] > 0.5
+        n = steps * r
+        h_toks = packed[:n].reshape(steps, r).astype(np.int64)
+        h_logps = packed[n : 2 * n].reshape(steps, r)
+        h_emitted = packed[2 * n : 3 * n].reshape(steps, r) > 0.5
+        h_active = packed[3 * n : 3 * n + r] > 0.5
         now = time.monotonic()
         n_emitted = int(h_emitted.sum())
         if self._last_decode_mark is not None and n_emitted:
@@ -1286,33 +1451,49 @@ class GenerationEngine:
                     else 0.8 * self._decode_tps + 0.2 * inst
                 )
         self._last_decode_mark = now
-        for slot, req in chunk["reqs"].items():
-            if self._active.get(slot) is not req:
+        # per-row emitted prefix length (device emission is a prefix —
+        # `emitted` is the step-entry active flag, which only falls)
+        n_emit = np.where(
+            h_emitted.all(axis=0), steps, h_emitted.argmin(axis=0)
+        )
+        for row in range(r):
+            slot = int(row_slots[row])
+            if slot >= s:
+                continue  # compaction padding row
+            req = chunk["reqs"].get(slot)
+            if req is None or self._active.get(slot) is not req:
                 continue  # finished/preempted since dispatch
+            k = int(n_emit[row])
             stopped_host = False
-            for t in range(steps):
-                if not h_emitted[t, slot]:
-                    break
+            if k:
+                # host backstop over the FULL stop list (the device buffer
+                # only holds the first 8 stop ids), honoring
+                # min_new_tokens: the token at step t is output index
+                # len(output_ids) + t + 1
+                if req.stop_token_ids:
+                    hits = np.isin(
+                        h_toks[:k, row],
+                        np.asarray(req.stop_token_ids, np.int64),
+                    )
+                    t0 = req.min_new_tokens - len(req.output_ids) - 1
+                    if t0 > 0:
+                        hits[:t0] = False
+                    if hits.any():
+                        k = int(np.argmax(hits)) + 1
+                        stopped_host = True
                 if req.first_token_time is None:
                     req.first_token_time = now
-                # this step cached the slot's previous input token
-                self._cached_len[slot] += 1
-                tok = int(h_toks[t, slot])
-                req.output_ids.append(tok)
-                req.output_logprobs.append(float(h_logps[t, slot]))
-                req.output_versions.append(chunk["version"])
-                self.total_generated_tokens += 1
-                # host backstop over the FULL stop list (the device buffer
-                # only holds the first 8 stop ids)
-                if (
-                    tok in req.stop_token_ids
-                    and len(req.output_ids) >= req.min_new_tokens
-                ):
-                    stopped_host = True
-                    break
+                req.output_ids.extend(int(t) for t in h_toks[:k, row])
+                req.output_logprobs.extend(
+                    float(x) for x in h_logps[:k, row]
+                )
+                req.output_versions.extend([chunk["version"]] * k)
+                # each emitted step cached the slot's previous input token
+                self._cached_len[slot] += k
+                self.total_generated_tokens += k
             if stopped_host:
                 self._finish(slot, "stop")
-            elif not h_active[slot]:
+            elif not h_active[row]:
                 self._finish(slot, "length")
 
     def _sample_and_append(

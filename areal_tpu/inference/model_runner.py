@@ -637,13 +637,18 @@ def _decode_core(
     spb: int,
     topk_bound: int,
     rope_delta: Optional[jnp.ndarray] = None,  # [S] mrope text-position shift
+    slot_ids: Optional[jnp.ndarray] = None,  # [S] engine slot per row
 ):
     """Shared body of decode_multi / decode_step. When sample_args is None,
     runs exactly one step and returns the logits instead of sampling.
 
     ``rope_delta`` shifts ROPE positions only (VLM mrope compresses image
     blocks, so a text token's rotary position lags its cache index by a
-    per-request constant); attention windows still use cache lengths."""
+    per-request constant); attention windows still use cache lengths.
+
+    ``slot_ids`` keys each row's sampling RNG by its engine slot — under
+    decode tail compaction rows are a gathered subset of slots, and the
+    stream a slot produces must not depend on its row position."""
     s = tables.shape[0]
     d = cfg.head_dim
     nl, hkv_pool, num_pages, prow, fd = cache["k"].shape
@@ -728,7 +733,8 @@ def _decode_core(
         kbuf, vbuf, tokens, clen, active, remaining, no_stop = carry
         kbuf, vbuf, logits = model_step(kbuf, vbuf, tokens, clen, active)
         toks, logps = _sample_impl(
-            logits, step_key, temperature, top_p, top_k, greedy, topk_bound
+            logits, step_key, temperature, top_p, top_k, greedy,
+            topk_bound, slot_ids=slot_ids,
         )
         emitted = active
         hit_stop = jnp.any(
@@ -783,6 +789,7 @@ def _decode_multi_forward(
     ppcb: int = 4,
     spb: int = 8,
     rope_delta: Optional[jnp.ndarray] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
 ):
     """`steps` fused decode+sample iterations in ONE dispatch with stop
     handling on device (see module doc). Host contract: tables cover
@@ -795,6 +802,7 @@ def _decode_multi_forward(
         (temperature, top_p, top_k, greedy),
         (remaining, no_stop_before, stop_tokens),
         steps, attn_impl, ppcb, spb, topk_bound, rope_delta=rope_delta,
+        slot_ids=slot_ids,
     )
 
 
@@ -821,6 +829,7 @@ def decode_multi(
     spb: int = 16,
     last_rows: Optional[Dict[str, jnp.ndarray]] = None,
     rope_delta: Optional[jnp.ndarray] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
 ):
     """`steps` fused decode+sample iterations: one READ-ONLY forward
     dispatch + one WRITE-ONLY merge dispatch (reading and writing the
@@ -828,12 +837,22 @@ def decode_multi(
     Host contract: tables cover ceil((pos0[s]+steps)/page_size) pages for
     every active slot.
 
+    ``slot_ids`` maps each ROW to its engine slot (default: identity).
+    Under decode tail compaction the engine dispatches a gathered subset
+    of slots; slot_ids keys the per-row sampling RNG and indexes
+    ``last_rows`` (which may then keep its full [L, max_num_seqs, ...]
+    shape), and the returned ``new_last_rows`` is in ROW space for the
+    caller to scatter back. Padding rows may carry an out-of-range slot
+    id — gathers clip, and the caller drops their scatter.
+
     Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S],
     active_after [S], remaining_after, no_stop_after, lens_after [S],
     new_last_rows). ``lens_after`` keeps the per-slot cached length
     device-resident so the host can dispatch chunk N+1 before fetching
     chunk N's results (the serving loop pipelines dispatch against result
     processing)."""
+    if slot_ids is None:
+        slot_ids = jnp.arange(tables.shape[0], dtype=jnp.int32)
     (
         toks, logps, emitted, active_a, remaining_a, no_stop_a, lens_a,
         kbuf, vbuf, clen,
@@ -841,10 +860,11 @@ def decode_multi(
         params, cfg, cache, tables, pos0, tokens, active, remaining,
         no_stop_before, stop_tokens, key, temperature, top_p, top_k,
         greedy, steps, topk_bound, attn_impl, ppcb, spb,
-        rope_delta=rope_delta,
+        rope_delta=rope_delta, slot_ids=slot_ids,
     )
     cache, new_last = merge_tokens(
-        cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows
+        cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows,
+        slot_ids=slot_ids,
     )
     return (
         cache, toks, logps, emitted, active_a, remaining_a, no_stop_a,
@@ -905,8 +925,15 @@ def _sample_impl(
     top_k: jnp.ndarray,  # [S] int32 (0 = disabled)
     greedy: jnp.ndarray,  # [S] bool
     topk_bound: int,
+    slot_ids: Optional[jnp.ndarray] = None,  # [S] engine slot per row
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-slot sampling; returns (tokens [S], logprobs [S]).
+
+    Each row draws under a key folded from ``slot_ids[row]`` (defaulting
+    to the row index), NOT from its position in the batch — so a
+    request's stream is invariant to which row it occupies. This is what
+    makes decode tail compaction (engine rows = active-slot bucket)
+    token-exact against the full-slot dispatch.
 
     ``topk_bound`` picks the truncation strategy (static):
       -1  no truncation anywhere (all slots top_p>=1, top_k=0) — a single
@@ -928,9 +955,15 @@ def _sample_impl(
     temp = jnp.maximum(temperature, 1e-5)[:, None]
     scaled = logits / temp
     logp_full = jax.nn.log_softmax(scaled, axis=-1)
+    if slot_ids is None:
+        slot_ids = jnp.arange(s, dtype=jnp.int32)
+    row_keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, slot_ids
+    )
+    _categorical = jax.vmap(lambda k_, l_: jax.random.categorical(k_, l_))
 
     if topk_bound < 0:
-        sampled = jax.random.categorical(key, scaled, axis=-1)
+        sampled = _categorical(row_keys, scaled)
     elif topk_bound > 0:
         kb = min(topk_bound, v)
         vals, idx = jax.lax.top_k(scaled, kb)  # [S, kb]
@@ -943,13 +976,13 @@ def _sample_impl(
         keep &= cumprev < top_p[:, None]
         keep = keep.at[:, 0].set(True)  # always keep the argmax token
         trunc = jnp.where(keep, vals, NEG_INF)
-        choice = jax.random.categorical(key, trunc, axis=-1)
+        choice = _categorical(row_keys, trunc)
         truncated_pick = jnp.take_along_axis(
             idx, choice[:, None], axis=-1
         )[:, 0]
         # untruncated slots keep the exact full-vocab distribution
         untruncated = (top_k <= 0) & (top_p >= 1.0)
-        full_pick = jax.random.categorical(key, scaled, axis=-1)
+        full_pick = _categorical(row_keys, scaled)
         sampled = jnp.where(untruncated, full_pick, truncated_pick)
     else:
         # exact path: full sort (slow; tests / host-side calls)
@@ -966,7 +999,7 @@ def _sample_impl(
         trunc = jnp.full_like(scaled, NEG_INF).at[
             jnp.arange(s)[:, None], sort_idx
         ].set(trunc_sorted)
-        sampled = jax.random.categorical(key, trunc, axis=-1)
+        sampled = _categorical(row_keys, trunc)
 
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy, argmax, sampled).astype(jnp.int32)
@@ -1001,7 +1034,11 @@ def sample_tokens(
     top_k: jnp.ndarray,  # [S] int32 (0 = disabled)
     greedy: jnp.ndarray,  # [S] bool
     topk_bound: int = 0,
+    slot_ids: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    if slot_ids is None:
+        slot_ids = jnp.arange(logits.shape[0], dtype=jnp.int32)
     return _sample_impl(
-        logits, key, temperature, top_p, top_k, greedy, topk_bound
+        logits, key, temperature, top_p, top_k, greedy, topk_bound,
+        slot_ids=slot_ids,
     )

@@ -185,6 +185,11 @@ def main(argv: Optional[list] = None):
         "--trace", action="store_true",
         help="record request-lifecycle spans (drain via GET /trace)",
     )
+    p.add_argument(
+        "--compilation-cache-dir", default="",
+        help="persistent XLA compile cache (warm engines skip the "
+        "decode bucket-ladder warmup)",
+    )
     args = p.parse_args(argv)
     cfg = JaxGenConfig(
         model_path=args.model_path,
@@ -195,6 +200,7 @@ def main(argv: Optional[list] = None):
         tensor_parallel_size=args.tensor_parallel_size,
         host=args.host,
         port=args.port,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
     cfg.tracing.enabled = args.trace
     engine = GenerationEngine(cfg).start()

@@ -111,6 +111,16 @@ def launch_servers(
     """Start n generation-server subprocesses; returns host:port addrs."""
     ports = network.find_free_ports(n_servers)
     addrs = []
+    if gen_config.compilation_cache_dir:
+        # export the cache dir as env too (not only the CLI flag the
+        # server forwards to the engine): jax reads
+        # JAX_COMPILATION_CACHE_DIR at interpreter start, so every
+        # restart of a server replays its compiles from disk instead of
+        # re-paying the decode bucket-ladder warmup
+        base_env = dict(base_env or {})
+        base_env["JAX_COMPILATION_CACHE_DIR"] = (
+            gen_config.compilation_cache_dir
+        )
     for i in range(n_servers):
         host = gen_config.host or "127.0.0.1"
         cmd = JaxGenConfig.build_cmd(

@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -2.3819763e38
 
+# jax renamed TPUCompilerParams → CompilerParams across the versions this
+# repo meets (0.4.x CPU CI vs the TPU image); take whichever exists
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 
 def pack_factor(head_dim: int) -> int:
     """Tokens per 128-lane pool row (1 for D>=128; D must divide 128)."""
@@ -64,17 +70,41 @@ def can_head_merge(num_kv_heads: int, head_dim: int) -> bool:
     )
 
 
+def resolve_pool_layout(
+    layout: str, num_kv_heads: int, head_dim: int,
+    single_device: bool = True,
+) -> str:
+    """Resolve a ``pool_layout`` config value ("auto" | "token_packed" |
+    "head_merged") to a concrete layout — the ONE place the default
+    lives. Since r6 "auto" means head_merged whenever the geometry
+    allows it (Hkv*D | 128) on a single-device engine: one DMA per page
+    moves every kv head, halving the decode kernel's per-page copy count
+    for Hkv=2 at identical bytes. Tensor-parallel serving stays
+    token_packed (TP shards the pool's kv-head dim, which merging
+    collapses). Explicit layouts pass through unchanged — validation of
+    an impossible explicit choice is the caller's job."""
+    if layout != "auto":
+        return layout
+    if single_device and can_head_merge(num_kv_heads, head_dim):
+        return "head_merged"
+    return "token_packed"
+
+
 def pool_layout(
     num_kv_heads: int, head_dim: int, head_merge: bool
 ):
     """(hkv_pool, tokens_per_row, lane_width, merged) for a pool layout.
 
-    token_packed (default): row = ``128//D`` consecutive tokens of ONE
+    token_packed: row = ``128//D`` consecutive tokens of ONE
     head — pool [L, Hkv, NP, BS//f, f*D].
-    head_merged (opt-in, r5): row = ``128//(Hkv*D)`` consecutive tokens ×
+    head_merged (default since r6 where geometry allows, see
+    resolve_pool_layout): row = ``128//(Hkv*D)`` consecutive tokens ×
     ALL kv heads — pool [L, 1, NP, BS//f', 128]. One DMA per page moves
     every head (the decode kernel's per-(page, head) copy count halves
-    for Hkv=2), at identical bytes.
+    for Hkv=2), at identical bytes. For true MQA (Hkv=1) the merged and
+    token-packed layouts coincide — ``layout_from_pool`` reports such a
+    pool as token_packed, and external kernel callers must still pass
+    ``num_kv_heads=1`` explicitly (see paged_decode_attention note).
     """
     if head_merge:
         if not can_head_merge(num_kv_heads, head_dim):
@@ -441,6 +471,15 @@ def paged_decode_attention(
     batches cheap). A head-merged pool (pool head dim 1 < num_kv_heads,
     ops.paged_attention.pool_layout) halves the per-page DMA count.
 
+    Row-compact batches (r6 decode tail compaction): S is the engine's
+    ACTIVE row bucket, not max_num_seqs — q/lengths/tables/chunk buffers
+    are gathered per active slot before the call. Any S >= 1 works: the
+    slot grouping degrades to ``sb = gcd-style largest divisor <=
+    slots_per_block`` and the grid shrinks with the batch, so a
+    2-straggler tail dispatches a 2-row grid instead of streaming pages
+    for 64 rows. Padding rows carry length 0 (+ chunk_count 0) and are
+    skipped by the per-page DMA predicates.
+
     .. note:: **True-MQA callers must pass** ``num_kv_heads=1``. Since the
        head-merged layout landed, a pool with kv-head dim 1 under a
        multi-head ``q`` is ambiguous (true MQA vs merged GQA heads) and
@@ -534,7 +573,7 @@ def paged_decode_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((s, hkv, gp, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
@@ -573,7 +612,10 @@ def paged_decode_attention_jnp(
     rows..., chunk], which softmax doesn't care about. ~3x the HBM
     traffic of the kernel; correctness-first path. Head-merged pools are
     unpacked to the per-head view first (one extra relayout — fine for
-    the CPU/TP correctness path).
+    the CPU/TP correctness path). Like the kernel, accepts row-compact
+    batches: S may be the engine's active row bucket with per-row
+    gathered tables; length-0 padding rows hit the all-masked softmax
+    guard and return zeros.
     """
     s, hq, d = q.shape
     nl, hkv_pool, np_, prow, fd = k_pages.shape

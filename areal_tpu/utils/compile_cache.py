@@ -1,0 +1,70 @@
+"""Opt-in persistent XLA compilation cache.
+
+The serving engine's compiled-program surface is a LADDER — prefill wave
+shapes × kv page buckets × decode row buckets × sampling modes — and a
+cold engine pays for all of it at warmup (the r5 bench capture burned
+378 s across 191 backend compiles before the first measured step). The
+programs are deterministic functions of (jaxlib, flags, HLO), so a
+persistent on-disk cache replays warmup from disk on every engine after
+the first.
+
+Wiring: ``JaxGenConfig.compilation_cache_dir`` (engine init calls
+``enable_compilation_cache`` before the first jit), the generation
+server's ``--compilation-cache-dir`` flag, the local launcher (exports
+``JAX_COMPILATION_CACHE_DIR`` to server subprocesses so the cache is
+active from interpreter start), and ``bench.py`` (which also counts
+cache hit/miss events into the bench record).
+
+Kept separate from the engine so trainers/tools can reuse it.
+"""
+
+import os
+import threading
+from typing import Optional
+
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("CompileCache")
+
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Thresholds are dropped to zero: the decode bucket ladder is many
+    SMALL programs (default jax only persists compiles > 1 s), and the
+    warmup cost is their sum, not any single entry. Returns True when
+    the cache is active; failures (old jax, read-only fs) are logged and
+    reported as False — the cache is an optimization, never a hard
+    dependency. Idempotent per directory."""
+    global _enabled_dir
+    if not cache_dir:
+        return False
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    with _lock:
+        if _enabled_dir == cache_dir:
+            return True
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+        except Exception as e:  # noqa: BLE001 — optimization, not a dep
+            logger.warning(f"compilation cache disabled: {e}")
+            return False
+        _enabled_dir = cache_dir
+        logger.info(f"persistent compilation cache at {cache_dir}")
+        return True
+
+
+def enabled_dir() -> Optional[str]:
+    """The directory the cache is currently pointed at (None = off)."""
+    return _enabled_dir

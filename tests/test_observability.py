@@ -127,11 +127,34 @@ class TestServerEndpoints:
             "areal_tpu_gen_prefill_tokens_per_sec",
             "areal_tpu_gen_total_preemptions",
             "areal_tpu_gen_model_version",
+            # r6 decode tail compaction occupancy gauges
+            "areal_tpu_gen_decode_rows_dispatched",
+            "areal_tpu_gen_decode_rows_active",
+            "areal_tpu_gen_decode_occupancy",
+            "areal_tpu_gen_total_decode_chunks",
+            "areal_tpu_gen_total_rows_dispatched",
+            "areal_tpu_gen_total_rows_active",
         ):
             assert any(
                 line.startswith(required + " ")
                 for line in text.splitlines()
             ), f"missing sample line for {required}"
+        # lifetime row counters render as Prometheus counters
+        assert "# TYPE areal_tpu_gen_total_rows_dispatched counter" in text
+
+    def test_decode_chunk_occupancy_spans(self, traced_engine):
+        """Compaction emits per-chunk rows_dispatched/rows_active attrs
+        onto the trace timeline (what --occupancy summarizes)."""
+        eng, _, _, _ = traced_engine
+        eng.tracer.drain()
+        _generate(eng, "rid-occupancy", max_new=8)
+        chunks = [
+            s for s in eng.tracer.snapshot() if s.name == "decode_chunk"
+        ]
+        assert chunks, "no decode_chunk spans recorded"
+        for s in chunks:
+            assert s.attrs["rows_dispatched"] >= s.attrs["rows_active"]
+            assert s.attrs["rows_active"] >= 0
 
     def test_trace_endpoint_drains(self, traced_engine):
         eng, addr, _, _ = traced_engine

@@ -190,3 +190,110 @@ def test_head_merged_layout_matches_token_packed(head_dim, hq, hkv, with_chunk):
             np.asarray(got_kernel)[defined], np.asarray(want)[defined],
             rtol=2e-5, atol=2e-5,
         )
+
+
+# ------------------------------------------------------- default layout pin
+def test_default_constructed_engine_pool_is_head_merged():
+    """r6: ``pool_layout='auto'`` resolves to head_merged on a
+    single-device engine whenever the geometry allows — pinned here so a
+    regression back to opt-in cannot land silently. ``layout_from_pool``
+    must round-trip the constructed pool's layout."""
+    import jax
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.ops.paged_attention import (
+        layout_from_pool,
+        pool_layout,
+        resolve_pool_layout,
+    )
+
+    cfg = tiny_config("qwen2")  # Hkv=2, D=16 → Hkv*D=32 | 128
+    assert (
+        resolve_pool_layout("auto", cfg.num_kv_heads, cfg.head_dim)
+        == "head_merged"
+    )
+    # TP placement and merge-incompatible geometry fall back
+    assert (
+        resolve_pool_layout(
+            "auto", cfg.num_kv_heads, cfg.head_dim, single_device=False
+        )
+        == "token_packed"
+    )
+    assert resolve_pool_layout("auto", 2, 48) == "token_packed"
+    # explicit choices pass through
+    assert resolve_pool_layout("token_packed", 2, 16) == "token_packed"
+
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", max_num_seqs=2, max_model_len=32,
+            page_size=8,
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    # default-constructed cache is merged, and layout_from_pool
+    # round-trips it (merged=True, tpr = 128 // (Hkv*D))
+    assert eng.cache["k"].shape[1] == 1
+    merged, tpr = layout_from_pool(
+        eng.cache["k"].shape, cfg.num_kv_heads, cfg.head_dim
+    )
+    assert merged and tpr == 128 // (cfg.num_kv_heads * cfg.head_dim)
+    # round-trip across layouts/geometries via packed_pool_shape
+    for hkv, d, merge in [(2, 64, True), (2, 64, False), (4, 32, True)]:
+        shp = packed_pool_shape(2, hkv, 8, 16, d, head_merge=merge)
+        got_merged, got_tpr = layout_from_pool(shp, hkv, d)
+        _, want_tpr, _, _ = pool_layout(hkv, d, merge)
+        assert got_merged == merge and got_tpr == want_tpr
+
+
+def test_mqa_pool_requires_explicit_num_kv_heads():
+    """True MQA (Hkv=1) after the head-merged default: the merged and
+    token-packed layouts coincide, layout_from_pool reports
+    token_packed, and the kernel/fallback (a) refuse ambiguous calls,
+    (b) agree when num_kv_heads=1 is passed (the ADVICE.md external-
+    caller contract)."""
+    from areal_tpu.ops.paged_attention import (
+        layout_from_pool,
+        pool_layout,
+    )
+
+    hkv, d = 1, 64
+    # merged and token-packed MQA pools are byte-identical
+    assert packed_pool_shape(2, hkv, 8, 16, d, head_merge=True) == (
+        packed_pool_shape(2, hkv, 8, 16, d, head_merge=False)
+    )
+    shp = packed_pool_shape(2, hkv, 8, 16, d, head_merge=True)
+    assert layout_from_pool(shp, hkv, d) == (False, 128 // d)
+    assert pool_layout(hkv, d, True)[1] == pool_layout(hkv, d, False)[1]
+
+    rng = np.random.default_rng(13)
+    lengths = [5, 17, 2, 30]
+    q, kp, vp, lens, tables, kwargs = _build_case(
+        rng, head_dim=d, hq=4, hkv=hkv, page_size=16, num_pages=32,
+        lengths=lengths, chunk_counts=[1, 0, 4, 2],
+    )
+    # ambiguous call (pool head dim 1, multi-head q, no kwarg) refuses
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        paged_decode_attention(
+            q, kp, vp, jnp.int32(0), lens, tables, interpret=True,
+            **kwargs,
+        )
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        paged_decode_attention_jnp(
+            q, kp, vp, jnp.int32(0), lens, tables, **kwargs
+        )
+    got = paged_decode_attention(
+        q, kp, vp, jnp.int32(0), lens, tables,
+        pages_per_compute_block=2, slots_per_block=4,
+        interpret=True, num_kv_heads=1, **kwargs,
+    )
+    want = paged_decode_attention_jnp(
+        q, kp, vp, jnp.int32(0), lens, tables, num_kv_heads=1, **kwargs
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )

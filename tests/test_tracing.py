@@ -224,3 +224,50 @@ class TestTraceReport:
         # a missing required phase fails the CI smoke check
         rc = trace_report.main([chrome, "--require", "nonexistent_phase"])
         assert rc == 1
+
+    def test_occupancy_mode(self, tmp_path, capsys):
+        """--occupancy summarizes decode_chunk rows_dispatched /
+        rows_active gauges from BOTH export formats, and fails the CI
+        smoke when a trace carries none."""
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import trace_report
+
+        t = _enabled_tracer()
+        # 3 full chunks at 64 rows, 5 compacted tail chunks at 4 rows
+        for _ in range(3):
+            t.instant(
+                "decode_chunk", "__engine__",
+                rows_dispatched=64, rows_active=60, steps=8,
+            )
+        for _ in range(5):
+            t.instant(
+                "decode_chunk", "__engine__",
+                rows_dispatched=4, rows_active=2, steps=8,
+            )
+        t.record("decode", "r0", 0.0, 1.0)  # unrelated span is ignored
+        jsonl = str(tmp_path / "occ.jsonl")
+        chrome = str(tmp_path / "occ.json")
+        t.export_jsonl(jsonl)
+        t.export_chrome(chrome)
+        for path in (jsonl, chrome):
+            occ = trace_report.occupancy_summary(
+                trace_report.load_spans(path)
+            )
+            assert occ["chunks"] == 8
+            assert occ["rows_dispatched"] == 3 * 64 + 5 * 4
+            assert occ["rows_active"] == 3 * 60 + 5 * 2
+            assert occ["rows_dispatched_hist"] == {"4": 5, "64": 3}
+            assert occ["occupancy"] == pytest.approx(
+                (3 * 60 + 5 * 2) / (3 * 64 + 5 * 4), abs=1e-4
+            )
+        rc = trace_report.main([jsonl, "--occupancy"])
+        assert rc == 0
+        assert "mean occupancy" in capsys.readouterr().out
+        # a trace with no occupancy gauges fails the smoke check
+        bare = _enabled_tracer()
+        bare.record("decode", "r0", 0.0, 1.0)
+        empty = str(tmp_path / "bare.jsonl")
+        bare.export_jsonl(empty)
+        assert trace_report.main([empty, "--occupancy"]) == 1

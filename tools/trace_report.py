@@ -16,6 +16,11 @@ Runs in CI as a smoke check against a synthetic trace
 (tests/test_tracing.py); on a real capture it is the first-look answer to
 "where did rollout wall time go" — queue wait vs prefill vs decode vs
 weight-update pauses.
+
+``--occupancy`` switches to the decode-row occupancy report instead:
+``decode_chunk`` spans carry the engine's per-chunk rows_dispatched /
+rows_active gauges (r6 decode tail compaction), and the report prints
+lifetime totals, mean occupancy, and a rows-per-chunk histogram.
 """
 
 import argparse
@@ -38,6 +43,13 @@ def load_spans(path: str) -> List[Dict[str, Any]]:
                 "rid": e.get("args", {}).get("rid", ""),
                 "ts": e.get("ts", 0.0) / 1e6,
                 "dur": e.get("dur", 0.0) / 1e6,
+                # span attrs ride in args next to rid (occupancy gauges
+                # like rows_dispatched live here)
+                "attrs": {
+                    k: v
+                    for k, v in e.get("args", {}).items()
+                    if k != "rid"
+                },
             }
             for e in doc.get("traceEvents", [])
             if e.get("ph") == "X"
@@ -77,6 +89,57 @@ def summarize(spans: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
     return out
 
 
+def occupancy_summary(
+    spans: Iterable[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Decode-row occupancy from ``decode_chunk`` spans (the engine's
+    per-chunk rows_dispatched / rows_active gauges): lifetime totals,
+    mean occupancy, and a rows_dispatched histogram — the first-look
+    answer to "is the decode tail compacting, and how hard"."""
+    chunks = 0
+    dispatched = 0
+    active = 0
+    hist: Dict[int, int] = {}
+    for s in spans:
+        if s.get("name") != "decode_chunk":
+            continue
+        attrs = s.get("attrs") or {}
+        rd = attrs.get("rows_dispatched")
+        if rd is None:
+            continue
+        rd = int(rd)
+        chunks += 1
+        dispatched += rd
+        active += int(attrs.get("rows_active", 0))
+        hist[rd] = hist.get(rd, 0) + 1
+    return {
+        "chunks": chunks,
+        "rows_dispatched": dispatched,
+        "rows_active": active,
+        "occupancy": round(active / dispatched, 4) if dispatched else 0.0,
+        "rows_dispatched_hist": {
+            str(k): hist[k] for k in sorted(hist)
+        },
+    }
+
+
+def format_occupancy(occ: Dict[str, Any]) -> str:
+    rows = [
+        f"decode chunks        {occ['chunks']}",
+        f"rows dispatched      {occ['rows_dispatched']}",
+        f"rows active          {occ['rows_active']}",
+        f"mean occupancy       {occ['occupancy'] * 100:.1f}%",
+        "",
+        f"{'rows/chunk':<12}{'chunks':>8}{'share':>9}",
+    ]
+    total = max(1, occ["chunks"])
+    for bucket, count in occ["rows_dispatched_hist"].items():
+        rows.append(
+            f"{bucket:<12}{count:>8}{count / total * 100:>8.1f}%"
+        )
+    return "\n".join(rows)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -104,8 +167,28 @@ def main(argv=None) -> int:
         help="comma-separated span names that MUST be present (CI smoke "
         "check); exit 1 when any is missing",
     )
+    p.add_argument(
+        "--occupancy", action="store_true",
+        help="summarize decode-row occupancy (rows_dispatched vs "
+        "rows_active from decode_chunk spans) instead of the latency "
+        "table; exit 1 when the trace carries no occupancy gauges",
+    )
     args = p.parse_args(argv)
     spans = load_spans(args.trace)
+    if args.occupancy:
+        occ = occupancy_summary(spans)
+        if args.json:
+            print(json.dumps(occ, indent=2))
+        else:
+            print(format_occupancy(occ))
+        if occ["chunks"] == 0:
+            print(
+                "no decode_chunk occupancy spans in trace "
+                "(tracing off, or a pre-r6 engine)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     summary = summarize(spans)
     if args.json:
         print(json.dumps(summary, indent=2))
