@@ -227,6 +227,11 @@ class InferenceEngineConfig:
     tracing: "TracingConfig" = dataclasses.field(
         default_factory=lambda: TracingConfig()
     )
+    # fleet resilience plane (inference/fleet.py): health probing, circuit
+    # breaking, failover-aware generation, dynamic membership
+    fleet: "FleetConfig" = dataclasses.field(
+        default_factory=lambda: FleetConfig()
+    )
 
 
 @dataclasses.dataclass
@@ -379,6 +384,39 @@ class TracingConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Rollout-fleet resilience plane (inference/fleet.py `FleetMonitor`):
+    per-server health state machine (HEALTHY → SUSPECT → DEAD →
+    RECOVERING), circuit breaker with half-open probes, graceful drain,
+    and dynamic membership via the name_resolve gen_servers subtree.
+    `engine/remote.py` consults it for failover-aware generation: on a
+    connect failure / timeout / exhausted 5xx retries the in-flight
+    request migrates to a healthy server and RESUMES from its
+    accumulated tokens (token-exact, courtesy of the interruptible
+    suffix-resume loop)."""
+
+    # start the background prober/membership thread (passive failure
+    # reports and failover still work when disabled)
+    enabled: bool = True
+    probe_interval_s: float = 2.0
+    probe_timeout_s: float = 2.0
+    # consecutive failures (probe or passive report) HEALTHY → SUSPECT
+    suspect_threshold: int = 1
+    # consecutive failures → DEAD (circuit opens; affinity evicted)
+    dead_threshold: int = 3
+    # consecutive half-open probe successes RECOVERING → HEALTHY
+    recover_threshold: int = 2
+    # DEAD servers are probed at most this often (the half-open window)
+    halfopen_interval_s: float = 5.0
+    # follow name_resolve gen_servers registrations live (only applies
+    # when the fleet was DISCOVERED there — explicit addrs stay static)
+    watch_membership: bool = True
+    membership_poll_s: float = 2.0
+    # per-request bound on server hops before the failure propagates
+    max_failovers_per_request: int = 8
+
+
+@dataclasses.dataclass
 class ProfilingConfig:
     """jax-profiler trace capture for selected steps (reference
     model_worker.py:829-910 per-MFC torch profiler)."""
@@ -420,8 +458,10 @@ class RecoverConfig:
 
 @dataclasses.dataclass
 class NameResolveConfig:
-    type: str = "nfs"  # memory | nfs
+    type: str = "nfs"  # memory | nfs | kv
     nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+    # kv backend rendezvous address (utils/kv_server.py), host:port
+    kv_address: str = ""
 
 
 @dataclasses.dataclass

@@ -66,14 +66,19 @@ from areal_tpu.api.io_struct import (
     WeightUpdateMethod,
 )
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
+from areal_tpu.inference.fleet import FleetMonitor
 from areal_tpu.utils import logging as logging_util, name_resolve, names
 from areal_tpu.utils import stats_tracker
-from areal_tpu.utils.http import arequest_with_retry
+from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
 from areal_tpu.utils.tracing import SpanTracer
 
 logger = logging_util.getLogger("RemoteInferenceEngine")
 
 SERVER_ADDRS_ENV = "AREAL_LLM_SERVER_ADDRS"
+
+
+class NoHealthyServersError(RuntimeError):
+    pass
 
 
 class RemoteInferenceEngine(InferenceEngine):
@@ -86,6 +91,14 @@ class RemoteInferenceEngine(InferenceEngine):
         self._lock = threading.Lock()
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
+        # fleet resilience plane (built in initialize once addresses are
+        # known): health state machine + circuit breaker + membership
+        self.fleet: Optional[FleetMonitor] = None
+        self._discovered = False  # addrs came from name_resolve (not env/
+        # explicit) — only then may the membership watch shrink the fleet
+        # last successful disk-path weight push (path, version): the
+        # catch-up source for servers that missed updates while DEAD
+        self._last_disk_update: Optional[tuple] = None
         # client-side request lifecycle spans (submit → first-token →
         # complete; weight-update pause windows) — no-op unless
         # config.tracing.enabled
@@ -113,17 +126,154 @@ class RemoteInferenceEngine(InferenceEngine):
                 if addrs:
                     break
                 time.sleep(0.5)
+            self._discovered = bool(addrs)
         if not addrs:
             raise RuntimeError("no generation servers found")
         self.addresses = list(addrs)
-        self._health_check_all()
+        unhealthy = self._health_check_all()
+        fleet_cfg = getattr(self.config, "fleet", None)
+        membership_key = None
+        if (
+            self._discovered
+            and fleet_cfg is not None
+            and fleet_cfg.watch_membership
+            and self.config.experiment_name
+        ):
+            membership_key = names.gen_servers(
+                self.config.experiment_name, self.config.trial_name
+            )
+        self.fleet = FleetMonitor(
+            self.addresses,
+            fleet_cfg,
+            membership_key=membership_key,
+            on_join=self._on_server_join,
+            on_leave=self._on_server_leave,
+            on_dead=self._on_server_dead,
+            on_recover=self._on_server_recovered,
+            seed_source="discovered" if membership_key else "seed",
+        )
+        # servers that failed the startup sweep open their circuit NOW
+        # (no traffic) instead of eating live requests' first retries
+        dead_after = fleet_cfg.dead_threshold if fleet_cfg else 3
+        for addr in unhealthy:
+            for _ in range(max(1, dead_after)):
+                self.fleet.report_failure(addr)
+        if fleet_cfg is None or fleet_cfg.enabled:
+            self.fleet.start()
         self.workflow_executor = WorkflowExecutor(self.config, self)
         self.workflow_executor.initialize()
         return self
 
+    # -- fleet callbacks (fleet lock NOT held here) --------------------
+    def _on_server_join(self, addr: str):
+        with self._lock:
+            if addr not in self.addresses:
+                self.addresses.append(addr)
+
+    def _on_server_leave(self, addr: str):
+        with self._lock:
+            if addr in self.addresses:
+                self.addresses.remove(addr)
+            self._evict_affinity_locked(addr)
+
+    def _on_server_dead(self, addr: str):
+        # dead-server affinity eviction: in-flight requests stuck to it
+        # must re-resolve on their next chunk instead of re-POSTing a
+        # dead address
+        with self._lock:
+            evicted = self._evict_affinity_locked(addr)
+        if evicted:
+            logger.warning(
+                f"server {addr} marked DEAD; evicted {evicted} sticky "
+                f"request(s)"
+            )
+
+    def _quarantine(self, addr: str):
+        """Force a server's circuit OPEN (straight to DEAD). Used when a
+        server missed a weight update or failed a re-sync: merely
+        marking it SUSPECT would leave it schedulable at stale weights,
+        and SUSPECT→HEALTHY deliberately skips the version check — DEAD
+        routes its re-admission through the on_recover re-sync."""
+        if self.fleet is None:
+            return
+        fleet_cfg = getattr(self.config, "fleet", None)
+        dead_after = fleet_cfg.dead_threshold if fleet_cfg else 3
+        for _ in range(max(1, dead_after)):
+            self.fleet.report_failure(addr)
+
+    def _on_server_recovered(self, addr: str):
+        """Fleet callback: a server re-entered rotation. The actual
+        re-sync does blocking HTTP (up to the disk-update timeout), and
+        this callback can fire from report_success INSIDE the asyncio
+        event loop — so the work is dispatched to the engine's worker
+        pool, never run inline. Until it completes the server may
+        briefly take traffic at a stale version; _resync quarantines it
+        the moment staleness is confirmed."""
+        try:
+            self.executor.submit(self._resync_recovered_server, addr)
+        except RuntimeError:  # executor already shut down (teardown)
+            pass
+
+    def _resync_recovered_server(self, addr: str):
+        """A server re-closed its circuit (RECOVERING → HEALTHY). It may
+        have missed weight updates while DEAD: verify the version it
+        serves; re-push the last disk checkpoint when it is behind, or —
+        when there is nothing to re-push (device-path transfers are
+        trainer-driven) — tell it to drain, because silently serving
+        stale tokens would poison the staleness accounting."""
+        try:
+            current = self.get_version()
+            if current <= 0:
+                return
+            r = _requests.get(
+                f"http://{addr}/get_model_info", timeout=30
+            )
+            r.raise_for_status()
+            served = int(r.json().get("model_version", -1))
+            if served >= current:
+                return
+            last = self._last_disk_update
+            if last is not None and last[1] >= current:
+                path, version = last
+                r = _requests.post(
+                    f"http://{addr}/update_weights_from_disk",
+                    json={"model_path": path, "version": version},
+                    timeout=600,
+                )
+                r.raise_for_status()
+                assert r.json().get("success"), r.json()
+                logger.info(
+                    f"re-synced recovered server {addr}: "
+                    f"v{served} -> v{version}"
+                )
+                return
+            logger.error(
+                f"recovered server {addr} serves stale weights "
+                f"(v{served} < v{current}) and no disk checkpoint is "
+                f"available to re-push; draining it out of rotation"
+            )
+            try:
+                _requests.post(f"http://{addr}/drain", timeout=30)
+            finally:
+                if self.fleet is not None:
+                    self.fleet.drain(addr)
+        except Exception as e:
+            # an unverifiable server must NOT linger schedulable at an
+            # unknown version — back to DEAD, retried via half-open
+            logger.error(f"recover re-sync for {addr} failed: {e}")
+            self._quarantine(addr)
+
+    def _evict_affinity_locked(self, addr: str) -> int:
+        stale = [r for r, a in self._rid_to_address.items() if a == addr]
+        for r in stale:
+            del self._rid_to_address[r]
+        return len(stale)
+
     def destroy(self):
         if self.workflow_executor is not None:
             self.workflow_executor.destroy()
+        if self.fleet is not None:
+            self.fleet.stop()
         self.executor.shutdown(wait=False)
         self.tracer.flush()  # drain to TracingConfig.export_path if set
         for _, (lp, s) in list(self._sessions.items()):
@@ -139,7 +289,12 @@ class RemoteInferenceEngine(InferenceEngine):
             _abandon_session(s)
         self._sessions.clear()
 
-    def _health_check_all(self):
+    def _health_check_all(self) -> List[str]:
+        """Startup health sweep. Requires at least ONE healthy server;
+        the unhealthy remainder is returned (not fatal — the fleet
+        monitor starts them DEAD and half-open probes re-admit them),
+        because a single crashed-after-registering server must not abort
+        a trainer fronting an otherwise-healthy fleet."""
         deadline = time.monotonic() + self.config.setup_timeout
         pending = set(self.addresses)
         while pending and time.monotonic() < deadline:
@@ -152,9 +307,22 @@ class RemoteInferenceEngine(InferenceEngine):
                     pass
             if pending:
                 time.sleep(0.5)
+        if len(pending) == len(self.addresses):
+            raise RuntimeError(
+                f"servers failed health check: {sorted(pending)}"
+            )
         if pending:
-            raise RuntimeError(f"servers failed health check: {sorted(pending)}")
-        logger.info(f"{len(self.addresses)} generation server(s) healthy")
+            logger.warning(
+                f"{len(pending)} server(s) failed the startup health "
+                f"check; starting on the healthy "
+                f"{len(self.addresses) - len(pending)} and leaving "
+                f"{sorted(pending)} to the fleet monitor"
+            )
+        else:
+            logger.info(
+                f"{len(self.addresses)} generation server(s) healthy"
+            )
+        return sorted(pending)
 
     # ------------------------------------------------------------------
     def get_version(self) -> int:
@@ -166,21 +334,51 @@ class RemoteInferenceEngine(InferenceEngine):
             self._version = version
 
     # ------------------------------------------------------------------
-    def choose_server(self, rid: Optional[str] = None) -> str:
+    def choose_server(
+        self, rid: Optional[str] = None, exclude: Optional[set] = None
+    ) -> str:
         """rid-affinity first (KV locality on resume), else scheduling
-        policy (reference sglang_remote.py:158-168)."""
+        policy (reference sglang_remote.py:158-168) — over the HEALTHY
+        fleet only. ``exclude`` is the per-request failover set: servers
+        this request already failed on. An affinity entry pointing at an
+        excluded/unhealthy server is evicted, not honored."""
         with self._lock:
+            fleet = self.fleet
+
+            def usable(a: str) -> bool:
+                if exclude and a in exclude:
+                    return False
+                return fleet is None or fleet.is_schedulable(a)
+
             if rid is not None and rid in self._rid_to_address:
-                return self._rid_to_address[rid]
+                addr = self._rid_to_address[rid]
+                if usable(addr):
+                    return addr
+                del self._rid_to_address[rid]
+            candidates = [a for a in self.addresses if usable(a)]
+            if not candidates:
+                # fail open on health (a stale SUSPECT/DEAD verdict must
+                # not strand requests when it is ALL we have), but never
+                # on the per-request exclusions — those servers already
+                # ate this request once
+                candidates = [
+                    a for a in self.addresses
+                    if not exclude or a not in exclude
+                ]
+            if not candidates:
+                raise NoHealthyServersError(
+                    f"no generation server available (fleet={len(self.addresses)}, "
+                    f"excluded={sorted(exclude) if exclude else []})"
+                )
             if self.config.schedule_policy == "least_requests":
                 addr = min(
-                    self.addresses,
+                    candidates,
                     key=lambda a: sum(
                         1 for v in self._rid_to_address.values() if v == a
                     ),
                 )
             else:  # round_robin
-                addr = self.addresses[self._server_idx % len(self.addresses)]
+                addr = candidates[self._server_idx % len(candidates)]
                 self._server_idx += 1
             if rid is not None:
                 self._rid_to_address[rid] = addr
@@ -222,89 +420,161 @@ class RemoteInferenceEngine(InferenceEngine):
         ttft = None
         n_calls = 0
         n_aborts = 0
+        n_failovers = 0
+        failed: set = set()  # servers this request already failed on
+        fleet_cfg = getattr(self.config, "fleet", None)
+        max_failovers = (
+            fleet_cfg.max_failovers_per_request if fleet_cfg else 8
+        )
         chunk = self.config.new_tokens_per_chunk or 0
-        while stop_reason not in ("stop", "length") and len(accumulated) < gconfig.max_new_tokens:
-            server = self.choose_server(req.rid)
-            remaining = gconfig.max_new_tokens - len(accumulated)
-            ask = min(remaining, chunk) if chunk > 0 else remaining
-            payload = {
-                "rid": req.rid,
-                "input_ids": list(req.input_ids) + accumulated,
-                "sampling_params": {
-                    "max_new_tokens": ask,
-                },
-            }
-            if req.image_data:
-                payload["image_data"] = list(req.image_data)
-            if req.mm is not None:
-                # JSON-safe multimodal payload. The big float32 patch
-                # tensor goes as ONE base64 blob (nested JSON lists would
-                # be ~8x the bytes and dominate request parsing); the
-                # small int meta arrays stay as lists.
-                import base64 as _b64
-                import numpy as _np
-
-                mm_json = {}
-                for k, v in req.mm.items():
-                    if k == "pixel_values":
-                        arr = _np.asarray(v, _np.float32)
-                        mm_json["pixel_values_b64"] = _b64.b64encode(
-                            arr.tobytes()
-                        ).decode()
-                        mm_json["pixel_values_shape"] = list(arr.shape)
-                    else:
-                        mm_json[k] = (
-                            v.tolist() if hasattr(v, "tolist") else v
-                        )
-                payload["mm"] = mm_json
-            payload["sampling_params"].update(
-                {
-                    "min_new_tokens": max(
-                        0, gconfig.min_new_tokens - len(accumulated)
-                    ),
-                    "temperature": gconfig.temperature,
-                    "top_p": gconfig.top_p,
-                    "top_k": gconfig.top_k,
-                    "greedy": gconfig.greedy,
-                    "stop_token_ids": gconfig.stop_token_ids,
-                }
-            )
-            t_call = time.monotonic()
-            result = await arequest_with_retry(
-                session,
-                f"http://{server}/generate",
-                payload,
-                max_retries=self.config.request_retries,
-                timeout=self.config.request_timeout,
-            )
-            n_calls += 1
-            if self.tracer.enabled:
-                self.tracer.record(
-                    "generate_call", req.rid, t_call, time.monotonic(),
-                    server=server, new_tokens=len(result["output_ids"]),
-                )
-            if ttft is None and result["output_ids"]:
-                ttft = time.monotonic() - start
-            accumulated.extend(result["output_ids"])
-            logprobs.extend(result["output_logprobs"])
-            versions.extend(result["output_versions"])
-            stop_reason = result["meta_info"]["finish_reason"]["type"]
-            if (
-                stop_reason == "length"
-                and ask < remaining
-                and len(result["output_ids"]) >= ask
+        try:
+            while (
+                stop_reason not in ("stop", "length")
+                and len(accumulated) < gconfig.max_new_tokens
             ):
-                # chunk boundary, not a genuine stop: the server delivered
-                # everything this chunk asked for — resume from here
-                # (reference partial_rollout.py:181-250 refresh cycle)
-                stop_reason = None
-            if stop_reason == "abort":
-                # server is in a weight-update window; brief backoff then
-                # resume with accumulated tokens
-                n_aborts += 1
-                await asyncio.sleep(self.config.pause_grace_period or 0.1)
-        with self._lock:
-            self._rid_to_address.pop(req.rid, None)
+                if failed and len(failed) >= len(self.addresses):
+                    # every server has failed this request once — forgive
+                    # the exclusions (one may have recovered) rather than
+                    # fail closed; max_failovers still bounds total hops
+                    failed.clear()
+                server = self.choose_server(req.rid, exclude=failed)
+                remaining = gconfig.max_new_tokens - len(accumulated)
+                ask = min(remaining, chunk) if chunk > 0 else remaining
+                payload = {
+                    "rid": req.rid,
+                    "input_ids": list(req.input_ids) + accumulated,
+                    "sampling_params": {
+                        "max_new_tokens": ask,
+                    },
+                }
+                if req.image_data:
+                    payload["image_data"] = list(req.image_data)
+                if req.mm is not None:
+                    # JSON-safe multimodal payload. The big float32 patch
+                    # tensor goes as ONE base64 blob (nested JSON lists
+                    # would be ~8x the bytes and dominate request
+                    # parsing); the small int meta arrays stay as lists.
+                    import base64 as _b64
+                    import numpy as _np
+
+                    mm_json = {}
+                    for k, v in req.mm.items():
+                        if k == "pixel_values":
+                            arr = _np.asarray(v, _np.float32)
+                            mm_json["pixel_values_b64"] = _b64.b64encode(
+                                arr.tobytes()
+                            ).decode()
+                            mm_json["pixel_values_shape"] = list(arr.shape)
+                        else:
+                            mm_json[k] = (
+                                v.tolist() if hasattr(v, "tolist") else v
+                            )
+                    payload["mm"] = mm_json
+                payload["sampling_params"].update(
+                    {
+                        "min_new_tokens": max(
+                            0, gconfig.min_new_tokens - len(accumulated)
+                        ),
+                        "temperature": gconfig.temperature,
+                        "top_p": gconfig.top_p,
+                        "top_k": gconfig.top_k,
+                        "greedy": gconfig.greedy,
+                        "stop_token_ids": gconfig.stop_token_ids,
+                    }
+                )
+                t_call = time.monotonic()
+                try:
+                    result = await arequest_with_retry(
+                        session,
+                        f"http://{server}/generate",
+                        payload,
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.request_timeout,
+                    )
+                except HttpRequestError as e:
+                    # retries exhausted against THIS server. 4xx means
+                    # the request itself is wrong — propagate. Everything
+                    # else (connect failure, timeout, 5xx) means the
+                    # server is gone or sick: fail over to a healthy one
+                    # and RESUME from the accumulated tokens — migration,
+                    # not restart (the suffix-resume loop makes the moved
+                    # request token-exact).
+                    status = getattr(e, "status", None)
+                    if status is not None and 400 <= status < 500:
+                        raise
+                    if self.fleet is not None:
+                        self.fleet.report_failure(server)
+                    with self._lock:
+                        if self._rid_to_address.get(req.rid) == server:
+                            del self._rid_to_address[req.rid]
+                    failed.add(server)
+                    n_failovers += 1
+                    migrated = len(accumulated) > 0
+                    if self.fleet is not None:
+                        self.fleet.record_failover(migrated)
+                    if self.tracer.enabled:
+                        reason = (
+                            f"http_{status}" if status is not None
+                            else "connect"
+                        )
+                        self.tracer.instant(
+                            "failover", req.rid, from_server=server,
+                            reason=reason,
+                            resumed_tokens=len(accumulated),
+                        )
+                        if migrated:
+                            self.tracer.instant(
+                                "migration", req.rid, from_server=server,
+                                resumed_tokens=len(accumulated),
+                            )
+                    if n_failovers > max_failovers:
+                        raise HttpRequestError(
+                            f"request {req.rid} exceeded "
+                            f"{max_failovers} failovers (last: {e})",
+                            status=status,
+                        ) from e
+                    logger.warning(
+                        f"failover: rid={req.rid} off {server} "
+                        f"({e}); resuming {len(accumulated)} tokens "
+                        f"elsewhere"
+                    )
+                    continue
+                if self.fleet is not None:
+                    self.fleet.report_success(server)
+                n_calls += 1
+                if self.tracer.enabled:
+                    self.tracer.record(
+                        "generate_call", req.rid, t_call, time.monotonic(),
+                        server=server, new_tokens=len(result["output_ids"]),
+                    )
+                if ttft is None and result["output_ids"]:
+                    ttft = time.monotonic() - start
+                accumulated.extend(result["output_ids"])
+                logprobs.extend(result["output_logprobs"])
+                versions.extend(result["output_versions"])
+                stop_reason = result["meta_info"]["finish_reason"]["type"]
+                if (
+                    stop_reason == "length"
+                    and ask < remaining
+                    and len(result["output_ids"]) >= ask
+                ):
+                    # chunk boundary, not a genuine stop: the server
+                    # delivered everything this chunk asked for — resume
+                    # from here (reference partial_rollout.py:181-250
+                    # refresh cycle)
+                    stop_reason = None
+                if stop_reason == "abort":
+                    # server is in a weight-update window; brief backoff
+                    # then resume with accumulated tokens
+                    n_aborts += 1
+                    await asyncio.sleep(
+                        self.config.pause_grace_period or 0.1
+                    )
+        finally:
+            # an exception anywhere above must not leave a stale affinity
+            # entry pinning this rid to a server it will never revisit
+            with self._lock:
+                self._rid_to_address.pop(req.rid, None)
         now = time.monotonic()
         if self.tracer.enabled:
             if ttft is not None:
@@ -316,6 +586,7 @@ class RemoteInferenceEngine(InferenceEngine):
                 output_tokens=len(accumulated),
                 stop_reason=stop_reason or "length",
                 n_calls=n_calls, n_aborts=n_aborts,
+                n_failovers=n_failovers,
             )
         # generation-time staleness: how far each produced token already
         # lags the trainer at COMPLETION time (the consumed-batch lag is
@@ -330,6 +601,7 @@ class RemoteInferenceEngine(InferenceEngine):
                 "rollout/latency_s": now - start,
                 "rollout/output_tokens": float(len(accumulated)),
                 "rollout/aborts_per_request": float(n_aborts),
+                "rollout/failovers_per_request": float(n_failovers),
             })
         return ModelResponse(
             input_tokens=list(req.input_ids),
@@ -351,12 +623,31 @@ class RemoteInferenceEngine(InferenceEngine):
         pause posts — runs off-thread so one slow server never stalls the
         train loop."""
 
+        def _alive_addresses():
+            """Fan-out target set: skip servers the fleet already knows
+            are DEAD/DRAINING — posting at them would stall or fail the
+            whole update for capacity that isn't serving anyway."""
+            if self.fleet is None:
+                return list(self.addresses)
+            alive = [
+                a for a in self.addresses if self.fleet.is_schedulable(a)
+            ]
+            return alive or list(self.addresses)
+
         def _pause_all():
-            for addr in self.addresses:
-                r = _requests.post(
-                    f"http://{addr}/pause_generation", timeout=30
-                )
-                r.raise_for_status()
+            for addr in _alive_addresses():
+                try:
+                    r = _requests.post(
+                        f"http://{addr}/pause_generation", timeout=30
+                    )
+                    r.raise_for_status()
+                except Exception as e:
+                    # a server that cannot even pause is effectively
+                    # gone; open its circuit and keep the rest of the
+                    # fleet moving (on recover, the re-sync path
+                    # re-pushes the last disk checkpoint or drains it)
+                    logger.error(f"pause_generation {addr} failed: {e}")
+                    self._quarantine(addr)
 
         # Pause SYNCHRONOUSLY before returning (reference pauses inline,
         # sglang_remote.py:252-254): callers overlap `update_weights(...)`
@@ -384,7 +675,7 @@ class RemoteInferenceEngine(InferenceEngine):
                     # (spmd_engine.upload_weights); wait on the SAME set of
                     # addresses it streams to (meta.addrs when given), or
                     # unstreamed servers would be polled forever
-                    targets = list(meta.addrs) or self.addresses
+                    targets = list(meta.addrs) or _alive_addresses()
                     # dedicated (shorter) bound: a failed upload must not
                     # hold every server paused for the full request
                     # timeout (3600s default)
@@ -392,23 +683,42 @@ class RemoteInferenceEngine(InferenceEngine):
                         self.config.request_timeout,
                         getattr(self.config, "weight_update_timeout", 300.0),
                     )
+                    reached = []
                     for addr in targets:
-                        while True:
-                            r = _requests.get(
-                                f"http://{addr}/get_model_info", timeout=30
-                            )
-                            r.raise_for_status()
-                            if (
-                                int(r.json().get("model_version", -1))
-                                >= meta.model_version
-                            ):
-                                break
-                            if time.monotonic() > deadline:
-                                raise TimeoutError(
-                                    f"{addr} never reached weight version "
-                                    f"{meta.model_version}"
+                        try:
+                            while True:
+                                r = _requests.get(
+                                    f"http://{addr}/get_model_info",
+                                    timeout=30,
                                 )
-                            time.sleep(0.2)
+                                r.raise_for_status()
+                                if (
+                                    int(r.json().get("model_version", -1))
+                                    >= meta.model_version
+                                ):
+                                    reached.append(addr)
+                                    break
+                                if time.monotonic() > deadline:
+                                    raise TimeoutError(
+                                        f"{addr} never reached weight "
+                                        f"version {meta.model_version}"
+                                    )
+                                time.sleep(0.2)
+                        except Exception as e:
+                            # one lost server must not strand the update
+                            # on the surviving fleet — but it now holds
+                            # STALE weights, so its circuit opens and
+                            # re-admission goes through the version check
+                            logger.error(
+                                f"device weight update: {addr} dropped "
+                                f"({e})"
+                            )
+                            self._quarantine(addr)
+                    if not reached:
+                        raise RuntimeError(
+                            f"no server reached weight version "
+                            f"{meta.model_version}"
+                        )
                     self.set_version(meta.model_version)
                 finally:
                     self._resume_all_best_effort()
@@ -440,18 +750,36 @@ class RemoteInferenceEngine(InferenceEngine):
                             f"weight checkpoint never appeared at {meta.path}"
                         )
                     time.sleep(0.2)
-                for addr in self.addresses:
-                    r = _requests.post(
-                        f"http://{addr}/update_weights_from_disk",
-                        json={
-                            "model_path": meta.path,
-                            "version": meta.model_version,
-                        },
-                        timeout=600,
+                updated = []
+                for addr in _alive_addresses():
+                    try:
+                        r = _requests.post(
+                            f"http://{addr}/update_weights_from_disk",
+                            json={
+                                "model_path": meta.path,
+                                "version": meta.model_version,
+                            },
+                            timeout=600,
+                        )
+                        r.raise_for_status()
+                        assert r.json().get("success"), r.json()
+                        updated.append(addr)
+                    except Exception as e:
+                        # it missed this version: quarantine so it can
+                        # only re-enter through the re-sync path
+                        logger.error(
+                            f"disk weight update: {addr} dropped ({e})"
+                        )
+                        self._quarantine(addr)
+                if not updated:
+                    raise RuntimeError(
+                        f"no server accepted weight version "
+                        f"{meta.model_version}"
                     )
-                    r.raise_for_status()
-                    assert r.json().get("success"), r.json()
                 self.set_version(meta.model_version)
+                # catch-up source for servers that were DEAD just now:
+                # _on_server_recovered re-pushes this checkpoint
+                self._last_disk_update = (meta.path, meta.model_version)
             finally:
                 self._resume_all_best_effort()
                 _record_pause_window()

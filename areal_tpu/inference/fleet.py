@@ -1,0 +1,442 @@
+"""Fleet resilience plane: health-aware membership over N generation servers.
+
+The async architecture assumes a long-lived disaggregated fleet behind the
+trainer; at the scale the north star names, server loss is a *when*. This
+module is the piece every layer consults before trusting an address:
+
+- **Per-server state machine** — ``HEALTHY → SUSPECT → DEAD →
+  RECOVERING (→ HEALTHY)``, driven by active ``/health`` probes AND
+  passive failure/success reports from clients (``engine/remote.py``
+  reports every request outcome, so a crash is noticed at the first
+  failed call, not the next probe tick).
+- **Circuit breaker with half-open probes** — a DEAD server stops
+  receiving traffic and is probed at most every
+  ``halfopen_interval_s``; one success moves it to RECOVERING, where
+  ``recover_threshold`` consecutive successes must land before it is
+  schedulable again (a flapping server cannot re-enter the fleet on one
+  lucky probe).
+- **Graceful drain** — ``drain(addr)`` marks a server DRAINING
+  (unschedulable, but not a failure); a server whose ``/health`` body
+  says ``draining`` is classified the same way, so a server-initiated
+  drain propagates without any control-plane call.
+- **Dynamic membership** — when constructed with a name_resolve
+  ``membership_key``, the monitor polls the gen_servers subtree and
+  joins/leaves servers live (discovered entries only: explicitly seeded
+  or ``/register``-ed servers are never removed by the watch).
+
+The monitor never *chooses* servers — ``engine/remote.choose_server``
+and ``inference/router.RouterState.schedule`` own policy — it answers
+``is_schedulable`` and fires ``on_dead/on_join/on_leave`` callbacks so
+owners can evict affinity and reclaim capacity. Scheduling semantics:
+HEALTHY and SUSPECT take traffic (one failed probe must not drain a
+server that is merely slow); DEAD, RECOVERING, and DRAINING do not.
+
+Everything is injectable (``probe_fn``, ``time_fn``) so the state
+machine is unit-testable without sockets or sleeps; the chaos harness
+(``utils/chaos.py``) covers the integration side.
+"""
+
+import enum
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils import name_resolve
+
+logger = logging_util.getLogger("FleetMonitor")
+
+
+class ServerState(str, enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    DEAD = "dead"
+    RECOVERING = "recovering"
+    DRAINING = "draining"
+
+
+# states that may receive new work
+_SCHEDULABLE = (ServerState.HEALTHY, ServerState.SUSPECT)
+
+
+class ServerHealth:
+    __slots__ = (
+        "addr", "state", "fails", "successes", "probe_latency_s",
+        "last_probe", "last_transition", "source",
+    )
+
+    def __init__(self, addr: str, source: str = "seed",
+                 t: float = 0.0):
+        self.addr = addr
+        self.state = ServerState.HEALTHY
+        self.fails = 0  # consecutive failures (probe or passive)
+        self.successes = 0  # consecutive successes
+        self.probe_latency_s = 0.0
+        self.last_probe = -float("inf")
+        self.last_transition = t
+        self.source = source  # seed | registered | discovered
+
+
+def default_probe(addr: str, timeout: float) -> Tuple[str, float]:
+    """GET /health → ("ok" | "draining" | "fail", latency_s)."""
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(
+            f"http://{addr}/health", timeout=timeout
+        ) as r:
+            latency = time.monotonic() - t0
+            if r.status != 200:
+                return "fail", latency
+            try:
+                status = json.loads(r.read()).get("status", "ok")
+            except Exception:
+                status = "ok"
+            return ("draining" if status == "draining" else "ok"), latency
+    except Exception:
+        return "fail", time.monotonic() - t0
+
+
+class FleetMonitor:
+    def __init__(
+        self,
+        addresses: List[str],
+        config: Optional[FleetConfig] = None,
+        probe_fn: Optional[Callable[[str], Tuple[str, float]]] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+        membership_key: Optional[str] = None,
+        on_join: Optional[Callable[[str], None]] = None,
+        on_leave: Optional[Callable[[str], None]] = None,
+        on_dead: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+        seed_source: str = "seed",
+    ):
+        self.config = config or FleetConfig()
+        self._probe_fn = probe_fn or (
+            lambda a: default_probe(a, self.config.probe_timeout_s)
+        )
+        self._time = time_fn
+        self.membership_key = membership_key
+        self.on_join = on_join
+        self.on_leave = on_leave
+        self.on_dead = on_dead
+        # fired when a server RE-ENTERS rotation after being out of it
+        # (DEAD→RECOVERING→HEALTHY or DRAINING→HEALTHY) — owners verify
+        # the server didn't miss weight updates while it was gone
+        self.on_recover = on_recover
+        self._lock = threading.RLock()
+        now = self._time()
+        # owners that DISCOVERED their fleet from name_resolve seed with
+        # source="discovered", so the membership watch may remove the
+        # initial servers too when their registrations vanish; explicit
+        # "seed" servers are never watched away
+        self._servers: Dict[str, ServerHealth] = {
+            a: ServerHealth(a, source=seed_source, t=now)
+            for a in addresses
+        }
+        # fleet-wide counters (owners feed failovers via record_failover)
+        self.failovers_total = 0
+        self.requests_migrated_total = 0
+        self.probes_total = 0
+        self.probe_failures_total = 0
+        self._last_membership_poll = -float("inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # Membership
+    # ------------------------------------------------------------------
+    def addresses(self) -> List[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def add_server(self, addr: str, source: str = "registered") -> bool:
+        """Join a server (idempotent). New members start HEALTHY — the
+        next probe demotes them if they lied."""
+        with self._lock:
+            if addr in self._servers:
+                return False
+            self._servers[addr] = ServerHealth(addr, source, self._time())
+        logger.info(f"fleet join: {addr} ({source})")
+        if self.on_join:
+            self.on_join(addr)
+        return True
+
+    def remove_server(self, addr: str) -> bool:
+        with self._lock:
+            if self._servers.pop(addr, None) is None:
+                return False
+        logger.info(f"fleet leave: {addr}")
+        if self.on_leave:
+            self.on_leave(addr)
+        return True
+
+    def poll_membership(self) -> None:
+        """Diff the name_resolve gen_servers subtree against the fleet:
+        new registrations join, vanished DISCOVERED entries leave."""
+        if not self.membership_key:
+            return
+        try:
+            current = set(name_resolve.get_subtree(self.membership_key))
+        except Exception as e:  # rendezvous hiccup ≠ fleet change
+            logger.warning(f"membership poll failed: {e}")
+            return
+        with self._lock:
+            known = set(self._servers)
+            discovered_gone = [
+                a for a, h in self._servers.items()
+                if h.source == "discovered" and a not in current
+            ]
+        for addr in current - known:
+            self.add_server(addr, source="discovered")
+        for addr in discovered_gone:
+            self.remove_server(addr)
+
+    # ------------------------------------------------------------------
+    # State machine
+    # ------------------------------------------------------------------
+    def state(self, addr: str) -> Optional[ServerState]:
+        with self._lock:
+            h = self._servers.get(addr)
+            return h.state if h else None
+
+    def is_schedulable(self, addr: str) -> bool:
+        with self._lock:
+            h = self._servers.get(addr)
+            return h is not None and h.state in _SCHEDULABLE
+
+    def schedulable_addresses(self) -> List[str]:
+        with self._lock:
+            return [
+                a for a, h in self._servers.items()
+                if h.state in _SCHEDULABLE
+            ]
+
+    def _transition(self, h: ServerHealth, to: ServerState) -> Optional[str]:
+        """Returns the addr to fire on_dead for (outside the lock)."""
+        if h.state is to:
+            return None
+        logger.info(f"fleet: {h.addr} {h.state.value} -> {to.value}")
+        h.state = to
+        h.last_transition = self._time()
+        return h.addr if to is ServerState.DEAD else None
+
+    def _apply_failure(self, h: ServerHealth) -> Optional[str]:
+        h.fails += 1
+        h.successes = 0
+        cfg = self.config
+        if h.state is ServerState.DRAINING:
+            return None  # draining servers are already out of rotation
+        if h.state is ServerState.RECOVERING:
+            # a half-open failure re-opens the circuit immediately
+            return self._transition(h, ServerState.DEAD)
+        if (
+            h.state is ServerState.HEALTHY
+            and h.fails >= cfg.suspect_threshold
+        ):
+            self._transition(h, ServerState.SUSPECT)
+        if (
+            h.state is ServerState.SUSPECT
+            and h.fails >= cfg.dead_threshold
+        ):
+            return self._transition(h, ServerState.DEAD)
+        return None
+
+    def _apply_success(
+        self, h: ServerHealth, from_probe: bool = False
+    ) -> Optional[str]:
+        """Returns the addr to fire on_recover for (outside the lock)
+        when the server RE-ENTERED rotation from an out-of-rotation
+        state; SUSPECT→HEALTHY is not a recovery (it never left)."""
+        h.fails = 0
+        h.successes += 1
+        if h.state is ServerState.HEALTHY:
+            return None
+        if h.state is ServerState.DRAINING:
+            # only a PROBE may undo a drain (the server's own /health no
+            # longer says draining — drain cancelled or it restarted
+            # admission); a passive success is just in-flight work from
+            # before the drain finishing, not a rejoin signal
+            if from_probe:
+                self._transition(h, ServerState.HEALTHY)
+                return h.addr
+        elif h.state is ServerState.SUSPECT:
+            self._transition(h, ServerState.HEALTHY)
+        elif h.state is ServerState.DEAD:
+            # first half-open success: circuit half-closes
+            self._transition(h, ServerState.RECOVERING)
+        elif h.state is ServerState.RECOVERING:
+            if h.successes >= self.config.recover_threshold:
+                self._transition(h, ServerState.HEALTHY)
+                return h.addr
+        return None
+
+    # passive signals from request outcomes ----------------------------
+    def report_failure(self, addr: str) -> None:
+        dead: Optional[str] = None
+        with self._lock:
+            h = self._servers.get(addr)
+            if h is not None:
+                dead = self._apply_failure(h)
+        if dead and self.on_dead:
+            self.on_dead(dead)
+
+    def report_success(self, addr: str) -> None:
+        recovered: Optional[str] = None
+        with self._lock:
+            h = self._servers.get(addr)
+            if h is not None:
+                recovered = self._apply_success(h)
+        if recovered and self.on_recover:
+            self.on_recover(recovered)
+
+    def drain(self, addr: str) -> bool:
+        with self._lock:
+            h = self._servers.get(addr)
+            if h is None:
+                return False
+            self._transition(h, ServerState.DRAINING)
+            return True
+
+    def record_failover(self, migrated: bool) -> None:
+        """One request hopped servers; migrated = it carried accumulated
+        tokens (a resumed suffix), not a fresh start."""
+        with self._lock:
+            self.failovers_total += 1
+            if migrated:
+                self.requests_migrated_total += 1
+
+    # ------------------------------------------------------------------
+    # Probing
+    # ------------------------------------------------------------------
+    def probe_once(self) -> None:
+        """One probe sweep. DEAD servers are only probed once per
+        half-open window; everyone else is probed every sweep."""
+        now = self._time()
+        with self._lock:
+            due = [
+                h.addr for h in self._servers.values()
+                if not (
+                    h.state is ServerState.DEAD
+                    and now - h.last_probe
+                    < self.config.halfopen_interval_s
+                )
+            ]
+        for addr in due:
+            status, latency = self._probe_fn(addr)
+            dead: Optional[str] = None
+            recovered: Optional[str] = None
+            with self._lock:
+                h = self._servers.get(addr)
+                if h is None:  # left the fleet mid-sweep
+                    continue
+                h.last_probe = self._time()
+                h.probe_latency_s = latency
+                self.probes_total += 1
+                if status == "ok":
+                    recovered = self._apply_success(h, from_probe=True)
+                elif status == "draining":
+                    # server-initiated drain: out of rotation, no circuit
+                    self._transition(h, ServerState.DRAINING)
+                else:
+                    self.probe_failures_total += 1
+                    dead = self._apply_failure(h)
+            if dead and self.on_dead:
+                self.on_dead(dead)
+            if recovered and self.on_recover:
+                self.on_recover(recovered)
+
+    # ------------------------------------------------------------------
+    # Background loop
+    # ------------------------------------------------------------------
+    def start(self) -> "FleetMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-monitor"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+
+    def _loop(self) -> None:
+        interval = max(0.05, self.config.probe_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self.probe_once()
+                if (
+                    self.membership_key
+                    and self.config.watch_membership
+                    and self._time() - self._last_membership_poll
+                    >= self.config.membership_poll_s
+                ):
+                    self._last_membership_poll = self._time()
+                    self.poll_membership()
+            except Exception as e:  # the monitor must never die
+                logger.error(f"fleet monitor sweep failed: {e}")
+
+    # ------------------------------------------------------------------
+    # Metrics
+    # ------------------------------------------------------------------
+    def state_metrics(self) -> Dict[str, float]:
+        """Fleet-shape gauges only (owners with their own failover
+        counters merge these; see RouterState.metrics)."""
+        with self._lock:
+            states = [h.state for h in self._servers.values()]
+            return {
+                "fleet_servers": float(len(states)),
+                "fleet_healthy_servers": float(
+                    sum(s is ServerState.HEALTHY for s in states)
+                ),
+                "fleet_suspect_servers": float(
+                    sum(s is ServerState.SUSPECT for s in states)
+                ),
+                "fleet_dead_servers": float(
+                    sum(s is ServerState.DEAD for s in states)
+                ),
+                "fleet_recovering_servers": float(
+                    sum(s is ServerState.RECOVERING for s in states)
+                ),
+                "fleet_draining_servers": float(
+                    sum(s is ServerState.DRAINING for s in states)
+                ),
+                # open circuits = DEAD; half-open = RECOVERING
+                "fleet_circuit_open": float(
+                    sum(s is ServerState.DEAD for s in states)
+                ),
+                "fleet_circuit_half_open": float(
+                    sum(s is ServerState.RECOVERING for s in states)
+                ),
+                "fleet_probes_total": float(self.probes_total),
+                "fleet_probe_failures_total": float(
+                    self.probe_failures_total
+                ),
+            }
+
+    def metrics(self) -> Dict[str, float]:
+        out = self.state_metrics()
+        with self._lock:
+            out["failovers_total"] = float(self.failovers_total)
+            out["requests_migrated_total"] = float(
+                self.requests_migrated_total
+            )
+        return out
+
+    def per_server(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                a: {
+                    "state": h.state.value,
+                    "probe_latency_s": h.probe_latency_s,
+                    "consecutive_failures": float(h.fails),
+                }
+                for a, h in self._servers.items()
+            }

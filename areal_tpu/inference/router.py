@@ -19,6 +19,16 @@ generation fleet, which client-side policies in each process cannot do:
 - ``GET /metrics`` — aggregated Prometheus scrape of all servers
   (gserver_manager.py:293-325).
 
+Resilience plane (inference/fleet.py): the router owns a `FleetMonitor`
+whose verdicts gate scheduling — DEAD/DRAINING/RECOVERING servers take
+no new work, a server going DEAD evicts every qid-affinity entry
+pinned to it and reclaims its estimated in-flight capacity, and the
+fleet can grow/shrink live via ``POST /register`` / ``POST /drain`` (or
+the name_resolve membership watch). ``GET /metrics`` exports the fleet
+gauges (`fleet_healthy_servers`, `fleet_circuit_open`,
+`failovers_total`, `requests_migrated_total`, per-server probe
+latency) next to the capacity counters.
+
 Servers are discovered from ``name_resolve`` (names.gen_servers) or given
 explicitly. Thread-safe; stdlib HTTP only (the reference uses FastAPI —
 rejected here to keep the serving tier dependency-free).
@@ -28,9 +38,12 @@ import json
 import threading
 import time
 import urllib.request
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.inference.fleet import FleetMonitor, ServerState
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils import name_resolve, names, network
 
@@ -45,6 +58,7 @@ class RouterState:
         max_head_offpolicyness: int = 10**9,
         max_concurrent_rollouts: int = 10**9,
         schedule_policy: str = "least_token_usage",
+        qid_cache_size: int = 8192,
     ):
         self.lock = threading.Lock()
         self.addresses = list(addresses)
@@ -57,7 +71,11 @@ class RouterState:
         self.accepted = 0  # total allocated
         self.finished = 0  # total finished (≈ samples produced)
         self._rr = 0
-        self._qid_server: Dict[str, str] = {}
+        # qid → server affinity, LRU-bounded WITHIN a weight version (a
+        # version bump still clears it wholesale; the cap stops unbounded
+        # growth between bumps on long-offpolicyness runs)
+        self.qid_cache_size = max(1, qid_cache_size)
+        self._qid_server: "OrderedDict[str, str]" = OrderedDict()
         self._requests: Dict[str, int] = {a: 0 for a in addresses}
         self._tokens: Dict[str, float] = {a: 0.0 for a in addresses}
         # rid/qid-affinity effectiveness: hits land a request back on the
@@ -65,12 +83,37 @@ class RouterState:
         # the hit RATE is the sibling-dedup health signal on /metrics
         self.sched_total = 0
         self.sched_affinity_hits = 0
+        # resilience plane: set by serve_router (monitor needs `self` for
+        # its on_dead callback); None = every address is trusted
+        self.fleet: Optional[FleetMonitor] = None
+        # last successful /update_weights fan-out (path, version): the
+        # catch-up source for servers that were DEAD during it
+        self._last_weight_update: Optional[tuple] = None
+        self.failovers_total = 0  # schedule decisions redirected off an
+        # unhealthy server (sticky/affinity target no longer schedulable)
+        self.requests_migrated_total = 0  # affinity entries evicted from
+        # a DEAD server — in-flight work forced to move
 
     # -- scheduling ----------------------------------------------------
+    def _schedulable(self, addr: str) -> bool:
+        return self.fleet is None or self.fleet.is_schedulable(addr)
+
     def schedule(self, meta: Dict) -> Dict:
         with self.lock:
             self.sched_total += 1
             qid = str(meta.get("qid") or meta.get("rid") or "")
+            candidates = [a for a in self.addresses if self._schedulable(a)]
+            if not candidates:
+                # fail open: a wholly-unhealthy verdict is likelier a
+                # probe outage than a fleet-wide loss; routing somewhere
+                # beats routing nowhere
+                candidates = list(self.addresses)
+            if not candidates:
+                # every server deregistered/drained away — an explicit
+                # error beats a 500 from an empty min()/modulo
+                return {"success": False, "reason": "no_servers"}
+            cset = set(candidates)
+            redirected = False
             prev = meta.get("previous_server")
             if (
                 prev in self._requests
@@ -78,30 +121,126 @@ class RouterState:
             ):
                 # sticky while the version is unchanged (interruptible
                 # resubmits reuse the server's cached prefix)
-                self.sched_affinity_hits += 1
-                return {"url": prev, "version": self.version}
+                if prev in cset:
+                    self.sched_affinity_hits += 1
+                    return {"url": prev, "version": self.version}
+                redirected = True  # sticky target unhealthy → reroute
             if qid and qid in self._qid_server:
                 addr = self._qid_server[qid]
-                self.sched_affinity_hits += 1
-                return {"url": addr, "version": self.version}
+                if addr in cset:
+                    if redirected:
+                        # the sticky target was unhealthy even though
+                        # the group already migrated — still a redirect
+                        self.failovers_total += 1
+                    self.sched_affinity_hits += 1
+                    self._qid_server.move_to_end(qid)
+                    return {"url": addr, "version": self.version}
+                del self._qid_server[qid]  # dead-server affinity eviction
+                redirected = True
+            if redirected:
+                self.failovers_total += 1
             if self.schedule_policy == "round_robin":
-                addr = self.addresses[self._rr % len(self.addresses)]
+                addr = candidates[self._rr % len(candidates)]
                 self._rr += 1
             elif self.schedule_policy == "least_requests":
-                addr = min(self.addresses, key=lambda a: self._requests[a])
+                addr = min(
+                    candidates, key=lambda a: self._requests.get(a, 0)
+                )
             else:  # least_token_usage
-                addr = min(self.addresses, key=lambda a: self._tokens[a])
+                addr = min(
+                    candidates, key=lambda a: self._tokens.get(a, 0.0)
+                )
             if qid:
                 self._qid_server[qid] = addr
-            self._requests[addr] += 1
+                self._qid_server.move_to_end(qid)
+                while len(self._qid_server) > self.qid_cache_size:
+                    self._qid_server.popitem(last=False)
+            self._requests[addr] = self._requests.get(addr, 0) + 1
             # expected token load: prompt + a fraction of the budget (the
             # reference's 0.4 heuristic — most gens stop well before the
             # budget)
-            self._tokens[addr] += float(meta.get("prompt_len", 0)) + 0.4 * (
+            self._tokens[addr] = self._tokens.get(addr, 0.0) + float(
+                meta.get("prompt_len", 0)
+            ) + 0.4 * (
                 float(meta.get("new_token_budget", 0))
                 * max(1, int(meta.get("group_size", 1)))
             )
             return {"url": addr, "version": self.version}
+
+    # -- fleet membership / failure handling ---------------------------
+    def register(self, addr: str) -> Dict:
+        """Join a server live (POST /register): schedulable immediately;
+        the prober demotes it if it lied."""
+        with self.lock:
+            if addr not in self.addresses:
+                self.addresses.append(addr)
+            self._requests.setdefault(addr, 0)
+            self._tokens.setdefault(addr, 0.0)
+        if self.fleet is not None:
+            self.fleet.add_server(addr)
+        logger.info(f"registered server {addr}")
+        return {"success": True, "servers": len(self.addresses)}
+
+    def deregister(self, addr: str) -> Dict:
+        with self.lock:
+            if addr in self.addresses:
+                self.addresses.remove(addr)
+        self.evict_server(addr, count_migrations=False)
+        with self.lock:
+            # drop the load estimates entirely (a member's counters are
+            # only reset) — under churn the maps must not accumulate
+            # keys for long-gone servers, and the sticky check keys
+            # membership off _requests
+            self._requests.pop(addr, None)
+            self._tokens.pop(addr, None)
+        if self.fleet is not None:
+            self.fleet.remove_server(addr)
+        logger.info(f"deregistered server {addr}")
+        return {"success": True, "servers": len(self.addresses)}
+
+    def drain(self, addr: str) -> Dict:
+        """Graceful removal (POST /drain): stop scheduling onto the
+        server, tell it to finish in-flight work and deregister. New
+        sibling samples re-resolve elsewhere; nothing is killed."""
+        if self.fleet is not None:
+            self.fleet.drain(addr)
+        self.evict_server(addr, count_migrations=False)
+        forwarded = False
+        try:
+            self._post(addr, "/drain", {}, timeout=10)
+            forwarded = True
+        except Exception as e:
+            logger.warning(f"drain forward to {addr} failed: {e}")
+        return {"success": True, "forwarded": forwarded}
+
+    def evict_server(self, addr: str, count_migrations: bool = True) -> int:
+        """Dead-server bookkeeping: drop every qid pinned to ``addr``
+        (their in-flight rollouts must migrate) and reclaim its
+        estimated request/token load so a recovered server re-enters the
+        balance clean."""
+        with self.lock:
+            stale = [
+                q for q, a in self._qid_server.items() if a == addr
+            ]
+            for q in stale:
+                del self._qid_server[q]
+            if count_migrations:
+                self.requests_migrated_total += len(stale)
+                self.failovers_total += len(stale)
+            # in-flight capacity reclamation: the load estimates pointed
+            # at work that died with the server. Members are reset to 0;
+            # departed servers must not be resurrected into the maps
+            if addr in self.addresses:
+                self._requests[addr] = 0
+                self._tokens[addr] = 0.0
+            else:
+                self._requests.pop(addr, None)
+                self._tokens.pop(addr, None)
+        if stale:
+            logger.warning(
+                f"evicted {len(stale)} qid affinities from {addr}"
+            )
+        return len(stale)
 
     # -- capacity + staleness gate ------------------------------------
     def allocate(self) -> Dict:
@@ -130,17 +269,32 @@ class RouterState:
         path = meta.get("path", "")
         version = int(meta.get("version", self.version + 1))
         results = {}
-        for addr in self.addresses:
-            self._post(addr, "/pause_generation", {})
+        targets = [a for a in self.addresses if self._schedulable(a)]
+        if not targets:
+            targets = list(self.addresses)
+        for addr in targets:
+            try:
+                self._post(addr, "/pause_generation", {})
+            except Exception as e:
+                logger.error(f"pause_generation {addr}: {e}")
+                if self.fleet is not None:
+                    self.fleet.report_failure(addr)
         try:
-            for addr in self.addresses:
-                results[addr] = self._post(
-                    addr, "/update_weights_from_disk",
-                    {"path": path, "version": version},
-                    timeout=600,
-                )
+            for addr in targets:
+                try:
+                    results[addr] = self._post(
+                        addr, "/update_weights_from_disk",
+                        {"path": path, "version": version},
+                        timeout=600,
+                    )
+                except Exception as e:
+                    # one dead server must not fail the fleet-wide update
+                    logger.error(f"update_weights {addr}: {e}")
+                    results[addr] = {"success": False, "error": str(e)}
+                    if self.fleet is not None:
+                        self.fleet.report_failure(addr)
         finally:
-            for addr in self.addresses:
+            for addr in targets:
                 try:
                     self._post(addr, "/continue_generation", {})
                 except Exception as e:  # keep resuming the rest
@@ -150,7 +304,50 @@ class RouterState:
             # fresh version invalidates the qid affinity map (the cached
             # prefixes it pointed at were flushed by the servers)
             self._qid_server.clear()
+            if path:
+                self._last_weight_update = (path, version)
         return {"success": True, "version": version, "servers": results}
+
+    def resync_server(self, addr: str) -> None:
+        """on_recover hook: a server re-entered rotation after being out
+        of it (it may have been skipped by /update_weights fan-outs).
+        Verify the version it serves; push the last checkpoint when it
+        is behind, else drain it — re-admission must be version-checked
+        on the router path too, not only the trainer-client path."""
+        try:
+            with self.lock:
+                current = self.version
+                last = self._last_weight_update
+            if current <= 0:
+                return
+            req = urllib.request.Request(
+                f"http://{addr}/get_model_info"
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                served = int(json.loads(r.read()).get("model_version", -1))
+            if served >= current:
+                return
+            if last is not None and last[1] >= current:
+                out = self._post(
+                    addr, "/update_weights_from_disk",
+                    {"path": last[0], "version": last[1]}, timeout=600,
+                )
+                assert out.get("success"), out
+                logger.info(
+                    f"re-synced recovered {addr}: v{served} -> v{last[1]}"
+                )
+                return
+            logger.error(
+                f"recovered {addr} serves stale v{served} < v{current} "
+                f"with no checkpoint to re-push; draining it"
+            )
+            self.drain(addr)
+        except Exception as e:
+            logger.error(f"recover re-sync for {addr} failed: {e}")
+            if self.fleet is not None:
+                # unverifiable ≠ schedulable: reopen the circuit
+                for _ in range(max(1, self.fleet.config.dead_threshold)):
+                    self.fleet.report_failure(addr)
 
     def metrics(self) -> str:
         from areal_tpu.utils.tracing import render_prometheus
@@ -169,17 +366,43 @@ class RouterState:
                     if self.sched_total
                     else 0.0
                 ),
+                "qid_affinity_entries": len(self._qid_server),
+                "failovers_total": self.failovers_total,
+                "requests_migrated_total": self.requests_migrated_total,
             }
+        if self.fleet is not None:
+            own.update(self.fleet.state_metrics())
         lines = [
             render_prometheus(
                 own, prefix="areal_tpu_router_",
                 types={
                     "sched_total": "counter",
                     "sched_affinity_hits": "counter",
+                    "failovers_total": "counter",
+                    "requests_migrated_total": "counter",
+                    "fleet_probes_total": "counter",
+                    "fleet_probe_failures_total": "counter",
                 },
             ).rstrip("\n")
         ]
+        if self.fleet is not None:
+            # per-server fleet detail, labeled like the scraped samples
+            for addr, info in self.fleet.per_server().items():
+                tag = addr.replace(":", "_").replace(".", "_")
+                lines.append(
+                    f'areal_tpu_router_fleet_probe_latency_s'
+                    f'{{server="{tag}"}} {info["probe_latency_s"]}'
+                )
+                lines.append(
+                    f'areal_tpu_router_fleet_server_up'
+                    f'{{server="{tag}",state="{info["state"]}"}} '
+                    f'{1 if info["state"] in ("healthy", "suspect") else 0}'
+                )
         for addr in self.addresses:
+            if self.fleet is not None and self.fleet.state(addr) in (
+                ServerState.DEAD,
+            ):
+                continue  # scraping a corpse just burns the timeout
             try:
                 req = urllib.request.Request(f"http://{addr}/metrics")
                 with urllib.request.urlopen(req, timeout=10) as r:
@@ -236,6 +459,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/servers":
             self._send_json({"servers": self.state.addresses,
                              "version": self.state.version})
+        elif self.path == "/fleet":
+            fleet = self.state.fleet
+            self._send_json({
+                "servers": fleet.per_server() if fleet else {},
+                "metrics": fleet.metrics() if fleet else {},
+            })
         else:
             self._send_json({"error": f"unknown path {self.path}"}, 404)
 
@@ -250,6 +479,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(self.state.finish())
             elif self.path == "/update_weights":
                 self._send_json(self.state.update_weights(payload))
+            elif self.path == "/register":
+                self._send_json(self.state.register(str(payload["addr"])))
+            elif self.path == "/deregister":
+                self._send_json(
+                    self.state.deregister(str(payload["addr"]))
+                )
+            elif self.path == "/drain":
+                self._send_json(self.state.drain(str(payload["addr"])))
             elif self.path == "/set_version":
                 with self.state.lock:
                     self.state.version = int(payload["version"])
@@ -267,17 +504,51 @@ def serve_router(
     host: str = "127.0.0.1",
     port: int = 0,
     background: bool = True,
+    fleet_config: Optional[FleetConfig] = None,
+    probe_interval_s: float = 0.0,
     **state_kwargs,
 ) -> ThreadingHTTPServer:
     """Start the router; discovers servers from name_resolve when
     ``addresses`` is not given (reference generation_server registration,
-    generation_server.py:159-170)."""
+    generation_server.py:159-170).
+
+    The resilience plane is always present (fleet state, /register,
+    /drain, eviction-on-death); ACTIVE probing + the membership watch
+    start only when ``probe_interval_s > 0`` or an explicit
+    ``fleet_config`` asks for them — a router without a prober still
+    reacts to passive signals and drains."""
+    discovered = addresses is None
     if addresses is None:
         key = names.gen_servers(experiment_name, trial_name)
         addresses = sorted(name_resolve.get_subtree(key))
     if not addresses:
         raise ValueError("router needs at least one generation server")
     state = RouterState(addresses, **state_kwargs)
+    cfg = fleet_config
+    if cfg is None:
+        cfg = FleetConfig(enabled=probe_interval_s > 0)
+        if probe_interval_s > 0:
+            cfg.probe_interval_s = probe_interval_s
+    membership_key = None
+    if discovered and cfg.watch_membership and experiment_name:
+        membership_key = names.gen_servers(experiment_name, trial_name)
+    monitor = FleetMonitor(
+        addresses,
+        cfg,
+        membership_key=membership_key,
+        on_join=lambda a: state.register(a),
+        on_leave=lambda a: state.deregister(a),
+        on_dead=lambda a: state.evict_server(a),
+        # re-sync does blocking HTTP (up to the disk-update timeout) —
+        # run it off the monitor thread so probing never stalls
+        on_recover=lambda a: threading.Thread(
+            target=state.resync_server, args=(a,), daemon=True
+        ).start(),
+        seed_source="discovered" if membership_key else "seed",
+    )
+    state.fleet = monitor
+    if cfg.enabled:
+        monitor.start()
     handler = type("Handler", (_Handler,), {"state": state})
     if port == 0:
         port = network.find_free_ports(1)[0]
@@ -306,7 +577,15 @@ def main(argv=None):
     p.add_argument("--max-head-offpolicyness", type=int, default=10**9)
     p.add_argument("--max-concurrent-rollouts", type=int, default=10**9)
     p.add_argument("--schedule-policy", default="least_token_usage")
+    p.add_argument(
+        "--probe-interval", type=float, default=2.0,
+        help="health-probe period in seconds (0 disables active probing)",
+    )
+    p.add_argument("--qid-cache-size", type=int, default=8192)
     args = p.parse_args(argv)
+    # rendezvous in the launcher's namespace (AREAL_NAME_RESOLVE): server
+    # discovery AND the live membership watch both read that subtree
+    name_resolve.reconfigure_from_env()
     serve_router(
         addresses=[a for a in args.addrs.split(",") if a] or None,
         experiment_name=args.experiment_name,
@@ -317,6 +596,8 @@ def main(argv=None):
         max_head_offpolicyness=args.max_head_offpolicyness,
         max_concurrent_rollouts=args.max_concurrent_rollouts,
         schedule_policy=args.schedule_policy,
+        probe_interval_s=args.probe_interval,
+        qid_cache_size=args.qid_cache_size,
     )
 
 

@@ -13,22 +13,79 @@ Observability endpoints: ``GET /metrics`` serves the engine gauges and
 counters in Prometheus text-exposition format; ``GET /trace`` DRAINS the
 engine's span buffer as Chrome trace-event JSON (``?format=jsonl`` for the
 line format `tools/trace_report.py` consumes).
+
+Resilience plane: ``POST /drain`` puts the server in drain mode — new
+``/generate`` calls get 503, in-flight requests run to completion, and
+the name_resolve registration is removed once the engine is empty (so
+routers/clients watching membership see the server leave). ``/health``
+reports ``{"status": "draining"}`` during the window, which
+`inference/fleet.FleetMonitor` classifies as out-of-rotation without
+opening a circuit. ``POST /chaos`` installs chaos rules at runtime
+(``{"spec": "..."}``, utils/chaos.py grammar; ``{}`` disables) and the
+handler honors server-side rules on every request — connection drops,
+injected 500s, latency spikes, and hard kills, all deterministic.
 """
 
 import argparse
 import json
+import os
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from areal_tpu.api.cli_args import JaxGenConfig
 from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import chaos
 from areal_tpu.utils import logging as logging_util, names, network
 from areal_tpu.utils import name_resolve
 from areal_tpu.utils.tracing import render_prometheus
 
 logger = logging_util.getLogger("GenServer")
+
+
+class ServerControl:
+    """Server-shell state that is not the engine's: drain mode + the
+    name_resolve registration to tear down on exit."""
+
+    def __init__(self, engine: GenerationEngine):
+        self.engine = engine
+        self.draining = threading.Event()
+        self.registration_key: Optional[str] = None
+        self._drain_thread: Optional[threading.Thread] = None
+
+    def deregister(self) -> None:
+        key, self.registration_key = self.registration_key, None
+        if key is None:
+            return
+        try:
+            name_resolve.delete(key)
+            logger.info(f"deregistered {key}")
+        except Exception as e:
+            logger.warning(f"deregister failed: {e}")
+
+    def start_drain(self) -> int:
+        """Enter drain mode; returns the in-flight count at entry. A
+        watcher thread deregisters once the engine is empty."""
+        self.draining.set()
+        m = self.engine.metrics()
+        in_flight = int(m["running_requests"] + m["queued_requests"])
+        if self._drain_thread is None or not self._drain_thread.is_alive():
+            self._drain_thread = threading.Thread(
+                target=self._watch_drain, daemon=True
+            )
+            self._drain_thread.start()
+        return in_flight
+
+    def _watch_drain(self) -> None:
+        while True:
+            m = self.engine.metrics()
+            if m["running_requests"] + m["queued_requests"] <= 0:
+                break
+            time.sleep(0.2)
+        self.deregister()
+        logger.info("drain complete: engine empty, registration removed")
 
 _METRIC_HELP = {
     "running_requests": "requests currently holding a decode slot",
@@ -44,10 +101,48 @@ _METRIC_HELP = {
 
 class _Handler(BaseHTTPRequestHandler):
     engine: GenerationEngine = None  # set by serve()
+    control: ServerControl = None  # set by serve()
+    # runtime POST /chaos gate: the CLI path (production launchers)
+    # closes it unless --enable-chaos; the embedded serve() path (tests,
+    # bench harnesses) leaves it open. An open /chaos is a remote kill
+    # switch — it must be an operator's opt-in, never a default.
+    chaos_endpoint: bool = True
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet default access logs
         pass
+
+    def _apply_chaos(self) -> bool:
+        """Honor server-side chaos rules for this request. Returns True
+        when a response was already produced (caller must return)."""
+        inj = chaos.get_injector()
+        if inj is None:
+            return False
+        act = inj.check("server", self.path)
+        if act is None:
+            return False
+        mode = act["mode"]
+        if mode == "latency":
+            time.sleep(act["latency_s"])
+            return False  # delayed, then served normally
+        if mode == "http_500":
+            self._send_json({"error": "chaos injected"}, 500)
+            return True
+        if mode == "connect_drop":
+            # die without a response: the client sees a reset socket
+            try:
+                self.connection.close()
+            except Exception:
+                pass
+            return True
+        if mode == "kill":
+            # the SIGKILL analog: no cleanup, no flush — the process is
+            # simply gone (what a preempted VM / OOM-killed server does)
+            logger.error(
+                f"chaos: hard-killing server (exit {act['exit_code']})"
+            )
+            os._exit(act["exit_code"])
+        return False
 
     def _send_json(self, obj, code: int = 200):
         body = json.dumps(obj).encode()
@@ -72,9 +167,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         eng = self.engine
+        if self._apply_chaos():
+            return
         url = urllib.parse.urlparse(self.path)
         if url.path == "/health":
-            self._send_json({"status": "ok"})
+            draining = (
+                self.control is not None
+                and self.control.draining.is_set()
+            )
+            self._send_json(
+                {"status": "draining" if draining else "ok"}
+            )
         elif url.path == "/get_model_info":
             self._send_json(
                 {
@@ -106,11 +209,43 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         eng = self.engine
+        if self._apply_chaos():
+            return
         try:
             if self.path == "/generate":
+                if (
+                    self.control is not None
+                    and self.control.draining.is_set()
+                ):
+                    # drain mode: no new admissions; in-flight requests
+                    # (already inside eng.generate) run to completion
+                    self._send_json({"error": "draining"}, 503)
+                    return
                 payload = self._read_json()
                 result = eng.generate(payload)
                 self._send_json(result)
+            elif self.path == "/drain":
+                self._read_json()  # drain takes no arguments; drain the body
+                if self.control is None:
+                    self._send_json({"error": "no server control"}, 500)
+                else:
+                    n = self.control.start_drain()
+                    self._send_json(
+                        {"status": "draining", "in_flight": n}
+                    )
+            elif self.path == "/chaos":
+                payload = self._read_json()
+                if not self.chaos_endpoint:
+                    self._send_json(
+                        {"error": "chaos endpoint disabled "
+                         "(start the server with --enable-chaos)"}, 403
+                    )
+                    return
+                inj = chaos.configure(payload.get("spec") or None)
+                self._send_json({
+                    "success": True,
+                    "rules": inj.stats() if inj else [],
+                })
             elif self.path == "/pause_generation":
                 eng.pause()
                 self._send_json({"status": "paused"})
@@ -147,18 +282,44 @@ def serve(
     trial_name: str = "",
     server_index: int = 0,
     background: bool = False,
+    router_addr: str = "",
+    chaos_endpoint: bool = True,
 ) -> ThreadingHTTPServer:
     if port == 0:
         port = network.find_free_ports(1)[0]
-    handler = type("Handler", (_Handler,), {"engine": engine})
+    control = ServerControl(engine)
+    handler = type(
+        "Handler", (_Handler,),
+        {"engine": engine, "control": control,
+         "chaos_endpoint": chaos_endpoint},
+    )
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
+    httpd.server_control = control  # for tests/introspection
     if experiment_name and trial_name:
-        # register for discovery (reference generation_server.py:159-170)
-        name_resolve.add_subentry(
+        # register for discovery (reference generation_server.py:159-170);
+        # the key is kept so /drain can deregister this server live
+        control.registration_key = name_resolve.add_subentry(
             names.gen_servers(experiment_name, trial_name),
             f"{host}:{port}",
         )
+    if router_addr:
+        # dynamic membership without a shared name_resolve: announce
+        # directly to the fronting router (best-effort — the router's
+        # prober also finds us through the membership watch)
+        try:
+            import urllib.request as _rq
+
+            req = _rq.Request(
+                f"http://{router_addr}/register",
+                data=json.dumps({"addr": f"{host}:{port}"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with _rq.urlopen(req, timeout=10) as r:
+                r.read()
+            logger.info(f"registered with router {router_addr}")
+        except Exception as e:
+            logger.warning(f"router registration failed: {e}")
     logger.info(f"generation server listening on {host}:{port}")
     if background:
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
@@ -190,7 +351,21 @@ def main(argv: Optional[list] = None):
         help="persistent XLA compile cache (warm engines skip the "
         "decode bucket-ladder warmup)",
     )
+    p.add_argument(
+        "--router-addr", default="",
+        help="router host:port to POST /register to at startup "
+        "(dynamic fleet membership without shared name_resolve)",
+    )
+    p.add_argument(
+        "--enable-chaos", action="store_true",
+        help="open the runtime POST /chaos fault-injection endpoint "
+        "(resilience testing only — it can hard-kill the server)",
+    )
     args = p.parse_args(argv)
+    # subprocess servers rendezvous in the launcher's namespace: the
+    # launcher exports AREAL_NAME_RESOLVE (e.g. "nfs:/shared/root") so
+    # registrations land where trainers/routers watch for them
+    name_resolve.reconfigure_from_env()
     cfg = JaxGenConfig(
         model_path=args.model_path,
         dtype=args.dtype,
@@ -211,6 +386,8 @@ def main(argv: Optional[list] = None):
         experiment_name=args.experiment_name,
         trial_name=args.trial_name,
         server_index=args.server_index,
+        router_addr=args.router_addr,
+        chaos_endpoint=args.enable_chaos,
     )
 
 

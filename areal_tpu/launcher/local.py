@@ -167,6 +167,18 @@ def local_main(
         env = {}
         if _attempt > 0 and recover_enabled:
             env[RECOVER_ENV] = "1"
+        # every subprocess (servers AND trainer) rendezvous in the same
+        # name_resolve namespace: server registration/deregistration is
+        # what drives dynamic fleet membership (inference/fleet.py), so
+        # it must land where the trainer's FleetMonitor watches
+        nr = getattr(config.cluster, "name_resolve", None)
+        if nr is not None:
+            from areal_tpu.utils.name_resolve import BACKEND_ENV
+
+            if nr.type == "nfs":
+                env[BACKEND_ENV] = f"nfs:{nr.nfs_record_root}"
+            elif nr.type == "kv" and getattr(nr, "kv_address", ""):
+                env[BACKEND_ENV] = f"kv:{nr.kv_address}"
         if alloc is not None and alloc.type_ in (
             AllocationType.DECOUPLED_TRAIN,
             AllocationType.LLM_SERVER_ONLY,

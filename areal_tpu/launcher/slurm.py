@@ -90,14 +90,22 @@ class SlurmLauncher:
     # ------------------------------------------------------------------
     def launch_servers(self, server_cmd: List[str]) -> List[str]:
         """One sbatch job per generation server; each registers its
-        address in name_resolve (server.py does this on startup)."""
+        address in name_resolve (server.py does this on startup) — the
+        submit host's AREAL_NAME_RESOLVE backend spec is forwarded so
+        registration/drain events land in the namespace the trainer's
+        FleetMonitor watches (dynamic membership across the cluster)."""
+        from areal_tpu.utils.name_resolve import BACKEND_ENV
+
         ids = []
+        nr_spec = os.environ.get(BACKEND_ENV, "")
         for i in range(self.server_count):
             lines = self._header(f"server{i}", nodes=1)
-            lines += [
-                f"export AREAL_SERVER_INDEX={i}",
-                " ".join(shlex.quote(c) for c in server_cmd),
-            ]
+            lines += [f"export AREAL_SERVER_INDEX={i}"]
+            if nr_spec:
+                lines += [
+                    f"export {BACKEND_ENV}={shlex.quote(nr_spec)}"
+                ]
+            lines += [" ".join(shlex.quote(c) for c in server_cmd)]
             ids.append(self.submit(self._write(f"server{i}", lines)))
         self.job_ids += ids
         return ids

@@ -1,0 +1,199 @@
+"""Deterministic chaos-injection harness for the rollout fleet.
+
+Resilience (inference/fleet.py, the failover path in engine/remote.py)
+must be testable in tier-1 without real crashes or wall-clock flakiness,
+so every failure mode here fires on a *counted* schedule, never a random
+one: a rule matches its Nth..(N+count)th qualifying call, exactly, on
+every run. The modes mirror what a real fleet sees:
+
+- ``connect_drop`` — the connection dies before a response (client side:
+  raised as an ``aiohttp.ClientConnectionError`` inside
+  ``utils/http.arequest_with_retry``; server side: the socket is closed
+  without writing a response).
+- ``http_500``     — the server answers 500 (retryable per the retry
+  policy, unlike 4xx).
+- ``latency``      — a fixed delay is inserted before the call proceeds
+  (``latency_s`` seconds).
+- ``kill``         — the server process hard-exits (``os._exit``), the
+  SIGKILL analog; only honored on the server side.
+
+Rules are configured from a spec string (config, the ``AREAL_CHAOS``
+environment variable — read lazily so subprocess servers inherit it —
+or at runtime via the generation server's ``POST /chaos`` endpoint)::
+
+    mode[:key=value[,key=value...]][;mode:...]
+
+keys: ``match`` (URL/path substring, empty = all), ``side`` (``client`` |
+``server`` | ``any``), ``start`` (0-based index of the first qualifying
+call the rule fires on), ``count`` (how many qualifying calls it fires
+on; -1 = forever), ``latency_s``, ``exit_code``. Example — kill the
+server on its 3rd /generate, after injecting one 500::
+
+    http_500:side=server,match=/generate,start=1,count=1;kill:side=server,match=/generate,start=2
+
+Injection points call :func:`get_injector` (None when chaos is off —
+the disabled path is one module-level read) and apply the returned
+action themselves; the injector never sleeps, raises, or exits on its
+own, so each call site stays in control of its error semantics.
+"""
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+ENV_VAR = "AREAL_CHAOS"
+
+MODES = ("connect_drop", "http_500", "latency", "kill")
+
+
+@dataclasses.dataclass
+class ChaosRule:
+    mode: str
+    match: str = ""  # URL/path substring; "" matches everything
+    side: str = "any"  # client | server | any
+    start: int = 0  # first qualifying call (0-based) the rule fires on
+    count: int = -1  # qualifying calls it fires on; -1 = forever
+    latency_s: float = 0.0
+    exit_code: int = 137  # SIGKILL analog for `kill`
+    seen: int = dataclasses.field(default=0, compare=False)
+    fired: int = dataclasses.field(default=0, compare=False)
+
+    def applies(self, side: str, url: str) -> bool:
+        if self.side != "any" and self.side != side:
+            return False
+        return self.match in url
+
+    def tick(self) -> bool:
+        """Count one qualifying call; True when the call index falls in
+        this rule's [start, start+count) window. ``fired`` is NOT
+        incremented here — only the rule whose action is actually
+        applied records a firing (ChaosInjector.check)."""
+        idx = self.seen
+        self.seen += 1
+        if idx < self.start:
+            return False
+        if self.count >= 0 and idx >= self.start + self.count:
+            return False
+        return True
+
+    def action(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "latency_s": self.latency_s,
+            "exit_code": self.exit_code,
+        }
+
+
+def parse_spec(spec: str) -> List[ChaosRule]:
+    rules: List[ChaosRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        mode, _, rest = part.partition(":")
+        mode = mode.strip()
+        if mode not in MODES:
+            raise ValueError(f"unknown chaos mode {mode!r} (of {MODES})")
+        kwargs: Dict[str, Any] = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k in ("start", "count", "exit_code"):
+                kwargs[k] = int(v)
+            elif k == "latency_s":
+                kwargs[k] = float(v)
+            elif k in ("match", "side"):
+                kwargs[k] = v.strip()
+            else:
+                raise ValueError(f"unknown chaos rule key {k!r}")
+        rules.append(ChaosRule(mode=mode, **kwargs))
+    return rules
+
+
+class ChaosInjector:
+    """Holds the active rules; thread-safe counted matching."""
+
+    def __init__(self, rules: List[ChaosRule]):
+        self.rules = rules
+        self._lock = threading.Lock()
+
+    def check(self, side: str, url: str) -> Optional[Dict[str, Any]]:
+        """Count this call against every matching rule; return the action
+        of the first rule (spec order) whose window covers it, else
+        None. Every matching rule's call counter advances regardless —
+        windows are positional, so overlapping rules shadow each other
+        on shared calls (first in spec order wins) rather than shifting
+        later. Only the rule whose action is returned records a
+        ``fired``, so stats() reports what actually happened."""
+        fired: Optional[Dict[str, Any]] = None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.applies(side, url):
+                    continue
+                if rule.tick() and fired is None:
+                    rule.fired += 1
+                    fired = rule.action()
+        return fired
+
+    def stats(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {
+                    "mode": r.mode, "match": r.match, "side": r.side,
+                    "start": r.start, "count": r.count,
+                    "seen": r.seen, "fired": r.fired,
+                }
+                for r in self.rules
+            ]
+
+
+_LOCK = threading.Lock()
+_INJECTOR: Optional[ChaosInjector] = None
+_ENV_CHECKED = False
+
+
+def configure(spec: Union[str, List[ChaosRule], None]) -> Optional[ChaosInjector]:
+    """Install rules globally (spec string or pre-built rule list).
+    ``None``/empty disables chaos. Returns the active injector."""
+    global _INJECTOR, _ENV_CHECKED
+    with _LOCK:
+        _ENV_CHECKED = True  # explicit configuration overrides the env
+        if not spec:
+            _INJECTOR = None
+        elif isinstance(spec, str):
+            _INJECTOR = ChaosInjector(parse_spec(spec))
+        else:
+            _INJECTOR = ChaosInjector(list(spec))
+        return _INJECTOR
+
+
+def disable() -> None:
+    configure(None)
+
+
+def reset() -> None:
+    """Forget everything, including that the env was consulted (tests)."""
+    global _INJECTOR, _ENV_CHECKED
+    with _LOCK:
+        _INJECTOR = None
+        _ENV_CHECKED = False
+
+
+def get_injector() -> Optional[ChaosInjector]:
+    """The active injector, lazily initialized from ``AREAL_CHAOS`` the
+    first time anything asks — subprocess servers get their rules from
+    the environment without any wiring."""
+    global _INJECTOR, _ENV_CHECKED
+    if _ENV_CHECKED or _INJECTOR is not None:
+        return _INJECTOR
+    with _LOCK:
+        if not _ENV_CHECKED:
+            _ENV_CHECKED = True
+            spec = os.environ.get(ENV_VAR, "").strip()
+            if spec:
+                _INJECTOR = ChaosInjector(parse_spec(spec))
+    return _INJECTOR

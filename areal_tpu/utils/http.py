@@ -1,13 +1,39 @@
-"""Async HTTP helpers (role of reference areal/utils/http.py)."""
+"""Async HTTP helpers (role of reference areal/utils/http.py).
+
+Retry policy: connection errors, timeouts, and 5xx responses are
+retryable (the server may be mid-crash, mid-restart, or behind a weight
+update); 4xx responses are NOT — they mean the request itself is wrong,
+and re-POSTing it N times just multiplies the error. Backoff is
+exponential with bounded random jitter so N clients whose server died
+under them don't re-converge on the survivor in lockstep.
+
+Chaos hooks (utils/chaos.py): when an injector is active, each attempt
+first consults it — injected connection drops / 500s behave exactly
+like the real thing (retryable), and injected latency is awaited here,
+so resilience tests exercise this function's real control flow.
+"""
 
 import asyncio
+import random
 from typing import Any, Dict, Optional
 
 import aiohttp
 
+from areal_tpu.utils import chaos
+
 
 class HttpRequestError(Exception):
-    pass
+    """Request failed. ``status`` carries the last HTTP status when the
+    failure was a response (None for connection errors / timeouts), so
+    callers can distinguish "server is gone" from "request is wrong"."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
+
+
+def retryable_status(status: int) -> bool:
+    return status >= 500
 
 
 async def arequest_with_retry(
@@ -18,17 +44,35 @@ async def arequest_with_retry(
     max_retries: int = 3,
     timeout: float = 3600.0,
     retry_delay: float = 0.5,
+    max_retry_delay: float = 30.0,
+    jitter: float = 0.5,
 ) -> Dict[str, Any]:
     last_exc: Optional[Exception] = None
     for attempt in range(max_retries):
         try:
+            inj = chaos.get_injector()
+            if inj is not None:
+                act = inj.check("client", url)
+                if act is not None:
+                    if act["mode"] == "latency":
+                        await asyncio.sleep(act["latency_s"])
+                    elif act["mode"] == "connect_drop":
+                        raise aiohttp.ClientConnectionError(
+                            "chaos: connection dropped"
+                        )
+                    elif act["mode"] == "http_500":
+                        raise HttpRequestError(
+                            f"{method.upper()} {url} -> 500: chaos injected",
+                            status=500,
+                        )
             t = aiohttp.ClientTimeout(total=timeout)
             if method.upper() == "POST":
                 async with session.post(url, json=payload, timeout=t) as resp:
                     if resp.status != 200:
                         body = await resp.text()
                         raise HttpRequestError(
-                            f"POST {url} -> {resp.status}: {body[:500]}"
+                            f"POST {url} -> {resp.status}: {body[:500]}",
+                            status=resp.status,
                         )
                     return await resp.json()
             else:
@@ -36,11 +80,22 @@ async def arequest_with_retry(
                     if resp.status != 200:
                         body = await resp.text()
                         raise HttpRequestError(
-                            f"GET {url} -> {resp.status}: {body[:500]}"
+                            f"GET {url} -> {resp.status}: {body[:500]}",
+                            status=resp.status,
                         )
                     return await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, HttpRequestError) as e:
+            status = getattr(e, "status", None)
+            if status is not None and not retryable_status(status):
+                # 4xx: the request is malformed/rejected — retrying the
+                # same bytes cannot succeed; surface it immediately
+                raise
             last_exc = e
             if attempt + 1 < max_retries:
-                await asyncio.sleep(retry_delay * (2**attempt))
-    raise HttpRequestError(f"request to {url} failed after {max_retries} tries") from last_exc
+                delay = min(max_retry_delay, retry_delay * (2**attempt))
+                delay += random.uniform(0.0, jitter * delay)
+                await asyncio.sleep(delay)
+    raise HttpRequestError(
+        f"request to {url} failed after {max_retries} tries",
+        status=getattr(last_exc, "status", None),
+    ) from last_exc

@@ -294,6 +294,29 @@ class KvNameRecordRepository(NameRecordRepository):
 
 DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
 
+# launchers export this so subprocess servers/routers rendezvous in the
+# parent's namespace: "memory", "nfs:/record/root", or "kv:host:port"
+BACKEND_ENV = "AREAL_NAME_RESOLVE"
+
+
+def reconfigure_from_env() -> Optional[NameRecordRepository]:
+    """Configure the global repository from ``AREAL_NAME_RESOLVE``;
+    no-op (returns None) when the variable is unset/empty."""
+    spec = os.environ.get(BACKEND_ENV, "").strip()
+    if not spec:
+        return None
+    backend, _, arg = spec.partition(":")
+    if backend == "nfs":
+        kwargs = {"record_root": arg} if arg else {}
+        return reconfigure("nfs", **kwargs)
+    if backend == "kv":
+        if not arg:
+            raise ValueError(f"{BACKEND_ENV}=kv needs an address (kv:host:port)")
+        return reconfigure("kv", address=arg)
+    if backend == "memory":
+        return reconfigure("memory")
+    raise ValueError(f"unknown {BACKEND_ENV} backend: {spec!r}")
+
 
 def reconfigure(backend: str = "memory", **kwargs) -> NameRecordRepository:
     """Swap the global repository ('memory', 'nfs', or 'kv')."""

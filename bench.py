@@ -82,6 +82,155 @@ if _flag not in os.environ.get("LIBTPU_INIT_ARGS", ""):
 BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE = 2520.0
 
 
+def _resilience_phase() -> dict:
+    """Kill-one-of-two under the chaos harness, measured. Two tiny-model
+    CPU server subprocesses (tests/genserver_worker.py — they force the
+    host platform, so they never contend for the bench chip) front a
+    RemoteInferenceEngine; wave 1 runs undisturbed for the latency
+    baseline, then POST /chaos arms a deterministic hard-kill on one
+    server (3rd /generate of wave 2) and wave 2 must complete entirely
+    on the survivor. Reports completion rate, added latency, and the
+    failover/migration counts from the client's FleetMonitor."""
+    import asyncio
+    import queue as _q
+    import subprocess
+    import threading
+
+    import urllib.request as _rq
+
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "genserver_worker.py",
+    )
+    procs = []
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, worker, "0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        procs.append(proc)
+        lines: "_q.Queue[str]" = _q.Queue()
+
+        def drain():
+            for line in proc.stdout:
+                lines.put(line)
+
+        threading.Thread(target=drain, daemon=True).start()
+        return proc, lines
+
+    def wait_port(proc, lines, deadline):
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("resilience worker died at startup")
+            try:
+                line = lines.get(timeout=1.0)
+            except _q.Empty:
+                continue
+            if line.startswith("PORT "):
+                return int(line.split()[1])
+        raise RuntimeError("resilience worker never reported a port")
+
+    try:
+        (vproc, vlines), (sproc, slines) = spawn(), spawn()
+        deadline = time.monotonic() + 240
+        victim = f"127.0.0.1:{wait_port(vproc, vlines, deadline)}"
+        survivor = f"127.0.0.1:{wait_port(sproc, slines, deadline)}"
+        client = RemoteInferenceEngine(
+            InferenceEngineConfig(
+                consumer_batch_size=4, max_concurrent_rollouts=8,
+                request_timeout=120, request_retries=2,
+                setup_timeout=120, schedule_policy="round_robin",
+                new_tokens_per_chunk=8,
+                fleet=FleetConfig(
+                    probe_interval_s=0.5, probe_timeout_s=2.0,
+                    dead_threshold=2, halfopen_interval_s=120.0,
+                ),
+            )
+        ).initialize(addrs=[victim, survivor])
+
+        n_wave, max_new = 4, 24
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(1, 100, size=6).tolist() for _ in range(n_wave)
+        ]
+
+        def run_wave(tag):
+            async def wave():
+                reqs = [
+                    ModelRequest(
+                        rid=f"{tag}{i}", input_ids=p,
+                        gconfig=GenerationHyperparameters(
+                            n_samples=1, max_new_tokens=max_new,
+                            greedy=True,
+                        ),
+                    )
+                    for i, p in enumerate(prompts)
+                ]
+                return await asyncio.gather(
+                    *[client.agenerate(r) for r in reqs],
+                    return_exceptions=True,
+                )
+
+            t0 = time.perf_counter()
+            outs = asyncio.run(wave())
+            dt = time.perf_counter() - t0
+            done = sum(
+                1 for o in outs
+                if not isinstance(o, Exception)
+                and len(o.output_tokens) == max_new
+            )
+            return done, dt
+
+        try:
+            run_wave("w")  # warm both engines (compiles)
+            base_done, base_dt = run_wave("b")
+            # arm the deterministic kill for wave 2 and run it
+            req = _rq.Request(
+                f"http://{victim}/chaos",
+                data=json.dumps({
+                    "spec": "kill:side=server,match=/generate,start=2"
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with _rq.urlopen(req, timeout=10) as r:
+                r.read()
+            chaos_done, chaos_dt = run_wave("c")
+            fm = client.fleet.metrics()
+        finally:
+            client.destroy()
+        return {
+            "resilience_completion_rate": round(
+                chaos_done / n_wave, 4
+            ),
+            "resilience_baseline_completion_rate": round(
+                base_done / n_wave, 4
+            ),
+            "resilience_baseline_wave_s": round(base_dt, 3),
+            "resilience_chaos_wave_s": round(chaos_dt, 3),
+            "resilience_added_latency_s": round(chaos_dt - base_dt, 3),
+            "resilience_failovers": int(fm["failovers_total"]),
+            "resilience_migrations": int(fm["requests_migrated_total"]),
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -857,6 +1006,27 @@ def main():
     except Exception as e:
         extra["1p5b_error"] = f"{type(e).__name__}: {str(e)[:200]}"
         emit_phase("1p5b", {"error": extra["1p5b_error"]})
+
+    # --- resilience phase: one injected server kill under the chaos
+    # harness (utils/chaos.py) against a two-subprocess CPU fleet. The
+    # numbers of record are rollout COMPLETION RATE with one server lost
+    # mid-wave and the latency the failover added vs an undisturbed wave
+    # on the same fleet. Cells degrade to null on any failure, like the
+    # decode A/B phase — this phase must never cost the measured ones ---
+    try:
+        resil = _resilience_phase()
+        extra.update(resil)
+        emit_phase("resilience", resil)
+    except Exception as e:
+        extra["resilience_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "resilience",
+            {
+                "resilience_completion_rate": None,
+                "resilience_added_latency_s": None,
+                "error": extra["resilience_error"],
+            },
+        )
 
     unit = (
         "tokens/s (Qwen2-0.5B shape, 2k-token gens, async overlapped "

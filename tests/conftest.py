@@ -21,6 +21,17 @@ _ensure_virtual_devices(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: chaos-injection resilience tests (fleet failover, "
+        "deterministic fault harness — utils/chaos.py)",
+    )
+
+
 @pytest.fixture
 def memory_name_resolve():
     from areal_tpu.utils import name_resolve

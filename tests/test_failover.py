@@ -1,0 +1,222 @@
+"""Kill-one-of-N resilience, end to end and deterministic: two real
+generation-server subprocesses with IDENTICAL weights (same init seed),
+the chaos harness hard-kills one (``os._exit``) on its 3rd /generate —
+mid-wave, by construction — and every in-flight rollout must complete on
+the survivor with a token-exact resumed suffix (greedy streams equal to
+an uninterrupted single-server run). The client's FleetMonitor and a
+router fronting the pair must both reflect the event
+(failovers_total / requests_migrated_total / fleet_healthy_servers)."""
+
+import asyncio
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import jax
+
+# dies on its 3rd /generate: with 4 concurrent rollouts round-robined
+# 2-per-server, calls 1+2 are its two rids' FIRST chunks (both issued at
+# wave start), so the kill always lands on a SECOND chunk — every
+# migrated request carries a non-empty accumulated suffix
+VICTIM_CHAOS = "kill:side=server,match=/generate,start=2"
+
+
+def _spawn_worker(env_extra=None):
+    worker = os.path.join(os.path.dirname(__file__), "genserver_worker.py")
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, worker, "0"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def drain():
+        for line in proc.stdout:
+            lines.put(line)
+
+    threading.Thread(target=drain, daemon=True).start()
+    return proc, lines
+
+
+def _wait_port(proc, lines, deadline):
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("server process died during startup")
+        try:
+            line = lines.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if line.startswith("PORT "):
+            return int(line.split()[1])
+    raise RuntimeError("server never reported its port")
+
+
+@pytest.fixture(scope="module")
+def two_servers():
+    """(victim_addr, survivor_addr): same seed-0 weights; the victim
+    carries the chaos kill rule in its environment."""
+    victim, vlines = _spawn_worker({"AREAL_CHAOS": VICTIM_CHAOS})
+    survivor, slines = _spawn_worker()
+    deadline = time.monotonic() + 240
+    try:
+        vport = _wait_port(victim, vlines, deadline)
+        sport = _wait_port(survivor, slines, deadline)
+    except Exception:
+        victim.kill()
+        survivor.kill()
+        raise
+    yield f"127.0.0.1:{vport}", f"127.0.0.1:{sport}"
+    for proc in (victim, survivor):
+        if proc.poll() is None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+
+
+PROMPTS = [[7, 6, 5, 4], [1, 2, 3], [9, 8, 7], [2, 4, 6, 8]]
+MAX_NEW = 12
+
+
+@pytest.mark.chaos
+def test_hard_kill_migrates_inflight_rollouts_token_exact(two_servers):
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.router import serve_router
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+
+    victim_addr, survivor_addr = two_servers
+    router = serve_router(
+        addresses=[victim_addr, survivor_addr],
+        fleet_config=FleetConfig(
+            probe_interval_s=0.3, probe_timeout_s=2.0, dead_threshold=2,
+            halfopen_interval_s=60.0, watch_membership=False,
+        ),
+    )
+    router_addr = f"127.0.0.1:{router.server_address[1]}"
+    client = RemoteInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="failover", trial_name="t0",
+            consumer_batch_size=4, max_concurrent_rollouts=8,
+            request_timeout=60, request_retries=2, setup_timeout=120,
+            schedule_policy="round_robin",
+            # small chunks: weight-version interleave points AND the
+            # suffix-resume granularity the migration rides on
+            new_tokens_per_chunk=4,
+            fleet=FleetConfig(
+                probe_interval_s=0.3, probe_timeout_s=2.0,
+                dead_threshold=2, halfopen_interval_s=60.0,
+            ),
+        )
+    ).initialize(addrs=[victim_addr, survivor_addr])
+
+    try:
+        async def wave():
+            reqs = [
+                ModelRequest(
+                    rid=f"r{i}",
+                    input_ids=p,
+                    gconfig=GenerationHyperparameters(
+                        n_samples=1, max_new_tokens=MAX_NEW, greedy=True
+                    ),
+                )
+                for i, p in enumerate(PROMPTS)
+            ]
+            return await asyncio.gather(
+                *[client.agenerate(r) for r in reqs]
+            )
+
+        results = asyncio.run(wave())
+
+        # zero lost rollouts: every request ran to its full budget
+        assert len(results) == len(PROMPTS)
+        for out in results:
+            assert len(out.output_tokens) == MAX_NEW
+            assert out.stop_reason in ("stop", "length")
+
+        # the kill actually happened and in-flight work MIGRATED (resumed
+        # from accumulated tokens, not restarted)
+        fm = client.fleet.metrics()
+        assert fm["failovers_total"] >= 1, fm
+        assert fm["requests_migrated_total"] >= 1, fm
+
+        # token-exact: greedy streams equal an uninterrupted run on one
+        # engine holding the same seed-0 weights (the migration boundary
+        # is invisible in the output)
+        cfg = tiny_config("qwen2")
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jax.numpy.float32
+        )
+        ref = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+            ),
+            model_config=cfg,
+            params=params,
+        ).start()
+        try:
+            for prompt, out in zip(PROMPTS, results):
+                expect = ref.generate(
+                    {
+                        "input_ids": prompt,
+                        "sampling_params": {
+                            "max_new_tokens": MAX_NEW, "greedy": True
+                        },
+                    }
+                )
+                assert out.output_tokens == expect["output_ids"], (
+                    f"prompt {prompt}: migrated stream diverged"
+                )
+        finally:
+            ref.stop()
+
+        # the client's prober opens the circuit on the corpse
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            fm = client.fleet.metrics()
+            if fm["fleet_healthy_servers"] == 1.0:
+                break
+            time.sleep(0.2)
+        assert fm["fleet_healthy_servers"] == 1.0, fm
+        assert fm["fleet_circuit_open"] == 1.0, fm
+
+        # ... and the event is visible on the router's /metrics plane
+        deadline = time.monotonic() + 20
+        text = ""
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                f"http://{router_addr}/metrics", timeout=10
+            ) as r:
+                text = r.read().decode()
+            if "areal_tpu_router_fleet_healthy_servers 1" in text:
+                break
+            time.sleep(0.2)
+        assert "areal_tpu_router_fleet_healthy_servers 1" in text
+        assert "areal_tpu_router_fleet_circuit_open 1" in text
+        assert "areal_tpu_router_failovers_total" in text
+        assert "areal_tpu_router_requests_migrated_total" in text
+    finally:
+        client.destroy()
+        router.shutdown()

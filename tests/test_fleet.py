@@ -1,0 +1,801 @@
+"""Fleet resilience plane, deterministically: the FleetMonitor state
+machine (HEALTHY → SUSPECT → DEAD → RECOVERING, half-open probe gating,
+drain), the chaos harness's counted failure schedules, the hardened
+retry policy in utils/http.py, and the router's health-aware scheduling
++ /register + /drain + dead-server eviction. No real crashes here — the
+cross-process hard-kill lives in tests/test_failover.py."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.inference.fleet import FleetMonitor, ServerState
+from areal_tpu.utils import chaos, network
+from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# --------------------------------------------------------------------------
+# FleetMonitor state machine (injected probe + clock: zero sleeps)
+# --------------------------------------------------------------------------
+class Scripted:
+    """probe_fn returning per-address scripted results; repeats the last."""
+
+    def __init__(self, results):
+        self.results = {a: list(r) for a, r in results.items()}
+
+    def __call__(self, addr):
+        seq = self.results[addr]
+        status = seq.pop(0) if len(seq) > 1 else seq[0]
+        return status, 0.001
+
+
+def _cfg(**kw):
+    base = dict(
+        enabled=False, probe_interval_s=0.01, suspect_threshold=1,
+        dead_threshold=3, recover_threshold=2, halfopen_interval_s=10.0,
+        watch_membership=False,
+    )
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def test_state_machine_to_dead_and_halfopen_recovery():
+    clock = [0.0]
+    probe = Scripted({"a:1": ["fail"], "b:1": ["ok"]})
+    dead_events = []
+    m = FleetMonitor(
+        ["a:1", "b:1"], _cfg(), probe_fn=probe,
+        time_fn=lambda: clock[0], on_dead=dead_events.append,
+    )
+    assert m.is_schedulable("a:1") and m.is_schedulable("b:1")
+
+    m.probe_once()  # 1st failure: HEALTHY -> SUSPECT (still schedulable)
+    assert m.state("a:1") is ServerState.SUSPECT
+    assert m.is_schedulable("a:1")
+    m.probe_once()
+    m.probe_once()  # 3rd consecutive failure: SUSPECT -> DEAD
+    assert m.state("a:1") is ServerState.DEAD
+    assert not m.is_schedulable("a:1")
+    assert dead_events == ["a:1"]
+    assert m.state("b:1") is ServerState.HEALTHY
+    assert m.schedulable_addresses() == ["b:1"]
+
+    # circuit open: within the half-open window the corpse is NOT probed
+    probe.results["a:1"] = ["ok"]
+    clock[0] += 1.0
+    m.probe_once()
+    assert m.state("a:1") is ServerState.DEAD  # probe gated, no change
+
+    # past the window: one success half-closes the circuit (RECOVERING,
+    # still unschedulable), recover_threshold successes close it
+    clock[0] += 10.0
+    m.probe_once()
+    assert m.state("a:1") is ServerState.RECOVERING
+    assert not m.is_schedulable("a:1")
+    m.probe_once()
+    assert m.state("a:1") is ServerState.HEALTHY
+    assert m.is_schedulable("a:1")
+
+    metrics = m.metrics()
+    assert metrics["fleet_healthy_servers"] == 2
+    assert metrics["fleet_circuit_open"] == 0
+    assert metrics["fleet_probe_failures_total"] == 3
+
+
+def test_halfopen_failure_reopens_circuit():
+    clock = [0.0]
+    probe = Scripted({"a:1": ["fail"]})
+    m = FleetMonitor(
+        ["a:1"], _cfg(dead_threshold=1, suspect_threshold=1),
+        probe_fn=probe, time_fn=lambda: clock[0],
+    )
+    m.probe_once()
+    assert m.state("a:1") is ServerState.DEAD
+    clock[0] += 11.0
+    probe.results["a:1"] = ["ok", "fail"]
+    m.probe_once()  # half-open success
+    assert m.state("a:1") is ServerState.RECOVERING
+    m.probe_once()  # RECOVERING failure -> straight back to DEAD
+    assert m.state("a:1") is ServerState.DEAD
+    assert m.metrics()["fleet_circuit_open"] == 1
+
+
+def test_passive_reports_drive_the_same_machine():
+    m = FleetMonitor(["a:1", "b:1"], _cfg())
+    for _ in range(3):
+        m.report_failure("a:1")
+    assert m.state("a:1") is ServerState.DEAD
+    # suspect heals on one passive success
+    m.report_failure("b:1")
+    assert m.state("b:1") is ServerState.SUSPECT
+    m.report_success("b:1")
+    assert m.state("b:1") is ServerState.HEALTHY
+    m.record_failover(migrated=True)
+    m.record_failover(migrated=False)
+    metrics = m.metrics()
+    assert metrics["failovers_total"] == 2
+    assert metrics["requests_migrated_total"] == 1
+
+
+def test_draining_is_out_of_rotation_without_circuit():
+    probe = Scripted({"a:1": ["draining"], "b:1": ["ok"]})
+    m = FleetMonitor(["a:1", "b:1"], _cfg(), probe_fn=probe)
+    m.probe_once()
+    assert m.state("a:1") is ServerState.DRAINING
+    assert not m.is_schedulable("a:1")
+    assert m.metrics()["fleet_circuit_open"] == 0
+    # a drained server coming back reports ok again
+    probe.results["a:1"] = ["ok"]
+    m.probe_once()
+    assert m.state("a:1") is ServerState.HEALTHY
+
+
+def test_on_recover_fires_only_for_rotation_reentry():
+    clock = [0.0]
+    probe = Scripted({"a:1": ["fail"], "b:1": ["fail"]})
+    recovered = []
+    m = FleetMonitor(
+        ["a:1", "b:1"],
+        _cfg(dead_threshold=1, recover_threshold=1,
+             halfopen_interval_s=0.0),
+        probe_fn=probe, time_fn=lambda: clock[0],
+        on_recover=recovered.append,
+    )
+    m.probe_once()  # both DEAD (dead_threshold=1)
+    assert m.state("a:1") is ServerState.DEAD
+    probe.results["a:1"] = ["ok"]
+    m.probe_once()  # a: DEAD -> RECOVERING (no recover event yet)
+    assert m.state("a:1") is ServerState.RECOVERING
+    assert recovered == []
+    m.probe_once()  # a: RECOVERING -> HEALTHY fires on_recover
+    assert m.state("a:1") is ServerState.HEALTHY
+    assert recovered == ["a:1"]
+    # DRAINING -> HEALTHY via probe is also a rotation re-entry
+    m.drain("a:1")
+    m.probe_once()
+    assert recovered == ["a:1", "a:1"]
+    # SUSPECT -> HEALTHY is NOT (the server never left rotation);
+    # fresh monitor with default thresholds so one failure stays SUSPECT
+    recovered2 = []
+    m2 = FleetMonitor(["c:1"], _cfg(), on_recover=recovered2.append)
+    m2.report_failure("c:1")
+    assert m2.state("c:1") is ServerState.SUSPECT
+    m2.report_success("c:1")
+    assert m2.state("c:1") is ServerState.HEALTHY
+    assert recovered2 == []
+
+
+def test_recovered_stale_server_is_resynced_or_drained():
+    """engine/remote._on_server_recovered: a server re-entering rotation
+    at an old weight version gets the last disk checkpoint re-pushed;
+    with nothing to re-push it is told to /drain and marked DRAINING
+    (stale tokens must not silently enter the staleness accounting)."""
+    from areal_tpu.api.cli_args import InferenceEngineConfig
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+
+    events = []
+
+    class StaleServer:
+        def __init__(self):
+            outer_events = events
+
+            class H(BaseHTTPRequestHandler):
+                def log_message(self, fmt, *args):
+                    pass
+
+                def _send(self, obj):
+                    body = json.dumps(obj).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    outer_events.append(self.path)
+                    self._send({"model_version": 1})  # stale
+
+                def do_POST(self):
+                    n = int(self.headers.get("Content-Length", 0))
+                    self.rfile.read(n)
+                    outer_events.append(self.path)
+                    self._send({"success": True, "model_version": 5})
+
+            port = network.find_free_ports(1)[0]
+            self.addr = f"127.0.0.1:{port}"
+            self.httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
+            self.httpd.daemon_threads = True
+            threading.Thread(
+                target=self.httpd.serve_forever, daemon=True
+            ).start()
+
+    srv = StaleServer()
+    eng = RemoteInferenceEngine(InferenceEngineConfig())
+    eng.addresses = [srv.addr]
+    eng.fleet = FleetMonitor([srv.addr], _cfg())
+    eng.set_version(5)
+    try:
+        # no checkpoint to re-push -> told to drain + marked DRAINING
+        # (_resync_recovered_server is the sync body the on_recover
+        # callback dispatches to the worker pool)
+        eng._resync_recovered_server(srv.addr)
+        assert "/get_model_info" in events and "/drain" in events
+        assert eng.fleet.state(srv.addr) is ServerState.DRAINING
+        # with a current disk checkpoint -> re-pushed instead
+        events.clear()
+        eng.fleet = FleetMonitor([srv.addr], _cfg())
+        eng._last_disk_update = ("/tmp/ckpt", 5)
+        eng._resync_recovered_server(srv.addr)
+        assert "/update_weights_from_disk" in events
+        assert "/drain" not in events
+        assert eng.fleet.state(srv.addr) is ServerState.HEALTHY
+        # a failing re-sync must QUARANTINE (DEAD), not leave the server
+        # schedulable at an unknown version via SUSPECT
+        eng.fleet = FleetMonitor([srv.addr], _cfg())
+        srv.httpd.shutdown()
+        eng._resync_recovered_server(srv.addr)
+        assert eng.fleet.state(srv.addr) is ServerState.DEAD
+    finally:
+        srv.httpd.shutdown()
+
+
+def test_membership_watch_joins_and_leaves(memory_name_resolve):
+    from areal_tpu.utils import name_resolve
+
+    key = "test_fleet/gen_servers"
+    joined, left = [], []
+    m = FleetMonitor(
+        ["seed:1"], _cfg(watch_membership=True),
+        probe_fn=Scripted({"seed:1": ["ok"], "new:1": ["ok"]}),
+        membership_key=key, on_join=joined.append, on_leave=left.append,
+    )
+    sub = name_resolve.add_subentry(key, "new:1")
+    m.poll_membership()
+    assert joined == ["new:1"]
+    assert set(m.addresses()) == {"seed:1", "new:1"}
+    # deregistration removes DISCOVERED servers only; the seed stays
+    name_resolve.delete(sub)
+    m.poll_membership()
+    assert left == ["new:1"]
+    assert m.addresses() == ["seed:1"]
+
+
+# --------------------------------------------------------------------------
+# Chaos harness
+# --------------------------------------------------------------------------
+def test_chaos_spec_parsing_and_counted_schedule():
+    rules = chaos.parse_spec(
+        "http_500:side=server,match=/generate,start=1,count=2;"
+        "kill:side=server,match=/generate,start=3"
+    )
+    assert [r.mode for r in rules] == ["http_500", "kill"]
+    inj = chaos.ChaosInjector(rules)
+    # call 0: before start. calls 1,2: 500s. call 3: kill (both rules
+    # count every matching call independently).
+    acts = [inj.check("server", "/generate") for _ in range(4)]
+    assert acts[0] is None
+    assert acts[1]["mode"] == "http_500" and acts[2]["mode"] == "http_500"
+    assert acts[3]["mode"] == "kill"
+    # side + match filters
+    assert inj.check("client", "/generate") is None
+    assert inj.check("server", "/health") is None
+    stats = inj.stats()
+    assert stats[0]["fired"] == 2 and stats[1]["fired"] == 1
+    # overlapping windows: first rule in spec order wins the shared
+    # call; the shadowed rule's `fired` stat must stay 0 (it never
+    # actually happened), though its positional window still elapses
+    inj2 = chaos.ChaosInjector(chaos.parse_spec(
+        "http_500:start=0,count=1;connect_drop:start=0,count=1"
+    ))
+    assert inj2.check("server", "/x")["mode"] == "http_500"
+    assert inj2.check("server", "/x") is None  # drop's window elapsed
+    s2 = inj2.stats()
+    assert s2[0]["fired"] == 1 and s2[1]["fired"] == 0
+
+
+def test_chaos_env_and_configure(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "latency:latency_s=0.25,count=1")
+    chaos.reset()
+    inj = chaos.get_injector()
+    assert inj is not None
+    act = inj.check("client", "http://x/generate")
+    assert act["mode"] == "latency" and act["latency_s"] == 0.25
+    assert inj.check("client", "http://x/generate") is None  # count=1
+    chaos.configure(None)
+    assert chaos.get_injector() is None  # explicit config beats env
+    with pytest.raises(ValueError):
+        chaos.parse_spec("frobnicate:count=1")
+
+
+# --------------------------------------------------------------------------
+# Hardened HTTP retry policy
+# --------------------------------------------------------------------------
+class _CountingServer:
+    """/flaky: 500 twice then 200; /bad: always 404; /ok: 200."""
+
+    def __init__(self):
+        self.hits = {"/flaky": 0, "/bad": 0, "/ok": 0}
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                outer.hits[self.path] = outer.hits.get(self.path, 0) + 1
+                if self.path == "/bad":
+                    code = 404
+                elif (
+                    self.path == "/flaky"
+                    and outer.hits["/flaky"] <= 2
+                ):
+                    code = 500
+                else:
+                    code = 200
+                body = json.dumps({"ok": code == 200}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        port = network.find_free_ports(1)[0]
+        self.addr = f"127.0.0.1:{port}"
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def counting_server():
+    s = _CountingServer()
+    yield s
+    s.stop()
+
+
+def test_4xx_is_not_retried_5xx_is(counting_server):
+    import aiohttp
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            # 404: raised on the FIRST attempt, no re-POSTs
+            with pytest.raises(HttpRequestError) as ei:
+                await arequest_with_retry(
+                    session, f"http://{counting_server.addr}/bad", {},
+                    max_retries=5, retry_delay=0.01,
+                )
+            assert ei.value.status == 404
+            assert counting_server.hits["/bad"] == 1
+            # 500 twice then 200: retries drive it to success
+            out = await arequest_with_retry(
+                session, f"http://{counting_server.addr}/flaky", {},
+                max_retries=5, retry_delay=0.01,
+            )
+            assert out == {"ok": True}
+            assert counting_server.hits["/flaky"] == 3
+
+    asyncio.run(run())
+
+
+def test_exhausted_retries_carry_last_status(counting_server):
+    import aiohttp
+
+    counting_server.hits["/flaky"] = -10**9  # keep it failing throughout
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            with pytest.raises(HttpRequestError) as ei:
+                await arequest_with_retry(
+                    session, f"http://{counting_server.addr}/flaky", {},
+                    max_retries=2, retry_delay=0.01,
+                )
+            assert ei.value.status == 500
+
+    asyncio.run(run())
+
+
+def test_backoff_jitter_is_bounded(monkeypatch):
+    import aiohttp
+
+    delays = []
+
+    async def fake_sleep(d):
+        delays.append(d)
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+    chaos.configure("connect_drop:side=client")  # every attempt drops
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            with pytest.raises(HttpRequestError):
+                await arequest_with_retry(
+                    session, "http://127.0.0.1:1/x", {},
+                    max_retries=4, retry_delay=0.5, jitter=0.5,
+                )
+
+    asyncio.run(run())
+    # 3 backoffs for 4 attempts; each in [base, base*(1+jitter)]
+    assert len(delays) == 3
+    for i, d in enumerate(delays):
+        base = 0.5 * (2**i)
+        assert base <= d <= base * 1.5 + 1e-9
+
+
+def test_chaos_client_injection_consumes_retries():
+    import aiohttp
+
+    # exactly 2 injected drops, then there is still no server listening —
+    # but the schedule itself must be exact: 2 fired, counters say so
+    chaos.configure("connect_drop:side=client,count=2")
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            with pytest.raises(HttpRequestError):
+                await arequest_with_retry(
+                    session, "http://127.0.0.1:1/x", {},
+                    max_retries=3, retry_delay=0.01,
+                )
+
+    asyncio.run(run())
+    assert chaos.get_injector().stats()[0]["fired"] == 2
+
+
+# --------------------------------------------------------------------------
+# Router: health-aware scheduling, /register, /drain, eviction, LRU cap
+# --------------------------------------------------------------------------
+class MockServer:
+    def __init__(self):
+        self.events = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                outer.events.append(self.path)
+                self._send({"success": True, "status": "draining"})
+
+            def do_GET(self):
+                outer.events.append(self.path)
+                self._send({"status": "ok"})
+
+        port = network.find_free_ports(1)[0]
+        self.addr = f"127.0.0.1:{port}"
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _post(addr, path, payload=None):
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def resilient_fleet():
+    from areal_tpu.inference.router import serve_router
+
+    servers = [MockServer() for _ in range(2)]
+    router = serve_router(
+        addresses=[s.addr for s in servers],
+        schedule_policy="round_robin",
+        qid_cache_size=4,
+    )
+    addr = f"127.0.0.1:{router.server_address[1]}"
+    yield servers, router, addr
+    router.shutdown()
+    for s in servers:
+        s.stop()
+
+
+def test_router_skips_dead_and_evicts_affinity(resilient_fleet):
+    servers, router, addr = resilient_fleet
+    state = router.router_state
+    a = _post(addr, "/schedule_request", {"qid": "q1"})["url"]
+    # kill the affine server from the monitor's point of view
+    for _ in range(3):
+        state.fleet.report_failure(a)
+    assert not state.fleet.is_schedulable(a)
+    # on_dead evicted the q1 pin; rescheduling q1 lands on the survivor
+    b = _post(addr, "/schedule_request", {"qid": "q1"})["url"]
+    assert b != a
+    # fresh work also avoids the corpse
+    assert _post(addr, "/schedule_request", {"qid": "q2"})["url"] == b
+    assert state.failovers_total >= 1
+    assert state.requests_migrated_total >= 1
+    # capacity the dead server was carrying is reclaimed
+    assert state._requests[a] == 0 and state._tokens[a] == 0.0
+    # sticky resubmit at an unchanged version also redirects off a corpse
+    r = _post(addr, "/schedule_request",
+              {"qid": "q3", "previous_server": a, "previous_version": 0})
+    assert r["url"] == b
+    # fleet gauges on /metrics
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "areal_tpu_router_fleet_healthy_servers 1" in text
+    assert "areal_tpu_router_fleet_circuit_open 1" in text
+    assert "# TYPE areal_tpu_router_failovers_total counter" in text
+    assert 'areal_tpu_router_fleet_probe_latency_s{server="' in text
+
+
+def test_router_register_and_drain(resilient_fleet):
+    servers, router, addr = resilient_fleet
+    state = router.router_state
+    extra = MockServer()
+    try:
+        out = _post(addr, "/register", {"addr": extra.addr})
+        assert out["success"] and out["servers"] == 3
+        assert extra.addr in state.addresses
+        assert state.fleet.is_schedulable(extra.addr)
+        # round_robin now cycles through 3 servers
+        urls = {
+            _post(addr, "/schedule_request", {"qid": f"rq{i}"})["url"]
+            for i in range(3)
+        }
+        assert extra.addr in urls
+        # drain: out of rotation, forwarded to the server itself
+        out = _post(addr, "/drain", {"addr": extra.addr})
+        assert out["success"] and out["forwarded"]
+        assert "/drain" in extra.events
+        assert not state.fleet.is_schedulable(extra.addr)
+        urls = {
+            _post(addr, "/schedule_request", {"qid": f"dq{i}"})["url"]
+            for i in range(4)
+        }
+        assert extra.addr not in urls
+        with urllib.request.urlopen(
+            f"http://{addr}/fleet", timeout=10
+        ) as r:
+            fleet_dump = json.loads(r.read())
+        assert fleet_dump["servers"][extra.addr]["state"] == "draining"
+    finally:
+        extra.stop()
+
+
+def test_server_drain_mode_and_deregistration(memory_name_resolve):
+    """POST /drain on the generation-server shell: /health flips to
+    draining, new /generate gets 503, and once the engine is empty the
+    name_resolve registration disappears (a watching fleet sees the
+    server leave). The engine is a stub — drain is shell behavior."""
+    from areal_tpu.inference.server import serve
+    from areal_tpu.utils import name_resolve, names
+
+    class StubEngine:
+        def __init__(self):
+            self.running = 1  # one in-flight request at drain time
+
+        def metrics(self):
+            return {
+                "running_requests": float(self.running),
+                "queued_requests": 0.0,
+            }
+
+        def generate(self, payload):
+            return {"output_ids": [1], "output_logprobs": [0.0],
+                    "output_versions": [0],
+                    "meta_info": {"finish_reason": {"type": "stop"}}}
+
+    eng = StubEngine()
+    httpd = serve(
+        eng, host="127.0.0.1", port=0,
+        experiment_name="drain_t", trial_name="t0", background=True,
+    )
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    key = names.gen_servers("drain_t", "t0")
+    try:
+        assert name_resolve.get_subtree(key) == [addr]
+        with urllib.request.urlopen(f"http://{addr}/health", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        out = _post(addr, "/drain")
+        assert out["status"] == "draining" and out["in_flight"] == 1
+        with urllib.request.urlopen(f"http://{addr}/health", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "draining"
+        # drain mode rejects new admissions with 503
+        try:
+            _post(addr, "/generate", {"input_ids": [1, 2]})
+            raise AssertionError("draining server accepted a request")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # registration stays while work is in flight...
+        assert name_resolve.get_subtree(key) == [addr]
+        # ...and is removed once the engine empties
+        eng.running = 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if not name_resolve.get_subtree(key):
+                break
+            time.sleep(0.05)
+        assert name_resolve.get_subtree(key) == []
+    finally:
+        httpd.shutdown()
+
+
+def test_server_runtime_chaos_endpoint(memory_name_resolve):
+    """POST /chaos installs rules live: the next /generate eats an
+    injected 500, the one after succeeds (count=1 schedule)."""
+    from areal_tpu.inference.server import serve
+
+    class StubEngine:
+        def metrics(self):
+            return {"running_requests": 0.0, "queued_requests": 0.0}
+
+        def generate(self, payload):
+            return {"output_ids": [1], "output_logprobs": [0.0],
+                    "output_versions": [0],
+                    "meta_info": {"finish_reason": {"type": "stop"}}}
+
+    httpd = serve(StubEngine(), host="127.0.0.1", port=0, background=True)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        out = _post(addr, "/chaos", {
+            "spec": "http_500:side=server,match=/generate,count=1"
+        })
+        assert out["success"] and len(out["rules"]) == 1
+        try:
+            _post(addr, "/generate", {"input_ids": [1]})
+            raise AssertionError("chaos 500 not injected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+        assert _post(addr, "/generate", {"input_ids": [1]})[
+            "output_ids"] == [1]
+        _post(addr, "/chaos", {})  # disable
+    finally:
+        httpd.shutdown()
+
+
+def test_router_resync_recovered_server(resilient_fleet):
+    """Router-side version-checked re-admission: a recovered server
+    serving a stale version gets the last /update_weights checkpoint
+    re-pushed; with nothing to re-push it is drained instead."""
+    servers, router, addr = resilient_fleet
+    state = router.router_state
+    target = servers[0].addr  # MockServer GETs lack model_version → -1
+    with state.lock:
+        state.version = 3
+        state._last_weight_update = ("/tmp/ckpt", 3)
+    state.resync_server(target)
+    assert "/update_weights_from_disk" in servers[0].events
+    # no checkpoint → drain path
+    with state.lock:
+        state._last_weight_update = None
+    state.resync_server(servers[1].addr)
+    assert "/drain" in servers[1].events
+    from areal_tpu.inference.fleet import ServerState as _SS
+    assert state.fleet.state(servers[1].addr) is _SS.DRAINING
+
+
+def test_chaos_endpoint_gate(memory_name_resolve):
+    """serve(chaos_endpoint=False) — the CLI default without
+    --enable-chaos — answers POST /chaos with 403."""
+    from areal_tpu.inference.server import serve
+
+    class StubEngine:
+        def metrics(self):
+            return {"running_requests": 0.0, "queued_requests": 0.0}
+
+        def generate(self, payload):
+            return {"output_ids": [1]}
+
+    httpd = serve(StubEngine(), host="127.0.0.1", port=0,
+                  background=True, chaos_endpoint=False)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    try:
+        try:
+            _post(addr, "/chaos", {"spec": "kill:side=server"})
+            raise AssertionError("gated /chaos accepted a spec")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        assert chaos.get_injector() is None
+    finally:
+        httpd.shutdown()
+
+
+def test_router_deregister_drops_load_maps(resilient_fleet):
+    """A departed server must not linger in the load maps (unbounded
+    growth under membership churn) nor keep satisfying the sticky
+    previous_server membership check."""
+    servers, router, addr = resilient_fleet
+    state = router.router_state
+    extra = MockServer()
+    try:
+        _post(addr, "/register", {"addr": extra.addr})
+        _post(addr, "/schedule_request", {"qid": "dz"})
+        out = _post(addr, "/deregister", {"addr": extra.addr})
+        assert out["success"]
+        assert extra.addr not in state.addresses
+        assert extra.addr not in state._requests
+        assert extra.addr not in state._tokens
+        # sticky resubmit naming the departed server reroutes cleanly
+        r = _post(addr, "/schedule_request",
+                  {"qid": "dz2", "previous_server": extra.addr,
+                   "previous_version": 0})
+        assert r["url"] in state.addresses
+    finally:
+        extra.stop()
+
+
+def test_trace_report_failover_summary(tmp_path):
+    """tools/trace_report.py --failover over a synthetic span file."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from trace_report import failover_summary, load_spans, main
+    finally:
+        sys.path.pop(0)
+
+    spans = [
+        {"name": "failover", "rid": "r0", "ts": 0.0, "dur": 0.0,
+         "attrs": {"from_server": "a:1", "reason": "connect",
+                   "resumed_tokens": 4}},
+        {"name": "migration", "rid": "r0", "ts": 0.0, "dur": 0.0,
+         "attrs": {"from_server": "a:1", "resumed_tokens": 4}},
+        {"name": "failover", "rid": "r1", "ts": 1.0, "dur": 0.0,
+         "attrs": {"from_server": "a:1", "reason": "http_503",
+                   "resumed_tokens": 8}},
+        {"name": "migration", "rid": "r1", "ts": 1.0, "dur": 0.0,
+         "attrs": {"from_server": "a:1", "resumed_tokens": 8}},
+        {"name": "decode", "rid": "r1", "ts": 1.0, "dur": 0.5},
+    ]
+    path = tmp_path / "trace.jsonl"
+    path.write_text("".join(json.dumps(s) + "\n" for s in spans))
+    fo = failover_summary(load_spans(str(path)))
+    assert fo["failovers"] == 2 and fo["migrations"] == 2
+    assert fo["rids"] == 2
+    assert fo["by_reason"] == {"connect": 1, "http_503": 1}
+    assert fo["by_from_server"] == {"a:1": 2}
+    assert fo["resumed_tokens_mean"] == 6.0
+    assert fo["resumed_tokens_max"] == 8
+    assert main([str(path), "--failover", "--json"]) == 0
+    # an uneventful trace exits 1 (CI contract)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"name": "decode", "rid": "x",
+                                 "ts": 0.0, "dur": 0.1}) + "\n")
+    assert main([str(empty), "--failover"]) == 1
+
+
+def test_router_qid_cache_is_lru_bounded(resilient_fleet):
+    servers, router, addr = resilient_fleet
+    state = router.router_state  # qid_cache_size=4
+    for i in range(10):
+        _post(addr, "/schedule_request", {"qid": f"q{i}"})
+    assert len(state._qid_server) == 4
+    assert "q9" in state._qid_server and "q0" not in state._qid_server
+    # a hit refreshes recency: q6 survives the next insertion, q7 dies
+    _post(addr, "/schedule_request", {"qid": "q6"})
+    _post(addr, "/schedule_request", {"qid": "fresh"})
+    assert "q6" in state._qid_server and "q7" not in state._qid_server

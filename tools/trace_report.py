@@ -140,6 +140,63 @@ def format_occupancy(occ: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def failover_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Resilience-event report from ``failover``/``migration`` spans
+    (engine/remote.py records one instant per server hop; migrations are
+    the hops that carried a non-empty accumulated suffix): totals, the
+    failure-reason histogram, per-from-server counts, and resumed-suffix
+    length stats — the first-look answer to "what did the fleet lose and
+    how gracefully did it move"."""
+    fo = [s for s in spans if s.get("name") == "failover"]
+    migrations = sum(
+        1 for s in spans if s.get("name") == "migration"
+    )
+    reasons: Dict[str, int] = {}
+    from_servers: Dict[str, int] = {}
+    resumed: List[int] = []
+    for s in fo:
+        attrs = s.get("attrs") or {}
+        reasons[str(attrs.get("reason", "?"))] = (
+            reasons.get(str(attrs.get("reason", "?")), 0) + 1
+        )
+        src = str(attrs.get("from_server", "?"))
+        from_servers[src] = from_servers.get(src, 0) + 1
+        resumed.append(int(attrs.get("resumed_tokens", 0)))
+    resumed.sort()
+    return {
+        "failovers": len(fo),
+        "migrations": migrations,
+        "rids": len({s.get("rid", "") for s in fo}),
+        "by_reason": dict(sorted(reasons.items())),
+        "by_from_server": dict(sorted(from_servers.items())),
+        "resumed_tokens_mean": (
+            round(sum(resumed) / len(resumed), 2) if resumed else 0.0
+        ),
+        "resumed_tokens_p50": _percentile(resumed, 0.50),
+        "resumed_tokens_max": resumed[-1] if resumed else 0,
+    }
+
+
+def format_failover(fo: Dict[str, Any]) -> str:
+    rows = [
+        f"failovers            {fo['failovers']}",
+        f"migrations           {fo['migrations']} "
+        f"(resumed a non-empty suffix)",
+        f"requests affected    {fo['rids']}",
+        f"resumed tokens       mean {fo['resumed_tokens_mean']}  "
+        f"p50 {fo['resumed_tokens_p50']}  max {fo['resumed_tokens_max']}",
+        "",
+        f"{'reason':<20}{'count':>7}",
+    ]
+    for reason, count in fo["by_reason"].items():
+        rows.append(f"{reason:<20}{count:>7}")
+    if fo["by_from_server"]:
+        rows += ["", f"{'failed server':<24}{'count':>7}"]
+        for srv, count in fo["by_from_server"].items():
+            rows.append(f"{srv:<24}{count:>7}")
+    return "\n".join(rows)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -173,8 +230,28 @@ def main(argv=None) -> int:
         "rows_active from decode_chunk spans) instead of the latency "
         "table; exit 1 when the trace carries no occupancy gauges",
     )
+    p.add_argument(
+        "--failover", action="store_true",
+        help="summarize resilience events (failover/migration spans "
+        "from engine/remote.py) instead of the latency table; exit 1 "
+        "when the trace carries none",
+    )
     args = p.parse_args(argv)
     spans = load_spans(args.trace)
+    if args.failover:
+        fo = failover_summary(spans)
+        if args.json:
+            print(json.dumps(fo, indent=2))
+        else:
+            print(format_failover(fo))
+        if fo["failovers"] == 0:
+            print(
+                "no failover spans in trace (tracing off, or an "
+                "uneventful fleet)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     if args.occupancy:
         occ = occupancy_summary(spans)
         if args.json:
