@@ -319,6 +319,13 @@ class JaxGenConfig:
     tensor_parallel_size: int = 1
     mem_fraction: float = 0.85
     enable_metrics: bool = True
+    # draft-free speculative decoding (r7): host-side n-gram proposals
+    # verified by one multi-token dispatch with KV rollback
+    # (inference/spec.py + model_runner.spec_verify). Off by default —
+    # disabled is a strict no-op (no extra dispatches, no metric keys)
+    spec: "SpecConfig" = dataclasses.field(
+        default_factory=lambda: SpecConfig()
+    )
     # engine-side request-lifecycle spans (queue-wait, prefill, decode,
     # preemption, weight-update windows); drained over GET /trace
     tracing: "TracingConfig" = dataclasses.field(
@@ -360,12 +367,53 @@ class JaxGenConfig:
             args.append(
                 f"--compilation-cache-dir={config.compilation_cache_dir}"
             )
+        if config.spec.enabled:
+            args += [
+                "--spec",
+                f"--spec-max-draft={config.spec.max_draft}",
+                f"--spec-ngram-min={config.spec.ngram_min}",
+                f"--spec-ngram-max={config.spec.ngram_max}",
+                f"--spec-accept-floor={config.spec.accept_floor}",
+                f"--spec-disable-patience={config.spec.disable_patience}",
+            ]
         return args
 
 
 # --------------------------------------------------------------------------
 # Aux subsystems
 # --------------------------------------------------------------------------
+@dataclasses.dataclass
+class SpecConfig:
+    """Draft-free speculative decoding (inference/spec.py proposers +
+    the multi-token verify dispatch in inference/model_runner.py).
+
+    A host-side proposer (n-gram self-speculation: suffix match against
+    the request's own prompt+output — no draft model) guesses up to
+    ``max_draft`` continuation tokens per slot; ONE device dispatch
+    scores every position causally and accepts the longest prefix the
+    model itself would have produced. Greedy streams are bit-identical
+    with speculation on or off (exact-match acceptance); sampled streams
+    keep their exact distribution (every kept token is drawn from the
+    true conditional under an independent key). Rejected positions roll
+    back: their K/V never reach the paged pool and cache-length
+    accounting matches a non-speculative run. Single-device dense
+    serving only (TP keeps the full-slot dispatch; MoE capacity routing
+    is batch-dependent)."""
+
+    enabled: bool = False
+    # draft tokens proposed per verify round; the verify window is
+    # max_draft + 1 positions (current token + drafts)
+    max_draft: int = 4
+    # suffix n-gram lengths tried for the history match (longest first)
+    ngram_min: int = 2
+    ngram_max: int = 4
+    # auto-disable hysteresis: speculation turns off (sticky) when the
+    # accept-rate EWMA stays below this floor for ``disable_patience``
+    # consecutive verify chunks; <= 0 never disables
+    accept_floor: float = 0.1
+    disable_patience: int = 32
+
+
 @dataclasses.dataclass
 class TracingConfig:
     """Request-lifecycle span tracing (utils/tracing.py): per-rid spans

@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import aiohttp
+import requests as _requests
 
 
 def _abandon_session(s: "aiohttp.ClientSession") -> None:
@@ -55,7 +56,7 @@ def _abandon_session(s: "aiohttp.ClientSession") -> None:
         logging.getLogger("areal_tpu.remote").warning(
             "could not tear down abandoned http session: %s", e
         )
-import requests as _requests
+
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine

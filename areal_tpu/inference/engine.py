@@ -362,7 +362,9 @@ class GenerationEngine:
         # decode_multi-returned update must join the `updates` dict —
         # otherwise compacted dispatches silently diverge from
         # full-width ones. tests/test_decode_compaction.py pins parity
-        # for the current set.
+        # for the current set. (_align_base_dev is such a special case:
+        # gathered per row with padding forced to 0, read-only on
+        # device — r7 speculative canonical alignment.)
         self._cur_tokens = jnp.zeros(s, jnp.int32)
         self._active_dev = jnp.zeros(s, bool)
         self._temp_dev = jnp.ones(s, jnp.float32)
@@ -375,6 +377,14 @@ class GenerationEngine:
         # device-resident cached length per slot: decode chunk N+1 can
         # dispatch before chunk N's results reach the host
         self._lens_dev = jnp.zeros(s, jnp.int32)
+        # per-slot admission cache length — the canonical chunk-alignment
+        # base for speculative serving (a partial draft accept leaves a
+        # slot between decode_chunk boundaries; every later dispatch
+        # replays boundary-to-now K/V from the pool so per-position
+        # numerics stay bit-identical to a non-speculative run). Only
+        # consulted when spec is configured
+        self._align_base_dev = jnp.zeros(s, jnp.int32)
+        self._align_base = np.zeros(s, np.int64)  # host mirror
         # VLM slots: mrope text positions lag the cache index by a
         # per-request constant; tracked per slot, passed to decode only
         # when some active slot is multimodal (text-only serving keeps
@@ -421,6 +431,7 @@ class GenerationEngine:
                 "_cur_tokens", "_active_dev", "_temp_dev", "_top_p_dev",
                 "_top_k_dev", "_greedy_dev", "_remaining", "_no_stop",
                 "_stop_tokens", "_lens_dev", "_rope_delta_dev",
+                "_align_base_dev",
             ):
                 setattr(
                     self, attr,
@@ -429,6 +440,52 @@ class GenerationEngine:
             self._last_rows = jax.device_put(
                 self._last_rows, self._replicated
             )
+        # --- speculative decoding (r7): host-side draft-free n-gram
+        # proposals (inference/spec.py) verified by one multi-token
+        # dispatch (model_runner.spec_verify). Single-device dense models
+        # only: TP keeps the replicated full-slot dispatch, and MoE
+        # capacity routing is batch-composition-dependent (a K-position
+        # verify would route differently than K sequential steps).
+        sc = getattr(config, "spec", None)
+        spec_wanted = bool(sc is not None and sc.enabled)
+        # decode_chunk < 2 leaves no room for even one draft inside the
+        # canonical window (_propose_drafts trims to decode_chunk-1-rl),
+        # so speculation could never verify anything — but the
+        # drain-for-drafts branch would still fire on raw n-gram
+        # candidates, silently destroying pipelining forever
+        self._spec_configured = (
+            spec_wanted
+            and self.mesh is None
+            and not model_config.is_moe
+            and config.decode_chunk >= 2
+        )
+        if spec_wanted and not self._spec_configured:
+            logger.warning(
+                "speculative decoding requested but unavailable: needs "
+                "single-device serving, a dense model, and "
+                "decode_chunk >= 2 — running without speculation"
+            )
+        if self._spec_configured:
+            from areal_tpu.inference.spec import (
+                AcceptRateGate,
+                NgramProposer,
+            )
+
+            self._proposer = NgramProposer(sc.ngram_min, sc.ngram_max)
+            self._spec_gate = AcceptRateGate(
+                sc.accept_floor, sc.disable_patience
+            )
+        else:
+            self._proposer = None
+            self._spec_gate = None
+        self._spec_disable_logged = False
+        # set once the gate has sticky-disabled AND every active slot is
+        # back on a canonical boundary: later dispatches skip the
+        # alignment-replay machinery entirely (plain spec-off program)
+        self._spec_replay_off = False
+        self.total_spec_chunks = 0
+        self.spec_draft_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
         self._step_counter = 0
         # metrics
         self.total_generated_tokens = 0
@@ -563,7 +620,7 @@ class GenerationEngine:
 
     def metrics(self) -> Dict[str, float]:
         num_pages = max(1, self.cache_config.num_pages)
-        return dict(
+        m = dict(
             running_requests=len(self._active),
             queued_requests=self._admit_queue.qsize() + len(self._pending),
             free_slots=len(self._free_slots),
@@ -597,6 +654,22 @@ class GenerationEngine:
             paused=float(self._paused.is_set()),
             trace_spans=len(self.tracer) if self.tracer.enabled else 0,
         )
+        if self._spec_configured:
+            # spec gauges exist ONLY when speculation is configured —
+            # spec off is a strict no-op, metric surface included
+            gate = self._spec_gate
+            m.update(
+                spec_enabled=float(not gate.disabled),
+                spec_chunks_total=self.total_spec_chunks,
+                spec_draft_tokens_total=self.spec_draft_tokens_total,
+                spec_accepted_tokens_total=self.spec_accepted_tokens_total,
+                spec_accept_rate=round(
+                    self.spec_accepted_tokens_total
+                    / max(1, self.spec_draft_tokens_total), 4
+                ),
+                spec_accept_rate_ewma=round(gate.ewma or 0.0, 4),
+            )
+        return m
 
     # ------------------------------------------------------------------
     # Engine loop (single owner of device state)
@@ -752,6 +825,8 @@ class GenerationEngine:
         device considers active)."""
         pages = self._slot_pages.pop(slot, [])
         cached = int(self._cached_len[slot])
+        if self._proposer is not None:
+            self._proposer.drop(slot)
         if self._slot_mm[slot]:
             # pixel-conditioned KV must not enter the token-keyed prefix
             # registry (a text request with the same tokens would claim it)
@@ -1084,6 +1159,13 @@ class GenerationEngine:
             stops[j, : len(ids)] = ids
         sl = jnp.asarray(slots_np)
         self._lens_dev = self._lens_dev.at[sl].set(jnp.asarray(plens))
+        if self._spec_configured:
+            # canonical alignment base = cache length at admission (the
+            # off-run's chunk boundaries are multiples of decode_chunk
+            # from here)
+            self._align_base_dev = self._align_base_dev.at[sl].set(
+                jnp.asarray(plens)
+            )
         self._temp_dev = self._temp_dev.at[sl].set(jnp.asarray(temps))
         self._top_p_dev = self._top_p_dev.at[sl].set(jnp.asarray(top_ps))
         self._top_k_dev = self._top_k_dev.at[sl].set(jnp.asarray(top_ks))
@@ -1168,6 +1250,11 @@ class GenerationEngine:
         self._tables[slot] = self.cache_config.num_pages
         self._tables[slot, : len(pages)] = pages
         self._slot_mm[slot] = req.mm is not None
+        self._align_base[slot] = cached
+        if self._proposer is not None:
+            # full history (resumed/preempted requests re-enter with
+            # their accumulated output): the n-gram index rebuilds here
+            self._proposer.begin(slot, req.all_tokens)
 
     # ------------------------------------------------------------------
     # Decode
@@ -1256,19 +1343,105 @@ class GenerationEngine:
             self.config.sample_topk_bound,
         )
 
+    def _spec_on(self) -> bool:
+        """Speculation configured and not auto-disabled by the gate."""
+        return self._spec_configured and not self._spec_gate.disabled
+
+    def _spec_has_candidates(self) -> bool:
+        """Cheap probe: would any active slot propose a draft? (Used to
+        decide whether draining the pipeline for fresh drafts pays.)
+        Applies the same boundary trim as _propose_drafts — a slot whose
+        next token lands ON its canonical boundary cannot carry a draft
+        this round, so its n-gram candidates must not trigger a drain."""
+        cq = max(1, self.config.decode_chunk)
+        for slot in self._active:
+            rl = int(
+                (self._cached_len[slot] - self._align_base[slot]) % cq
+            )
+            if cq - 1 - rl <= 0:
+                continue
+            if self._proposer.has_candidate(slot):
+                return True
+        return False
+
+    def _propose_drafts(self) -> Dict[int, List[int]]:
+        """Per-slot n-gram drafts from CURRENT host history (callers
+        guarantee the pipeline is empty, so the history is exact).
+
+        Drafts are trimmed to the slot's canonical-boundary distance:
+        acceptance can never run past the boundary (the verify would
+        need this window's own pre-boundary K/V as merged pool entries
+        — model_runner._spec_verify_forward caps there), so proposing
+        past it only lowers the measured accept rate."""
+        kd = max(1, self.config.spec.max_draft)
+        cq = max(1, self.config.decode_chunk)
+        out: Dict[int, List[int]] = {}
+        for slot in self._active:
+            rl = int(
+                (self._cached_len[slot] - self._align_base[slot]) % cq
+            )
+            kd_s = min(kd, cq - 1 - rl)
+            if kd_s <= 0:
+                continue
+            d = self._proposer.propose(slot, kd_s)
+            if d:
+                out[slot] = d
+        return out
+
+    def _margin(self, new_steps: int) -> int:
+        """Page-growth margin for a new dispatch: its own worst case plus
+        every in-flight chunk's (the host view lags the device by the
+        pipeline; verify chunks may grow by K, regular chunks by
+        decode_chunk — sizes can mix)."""
+        return new_steps + sum(c["max_tokens"] for c in self._inflight)
+
     def _decode(self) -> bool:
         """Pipelined decode: dispatch chunk N+1, then process chunk N's
         results while N+1 executes on device — the result fetch (a full
-        round-trip over a driver tunnel) overlaps device compute."""
+        round-trip over a driver tunnel) overlaps device compute.
+
+        Speculation composition (r7): a verify dispatch needs drafts, and
+        drafts need CURRENT host history — so verify chunks are only
+        dispatched on an empty pipeline, and when the proposer has
+        candidates the loop drains in-flight chunks instead of stacking
+        more regular ones ("drain-for-drafts": speculation trades
+        pipeline overlap for multi-token verify; the accept-rate gate
+        auto-disables it when that trade loses). Slots with no candidate
+        n-grams ride along in the verify dispatch with draft_len 0 (a
+        plain single-token step for them); when NO slot has a candidate
+        the regular pipelined path runs untouched."""
         depth = max(0, self.config.decode_pipeline)
         did = False
         dispatched = False
+        drafts: Optional[Dict[int, List[int]]] = None
+        if self._spec_on() and self._active:
+            if not self._inflight:
+                drafts = self._propose_drafts() or None
+            elif self._spec_has_candidates():
+                # drain-for-drafts (see docstring)
+                self._process_chunk(self._inflight.pop(0))
+                self._flush_deferred()
+                return True
         if self._active and len(self._inflight) <= depth:
-            steps = max(1, self.config.decode_chunk)
-            margin = steps * (len(self._inflight) + 1)
-            if self._ensure_decode_pages(margin):
-                self._dispatch_chunk(steps, margin)
-                dispatched = did = True
+            if drafts:
+                # drafts are trimmed to <= decode_chunk-1 tokens and the
+                # verify boundary cap makes positions past that
+                # unemittable — clamp the window (and the page margin)
+                # to what can actually land
+                k = min(
+                    max(1, self.config.spec.max_draft),
+                    max(1, self.config.decode_chunk) - 1,
+                ) + 1
+                margin = self._margin(k)
+                if self._ensure_decode_pages(margin):
+                    self._dispatch_chunk(k, margin, drafts=drafts)
+                    dispatched = did = True
+            else:
+                steps = max(1, self.config.decode_chunk)
+                margin = self._margin(steps)
+                if self._ensure_decode_pages(margin):
+                    self._dispatch_chunk(steps, margin)
+                    dispatched = did = True
         if self._inflight and (
             len(self._inflight) > depth or not dispatched
         ):
@@ -1303,7 +1476,21 @@ class GenerationEngine:
             self._compact_shrink_streak = 0
         return self._compact_rows
 
-    def _dispatch_chunk(self, steps: int, margin: int):
+    def _dispatch_chunk(
+        self,
+        steps: int,
+        margin: int,
+        drafts: Optional[Dict[int, List[int]]] = None,
+    ):
+        """One decode dispatch over the (possibly compacted) row bucket.
+
+        With ``drafts`` (slot -> proposed tokens) this is a speculative
+        VERIFY dispatch: ``steps`` is the verify window K = max_draft + 1
+        and the device scores all K positions in one forward
+        (model_runner.spec_verify) — otherwise it is the regular fused
+        ``steps``-iteration decode. Both return the same state/result
+        contract, so everything downstream (row→slot scatter, packed
+        fetch, _process_chunk) is shared."""
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
         pps = self._pages_bound(margin)
@@ -1312,6 +1499,33 @@ class GenerationEngine:
         n_active = len(slots)
         rows = self._decode_rows_bucket(n_active) if self._compact_enabled else s
         want_rope = bool(self._slot_mm.any())
+        # after the gate's STICKY auto-disable, slots realign to their
+        # canonical boundaries within one chunk each (emission caps
+        # there) and full regular chunks preserve alignment forever —
+        # once every active slot sits on a boundary, latch the replay
+        # machinery off so every later dispatch runs the plain spec-off
+        # program instead of paying the boundary-to-now pool gather per
+        # chunk. In-flight REGULAR full chunks are tolerated (the host
+        # length view lags them, but they advance every surviving slot
+        # by exactly decode_chunk — or cap it at its boundary — so
+        # alignment mod decode_chunk is unchanged when they land); an
+        # in-flight verify chunk (partial accepts move slots off
+        # boundaries) defers the latch to a later dispatch.
+        if (
+            self._spec_configured
+            and not self._spec_replay_off
+            and self._spec_gate.disabled
+        ):
+            cq = max(1, self.config.decode_chunk)
+            if all(
+                c["spec_draft_lens"] is None and c["steps"] == cq
+                for c in self._inflight
+            ) and all(
+                (self._cached_len[sl] - self._align_base[sl]) % cq == 0
+                for sl in slots
+            ):
+                self._spec_replay_off = True
+        spec_align = self._spec_configured and not self._spec_replay_off
         # plain per-slot 1-D arrays: listed ONCE, gathered/aliased by the
         # loop below. Arrays with extra semantics (active &valid, stops
         # axis=0, lens zeroed on padding, rope conditional, last_rows) are
@@ -1331,6 +1545,7 @@ class GenerationEngine:
             stops, lens = self._stop_tokens, self._lens_dev
             rope = self._rope_delta_dev if want_rope else None
             slot_ids_dev = None  # identity — decode_multi default
+            align_dev = self._align_base_dev if spec_align else None
         else:
             # compact dispatch: gather per-slot state into the row space.
             # Padding rows carry slot id `s` — their gathers CLIP to slot
@@ -1357,28 +1572,81 @@ class GenerationEngine:
                 else None
             )
             slot_ids_dev = jnp.asarray(row_slots)
-        (
-            self.cache, toks, logps, emitted, active_after,
-            remaining_a, no_stop_a, lens_a, new_last,
-        ) = model_runner.decode_multi(
-            self.params, self.model_config, self.cache,
-            tables_dev, lens,
-            st["_cur_tokens"], active, st["_remaining"],
-            st["_no_stop"], stops, key,
-            st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
-            st["_greedy_dev"], steps=steps,
-            topk_bound=self._sampling_mode(),
-            attn_impl=self._attn_impl,
-            ppcb=self.config.pages_per_compute_block,
-            spb=self.config.slots_per_block,
-            last_rows=self._last_rows,
-            rope_delta=rope,
-            slot_ids=slot_ids_dev,
-        )
+            align_dev = (
+                jnp.where(valid, jnp.take(self._align_base_dev, clipped), 0)
+                if spec_align else None
+            )
+        # canonical-alignment replay width (spec engines only): partial
+        # draft accepts leave slots mid-chunk; the program replays
+        # boundary-to-now K/V so numerics never depend on dispatch
+        # boundaries (rl = 0 everywhere reduces to the plain program)
+        replay = max(1, self.config.decode_chunk) - 1 if spec_align else 0
+        spec_draft_lens: Optional[np.ndarray] = None
+        if drafts is not None:
+            # draft rows in ROW space (compact or full-width alike):
+            # rows without a proposal carry draft_len 0 — a plain
+            # single-token step for them inside the same dispatch
+            kd = steps - 1
+            draft_np = np.zeros((rows, kd), np.int32)
+            spec_draft_lens = np.zeros(rows, np.int32)
+            for r_ in range(rows):
+                sl_ = int(row_slots[r_])
+                toks_d = drafts.get(sl_) if sl_ < s else None
+                if toks_d:
+                    m_ = min(len(toks_d), kd)
+                    draft_np[r_, :m_] = toks_d[:m_]
+                    spec_draft_lens[r_] = m_
+            (
+                self.cache, toks, logps, emitted, active_after,
+                remaining_a, no_stop_a, lens_a, new_last, cur_next,
+            ) = model_runner.spec_verify(
+                self.params, self.model_config, self.cache,
+                tables_dev, lens,
+                st["_cur_tokens"], jnp.asarray(draft_np),
+                jnp.asarray(spec_draft_lens), active, st["_remaining"],
+                st["_no_stop"], stops, key,
+                st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
+                st["_greedy_dev"], k=steps,
+                topk_bound=self._sampling_mode(),
+                attn_impl=self._attn_impl,
+                ppcb=self.config.pages_per_compute_block,
+                spb=self.config.slots_per_block,
+                last_rows=self._last_rows,
+                rope_delta=rope,
+                slot_ids=slot_ids_dev,
+                align_base=align_dev,
+                replay=replay,
+            )
+        else:
+            out = model_runner.decode_multi(
+                self.params, self.model_config, self.cache,
+                tables_dev, lens,
+                st["_cur_tokens"], active, st["_remaining"],
+                st["_no_stop"], stops, key,
+                st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
+                st["_greedy_dev"], steps=steps,
+                topk_bound=self._sampling_mode(),
+                attn_impl=self._attn_impl,
+                ppcb=self.config.pages_per_compute_block,
+                spb=self.config.slots_per_block,
+                last_rows=self._last_rows,
+                rope_delta=rope,
+                slot_ids=slot_ids_dev,
+                align_base=align_dev,
+                replay=replay,
+            )
+            (
+                self.cache, toks, logps, emitted, active_after,
+                remaining_a, no_stop_a, lens_a, new_last,
+            ) = out[:9]
+            # replay-mode chunks return next_tokens: a row that hit its
+            # chunk boundary mid-dispatch resumes from its LAST emitted
+            # token, not from step steps-1's sample
+            cur_next = out[9] if len(out) > 9 else toks[-1]
         # updated per-slot state: ONE dict drives both the full-width
         # assignment and the compact row→slot scatter (padding rows drop)
         updates = {
-            "_cur_tokens": toks[-1],
+            "_cur_tokens": cur_next,
             "_active_dev": active_after,
             "_remaining": remaining_a,
             "_no_stop": no_stop_a,
@@ -1408,10 +1676,17 @@ class GenerationEngine:
             self.rows_dispatched_hist.get(rows, 0) + 1
         )
         if self.tracer.enabled:
-            self.tracer.instant(
-                "decode_chunk", "__engine__",
+            span_attrs = dict(
                 rows_dispatched=rows, rows_active=n_active, steps=steps,
             )
+            if spec_draft_lens is not None:
+                span_attrs["spec_draft_tokens"] = int(
+                    spec_draft_lens.sum()
+                )
+                span_attrs["spec_draft_rows"] = int(
+                    (spec_draft_lens > 0).sum()
+                )
+            self.tracer.instant("decode_chunk", "__engine__", **span_attrs)
         # ONE packed fetch per chunk (lazy: np.asarray in _process_chunk
         # blocks; until then the device crunches the next chunk)
         self._inflight.append(
@@ -1420,6 +1695,13 @@ class GenerationEngine:
                     toks, logps, emitted, active_after
                 ),
                 "steps": steps,
+                # worst-case token growth of this chunk (for later
+                # dispatches' page margins — verify and regular chunk
+                # sizes can mix in the pipeline)
+                "max_tokens": steps,
+                # per-row draft lengths of a verify chunk (accept-rate
+                # accounting happens at process time, None = regular)
+                "spec_draft_lens": spec_draft_lens,
                 # dispatch-time row→slot snapshot + slot→request snapshot:
                 # a slot finished and re-admitted between dispatch and
                 # processing must not absorb this chunk's stale results
@@ -1456,6 +1738,14 @@ class GenerationEngine:
         n_emit = np.where(
             h_emitted.all(axis=0), steps, h_emitted.argmin(axis=0)
         )
+        dl = chunk.get("spec_draft_lens")
+        # verify-chunk acceptance accounting runs AFTER the row loop on
+        # the HOST-truncated emit counts: the device buffer only holds
+        # the first 8 stop ids, so a stop landing inside an accepted
+        # draft is caught below — those positions are never delivered
+        # and must not count as accepted (they would inflate the gate's
+        # EWMA and delay auto-disable)
+        n_emit_host = n_emit.copy() if dl is not None else None
         for row in range(r):
             slot = int(row_slots[row])
             if slot >= s:
@@ -1481,6 +1771,8 @@ class GenerationEngine:
                     if hits.any():
                         k = int(np.argmax(hits)) + 1
                         stopped_host = True
+                        if n_emit_host is not None:
+                            n_emit_host[row] = k
                 if req.first_token_time is None:
                     req.first_token_time = now
                 req.output_ids.extend(int(t) for t in h_toks[:k, row])
@@ -1488,6 +1780,10 @@ class GenerationEngine:
                     float(x) for x in h_logps[:k, row]
                 )
                 req.output_versions.extend([chunk["version"]] * k)
+                if self._proposer is not None:
+                    self._proposer.extend(
+                        slot, [int(t) for t in h_toks[:k, row]]
+                    )
                 # each emitted step cached the slot's previous input token
                 self._cached_len[slot] += k
                 self.total_generated_tokens += k
@@ -1495,6 +1791,41 @@ class GenerationEngine:
                 self._finish(slot, "stop")
             elif not h_active[row]:
                 self._finish(slot, "length")
+        if dl is not None:
+            # per-row accepted drafts = delivered - 1 (the bonus token is
+            # free, not a draft), capped by what was actually drafted
+            self._observe_spec(
+                int(dl.sum()),
+                int(np.minimum(np.maximum(n_emit_host - 1, 0), dl).sum()),
+                rows=int((n_emit_host > 0).sum()),
+            )
+
+    def _observe_spec(
+        self, drafted: int, accepted: int, rows: int = 0
+    ) -> None:
+        """Accept-rate accounting for one verify chunk + the auto-disable
+        gate (sustained accept rates below the floor make drafting pure
+        overhead — the gate turns speculation off sticky)."""
+        self.total_spec_chunks += 1
+        self.spec_draft_tokens_total += drafted
+        self.spec_accepted_tokens_total += accepted
+        gate = self._spec_gate
+        still_on = gate.observe(drafted, accepted)
+        if not still_on and not self._spec_disable_logged:
+            self._spec_disable_logged = True
+            logger.warning(
+                f"speculative decoding auto-disabled: accept-rate EWMA "
+                f"{gate.ewma:.3f} stayed below floor {gate.floor} for "
+                f"{gate.patience} verify chunks"
+            )
+        if self.tracer.enabled:
+            # rows = rows that emitted this round (each contributes one
+            # guaranteed base token on top of its accepted drafts —
+            # trace_report --spec needs it for verified tok/s)
+            self.tracer.instant(
+                "spec_verify", "__engine__",
+                drafted=drafted, accepted=accepted, rows=rows,
+            )
 
     def _sample_and_append(
         self, logits: jnp.ndarray, only_slots: List[int]
@@ -1528,6 +1859,8 @@ class GenerationEngine:
             req.output_ids.append(int(toks[i]))
             req.output_logprobs.append(float(logps[i]))
             req.output_versions.append(self.model_version)
+            if self._proposer is not None:
+                self._proposer.extend(slot, [int(toks[i])])
             self.total_generated_tokens += 1
             out_len = len(req.output_ids)
             total_len = len(req.input_ids) + out_len

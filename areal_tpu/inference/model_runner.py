@@ -92,6 +92,38 @@ def _mlp(
     return (act(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
 
 
+def _layer_pre(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """Input norm + QKV projection — the front half of the per-layer
+    sandwich every forward path (prefill, decode, spec-verify) shares.
+    One copy with `_layer_post` so an architecture change (a new norm
+    variant, QK-norm tweak, ...) cannot silently drift between the
+    three forwards and break their bit-exactness contract; everything
+    between the halves (rope positions, KV staging, the attention core)
+    is genuinely path-specific."""
+    h = rms_norm(
+        x, lp["input_norm"], cfg.rms_norm_eps,
+        add_unit_offset=cfg.norm_add_unit_offset,
+    )
+    return _project_qkv(cfg, lp, h)
+
+
+def _layer_post(
+    cfg: ModelConfig,
+    lp: Params,
+    x: jnp.ndarray,
+    attn: jnp.ndarray,  # [..., q_dim] already in x.dtype
+    valid: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """Output-projection residual + post-attn norm + MLP residual — the
+    back half of the shared per-layer sandwich (see `_layer_pre`)."""
+    x = x + attn @ lp["wo"]
+    h2 = rms_norm(
+        x, lp["post_attn_norm"], cfg.rms_norm_eps,
+        add_unit_offset=cfg.norm_add_unit_offset,
+    )
+    return x + _mlp(cfg, lp, h2, valid=valid)
+
+
 def _final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray):
     x = rms_norm(
         x, params["final_norm"], cfg.rms_norm_eps,
@@ -432,13 +464,10 @@ def prefill_forward(
     # causal within the in-flight suffix
     suffix_mask = (sidx[:, :, None] >= sidx[:, None, :]) & valid_q[:, None, :]
 
-    uo = cfg.norm_add_unit_offset
-
     def layer(carry, xs):
         x = carry
         lp, li = xs
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, add_unit_offset=uo)
-        q, k, v = _project_qkv(cfg, lp, h)  # [N, Tp, H*, Dh]
+        q, k, v = _layer_pre(cfg, lp, x)  # [N, Tp, H*, Dh]
         q = _rope(q)
         k = _rope(k)
         kz = jnp.where(valid_q[..., None, None], k, 0)
@@ -504,11 +533,7 @@ def prefill_forward(
                 preferred_element_type=jnp.float32,
             )
         attn = attn.astype(x.dtype).reshape(n, tp, cfg.q_dim)
-        x = x + attn @ lp["wo"]
-        h2 = rms_norm(
-            x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
-        )
-        x = x + _mlp(cfg, lp, h2, valid=valid_q)
+        x = _layer_post(cfg, lp, x, attn, valid_q)
         kv_dtype = cache["k"].dtype
         return x, (kz.astype(kv_dtype), vz.astype(kv_dtype))
 
@@ -594,6 +619,53 @@ def copy_pages(
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
+def _gather_recent_kv(
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,  # [S, PPS]
+    pos0: jnp.ndarray,  # [S] cached tokens per slot
+    rl: jnp.ndarray,  # [S] replay length (tokens since the boundary)
+    replay: int,  # static buffer width (max replay = chunk quantum - 1)
+    num_kv_heads: int,
+    head_dim: int,
+):
+    """K/V of positions [pos0-rl, pos0) per slot, gathered from the POOL
+    into chunk-buffer form ([L, S, replay, Hkv, D] ×2; entry j holds
+    position pos0-rl+j, valid for j < rl, zeros elsewhere).
+
+    This is the canonical-alignment replay prefix for speculative
+    serving: a dispatch that starts mid-chunk (a partial draft accept
+    left the slot between ``decode_chunk`` boundaries) re-presents the
+    boundary-to-now K/V as in-window chunk entries, so every position's
+    attention sees EXACTLY the pool-window/chunk-buffer split a
+    non-speculative run would give it — the split changes softmax
+    summation order, so matching it is what keeps greedy streams
+    bit-identical. Pool bytes are the very bytes the sequential path
+    had in its buffer (merges are exact copies), so no recompute and no
+    numerics bet. Spec-off engines never call this."""
+    nl = cache["k"].shape[0]
+    num_pages = cache["k"].shape[2]
+    merged, tpr = layout_from_pool(
+        cache["k"].shape, num_kv_heads, head_dim
+    )
+    bs = cache["k"].shape[3] * tpr  # page size in tokens
+    j = jnp.arange(replay, dtype=jnp.int32)[None, :]
+    valid = j < rl[:, None]  # [S, R]
+    positions = jnp.where(valid, (pos0 - rl)[:, None] + j, 0)
+    page = jnp.take_along_axis(
+        tables, jnp.clip(positions // bs, 0, tables.shape[1] - 1), axis=1
+    )
+    flat = jnp.clip(page, 0, num_pages - 1) * bs + positions % bs  # [S, R]
+
+    def gather(pool):
+        view = unpacked_view(pool, head_dim, num_kv_heads)
+        view = view.reshape(nl, view.shape[1], -1, head_dim)
+        g = view[:, :, flat]  # [L, Hkv, S, R, D]
+        g = g.transpose(0, 2, 3, 1, 4)  # [L, S, R, Hkv, D]
+        return jnp.where(valid[None, :, :, None, None], g, 0)
+
+    return gather(cache["k"]), gather(cache["v"])
+
+
 def _attend(
     cfg: ModelConfig,
     cache: Dict[str, jnp.ndarray],
@@ -638,6 +710,8 @@ def _decode_core(
     topk_bound: int,
     rope_delta: Optional[jnp.ndarray] = None,  # [S] mrope text-position shift
     slot_ids: Optional[jnp.ndarray] = None,  # [S] engine slot per row
+    align_base: Optional[jnp.ndarray] = None,  # [S] admission cache length
+    replay: int = 0,  # static: canonical chunk quantum - 1 (0 = off)
 ):
     """Shared body of decode_multi / decode_step. When sample_args is None,
     runs exactly one step and returns the logits instead of sampling.
@@ -648,7 +722,25 @@ def _decode_core(
 
     ``slot_ids`` keys each row's sampling RNG by its engine slot — under
     decode tail compaction rows are a gathered subset of slots, and the
-    stream a slot produces must not depend on its row position."""
+    stream a slot produces must not depend on its row position.
+
+    ``align_base``/``replay`` (replay MUST equal steps - 1 when used)
+    enable canonical-alignment replay for speculative serving (see
+    _gather_recent_kv): a slot sitting ``rl = (pos0 - align_base) %
+    steps`` tokens past its last canonical chunk boundary gets the
+    boundary-to-now K/V gathered from the pool into the leading chunk
+    buffer entries, starts the scan at within-chunk count rl, and stops
+    emitting at the boundary (dormant rows stay alive and resume next
+    dispatch realigned). The buffer stays EXACTLY ``steps`` wide and
+    every position lands at within-chunk column (p - base) with the
+    pool window ending at its boundary — the same SHAPES and the same
+    inputs as the non-speculative run, which is what bit-exactness
+    actually requires (merely masking extra buffer columns changes
+    reduce codegen and drifts ulps; measured on the head-merged
+    layout). Spec-off engines pass replay = 0 and run the unchanged
+    program; with replay the sample path returns a trailing
+    ``next_tokens`` [S] (a dormant row's next input is its LAST emitted
+    token, not step steps-1's sample)."""
     s = tables.shape[0]
     d = cfg.head_dim
     nl, hkv_pool, num_pages, prow, fd = cache["k"].shape
@@ -662,6 +754,17 @@ def _decode_core(
     )
     srange = jnp.arange(s)
     kv_dtype = cache["k"].dtype
+    use_replay = replay > 0 and align_base is not None
+    if use_replay and replay != steps - 1:
+        raise ValueError(
+            f"replay ({replay}) must be steps - 1 ({steps - 1}): the "
+            "canonical chunk quantum IS the dispatch step count"
+        )
+    if use_replay:
+        rl = jnp.where(active0, jnp.mod(pos0 - align_base, steps), 0)
+    else:
+        rl = jnp.zeros(s, jnp.int32)
+    base = pos0 - rl  # pool window ends at the canonical boundary
 
     def model_step(kbuf, vbuf, tokens, clen, active):
         """One forward pass for all slots; new K/V appended to the chunk
@@ -675,20 +778,18 @@ def _decode_core(
         x = params["embedding"][tokens]  # [S, D]
         if cfg.scale_embeddings:  # gemma
             x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
-        pos = pos0 + clen
+        # clen is the ABSOLUTE within-chunk count (starts at rl under
+        # replay — the replayed entries occupy buffer cols [0, rl)); the
+        # just-written self token is visible
+        pos = base + clen
         if rope_delta is not None:
             pos = jnp.maximum(pos + rope_delta, 0)
-        counts = clen + 1  # the just-written self token is visible
+        counts = clen + 1
         ci = jnp.where(active, clen, steps)
-
-        uo = cfg.norm_add_unit_offset
 
         def layer(x, xs):
             lp, li = xs
-            h = rms_norm(
-                x, lp["input_norm"], cfg.rms_norm_eps, add_unit_offset=uo
-            )
-            q, k, v = _project_qkv(cfg, lp, h)  # q [S,Hq,D] k/v [S,Hkv,D]
+            q, k, v = _layer_pre(cfg, lp, x)  # q [S,Hq,D] k/v [S,Hkv,D]
             q = apply_rope(q[:, None], pos[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], pos[:, None], cos, sin)[:, 0]
             kb = jax.lax.dynamic_index_in_dim(kbuf, li, 0, keepdims=False)
@@ -696,15 +797,14 @@ def _decode_core(
             kb = kb.at[srange, ci].set(k.astype(kv_dtype), mode="drop")
             vb = vb.at[srange, ci].set(v.astype(kv_dtype), mode="drop")
             attn = _attend(
-                cfg, cache, li, q, pos0, tables,
+                cfg, cache, li, q, base, tables,
                 kb.transpose(0, 2, 1, 3), vb.transpose(0, 2, 1, 3),
                 counts, attn_impl, ppcb, spb,
             )
-            x = x + attn.reshape(s, cfg.q_dim).astype(x.dtype) @ lp["wo"]
-            h2 = rms_norm(
-                x, lp["post_attn_norm"], cfg.rms_norm_eps, add_unit_offset=uo
+            x = _layer_post(
+                cfg, lp, x, attn.reshape(s, cfg.q_dim).astype(x.dtype),
+                active,
             )
-            x = x + _mlp(cfg, lp, h2, valid=active)
             return x, (k.astype(kv_dtype), v.astype(kv_dtype))
 
         x, (knew, vnew) = jax.lax.scan(
@@ -718,54 +818,83 @@ def _decode_core(
     # inactive slots scatter at index `steps` (out of range → dropped)
     kbuf0 = jnp.zeros((nl, s, steps, hkv, d), kv_dtype)
     vbuf0 = jnp.zeros_like(kbuf0)
+    if use_replay:
+        seed_k, seed_v = _gather_recent_kv(
+            cache, tables, pos0, rl, replay, hkv, d
+        )
+        kbuf0 = kbuf0.at[:, :, :replay].set(seed_k)
+        vbuf0 = vbuf0.at[:, :, :replay].set(seed_v)
+
+    def merge_view(buf):
+        """This chunk's OWN entries (cols [rl, rl+emitted) per row) —
+        the replay prefix is already in the pool and must not re-merge
+        (its first row may predate last_rows' remembered partial row).
+        Clipped out-of-range cols land beyond the merge counts and
+        drop."""
+        if not use_replay:
+            return buf
+        idx = jnp.clip(
+            rl[:, None] + jnp.arange(steps, dtype=jnp.int32)[None, :],
+            0, steps - 1,
+        )
+        return jnp.take_along_axis(
+            buf, idx[None, :, :, None, None], axis=2
+        )
 
     if sample_args is None:
-        kbuf, vbuf, logits = model_step(
-            kbuf0, vbuf0, tokens0, jnp.zeros(s, jnp.int32), active0
-        )
+        kbuf, vbuf, logits = model_step(kbuf0, vbuf0, tokens0, rl, active0)
         clen_final = active0.astype(jnp.int32)
-        return logits, kbuf, vbuf, clen_final
+        return logits, merge_view(kbuf), merge_view(vbuf), clen_final
 
     temperature, top_p, top_k, greedy = sample_args
     remaining0, no_stop0, stop_tokens = stop_args
 
     def step(carry, step_key):
         kbuf, vbuf, tokens, clen, active, remaining, no_stop = carry
-        kbuf, vbuf, logits = model_step(kbuf, vbuf, tokens, clen, active)
+        # boundary cap: a row whose within-chunk count reached `steps`
+        # goes DORMANT for the rest of this dispatch (still alive — it
+        # resumes realigned next dispatch). Only ever binds under
+        # replay, where clen starts at rl > 0
+        on = active & (clen < steps)
+        kbuf, vbuf, logits = model_step(kbuf, vbuf, tokens, clen, on)
         toks, logps = _sample_impl(
             logits, step_key, temperature, top_p, top_k, greedy,
             topk_bound, slot_ids=slot_ids,
         )
-        emitted = active
+        emitted = on
         hit_stop = jnp.any(
             toks[:, None] == stop_tokens, axis=1
         ) & (no_stop <= 1)
-        clen = clen + active
-        remaining = jnp.where(active, remaining - 1, remaining)
-        no_stop = jnp.where(active, no_stop - 1, no_stop)
-        active = active & ~hit_stop & (remaining > 0)
-        return (kbuf, vbuf, toks, clen, active, remaining, no_stop), (
+        clen = clen + on
+        remaining = jnp.where(on, remaining - 1, remaining)
+        no_stop = jnp.where(on, no_stop - 1, no_stop)
+        active = jnp.where(on, active & ~hit_stop & (remaining > 0), active)
+        # dormant rows keep their last emitted token — it is the next
+        # dispatch's input
+        tokens = jnp.where(on, toks, tokens)
+        return (kbuf, vbuf, tokens, clen, active, remaining, no_stop), (
             toks, logps, emitted,
         )
 
     keys = jax.random.split(key, steps)
-    (kbuf, vbuf, tokens, clen, active, remaining, no_stop), (
+    (kbuf, vbuf, next_tokens, clen, active, remaining, no_stop), (
         toks, logps, emitted,
     ) = jax.lax.scan(
         step,
-        (kbuf0, vbuf0, tokens0, jnp.zeros(s, jnp.int32),
-         active0, remaining0, no_stop0),
+        (kbuf0, vbuf0, tokens0, rl, active0, remaining0, no_stop0),
         keys,
     )
     return (
-        toks, logps, emitted, active, remaining, no_stop, pos0 + clen,
-        kbuf, vbuf, clen,
+        toks, logps, emitted, active, remaining, no_stop, base + clen,
+        merge_view(kbuf), merge_view(vbuf), clen - rl, next_tokens,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "topk_bound", "attn_impl", "ppcb", "spb"),
+    static_argnames=(
+        "cfg", "steps", "topk_bound", "attn_impl", "ppcb", "spb", "replay",
+    ),
 )
 def _decode_multi_forward(
     params: Params,
@@ -790,6 +919,8 @@ def _decode_multi_forward(
     spb: int = 8,
     rope_delta: Optional[jnp.ndarray] = None,
     slot_ids: Optional[jnp.ndarray] = None,
+    align_base: Optional[jnp.ndarray] = None,
+    replay: int = 0,
 ):
     """`steps` fused decode+sample iterations in ONE dispatch with stop
     handling on device (see module doc). Host contract: tables cover
@@ -802,7 +933,7 @@ def _decode_multi_forward(
         (temperature, top_p, top_k, greedy),
         (remaining, no_stop_before, stop_tokens),
         steps, attn_impl, ppcb, spb, topk_bound, rope_delta=rope_delta,
-        slot_ids=slot_ids,
+        slot_ids=slot_ids, align_base=align_base, replay=replay,
     )
 
 
@@ -830,6 +961,8 @@ def decode_multi(
     last_rows: Optional[Dict[str, jnp.ndarray]] = None,
     rope_delta: Optional[jnp.ndarray] = None,
     slot_ids: Optional[jnp.ndarray] = None,
+    align_base: Optional[jnp.ndarray] = None,
+    replay: int = 0,
 ):
     """`steps` fused decode+sample iterations: one READ-ONLY forward
     dispatch + one WRITE-ONLY merge dispatch (reading and writing the
@@ -850,26 +983,36 @@ def decode_multi(
     new_last_rows). ``lens_after`` keeps the per-slot cached length
     device-resident so the host can dispatch chunk N+1 before fetching
     chunk N's results (the serving loop pipelines dispatch against result
-    processing)."""
+    processing).
+
+    With canonical-alignment replay (``align_base`` given, ``replay`` =
+    steps - 1 — speculative engines only, see _decode_core) a trailing
+    ``next_tokens`` [S] joins the return: rows that hit their chunk
+    boundary mid-dispatch go dormant, so the next decode input is their
+    LAST emitted token rather than toks[-1]."""
     if slot_ids is None:
         slot_ids = jnp.arange(tables.shape[0], dtype=jnp.int32)
     (
         toks, logps, emitted, active_a, remaining_a, no_stop_a, lens_a,
-        kbuf, vbuf, clen,
+        kbuf, vbuf, clen, next_tokens,
     ) = _decode_multi_forward(
         params, cfg, cache, tables, pos0, tokens, active, remaining,
         no_stop_before, stop_tokens, key, temperature, top_p, top_k,
         greedy, steps, topk_bound, attn_impl, ppcb, spb,
-        rope_delta=rope_delta, slot_ids=slot_ids,
+        rope_delta=rope_delta, slot_ids=slot_ids, align_base=align_base,
+        replay=replay,
     )
     cache, new_last = merge_tokens(
         cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows,
         slot_ids=slot_ids,
     )
-    return (
+    out = (
         cache, toks, logps, emitted, active_a, remaining_a, no_stop_a,
         lens_a, new_last,
     )
+    if replay > 0 and align_base is not None:
+        return out + (next_tokens,)
+    return out
 
 
 @functools.partial(
@@ -912,6 +1055,304 @@ def decode_step(
         cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows
     )
     return cache, logits, new_last
+
+
+# ---------------------------------------------------------------------------
+# Speculative verify (draft-free multi-token decode)
+# ---------------------------------------------------------------------------
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "k", "topk_bound", "attn_impl", "ppcb", "spb", "replay",
+    ),
+)
+def _spec_verify_forward(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,  # [S, PPS]
+    pos0: jnp.ndarray,  # [S] cached tokens per slot
+    tokens: jnp.ndarray,  # [S] current input token per slot
+    draft: jnp.ndarray,  # [S, K-1] proposed continuation tokens
+    draft_len: jnp.ndarray,  # [S] valid drafts per slot (0..K-1)
+    active: jnp.ndarray,  # [S] bool
+    remaining: jnp.ndarray,  # [S]
+    no_stop_before: jnp.ndarray,  # [S]
+    stop_tokens: jnp.ndarray,  # [S, 8]
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    greedy: jnp.ndarray,
+    k: int,  # static verify window: 1 current token + K-1 draft positions
+    topk_bound: int = 0,
+    attn_impl: str = "jnp",
+    ppcb: int = 4,
+    spb: int = 8,
+    rope_delta: Optional[jnp.ndarray] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
+    align_base: Optional[jnp.ndarray] = None,
+    replay: int = 0,
+):
+    """Score ``k`` positions per slot in ONE forward and accept the
+    longest prefix the model itself would have produced.
+
+    Position i's input is ``[tokens, draft[0], ..., draft[i-1]][i]``; its
+    logits predict the NEXT token, sampled through the exact
+    ``_sample_impl`` the sequential decode scan uses (greedy slots:
+    argmax; sampled slots: an independent key per position — every kept
+    token is drawn from the true conditional, so the output distribution
+    is exactly the non-speculative one). Acceptance is EXACT MATCH: the
+    sampled token at position i must equal the draft token that was fed
+    as position i+1's input, otherwise positions > i were computed on a
+    wrong prefix and emission stops. Greedy streams are therefore
+    bit-identical with speculation on or off.
+
+    Numerics contract (what makes that bit-exactness hold): every op is
+    row/position-independent against the sequential ``_decode_core``
+    path — batched matmuls ([S, K, D] vs [S, D]) are row-stable, rope /
+    norms are elementwise, and each position's attention is the SAME
+    ``_attend`` call the scan makes (q [S, Hq, D], chunk counts i+1;
+    masked chunk/window tails contribute exact zeros regardless of
+    buffer size — the same shape-invariance the kv_bucket ladder and
+    decode compaction already rely on).
+
+    Stop/budget semantics mirror the scan step-for-step: a stop-token
+    hit or exhausted budget ends emission exactly where the sequential
+    path would.
+
+    Returns the ``_decode_multi_forward`` tuple plus nothing new: (toks
+    [K, S], logps, emitted, active_after, remaining_after, no_stop_after,
+    lens_after, kbuf, vbuf, clen) where ``clen`` is the per-slot count of
+    chunk-buffer positions whose K/V is VALID (inputs on the accepted
+    path) — the merge writes only those, which IS the KV rollback:
+    rejected positions never reach the pool, and cache-length accounting
+    (``lens_after = pos0 + clen``) matches a non-speculative run that
+    emitted the same tokens.
+    """
+    s = tables.shape[0]
+    d = cfg.head_dim
+    nl = cache["k"].shape[0]
+    hkv = cfg.num_kv_heads
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta
+    )
+    kv_dtype = cache["k"].dtype
+    if slot_ids is None:
+        slot_ids = jnp.arange(s, dtype=jnp.int32)
+    # canonical-alignment replay (see _gather_recent_kv / _decode_core):
+    # window position i is scored with the EXACT shapes the sequential
+    # engine gives that position — a width-cq chunk buffer holding its
+    # canonical chunk's entries at within-chunk columns, pool window
+    # ending at that chunk's boundary. cq = replay + 1 is the engine's
+    # decode_chunk; windows may cross boundaries (every position gets
+    # its own buffer/window). Without align_base (standalone use) the
+    # window itself plays the chunk-buffer role at width k.
+    use_replay = replay > 0 and align_base is not None
+    cq = replay + 1
+    if use_replay:
+        rl = jnp.where(active, jnp.mod(pos0 - align_base, cq), 0)
+        seed_k, seed_v = _gather_recent_kv(
+            cache, tables, pos0, rl, replay, hkv, d
+        )
+    else:
+        rl = jnp.zeros(s, jnp.int32)
+    base = pos0 - rl
+
+    # [S, K] input token matrix: current token then the draft guesses
+    tokens_mat = jnp.concatenate([tokens[:, None], draft], axis=1)
+    x = params["embedding"][tokens_mat]  # [S, K, D]
+    if cfg.scale_embeddings:  # gemma
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    pos = pos0[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]  # [S, K]
+    if rope_delta is not None:
+        pos = jnp.maximum(pos + rope_delta[:, None], 0)
+    valid_q = jnp.broadcast_to(active[:, None], (s, k))
+
+    def layer(x, xs):
+        lp, li = xs
+        q, kk, vv = _layer_pre(cfg, lp, x)  # [S, K, H*, D]
+        q = apply_rope(q, pos, cos, sin)
+        kk = apply_rope(kk, pos, cos, sin)
+        kwin = kk.astype(kv_dtype)  # [S, K, Hkv, D]
+        vwin = vv.astype(kv_dtype)
+        attns = []
+        if use_replay:
+            # canonical chunk buffer: col c ↔ cache position base + c —
+            # replayed boundary-to-now prefix at cols [0, rl), this
+            # window scattered at per-row cols [rl, rl+K). Width is
+            # EXACTLY cq (the sequential engine's chunk shape); window
+            # positions at or past the next boundary are never emitted
+            # (the acceptance loop caps there — their canonical chunk
+            # would need [pos0, boundary) as POOL entries, which are not
+            # merged yet), so slicing to cq loses nothing emittable.
+            sk = jax.lax.dynamic_index_in_dim(seed_k, li, 0, keepdims=False)
+            sv = jax.lax.dynamic_index_in_dim(seed_v, li, 0, keepdims=False)
+            widx = jnp.clip(
+                rl[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :],
+                0, replay + k - 1,
+            )
+            srows = jnp.arange(s)[:, None]
+            kb = jnp.concatenate(
+                [sk, jnp.zeros((s, k, hkv, d), kv_dtype)], axis=1
+            ).at[srows, widx].set(kwin)[:, :cq]
+            vb = jnp.concatenate(
+                [sv, jnp.zeros((s, k, hkv, d), kv_dtype)], axis=1
+            ).at[srows, widx].set(vwin)[:, :cq]
+            ck = kb.transpose(0, 2, 1, 3)  # [S, Hkv, cq, D]
+            cv = vb.transpose(0, 2, 1, 3)
+            for i in range(k):  # static unroll; K is small
+                attns.append(
+                    _attend(
+                        cfg, cache, li, q[:, i], base, tables, ck, cv,
+                        rl + i + 1, attn_impl, ppcb, spb,
+                    )
+                )
+        else:
+            # standalone (no alignment contract): the K in-window
+            # positions play the chunk buffer's role; position i sees
+            # entries [0, i] via counts
+            ck = kwin.transpose(0, 2, 1, 3)  # [S, Hkv, K, D]
+            cv = vwin.transpose(0, 2, 1, 3)
+            for i in range(k):
+                counts_i = jnp.full((s,), i + 1, jnp.int32)
+                attns.append(
+                    _attend(
+                        cfg, cache, li, q[:, i], pos0, tables, ck, cv,
+                        counts_i, attn_impl, ppcb, spb,
+                    )
+                )
+        attn = jnp.stack(attns, axis=1)  # [S, K, Hq, D]
+        x = _layer_post(
+            cfg, lp, x, attn.reshape(s, k, cfg.q_dim).astype(x.dtype),
+            valid_q,
+        )
+        return x, (kk.astype(kv_dtype), vv.astype(kv_dtype))
+
+    x, (knew, vnew) = jax.lax.scan(
+        layer, x, (params["layers"], jnp.arange(nl, dtype=jnp.int32))
+    )
+    # knew/vnew [L, S, K, Hkv, D] — already the decode chunk-buffer layout
+    logits = _final_logits(params, cfg, x)  # [S, K, V] fp32
+
+    keys = jax.random.split(key, k)
+    # ``on`` gates EMISSION (dies on stop/budget like the scan's active,
+    # and ALSO on a draft mismatch — later positions were computed on a
+    # wrong prefix); ``alive`` is the request's continued-existence flag
+    # the engine gets back: a rejected draft ends emission but NOT the
+    # request (it simply continues un-speculated next chunk)
+    on = active
+    alive = active
+    rem = remaining
+    nsb = no_stop_before
+    clen = jnp.zeros(s, jnp.int32)
+    toks_list, logps_list, emitted_list = [], [], []
+    for i in range(k):
+        toks_i, logps_i = _sample_impl(
+            logits[:, i], keys[i], temperature, top_p, top_k, greedy,
+            topk_bound, slot_ids=slot_ids,
+        )
+        emitted_i = on
+        emitted_list.append(emitted_i)
+        hit_stop = jnp.any(
+            toks_i[:, None] == stop_tokens, axis=1
+        ) & (nsb <= 1)
+        clen = clen + on
+        rem = jnp.where(on, rem - 1, rem)
+        nsb = jnp.where(on, nsb - 1, nsb)
+        # exactly the scan's continue condition for this emitted token
+        cont = ~hit_stop & (rem > 0)
+        alive = jnp.where(emitted_i, cont, alive)
+        on = emitted_i & cont
+        if i + 1 < k:
+            # continue into position i+1 only if the draft supplied it
+            # AND the model just produced exactly that token (the
+            # verified-prefix rule)
+            on = on & (draft_len >= i + 1) & (toks_i == tokens_mat[:, i + 1])
+            if use_replay:
+                # canonical-boundary cap: a position in the NEXT chunk
+                # would need this window's pre-boundary tokens as pool
+                # entries (not merged yet) — the row stops here and
+                # resumes realigned next dispatch
+                on = on & (rl + (i + 1) < cq)
+        toks_list.append(toks_i)
+        logps_list.append(logps_i)
+    toks = jnp.stack(toks_list)  # [K, S]
+    logps = jnp.stack(logps_list)
+    emitted = jnp.stack(emitted_list)
+    # next decode input per row = its LAST EMITTED token (a row that
+    # rejected its draft at position j resumes from token j, not from
+    # position k-1's wrong-prefix sample — unlike the sequential scan,
+    # toks[-1] is NOT the next input for every live row here)
+    last_idx = jnp.clip(clen - 1, 0, k - 1)[None, :]
+    next_tokens = jnp.take_along_axis(toks, last_idx, axis=0)[0]
+    return (
+        toks, logps, emitted, alive, rem, nsb, pos0 + clen, knew, vnew,
+        clen, next_tokens,
+    )
+
+
+def spec_verify(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Dict[str, jnp.ndarray],
+    tables: jnp.ndarray,
+    pos0: jnp.ndarray,
+    tokens: jnp.ndarray,
+    draft: jnp.ndarray,  # [S, K-1]
+    draft_len: jnp.ndarray,  # [S]
+    active: jnp.ndarray,
+    remaining: jnp.ndarray,
+    no_stop_before: jnp.ndarray,
+    stop_tokens: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    top_k: jnp.ndarray,
+    greedy: jnp.ndarray,
+    k: int,
+    topk_bound: int = 0,
+    attn_impl: str = "jnp",
+    ppcb: int = 1,
+    spb: int = 16,
+    last_rows: Optional[Dict[str, jnp.ndarray]] = None,
+    rope_delta: Optional[jnp.ndarray] = None,
+    slot_ids: Optional[jnp.ndarray] = None,
+    align_base: Optional[jnp.ndarray] = None,
+    replay: int = 0,
+):
+    """Multi-token verify with KV rollback: one READ-ONLY k-position
+    forward + the standard WRITE-ONLY merge, where the merge count is the
+    ACCEPTED prefix length — rejected positions' K/V never reach the
+    pool, so pool state after a verify equals a non-speculative run that
+    emitted the same tokens (pinned by tests/test_spec_decode.py).
+
+    Same return contract as ``decode_multi`` (the engine's dispatch /
+    fetch / process machinery treats both identically, with steps = k),
+    plus a trailing ``next_tokens`` [S]: each row's last EMITTED token —
+    the next decode input (``toks[-1]`` would be a wrong-prefix sample
+    for rows that rejected their draft early).
+    """
+    if slot_ids is None:
+        slot_ids = jnp.arange(tables.shape[0], dtype=jnp.int32)
+    (
+        toks, logps, emitted, active_a, remaining_a, no_stop_a, lens_a,
+        kbuf, vbuf, clen, next_tokens,
+    ) = _spec_verify_forward(
+        params, cfg, cache, tables, pos0, tokens, draft, draft_len,
+        active, remaining, no_stop_before, stop_tokens, key, temperature,
+        top_p, top_k, greedy, k, topk_bound, attn_impl, ppcb, spb,
+        rope_delta=rope_delta, slot_ids=slot_ids, align_base=align_base,
+        replay=replay,
+    )
+    cache, new_last = merge_tokens(
+        cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows,
+        slot_ids=slot_ids,
+    )
+    return (
+        cache, toks, logps, emitted, active_a, remaining_a, no_stop_a,
+        lens_a, new_last, next_tokens,
+    )
 
 
 # ---------------------------------------------------------------------------

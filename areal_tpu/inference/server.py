@@ -96,6 +96,13 @@ _METRIC_HELP = {
     "total_preemptions": "requests preempted under pool pressure",
     "model_version": "weight version currently being served",
     "paused": "1 while generation is paused for a weight update",
+    # speculative decoding (present only when spec is configured)
+    "spec_enabled": "1 while speculation is active (0 = auto-disabled)",
+    "spec_accept_rate": "lifetime accepted/drafted speculative tokens",
+    "spec_accept_rate_ewma": "recent accept-rate EWMA (the gate's signal)",
+    "spec_draft_tokens_total": "draft tokens proposed to verify dispatches",
+    "spec_accepted_tokens_total": "draft tokens accepted by the model",
+    "spec_chunks_total": "multi-token verify dispatches run",
 }
 
 
@@ -352,6 +359,16 @@ def main(argv: Optional[list] = None):
         "decode bucket-ladder warmup)",
     )
     p.add_argument(
+        "--spec", action="store_true",
+        help="enable draft-free speculative decoding (n-gram proposals "
+        "+ multi-token verify; greedy streams stay bit-identical)",
+    )
+    p.add_argument("--spec-max-draft", type=int, default=4)
+    p.add_argument("--spec-ngram-min", type=int, default=2)
+    p.add_argument("--spec-ngram-max", type=int, default=4)
+    p.add_argument("--spec-accept-floor", type=float, default=0.1)
+    p.add_argument("--spec-disable-patience", type=int, default=32)
+    p.add_argument(
         "--router-addr", default="",
         help="router host:port to POST /register to at startup "
         "(dynamic fleet membership without shared name_resolve)",
@@ -378,6 +395,13 @@ def main(argv: Optional[list] = None):
         compilation_cache_dir=args.compilation_cache_dir,
     )
     cfg.tracing.enabled = args.trace
+    cfg.spec.enabled = args.spec
+    if args.spec:
+        cfg.spec.max_draft = args.spec_max_draft
+        cfg.spec.ngram_min = args.spec_ngram_min
+        cfg.spec.ngram_max = args.spec_ngram_max
+        cfg.spec.accept_floor = args.spec_accept_floor
+        cfg.spec.disable_patience = args.spec_disable_patience
     engine = GenerationEngine(cfg).start()
     serve(
         engine,

@@ -1,0 +1,229 @@
+"""Draft-free speculative decoding: proposers + accept-rate gating.
+
+Rollout generation is decode-bound (the GRPO/GSM8K north-star workload),
+and PR 3's tail compaction only shrinks *rows* — every surviving sequence
+still pays one full forward per token. Speculation attacks the per-token
+cost itself: a host-side proposer guesses the next few tokens, and ONE
+multi-token verify dispatch (model_runner.spec_verify) scores every guess
+position in a single forward, so an accepted draft of length k turns k+1
+sequential param reads into one.
+
+This module is the HOST half of the subsystem:
+
+- ``Proposer`` — the pluggable contract. The engine feeds it every slot's
+  token history (prompt + generated, exactly what the host has already
+  processed) and asks for a draft per decode round. Proposals are pure
+  *guesses*: a wrong draft costs one rejected verify position, never
+  correctness — the verify dispatch accepts only the prefix the model
+  itself would have produced (exact-match acceptance, so greedy streams
+  are bit-identical with speculation on or off, and sampled streams keep
+  their exact distribution: every kept token was drawn from the true
+  conditional under an independent key).
+- ``NgramProposer`` — the first implementation: per-slot suffix match
+  against the request's OWN history (prompt-lookup / n-gram
+  self-speculation; no draft model). RLVR math traces are highly
+  self-repetitive, which is what makes draft-free proposals pay. O(1)
+  per appended token via a rolling n-gram index: each append inserts
+  (ngram_max - ngram_min + 1) fixed-length suffix keys; each proposal is
+  the same number of dict probes.
+- ``AcceptRateGate`` — auto-disable hysteresis. When the measured accept
+  rate stays below a floor for ``patience`` consecutive verify rounds,
+  the engine stops speculating (drafting + verifying below the floor is
+  pure overhead); the gate is sticky-off so a hostile workload pays the
+  probe cost once, not forever.
+
+The device half (k-token causal verify with KV rollback) lives in
+inference/model_runner.spec_verify; the scheduling composition rules live
+in inference/engine.py (drain-for-drafts) and docs/ARCHITECTURE.md §11.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Proposer", "NgramProposer", "AcceptRateGate"]
+
+
+class Proposer:
+    """Contract for host-side draft proposers (one instance per engine).
+
+    The engine calls, always from its single loop thread:
+
+    - ``begin(slot, tokens)`` when a request is installed in a slot (the
+      full prompt + any already-generated tokens — resumed/preempted
+      requests re-enter with their accumulated history);
+    - ``extend(slot, tokens)`` after each processed chunk with the tokens
+      the host accepted (speculation or not);
+    - ``drop(slot)`` when the slot is released (finish/abort/preempt);
+    - ``propose(slot, max_draft)`` before a verify dispatch — return up
+      to ``max_draft`` guessed continuation tokens, or [] to sit the
+      round out;
+    - ``has_candidate(slot)`` — cheap "would propose() return anything"
+      probe, used by the scheduler to decide whether draining the decode
+      pipeline for fresh drafts is worth it.
+
+    Implementations must never raise on unknown slots (drop/extend may
+    race admission bookkeeping) and must not block: the proposer runs on
+    the engine loop between device dispatches.
+    """
+
+    def begin(self, slot: int, tokens: Sequence[int]) -> None:
+        raise NotImplementedError
+
+    def extend(self, slot: int, tokens: Sequence[int]) -> None:
+        raise NotImplementedError
+
+    def drop(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def propose(self, slot: int, max_draft: int) -> List[int]:
+        raise NotImplementedError
+
+    def has_candidate(self, slot: int) -> bool:
+        return bool(self.propose(slot, 1))
+
+
+class _SlotIndex:
+    """Rolling n-gram index over one slot's token history.
+
+    For every n in [nmin, nmax], ``src[g]`` maps the n-gram ``g`` to the
+    END position of its most recent occurrence STRICTLY BEFORE the
+    current suffix — exactly what a proposal wants ("where did I last
+    see the text I am writing now, and what came after it"). Appending a
+    token updates each n's entry in O(1): the previous "latest
+    occurrence" becomes the proposal source when the same n-gram closes
+    again at the new tail.
+    """
+
+    __slots__ = ("hist", "src", "_latest")
+
+    def __init__(self) -> None:
+        self.hist: List[int] = []
+        # (n, gram) -> end position of the latest occurrence before the
+        # one currently at the tail
+        self.src: Dict[Tuple[int, ...], int] = {}
+        self._latest: Dict[Tuple[int, ...], int] = {}
+
+    def append(self, tok: int, nmin: int, nmax: int) -> None:
+        self.hist.append(int(tok))
+        p = len(self.hist) - 1  # end position of every gram closed here
+        for n in range(nmin, nmax + 1):
+            if p + 1 < n:
+                continue
+            g = tuple(self.hist[p - n + 1 : p + 1])
+            old = self._latest.get(g)
+            if old is not None:
+                self.src[g] = old
+            self._latest[g] = p
+
+    def lookup(self, nmin: int, nmax: int) -> Optional[int]:
+        """End position of the best (longest-n) earlier occurrence of the
+        current suffix, or None."""
+        L = len(self.hist)
+        for n in range(nmax, nmin - 1, -1):  # longest match wins
+            if L < n:
+                continue
+            q = self.src.get(tuple(self.hist[L - n :]))
+            if q is not None:
+                return q
+        return None
+
+
+class NgramProposer(Proposer):
+    """Suffix-match speculation against the request's own history.
+
+    If the last n tokens (n from ``ngram_max`` down to ``ngram_min``,
+    longest match preferred) occurred earlier in prompt+output, propose
+    the tokens that followed that occurrence. No draft model, no device
+    work — the draft is a memcpy from history.
+    """
+
+    def __init__(self, ngram_min: int = 2, ngram_max: int = 4):
+        if not (1 <= ngram_min <= ngram_max):
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"{ngram_min}..{ngram_max}"
+            )
+        self.ngram_min = ngram_min
+        self.ngram_max = ngram_max
+        self._slots: Dict[int, _SlotIndex] = {}
+
+    def begin(self, slot: int, tokens: Sequence[int]) -> None:
+        idx = _SlotIndex()
+        self._slots[slot] = idx
+        for t in tokens:
+            idx.append(t, self.ngram_min, self.ngram_max)
+
+    def extend(self, slot: int, tokens: Sequence[int]) -> None:
+        idx = self._slots.get(slot)
+        if idx is None:
+            return
+        for t in tokens:
+            idx.append(t, self.ngram_min, self.ngram_max)
+
+    def drop(self, slot: int) -> None:
+        self._slots.pop(slot, None)
+
+    def history(self, slot: int) -> List[int]:
+        idx = self._slots.get(slot)
+        return list(idx.hist) if idx is not None else []
+
+    def propose(self, slot: int, max_draft: int) -> List[int]:
+        idx = self._slots.get(slot)
+        if idx is None or max_draft <= 0:
+            return []
+        q = idx.lookup(self.ngram_min, self.ngram_max)
+        if q is None:
+            return []
+        # continuation after the matched occurrence; q < len-1 always
+        # (the occurrence at the tail itself is never a source)
+        return idx.hist[q + 1 : q + 1 + max_draft]
+
+    def has_candidate(self, slot: int) -> bool:
+        idx = self._slots.get(slot)
+        return (
+            idx is not None
+            and idx.lookup(self.ngram_min, self.ngram_max) is not None
+        )
+
+
+class AcceptRateGate:
+    """Accept-rate EWMA with sticky auto-disable hysteresis.
+
+    ``observe(drafted, accepted)`` after each verify round; speculation
+    stays enabled until the EWMA sits below ``floor`` for ``patience``
+    CONSECUTIVE rounds (one good round resets the streak — that is the
+    hysteresis: brief accept-rate dips don't kill speculation, sustained
+    ones do). ``floor <= 0`` disables the gate entirely.
+    """
+
+    def __init__(
+        self, floor: float = 0.1, patience: int = 32, alpha: float = 0.2
+    ):
+        self.floor = float(floor)
+        self.patience = max(1, int(patience))
+        self.alpha = float(alpha)
+        self.ewma: Optional[float] = None
+        self.low_streak = 0
+        self.disabled = False
+
+    def observe(self, drafted: int, accepted: int) -> bool:
+        """Record one verify round; returns True while spec stays on."""
+        if self.disabled:
+            return False
+        if drafted <= 0:  # a round with no drafts carries no signal
+            return True
+        inst = accepted / drafted
+        self.ewma = (
+            inst
+            if self.ewma is None
+            else (1 - self.alpha) * self.ewma + self.alpha * inst
+        )
+        if self.floor <= 0:
+            return True
+        if self.ewma < self.floor:
+            self.low_streak += 1
+            if self.low_streak >= self.patience:
+                self.disabled = True
+                return False
+        else:
+            self.low_streak = 0
+        return True

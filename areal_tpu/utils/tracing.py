@@ -231,9 +231,12 @@ class SpanTracer:
 def _prom_type(name: str, types: Optional[Dict[str, str]]) -> str:
     if types and name in types:
         return types[name]
-    # monotonically increasing engine totals are counters; everything else
-    # is a point-in-time gauge
-    return "counter" if name.startswith("total_") else "gauge"
+    # monotonically increasing engine totals are counters (legacy
+    # "total_" prefix or the Prometheus-conventional "_total" suffix);
+    # everything else is a point-in-time gauge
+    if name.startswith("total_") or name.endswith("_total"):
+        return "counter"
+    return "gauge"
 
 
 def render_prometheus(

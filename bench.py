@@ -423,6 +423,111 @@ def main():
     decode_ab_compiles = _ab_c1["count"] - _ab_c0["count"]
     decode_ab_compile_s = round(_ab_c1["secs"] - _ab_c0["secs"], 1)
 
+    # --- speculative decoding A/B sub-phase (r7): spec × compact. A
+    # self-repetitive greedy workload (tiled-motif prompts — the shape of
+    # RLVR math traces, where draft-free n-gram speculation pays) decoded
+    # with the verify dispatch on vs off. Reports decode tok/s per cell
+    # plus the measured accept rate; per-cell graceful degradation like
+    # the decode A/B (a broken cell records its error, never crashes the
+    # round). ---
+    def spec_ab_phase():
+        import gc
+        import itertools
+
+        from areal_tpu.api.cli_args import SpecConfig
+
+        results = {}
+        for spec_on, compact in itertools.product(
+            (True, False), (True, False)
+        ):
+            ab_rng = np.random.default_rng(43)
+            name = (
+                f"spec_{'on' if spec_on else 'off'}"
+                f"__compact_{'on' if compact else 'off'}"
+            )
+            g = None
+            try:
+                g = GenerationEngine(
+                    JaxGenConfig(
+                        dtype="bfloat16", max_num_seqs=64,
+                        max_model_len=4096, page_size=256, num_pages=320,
+                        prefill_chunk=128, decode_chunk=32,
+                        decode_pipeline=2, admit_wave=16, kv_bucket=1024,
+                        decode_compact=compact,
+                        # accept_floor 0: the A/B measures the mechanism
+                        # end-to-end — the production gate would turn a
+                        # losing cell off mid-phase and blur the number
+                        spec=SpecConfig(
+                            enabled=spec_on, max_draft=8, ngram_min=2,
+                            ngram_max=4, accept_floor=0.0,
+                        ),
+                    ),
+                    model_config=model_cfg,
+                    params=params,
+                ).start()
+
+                def wave(cnt, mnew):
+                    futs = []
+                    for _ in range(cnt):
+                        # tiled motif: the self-repetition n-gram
+                        # proposals feed on
+                        motif = ab_rng.integers(
+                            1, model_cfg.vocab_size, size=16
+                        ).tolist()
+                        prompt = (motif * 9)[:128]
+                        futs.append(
+                            g.submit(
+                                {
+                                    "input_ids": prompt,
+                                    "sampling_params": {
+                                        "max_new_tokens": mnew,
+                                        "greedy": True,
+                                    },
+                                }
+                            )
+                        )
+                    t0 = time.perf_counter()
+                    rs = [f.result(timeout=3600) for f in futs]
+                    dt = time.perf_counter() - t0
+                    return sum(len(r["output_ids"]) for r in rs) / dt
+
+                wave(64, 64)  # warm the shape ladder
+                tok_s = wave(64, 256)
+                m = g.metrics()
+                cell = {"decode_tok_s": round(tok_s, 1)}
+                if spec_on:
+                    cell.update(
+                        accept_rate=m.get("spec_accept_rate", 0.0),
+                        verify_chunks=int(m.get("spec_chunks_total", 0)),
+                        draft_tokens=int(
+                            m.get("spec_draft_tokens_total", 0)
+                        ),
+                        accepted_tokens=int(
+                            m.get("spec_accepted_tokens_total", 0)
+                        ),
+                    )
+                results[name] = cell
+            except Exception as e:  # degrade per-cell, keep the rest
+                results[name] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+            finally:
+                if g is not None:
+                    try:
+                        g.stop()
+                    except Exception:
+                        pass
+                    del g
+                gc.collect()
+            emit_phase("spec_ab", {"configs": results})
+        return results
+
+    _sp_c0 = compile_snap()
+    spec_ab = spec_ab_phase()
+    _sp_c1 = compile_snap()
+    spec_ab_compiles = _sp_c1["count"] - _sp_c0["count"]
+    spec_ab_compile_s = round(_sp_c1["secs"] - _sp_c0["secs"], 1)
+
     gen_cfg = JaxGenConfig(
         dtype="bfloat16",
         max_num_seqs=n_samples,
@@ -601,8 +706,12 @@ def main():
     warm_compiles = compile_snap()
     warm_compiles = {
         **warm_compiles,
-        "count": warm_compiles["count"] - decode_ab_compiles,
-        "secs": warm_compiles["secs"] - (_ab_c1["secs"] - _ab_c0["secs"]),
+        # keep the A/B phases' compile bills out of the warmup counter
+        # (comparable to the r5 baseline: main-loop warmup only)
+        "count": warm_compiles["count"] - decode_ab_compiles
+        - spec_ab_compiles,
+        "secs": warm_compiles["secs"] - (_ab_c1["secs"] - _ab_c0["secs"])
+        - (_sp_c1["secs"] - _sp_c0["secs"]),
     }
 
     # --- serial measurement (rollout -> train, no overlap) ---
@@ -782,6 +891,11 @@ def main():
         "decode_ab": decode_ab,
         "decode_ab_compiles": decode_ab_compiles,
         "decode_ab_compile_s": decode_ab_compile_s,
+        # r7: spec × compact speculative-decoding A/B (full per-cell
+        # record in BENCH_<round>_spec_ab.json)
+        "spec_ab": spec_ab,
+        "spec_ab_compiles": spec_ab_compiles,
+        "spec_ab_compile_s": spec_ab_compile_s,
         "compile_cache_dir": cache_dir,
         "compile_cache_hits": cache_events["hits"],
     }

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from areal_tpu.api.cli_args import JaxGenConfig, TracingConfig
+from areal_tpu.api.cli_args import JaxGenConfig, SpecConfig, TracingConfig
 from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.inference.server import serve
 from areal_tpu.models.config import tiny_config
@@ -192,6 +192,98 @@ class TestServerEndpoints:
             ]
         assert any(s["rid"] == "rid-jsonl" for s in lines)
         assert all({"name", "rid", "ts", "dur"} <= set(s) for s in lines)
+
+
+class TestSpecObservability:
+    """Speculative-decoding gauges: present (and Prometheus-rendered)
+    exactly when spec is configured; decode_chunk spans carry draft
+    attrs and verify rounds emit spec_verify instants."""
+
+    @pytest.fixture(scope="class")
+    def spec_engine(self):
+        cfg = tiny_config("qwen2")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        gcfg = JaxGenConfig(
+            dtype="float32", max_num_seqs=4, max_model_len=256,
+            prefill_chunk=16, page_size=8, decode_chunk=4,
+            prefix_reuse_min=0,
+            spec=SpecConfig(
+                enabled=True, max_draft=3, ngram_min=2, ngram_max=3,
+                accept_floor=0.0,
+            ),
+            tracing=TracingConfig(enabled=True, max_spans=10_000),
+        )
+        eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+        httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        yield eng, addr
+        httpd.shutdown()
+        eng.stop()
+
+    def test_spec_gauges_on_metrics_endpoint(self, spec_engine):
+        eng, addr = spec_engine
+        # long greedy run: tiny random models loop, so n-gram drafts
+        # fire and accepted counts move
+        eng.generate(
+            {
+                "rid": "rid-spec",
+                "input_ids": [3, 9, 4, 1, 7, 2, 8, 6, 5, 11],
+                "sampling_params": {"max_new_tokens": 80, "greedy": True},
+            }
+        )
+        m = eng.metrics()
+        assert m["spec_chunks_total"] > 0, "no verify dispatch ran"
+        assert m["spec_draft_tokens_total"] > 0
+        assert 0.0 <= m["spec_accept_rate"] <= 1.0
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        for required in (
+            "areal_tpu_gen_spec_enabled",
+            "areal_tpu_gen_spec_accept_rate",
+            "areal_tpu_gen_spec_draft_tokens_total",
+            "areal_tpu_gen_spec_accepted_tokens_total",
+            "areal_tpu_gen_spec_chunks_total",
+        ):
+            assert any(
+                line.startswith(required + " ")
+                for line in text.splitlines()
+            ), f"missing sample line for {required}"
+        assert "# TYPE areal_tpu_gen_spec_draft_tokens_total counter" in text
+
+    def test_spec_spans_on_trace(self, spec_engine):
+        eng, _ = spec_engine
+        # self-sufficient traffic (must not depend on sibling tests
+        # having already driven the shared engine)
+        eng.generate(
+            {
+                "rid": "rid-spec-spans",
+                "input_ids": [2, 8, 5, 1, 9, 3, 7, 4, 6, 12],
+                "sampling_params": {"max_new_tokens": 80, "greedy": True},
+            }
+        )
+        spans = eng.tracer.snapshot()
+        verify = [s for s in spans if s.name == "spec_verify"]
+        assert verify, "verify rounds must emit spec_verify instants"
+        for s in verify:
+            assert s.attrs["accepted"] <= s.attrs["drafted"]
+        chunk_attrs = [
+            s.attrs for s in spans
+            if s.name == "decode_chunk" and "spec_draft_tokens" in s.attrs
+        ]
+        assert chunk_attrs, "verify decode_chunk spans carry draft attrs"
+        for a in chunk_attrs:
+            assert a["spec_draft_tokens"] >= a["spec_draft_rows"] >= 1
+
+    def test_spec_off_metrics_have_no_spec_keys(self, traced_engine):
+        eng, addr, _, _ = traced_engine
+        assert not any(k.startswith("spec_") for k in eng.metrics())
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        assert "areal_tpu_gen_spec_" not in text
 
 
 class TestDisabledNoOp:

@@ -1,0 +1,712 @@
+"""Speculative decoding (r7): greedy stream parity, proposer units,
+accept-rate gating, and the KV rollback invariant.
+
+The tentpole invariant: with ``spec`` enabled and greedy sampling, the
+token AND logprob streams a request produces are BIT-IDENTICAL to a
+speculation-off run — across randomized cohorts with preemption,
+``decode_pipeline=2`` in-flight chunks, and decode-compaction row
+races. This holds because (a) acceptance is exact-match (a draft token
+survives only if the model's own sample equals it), (b) every window
+position is scored with the sequential engine's exact shapes (canonical
+chunk alignment: replayed boundary-to-now K/V, width-``decode_chunk``
+buffers, boundary-capped emission — model_runner._spec_verify_forward),
+and (c) rejected positions' K/V never reach the paged pool (the merge
+writes only the accepted prefix).
+
+Preempted requests are excluded from the bit-exactness comparison:
+preemption timing differs between spec on/off runs (token arrival rates
+differ), and a resumed request's next token comes from the prefill path
+whose numerics are not pinned against decode's. The cohorts submit
+greedy requests FIRST so preemption (youngest-victim) lands on the
+sampled tail; at least one greedy request must survive un-preempted in
+both runs for a test to count.
+
+Determinism discipline matches test_decode_compaction: all requests are
+submitted BEFORE the engine loop starts, ``admit_hold_s=0``, and
+``prefix_reuse_min=0`` (registry contents would otherwise depend on
+finish order, which speculation changes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import JaxGenConfig, SpecConfig
+from areal_tpu.inference import model_runner as mr
+from areal_tpu.inference.cache import CacheConfig, init_kv_pool
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.spec import AcceptRateGate, NgramProposer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _spec_cfg(enabled, **kw):
+    base = dict(
+        enabled=enabled, max_draft=3, ngram_min=2, ngram_max=3,
+        accept_floor=0.0,
+    )
+    base.update(kw)
+    return SpecConfig(**base)
+
+
+def _run_cohort(model, payloads, spec, **cfg_kw):
+    """Submit every payload BEFORE starting the loop (deterministic
+    admission), run to completion, return (results, metrics)."""
+    cfg, params = model
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", admit_hold_s=0.0, prefill_chunk=16,
+            spec=spec, **cfg_kw,
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    futs = [eng.submit(dict(p)) for p in payloads]
+    eng.start()
+    try:
+        outs = [f.result(timeout=600) for f in futs]
+        # quiesce: the pipelined loop may still hold in-flight chunks
+        # whose deferred page releases haven't flushed
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while (
+            eng._inflight or eng._deferred_release
+        ) and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        metrics = eng.metrics()
+    finally:
+        eng.stop()
+    return outs, metrics
+
+
+def _mixed_payloads(seed):
+    """Greedy requests FIRST (oldest — preemption prefers the sampled
+    tail), then sampled ones with ragged budgets, >8-id stop lists
+    (host-backstop coverage), and min_new_tokens."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(4):
+        out.append(
+            {
+                "rid": f"g{i}",
+                "input_ids": rng.integers(
+                    1, 128, size=int(rng.integers(4, 14))
+                ).tolist(),
+                "sampling_params": {
+                    "max_new_tokens": int(rng.integers(16, 30)),
+                    "greedy": True,
+                },
+            }
+        )
+    for i in range(6):
+        sp = {
+            "max_new_tokens": int(rng.integers(20, 34)),
+            "temperature": float(rng.choice([0.7, 1.0, 1.3])),
+            "top_p": float(rng.choice([1.0, 0.9])),
+            "top_k": int(rng.choice([0, 8])),
+        }
+        if rng.random() < 0.5:
+            sp["stop_token_ids"] = rng.integers(1, 128, size=12).tolist()
+            sp["min_new_tokens"] = int(rng.integers(0, 4))
+        out.append(
+            {
+                "rid": f"s{i}",
+                "input_ids": rng.integers(
+                    1, 128, size=int(rng.integers(4, 14))
+                ).tolist(),
+                "sampling_params": sp,
+            }
+        )
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spec_on_off_greedy_streams_identical_under_races(model, seed):
+    """The acceptance invariant under the hard regime: oversubscribed
+    pool (preempt + re-admit), decode_pipeline=2, compaction races, and
+    verify dispatches interleaving with regular chunks."""
+    payloads = _mixed_payloads(seed)
+    # pool/program shapes deliberately match test_decode_compaction's
+    # cohorts (which run earlier in a tier-1 process): the regular
+    # decode ladder is then already compiled and only the spec programs
+    # (verify + canonical-replay decode) pay compile time here
+    kw = dict(
+        max_num_seqs=4, max_model_len=64, page_size=8,
+        decode_chunk=4, decode_pipeline=2, admit_wave=4,
+        prefix_reuse_min=0, num_pages=12,
+        decode_compact_min_rows=1, decode_compact_hysteresis=2,
+    )
+    on, m_on = _run_cohort(model, payloads, _spec_cfg(True), **kw)
+    off, m_off = _run_cohort(model, payloads, _spec_cfg(False), **kw)
+    assert m_on["total_preemptions"] > 0, (
+        "pool was not oversubscribed — the preempt/re-admit race never ran"
+    )
+    assert m_off["total_preemptions"] > 0
+    assert m_on["spec_draft_tokens_total"] > 0, (
+        "no drafts were ever proposed — the verify dispatch never ran"
+    )
+    # every request completes in both runs
+    for o in on + off:
+        assert len(o["output_ids"]) > 0
+    compared = 0
+    for i in range(4):  # the greedy block
+        a, b = on[i], off[i]
+        if (
+            a["meta_info"]["preemptions"] > 0
+            or b["meta_info"]["preemptions"] > 0
+        ):
+            continue  # preemption timing legitimately differs on/off
+        compared += 1
+        assert a["output_ids"] == b["output_ids"], f"greedy req {i} tokens"
+        assert a["output_logprobs"] == b["output_logprobs"], (
+            f"greedy req {i} logprobs"
+        )
+        assert (
+            a["meta_info"]["finish_reason"]
+            == b["meta_info"]["finish_reason"]
+        )
+    assert compared >= 1, "every greedy request was preempted in some run"
+
+
+def test_spec_parity_all_greedy_with_accepts(model):
+    """All-greedy cohort long enough for tiny-model loops to feed the
+    n-gram proposer: verify chunks run, drafts get ACCEPTED, and
+    un-preempted streams (tokens + logprobs) are bit-identical. Fixed
+    max_new with no stop lists means every request reaches its budget,
+    so final pool accounting and token totals are identical even when
+    preemption timing differs on/off."""
+    rng = np.random.default_rng(0)
+    payloads = [
+        {
+            "rid": f"r{i}",
+            "input_ids": rng.integers(1, 128, size=10).tolist(),
+            "sampling_params": {"max_new_tokens": 40, "greedy": True},
+        }
+        for i in range(3)
+    ]
+    kw = dict(
+        max_num_seqs=4, max_model_len=64, page_size=8,
+        decode_chunk=4, decode_pipeline=2, admit_wave=4,
+        prefix_reuse_min=0, num_pages=12,
+        decode_compact_min_rows=1, decode_compact_hysteresis=2,
+    )
+    on, m_on = _run_cohort(model, payloads, _spec_cfg(True), **kw)
+    off, m_off = _run_cohort(model, payloads, _spec_cfg(False), **kw)
+    assert m_on["spec_chunks_total"] > 0
+    assert m_on["spec_accepted_tokens_total"] > 0, (
+        "looping greedy output should yield accepted n-gram drafts"
+    )
+    assert (
+        m_on["spec_accepted_tokens_total"]
+        <= m_on["spec_draft_tokens_total"]
+    )
+    compared = 0
+    for i, (a, b) in enumerate(zip(on, off)):
+        assert len(a["output_ids"]) == 40
+        assert len(b["output_ids"]) == 40
+        if (
+            a["meta_info"]["preemptions"] > 0
+            or b["meta_info"]["preemptions"] > 0
+        ):
+            continue
+        compared += 1
+        assert a["output_ids"] == b["output_ids"], f"req {i} tokens"
+        assert a["output_logprobs"] == b["output_logprobs"], (
+            f"req {i} logprobs"
+        )
+    assert compared >= 1
+    # identical budgets -> identical final pool accounting (the
+    # engine-level face of the KV rollback invariant)
+    assert m_on["free_pages"] == m_off["free_pages"]
+    assert (
+        m_on["total_generated_tokens"] == m_off["total_generated_tokens"]
+    )
+
+
+def test_spec_off_is_strict_noop(model):
+    """Disabled speculation adds nothing: no proposer, no verify
+    dispatches, no spec metric keys."""
+    payloads = [
+        {
+            "input_ids": [5] * 6,
+            "sampling_params": {"max_new_tokens": 8, "greedy": True},
+        }
+    ]
+    outs, metrics = _run_cohort(
+        model, payloads, _spec_cfg(False),
+        max_num_seqs=4, max_model_len=64, page_size=8,
+        decode_chunk=4, num_pages=12,
+        decode_compact_min_rows=1, decode_compact_hysteresis=2,
+    )
+    assert len(outs[0]["output_ids"]) == 8
+    assert not any(k.startswith("spec_") for k in metrics)
+
+
+def test_spec_refused_when_decode_chunk_too_small(model):
+    """decode_chunk=1 leaves no room for any draft inside the canonical
+    window, so speculation must be refused at init (not left half-on,
+    where the drain-for-drafts branch would destroy pipelining forever
+    without a single verify round for the gate to disable on)."""
+    payloads = [
+        {
+            "input_ids": [5, 6, 7] * 4,
+            "sampling_params": {"max_new_tokens": 10, "greedy": True},
+        }
+    ]
+    outs, metrics = _run_cohort(
+        model, payloads, _spec_cfg(True),
+        max_num_seqs=4, max_model_len=64, page_size=8,
+        decode_chunk=1, num_pages=12, decode_pipeline=2,
+    )
+    assert len(outs[0]["output_ids"]) == 10
+    # refused == strict no-op: no spec metric keys at all
+    assert not any(k.startswith("spec_") for k in metrics)
+
+
+def test_accept_accounting_respects_host_stop(model):
+    """The device stop buffer holds only the first 8 ids — a stop caught
+    by the HOST backstop inside an accepted draft truncates delivery,
+    and the accept accounting (metrics + the gate's EWMA) must count
+    only delivered draft tokens, not what the device emitted past the
+    stop."""
+    cfg, params = model
+
+    def run(prompt, stop_ids=None, min_new=0, spy=None):
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", admit_hold_s=0.0, prefill_chunk=16,
+                max_num_seqs=4, max_model_len=128, page_size=8,
+                decode_chunk=4, num_pages=24, spec=_spec_cfg(True),
+            ),
+            model_config=cfg,
+            params=params,
+        )
+        if spy is not None:
+            inner = eng._observe_spec
+
+            def wrapped(drafted, accepted, rows=0):
+                inner(drafted, accepted, rows=rows)
+                req = next(iter(eng._active.values()), None)
+                spy.append(
+                    (accepted, len(req.output_ids) if req else -1)
+                )
+
+            eng._observe_spec = wrapped
+        sp = {"max_new_tokens": 80, "greedy": True}
+        if stop_ids:
+            sp["stop_token_ids"] = stop_ids
+        if min_new:
+            sp["min_new_tokens"] = min_new
+        fut = eng.submit({"input_ids": prompt, "sampling_params": sp})
+        eng.start()
+        try:
+            out = fut.result(timeout=600)
+            metrics = eng.metrics()
+        finally:
+            eng.stop()
+        return out, metrics
+
+    # discovery: per-verify-chunk (accepted, output_len_after) — at
+    # observe time _process_chunk has already extended output_ids, so
+    # the chunk's delivered tokens are indices [ln-1-acc, ln). Find a
+    # round with >=2 accepted drafts so a stop on its FIRST accepted
+    # draft distinguishes device emission from host delivery;
+    # deterministic for these fixed seed-0 weights.
+    prompt = [2, 8, 5, 1, 9, 3, 7, 4, 6, 12]
+    spy = []
+    out1, m1 = run(prompt, spy=spy)
+    stream = out1["output_ids"]
+    assert m1["spec_draft_tokens_total"] > 0
+    target = next(
+        (i for i, (acc, ln) in enumerate(spy) if acc >= 2 and ln > 0),
+        None,
+    )
+    assert target is not None, f"no verify round accepted >=2: {spy}"
+    acc_t, len_after = spy[target]
+    base_idx = len_after - (acc_t + 1)  # the chunk's free base token
+    stop_idx = base_idx + 1  # its FIRST accepted draft
+    stop_tok = stream[stop_idx]
+    accepted_before = sum(acc for acc, _ in spy[:target])
+
+    # 8 ids the stream never contains fill the device stop buffer; the
+    # REAL stop hides at index 8 — only the host backstop sees it. The
+    # greedy stream loops, so the stop id occurs earlier too:
+    # min_new_tokens = stop_idx + 1 suppresses every earlier hit and
+    # makes the backstop fire exactly at stop_idx.
+    unused = [t for t in range(1, 200) if t not in set(stream)][:8]
+    out2, m2 = run(
+        prompt, stop_ids=unused + [stop_tok], min_new=stop_idx + 1
+    )
+    # greedy parity: run 2 mirrors run 1 exactly up to the stop
+    assert out2["output_ids"] == stream[: stop_idx + 1]
+    # the truncated chunk delivered base + ONE draft: exactly one of
+    # its acc_t device-accepted drafts may count as accepted — the
+    # rest were never delivered and must not inflate the gate's signal
+    assert m2["spec_accepted_tokens_total"] == accepted_before + 1, (
+        m2, spy[: target + 1],
+    )
+
+
+def test_replay_latch_after_auto_disable(model):
+    """Sticky auto-disable must not leave the engine paying the
+    alignment-replay pool gather forever: once every active slot is
+    back on a canonical boundary, later dispatches drop to the plain
+    spec-off program — and the stream stays token-exact across the
+    enabled → disabled → latched transitions."""
+    cfg, params = model
+    payload = {
+        "input_ids": [2, 8, 5, 1, 9, 3, 7, 4, 6, 12],
+        "sampling_params": {"max_new_tokens": 80, "greedy": True},
+    }
+    geom = dict(
+        max_num_seqs=4, max_model_len=128, page_size=8,
+        decode_chunk=4, num_pages=24,
+    )
+    ref, _ = _run_cohort(model, [dict(payload)], _spec_cfg(False), **geom)
+    # floor 1.0 + patience 1: the first verify round with any rejected
+    # draft trips the gate (this prompt's round 1 rejects everything)
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", admit_hold_s=0.0, prefill_chunk=16,
+            spec=_spec_cfg(True, accept_floor=1.0, disable_patience=1),
+            **geom,
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    fut = eng.submit(dict(payload))
+    eng.start()
+    try:
+        out = fut.result(timeout=600)
+    finally:
+        eng.stop()
+    assert eng._spec_gate.disabled, "gate never tripped — tune the prompt"
+    assert eng._spec_replay_off, "latch never engaged after disable"
+    assert out["output_ids"] == ref[0]["output_ids"]
+    assert out["output_logprobs"] == ref[0]["output_logprobs"]
+
+
+def test_verify_window_clamped_to_decode_chunk(model):
+    """Drafts are trimmed to <= decode_chunk-1 tokens and the boundary
+    cap makes later positions unemittable — the dispatch window (and the
+    page margin derived from it) must clamp there, not at the raw
+    max_draft the operator configured."""
+    cfg, params = model
+    eng = GenerationEngine(
+        JaxGenConfig(
+            dtype="float32", admit_hold_s=0.0, prefill_chunk=16,
+            max_num_seqs=4, max_model_len=128, page_size=8,
+            decode_chunk=4, num_pages=24,
+            spec=_spec_cfg(True, max_draft=8),
+        ),
+        model_config=cfg,
+        params=params,
+    )
+    verify_steps = []
+    inner = eng._dispatch_chunk
+
+    def spy(steps, margin, drafts=None, **kw):
+        if drafts is not None:
+            verify_steps.append(steps)
+        return inner(steps, margin, drafts=drafts, **kw)
+
+    eng._dispatch_chunk = spy
+    fut = eng.submit(
+        {
+            "input_ids": [3, 9, 4] * 6,
+            "sampling_params": {"max_new_tokens": 40, "greedy": True},
+        }
+    )
+    eng.start()
+    try:
+        out = fut.result(timeout=600)
+    finally:
+        eng.stop()
+    assert len(out["output_ids"]) == 40
+    assert verify_steps, "repetitive prompt must trigger verify rounds"
+    # window = min(max_draft, decode_chunk-1) + 1 = 4, never max_draft+1=9
+    assert max(verify_steps) <= 4, verify_steps
+
+
+# ---------------------------------------------------------------------------
+# NgramProposer
+# ---------------------------------------------------------------------------
+class TestNgramProposer:
+    def test_suffix_match_proposes_continuation(self):
+        p = NgramProposer(2, 3)
+        #        0  1  2  3  4  5  6  7
+        p.begin(0, [1, 2, 3, 9, 8, 1, 2, 3])
+        # suffix [1,2,3] matched at positions 0..2 -> continuation [9, 8]
+        assert p.propose(0, 2) == [9, 8]
+        assert p.propose(0, 5) == [9, 8, 1, 2, 3]
+        assert p.has_candidate(0)
+
+    def test_longest_ngram_wins(self):
+        p = NgramProposer(1, 3)
+        # 1-gram [5] occurs twice with different continuations; the
+        # 2-gram [4, 5] pins the second occurrence
+        p.begin(0, [5, 7, 4, 5, 9, 4, 5])
+        assert p.propose(0, 1) == [9]  # [4,5] -> 9, not the 1-gram's 7
+
+    def test_rolling_extend_matches_rebuild(self):
+        rng = np.random.default_rng(3)
+        toks = rng.integers(0, 6, size=200).tolist()
+        inc = NgramProposer(2, 4)
+        inc.begin(0, toks[:50])
+        for t in toks[50:]:
+            inc.extend(0, [t])
+        rebuilt = NgramProposer(2, 4)
+        rebuilt.begin(0, toks)
+        assert inc.propose(0, 4) == rebuilt.propose(0, 4)
+        assert inc.history(0) == toks
+
+    def test_empty_and_short_history(self):
+        p = NgramProposer(2, 3)
+        assert p.propose(0, 4) == []  # unknown slot
+        p.begin(1, [])
+        assert p.propose(1, 4) == []
+        assert not p.has_candidate(1)
+        p.extend(1, [7])
+        assert p.propose(1, 4) == []  # shorter than ngram_min
+
+    def test_no_repeat_no_proposal(self):
+        p = NgramProposer(2, 3)
+        p.begin(0, [1, 2, 3, 4, 5, 6, 7])
+        assert p.propose(0, 4) == []
+
+    def test_drop_clears_state(self):
+        p = NgramProposer(2, 2)
+        p.begin(0, [1, 2, 1, 2])
+        assert p.has_candidate(0)
+        p.drop(0)
+        assert not p.has_candidate(0)
+        assert p.propose(0, 4) == []
+        p.extend(0, [1, 2])  # extend after drop must not raise
+        assert p.propose(0, 4) == []
+
+    def test_validates_ngram_range(self):
+        with pytest.raises(ValueError):
+            NgramProposer(3, 2)
+        with pytest.raises(ValueError):
+            NgramProposer(0, 2)
+
+
+# ---------------------------------------------------------------------------
+# AcceptRateGate (auto-disable hysteresis)
+# ---------------------------------------------------------------------------
+class TestAcceptRateGate:
+    def test_disables_after_patience_low_rounds(self):
+        g = AcceptRateGate(floor=0.5, patience=3, alpha=1.0)
+        assert g.observe(10, 1)
+        assert g.observe(10, 1)
+        assert not g.observe(10, 1)  # third consecutive low round
+        assert g.disabled
+        assert not g.observe(10, 10)  # sticky off
+
+    def test_good_round_resets_streak(self):
+        g = AcceptRateGate(floor=0.5, patience=2, alpha=1.0)
+        assert g.observe(10, 0)
+        assert g.observe(10, 9)  # recovery resets the streak
+        assert g.observe(10, 0)
+        assert not g.observe(10, 0)
+
+    def test_no_draft_rounds_carry_no_signal(self):
+        g = AcceptRateGate(floor=0.5, patience=1, alpha=1.0)
+        for _ in range(10):
+            assert g.observe(0, 0)
+        assert not g.disabled
+
+    def test_floor_zero_never_disables(self):
+        g = AcceptRateGate(floor=0.0, patience=1, alpha=1.0)
+        for _ in range(20):
+            assert g.observe(10, 0)
+        assert not g.disabled
+        assert g.ewma == 0.0
+
+    def test_engine_auto_disable_wires_the_gate(self, model):
+        cfg, params = model
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=2, max_model_len=32,
+                page_size=8,
+                spec=_spec_cfg(True, accept_floor=0.9, disable_patience=2),
+            ),
+            model_config=cfg, params=params,
+        )
+        assert eng._spec_on()
+        eng._observe_spec(4, 0)
+        assert eng._spec_on()
+        eng._observe_spec(4, 0)
+        assert not eng._spec_on()  # gate tripped -> no more verify chunks
+        m = eng.metrics()
+        assert m["spec_enabled"] == 0.0
+        assert m["spec_chunks_total"] == 2
+
+
+# ---------------------------------------------------------------------------
+# KV rollback invariant (model_runner level)
+# ---------------------------------------------------------------------------
+def test_kv_rollback_matches_sequential_pool(model):
+    """A verify that REJECTS part of its draft leaves pool bytes, cache
+    lengths, last-row state, and the continued stream bit-identical to
+    a run that never speculated. Exercises the head-merged pool (the
+    engine default), a partial accept, the dormant-row continuation
+    chunk, and next_tokens threading."""
+    cfg, params = model
+    cc = CacheConfig(num_pages=40, page_size=8, max_model_len=256)
+    s = 4
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 128, size=(s, 10)).astype(np.int32)
+    tables = np.full((s, cc.max_pages_per_seq), cc.num_pages, np.int32)
+    it = iter(range(1, 40))
+    for i in range(s):
+        for j in range(6):
+            tables[i, j] = next(it)
+    TB = jnp.asarray(tables[:, :6])
+    base = jnp.full(s, 10, jnp.int32)
+    stop = jnp.full((s, 8), -1, jnp.int32)
+    ones, zeros = jnp.ones(s), jnp.zeros(s, jnp.int32)
+    gr = jnp.ones(s, bool)
+    key = jax.random.PRNGKey(7)
+
+    def fresh():
+        cache = init_kv_pool(cfg, cc, jnp.float32, head_merge=True)
+        cache, logits0, last = mr.prefill_batch(
+            params, cfg, cache, jnp.asarray(prompt),
+            jnp.zeros(s, jnp.int32), jnp.full(s, 10, jnp.int32), TB,
+        )
+        return cache, jnp.argmax(logits0, -1).astype(jnp.int32), last
+
+    def chunk(cache, pos0, tok, act, rem, ns, last):
+        out = mr.decode_multi(
+            params, cfg, cache, TB, pos0, tok, act, rem, ns, stop, key,
+            ones, ones, zeros, gr, steps=4, topk_bound=-1,
+            attn_impl="jnp", last_rows=last, align_base=base, replay=3,
+        )
+        return out  # 10-tuple (replay mode returns next_tokens)
+
+    # --- reference: three sequential chunks, 12 tokens ---
+    cache, t0, last = fresh()
+    act = jnp.ones(s, bool)
+    rem, ns = jnp.full(s, 60, jnp.int32), zeros
+    pos = jnp.full(s, 10, jnp.int32)
+    ref_t, ref_l = [], []
+    tok = t0
+    for _ in range(3):
+        (cache, toks, logps, _, act, rem, ns, pos, last, tok) = chunk(
+            cache, pos, tok, act, rem, ns, last
+        )
+        ref_t.append(np.asarray(toks))
+        ref_l.append(np.asarray(logps))
+    ref_cache, ref_pos = cache, np.asarray(pos)
+    ref_toks = np.concatenate(ref_t)
+    ref_logps = np.concatenate(ref_l)
+
+    # --- test: chunk, verify (1 good + 1 bad draft), chunk, chunk ---
+    cache, t0, last = fresh()
+    act = jnp.ones(s, bool)
+    rem, ns = jnp.full(s, 60, jnp.int32), zeros
+    (cache, toks1, _, _, act, rem, ns, pos, last, tok) = chunk(
+        cache, jnp.full(s, 10, jnp.int32), t0, act, rem, ns, last
+    )
+    draft = np.zeros((s, 3), np.int32)
+    draft[:, 0] = np.asarray(ref_toks[4])  # will be accepted
+    draft[:, 1] = (np.asarray(ref_toks[5]) + 1) % 128  # rejected
+    draft[:, 2] = 3
+    (cache, vt, vl, vem, act, rem, ns, pos, last, tok) = mr.spec_verify(
+        params, cfg, cache, TB, pos, tok, jnp.asarray(draft),
+        jnp.full(s, 3, jnp.int32), act, rem, ns, stop, key,
+        ones, ones, zeros, gr, k=4, topk_bound=-1, attn_impl="jnp",
+        last_rows=last, align_base=base, replay=3,
+    )
+    vem = np.asarray(vem)
+    n_emit = np.where(vem.all(0), 4, vem.argmin(0))
+    # 1 accepted draft + the bonus token = 2 emitted; rollback leaves
+    # cache lengths at exactly those 2
+    assert (n_emit == 2).all()
+    assert (np.asarray(pos) == 16).all()
+    assert (np.asarray(vt)[:2] == ref_toks[4:6]).all()
+    assert (np.asarray(vl)[:2] == ref_logps[4:6]).all()
+    got_t, got_l, got_e = [], [], []
+    for _ in range(2):
+        (cache, toks, logps, em, act, rem, ns, pos, last, tok) = chunk(
+            cache, pos, tok, act, rem, ns, last
+        )
+        got_t.append(np.asarray(toks))
+        got_l.append(np.asarray(logps))
+        got_e.append(np.asarray(em))
+    got_t, got_l, got_e = map(np.concatenate, (got_t, got_l, got_e))
+    for sl in range(s):
+        stream_t = got_t[:, sl][got_e[:, sl]]
+        stream_l = got_l[:, sl][got_e[:, sl]]
+        assert (stream_t[:6] == ref_toks[6:12, sl]).all()
+        assert (stream_l[:6] == ref_logps[6:12, sl]).all()
+    # the rollback invariant proper: identical pool bytes and lengths
+    assert (np.asarray(pos) == ref_pos).all()
+    assert bool(jnp.all(cache["k"] == ref_cache["k"]))
+    assert bool(jnp.all(cache["v"] == ref_cache["v"]))
+
+
+def test_verify_boundary_cap(model):
+    """A verify window reaching the canonical chunk boundary stops
+    accepting there (positions past it would need unmerged pool
+    entries) and the row realigns next dispatch."""
+    cfg, params = model
+    # shapes shared with test_kv_rollback_matches_sequential_pool above
+    # (same process → the jit cache already holds every program)
+    cc = CacheConfig(num_pages=40, page_size=8, max_model_len=256)
+    s = 4
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, 128, size=(s, 10)).astype(np.int32)
+    tables = np.full((s, cc.max_pages_per_seq), cc.num_pages, np.int32)
+    it = iter(range(1, 30))
+    for i in range(s):
+        for j in range(6):
+            tables[i, j] = next(it)
+    TB = jnp.asarray(tables[:, :6])
+    base = jnp.full(s, 10, jnp.int32)
+    stop = jnp.full((s, 8), -1, jnp.int32)
+    ones, zeros = jnp.ones(s), jnp.zeros(s, jnp.int32)
+    gr = jnp.ones(s, bool)
+    key = jax.random.PRNGKey(3)
+    cache = init_kv_pool(cfg, cc, jnp.float32, head_merge=True)
+    cache, logits0, last = mr.prefill_batch(
+        params, cfg, cache, jnp.asarray(prompt),
+        jnp.zeros(s, jnp.int32), jnp.full(s, 10, jnp.int32), TB,
+    )
+    t0 = jnp.argmax(logits0, -1).astype(jnp.int32)
+    act = jnp.ones(s, bool)
+    rem, ns = jnp.full(s, 60, jnp.int32), zeros
+    # greedy continuation for drafts
+    (c2, toks, _, _, _, _, _, _, _, _) = mr.decode_multi(
+        params, cfg, {k: jnp.copy(v) for k, v in cache.items()}, TB,
+        jnp.full(s, 10, jnp.int32), t0, act, rem, ns, stop, key,
+        ones, ones, zeros, gr, steps=4, topk_bound=-1, attn_impl="jnp",
+        last_rows=jax.tree_util.tree_map(jnp.copy, last),
+        align_base=base, replay=3,
+    )
+    toks = np.asarray(toks)
+    # aligned start (rl=0, cq=4): even a FULLY correct 3-token draft
+    # emits at most cq = 4 tokens and never crosses into position 4
+    draft = jnp.asarray(toks[:3].T)
+    (cache, vt, vl, vem, act, rem, ns, pos, last, nxt) = mr.spec_verify(
+        params, cfg, cache, TB, jnp.full(s, 10, jnp.int32), t0, draft,
+        jnp.full(s, 3, jnp.int32), act, rem, ns, stop, key,
+        ones, ones, zeros, gr, k=4, topk_bound=-1, attn_impl="jnp",
+        last_rows=last, align_base=base, replay=3,
+    )
+    vem = np.asarray(vem)
+    n_emit = np.where(vem.all(0), 4, vem.argmin(0))
+    assert (n_emit == 4).all()  # full accept fills the chunk exactly
+    assert (np.asarray(pos) == 14).all()  # at the boundary, realigned
+    assert (np.asarray(vt) == toks).all()

@@ -21,6 +21,11 @@ weight-update pauses.
 ``decode_chunk`` spans carry the engine's per-chunk rows_dispatched /
 rows_active gauges (r6 decode tail compaction), and the report prints
 lifetime totals, mean occupancy, and a rows-per-chunk histogram.
+
+``--spec`` switches to the speculative-decoding report (r7):
+``spec_verify`` instants carry per-round drafted/accepted counts, and
+the report prints the accept-rate histogram, draft-length distribution,
+and verified-tokens/s over the spec window.
 """
 
 import argparse
@@ -140,6 +145,86 @@ def format_occupancy(occ: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def spec_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Speculative-decoding report from ``spec_verify`` instants (one
+    per verify round, attrs drafted/accepted) and verify-flavored
+    ``decode_chunk`` spans (attrs spec_draft_tokens/spec_draft_rows):
+    totals, a per-round accept-rate histogram, the draft-length
+    distribution, and verified tokens/s across the spec window — the
+    first-look answer to "is speculation paying, and by how much"."""
+    rounds = 0
+    drafted = 0
+    accepted = 0
+    base_rows = 0
+    rate_hist: Dict[str, int] = {}
+    ts: List[float] = []
+    draft_rows = 0
+    draft_tokens = 0
+    for s in spans:
+        if s.get("name") == "spec_verify":
+            attrs = s.get("attrs") or {}
+            d = int(attrs.get("drafted", 0))
+            a = int(attrs.get("accepted", 0))
+            rounds += 1
+            drafted += d
+            accepted += a
+            # rows that emitted this round: each contributes one
+            # guaranteed base token on top of its accepted drafts (a
+            # verify chunk covers MANY rows — older traces without the
+            # attr fall back to 1/round, understating multi-row runs)
+            base_rows += int(attrs.get("rows", 1))
+            if d > 0:
+                bucket = min(9, int(10 * a / d))
+                key = f"{bucket * 10}-{bucket * 10 + 10}%"
+                rate_hist[key] = rate_hist.get(key, 0) + 1
+            ts.append(float(s.get("ts", 0.0)))
+        elif s.get("name") == "decode_chunk":
+            attrs = s.get("attrs") or {}
+            if "spec_draft_tokens" in attrs:
+                draft_tokens += int(attrs["spec_draft_tokens"])
+                draft_rows += int(attrs.get("spec_draft_rows", 0))
+    window = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    verified = base_rows + accepted
+    return {
+        "verify_rounds": rounds,
+        "draft_tokens": drafted,
+        "accepted_tokens": accepted,
+        "accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        # accepted drafts ride for free on top of the per-row base
+        # tokens — this is the decode speedup numerator
+        "verified_tokens_per_round": (
+            round(verified / rounds, 3) if rounds else 0.0
+        ),
+        "verified_tokens_per_sec": (
+            round(verified / window, 1) if window > 0 else 0.0
+        ),
+        "mean_draft_len": (
+            round(draft_tokens / draft_rows, 2) if draft_rows else 0.0
+        ),
+        "accept_rate_hist": {
+            k: rate_hist[k]
+            for k in sorted(rate_hist, key=lambda x: int(x.split("-")[0]))
+        },
+    }
+
+
+def format_spec(sp: Dict[str, Any]) -> str:
+    rows = [
+        f"verify rounds        {sp['verify_rounds']}",
+        f"draft tokens         {sp['draft_tokens']}",
+        f"accepted tokens      {sp['accepted_tokens']}",
+        f"accept rate          {sp['accept_rate'] * 100:.1f}%",
+        f"mean draft length    {sp['mean_draft_len']}",
+        f"verified tok/round   {sp['verified_tokens_per_round']}",
+        f"verified tok/s       {sp['verified_tokens_per_sec']}",
+        "",
+        f"{'accept rate':<14}{'rounds':>8}",
+    ]
+    for bucket, count in sp["accept_rate_hist"].items():
+        rows.append(f"{bucket:<14}{count:>8}")
+    return "\n".join(rows)
+
+
 def failover_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Resilience-event report from ``failover``/``migration`` spans
     (engine/remote.py records one instant per server hop; migrations are
@@ -231,6 +316,12 @@ def main(argv=None) -> int:
         "table; exit 1 when the trace carries no occupancy gauges",
     )
     p.add_argument(
+        "--spec", action="store_true",
+        help="summarize speculative decoding (spec_verify instants + "
+        "verify decode_chunk spans) instead of the latency table; exit "
+        "1 when the trace carries no verify rounds",
+    )
+    p.add_argument(
         "--failover", action="store_true",
         help="summarize resilience events (failover/migration spans "
         "from engine/remote.py) instead of the latency table; exit 1 "
@@ -238,6 +329,20 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
     spans = load_spans(args.trace)
+    if args.spec:
+        sp = spec_summary(spans)
+        if args.json:
+            print(json.dumps(sp, indent=2))
+        else:
+            print(format_spec(sp))
+        if sp["verify_rounds"] == 0:
+            print(
+                "no spec_verify spans in trace (tracing off, or "
+                "speculation never engaged)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     if args.failover:
         fo = failover_summary(spans)
         if args.json:
