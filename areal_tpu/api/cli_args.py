@@ -232,6 +232,12 @@ class InferenceEngineConfig:
     fleet: "FleetConfig" = dataclasses.field(
         default_factory=lambda: FleetConfig()
     )
+    # trainer-side durability plane (api/workflow_api.py): episode retry
+    # with poison quarantine, sliding-window failure budget → DEGRADED
+    # state, prepare_batch deadline + dead-fleet health probe
+    durability: "DurabilityConfig" = dataclasses.field(
+        default_factory=lambda: DurabilityConfig()
+    )
 
 
 @dataclasses.dataclass
@@ -465,6 +471,36 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class DurabilityConfig:
+    """Training-loop durability plane (api/workflow_api.py
+    `WorkflowExecutor`): a flaky reward/env call must not silently drop a
+    sample forever, a poison sample must not burn retry budget forever,
+    and a dead fleet must produce a clean error in bounded time instead
+    of an infinite 1-s-timeout loop. Retry/backoff mirrors the
+    utils/http.py policy shape (exponential, bounded jitter)."""
+
+    # additional attempts after the first failure before the sample is
+    # quarantined (0 = fail-fast quarantine, matching the old behavior of
+    # dropping on first exception — but visibly)
+    max_episode_retries: int = 2
+    retry_delay: float = 0.5  # first backoff, doubled per attempt
+    max_retry_delay: float = 30.0
+    retry_jitter: float = 0.5  # uniform extra in [0, jitter*delay)
+    # sliding window of episode-attempt outcomes driving the DEGRADED
+    # state: when at least half the window is populated and the failure
+    # fraction reaches `degraded_threshold`, the executor flips DEGRADED
+    # (gauge + log) instead of silently shrinking throughput
+    failure_window: int = 64
+    degraded_threshold: float = 0.5
+    # hard deadline for one prepare_batch() call; None = request_timeout
+    prepare_batch_timeout: Optional[float] = None
+    # with zero accepted progress for this long, prepare_batch consults
+    # the engine's FleetMonitor — a fully-dead fleet raises immediately
+    # rather than burning the rest of the deadline
+    health_probe_after: float = 30.0
+
+
+@dataclasses.dataclass
 class ProfilingConfig:
     """jax-profiler trace capture for selected steps (reference
     model_worker.py:829-910 per-MFC torch profiler)."""
@@ -502,6 +538,9 @@ class RecoverConfig:
     freq_epochs: Optional[int] = None
     freq_steps: Optional[int] = None
     freq_secs: Optional[int] = 600
+    # committed recover checkpoints retained (recover/step_<g>/ dirs with
+    # a COMMIT marker); older ones are GC'd after each successful dump
+    keep_last: int = 2
 
 
 @dataclasses.dataclass

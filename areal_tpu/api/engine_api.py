@@ -105,7 +105,8 @@ class InferenceEngine(abc.ABC):
     def set_version(self, version: int):
         raise NotImplementedError()
 
-    def submit(self, data: Dict[str, Any], workflow) -> None:
+    def submit(self, data: Dict[str, Any], workflow) -> bool:
+        """Queue one episode; False when refused (quarantined sample)."""
         raise NotImplementedError()
 
     def wait(self, count: int, timeout: Optional[float] = None):

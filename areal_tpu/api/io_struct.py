@@ -169,6 +169,14 @@ class RolloutStat:
     # sampling); dropped groups release staleness-gate budget so the
     # pipeline backfills them with fresh generations
     filtered: int = 0
+    # durability plane (workflow_api episode retry + quarantine):
+    # re-attempts performed after an episode failure
+    retried: int = 0
+    # samples that exhausted max_episode_retries and are barred from
+    # re-admission (persisted across restarts via RecoverInfo)
+    quarantined: int = 0
+    # submissions refused because the sample is already quarantined
+    quarantine_skipped: int = 0
 
 
 _COUNTER = itertools.count()

@@ -19,21 +19,44 @@ baggage the reference carries — not needed here).
 
 import abc
 import asyncio
+import collections
 import queue
 import random
 import threading
 import time
 import traceback
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 import numpy as np
 
-from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.cli_args import DurabilityConfig, InferenceEngineConfig
 from areal_tpu.api.io_struct import RolloutStat
+from areal_tpu.utils import chaos
 from areal_tpu.utils import data as data_utils
 from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils import stats_tracker
+from areal_tpu.utils.http import backoff_delay
 
 logger = logging_util.getLogger("WorkflowExecutor")
+
+
+class RolloutThreadError(RuntimeError):
+    """The background rollout thread died; the captured terminal
+    exception is chained as ``__cause__``. Raised promptly from
+    wait()/prepare_batch() instead of letting callers block out the full
+    request_timeout against a loop nobody is running."""
+
+
+class FleetUnavailableError(RuntimeError):
+    """Every generation server is unhealthy: prepare_batch cannot make
+    progress no matter how long it waits, so it fails fast with the
+    fleet gauges instead of burning its deadline 1 s at a time."""
+
+
+class EpisodeQuarantinedError(RuntimeError):
+    """An episode wait() was counting on got quarantined: the expected
+    result will never arrive, so the caller learns NOW instead of
+    timing out after request_timeout."""
 
 
 class RolloutWorkflow(abc.ABC):
@@ -85,9 +108,26 @@ class WorkflowExecutor:
         # submitted-but-unconsumed items are deliberately NOT here: their
         # rollouts are lost on crash and must be re-generated
         self.consumed_uids: List[str] = []
+        # poison quarantine: uids that exhausted max_episode_retries —
+        # barred from re-admission (persisted via RecoverInfo so a
+        # supervised restart doesn't grant them a fresh retry budget)
+        self.quarantined: Set[str] = set()
+        self.durability: DurabilityConfig = (
+            getattr(config, "durability", None) or DurabilityConfig()
+        )
+        # sliding window of episode-attempt outcomes (True = failure)
+        # driving the DEGRADED state
+        self._outcomes: "collections.deque[bool]" = collections.deque(
+            maxlen=max(1, self.durability.failure_window)
+        )
+        self._degraded = False
         self._lock = threading.Lock()
         self._exiting = threading.Event()
         self._paused = threading.Event()
+        # watchdog: the rollout thread's terminal exception, re-raised
+        # from wait()/prepare_batch() within one poll interval
+        self._failed = threading.Event()
+        self._thread_exc: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -113,6 +153,74 @@ class WorkflowExecutor:
         self._paused.clear()
 
     # ------------------------------------------------------------------
+    # Durability plane: degraded state, quarantine, thread watchdog
+    # ------------------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        """True while the sliding-window failure budget is blown — the
+        pipeline is still up but visibly losing a large fraction of its
+        episodes (flaky reward backend, sick env service)."""
+        return self._degraded
+
+    def _tracer(self):
+        t = getattr(self.engine, "tracer", None)
+        return t if t is not None and getattr(t, "enabled", False) else None
+
+    def _record_outcome(self, failure: bool) -> None:
+        """Feed the failure-budget window; flip/clear DEGRADED with a log
+        line on each transition (never silently)."""
+        dur = self.durability
+        with self._lock:
+            self._outcomes.append(failure)
+            window = self._outcomes
+            # require a half-full window (min 1, so tiny windows can
+            # still flip) before judging: one early failure must not
+            # flip a freshly started executor
+            populated = len(window) >= max(1, window.maxlen // 2)
+            frac = (sum(window) / len(window)) if window else 0.0
+            now_degraded = populated and frac >= dur.degraded_threshold
+            changed = now_degraded != self._degraded
+            self._degraded = now_degraded
+        # gauge on EVERY outcome, not just transitions: stats exports
+        # reset each window, so a transition-only emit would make an
+        # ongoing DEGRADED state invisible after one logging step
+        stats_tracker.scalar(**{"rollout/degraded": float(now_degraded)})
+        if changed:
+            if now_degraded:
+                logger.error(
+                    f"executor DEGRADED: {frac:.0%} of the last "
+                    f"{len(window)} episode attempts failed (threshold "
+                    f"{dur.degraded_threshold:.0%}) — throughput is being "
+                    f"propped up by retries, check reward/env backends"
+                )
+            else:
+                logger.info(
+                    f"executor recovered from DEGRADED "
+                    f"(failure fraction now {frac:.0%})"
+                )
+
+    def quarantine_snapshot(self) -> List[str]:
+        """Current quarantine set (recover.dump persists it)."""
+        with self._lock:
+            return sorted(self.quarantined)
+
+    def restore_quarantine(self, uids) -> None:
+        """Re-arm the quarantine after a supervised restart."""
+        with self._lock:
+            fresh = {u for u in uids if u} - self.quarantined
+            self.quarantined.update(fresh)
+            # the stat is also wait()'s fast-fail gate: restored poison
+            # must arm it, or the post-restart path re-grows the silent
+            # request_timeout hang this plane exists to fix
+            self.rollout_stat.quarantined += len(fresh)
+
+    def _check_thread(self) -> None:
+        if self._failed.is_set():
+            raise RolloutThreadError(
+                "rollout thread died; no episodes are running"
+            ) from self._thread_exc
+
+    # ------------------------------------------------------------------
     def get_capacity(self) -> int:
         """Staleness-aware admission budget (reference workflow_api.py:101)."""
         cfg = self.config
@@ -129,10 +237,20 @@ class WorkflowExecutor:
             return capacity
 
     # ------------------------------------------------------------------
-    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
-        self.input_queue.put_nowait(_WorkItem(data, workflow))
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> bool:
+        """Queue one episode; returns False (not queued) for quarantined
+        samples — a poison item must not re-enter the pipeline after a
+        resume or at an epoch wrap."""
+        item = _WorkItem(data, workflow)
+        with self._lock:
+            if item.uid and item.uid in self.quarantined:
+                self.rollout_stat.quarantine_skipped += 1
+                logger.info(f"skipping quarantined sample {item.uid}")
+                return False
+        self.input_queue.put_nowait(item)
         with self._lock:
             self.rollout_stat.submitted += 1
+        return True
 
     def wait(
         self,
@@ -140,6 +258,7 @@ class WorkflowExecutor:
         timeout: Optional[float] = None,
         group_filter: Optional[Callable[[Dict[str, np.ndarray]], bool]] = None,
         refill_fn: Optional[Callable[[int], None]] = None,
+        ignore_quarantine: bool = False,
     ) -> Dict[str, np.ndarray]:
         """Block until `count` accepted results; returns one concatenated
         padded batch sorted by creation time then shuffled (reference
@@ -150,18 +269,69 @@ class WorkflowExecutor:
         the SOURCE): a dropped episode is un-counted from ``accepted`` so
         the staleness gate reopens and the pipeline generates a replacement
         — the batch is backfilled with useful groups instead of silently
-        shrinking."""
+        shrinking.
+
+        Quarantine fast-fail: once any sample has ever been quarantined,
+        each iteration compares ``count`` against the episodes that can
+        still deliver (collected + running + queued, all read under one
+        lock so the launch/finish windows can't undercount). A deficit
+        asks ``refill_fn`` for replacements when one is provided; if the
+        deficit persists (nothing healthy left to refill, or a bare
+        submit-N/wait-N caller whose N-th episode was quarantined) the
+        wait raises :class:`EpisodeQuarantinedError` instead of blocking
+        out ``request_timeout`` on results that can never come.
+        ``ignore_quarantine`` disables the check for callers whose outer
+        loop backfills (prepare_batch: admission is capacity-gated, so a
+        transient deficit there is normal, not terminal)."""
         start = time.monotonic()
         timeout = timeout or self.config.request_timeout
         results: List[_ResultItem] = []
+
+        def _put_back():
+            for r in results:
+                self.output_queue.put_nowait(r)
+
+        def _deliverable() -> int:
+            with self._lock:
+                return (
+                    len(results) + self.rollout_stat.running
+                    + self.input_queue.qsize()
+                    + self.output_queue.qsize()
+                )
+
         while len(results) < count:
+            if self._failed.is_set():
+                # completed results survive the thread's death — put back
+                # what we took (the timeout path below does the same)
+                _put_back()
+                self._check_thread()
             if self._exiting.is_set():
                 raise RuntimeError("executor is shutting down")
+            if not ignore_quarantine and (
+                self.rollout_stat.quarantined or self.quarantined
+            ):
+                deficit = count - _deliverable()
+                if deficit > 0 and refill_fn is not None:
+                    # replace lost episodes; refill submits synchronously
+                    # so a successful top-up closes the deficit here
+                    refill_fn(deficit)
+                    deficit = count - _deliverable()
+                if deficit > 0:
+                    st = self.rollout_stat
+                    _put_back()
+                    raise EpisodeQuarantinedError(
+                        f"rollout wait can never complete: "
+                        f"{len(results)}/{count} results collected and "
+                        f"only {count - deficit} deliverable "
+                        f"(quarantined={st.quarantined} "
+                        f"rejected={st.rejected}, e.g. "
+                        f"{self.quarantine_snapshot()[:4]}); poison "
+                        f"samples exhausted their retry budget"
+                    )
             remain = timeout - (time.monotonic() - start)
             if remain <= 0:
                 # put back what we took so nothing is lost
-                for r in results:
-                    self.output_queue.put_nowait(r)
+                _put_back()
                 raise TimeoutError(
                     f"rollout wait timed out: {len(results)}/{count}"
                 )
@@ -204,18 +374,44 @@ class WorkflowExecutor:
         pipeline to top it up."""
         import itertools
 
-        for item in data:
-            self.submit(item, workflow)
+        submitted = sum(1 for item in data if self.submit(item, workflow))
+        if data and not submitted:
+            # every item refused: returning a silently empty batch would
+            # crash the trainer far downstream with no cause attached
+            raise RuntimeError(
+                f"rollout_batch: all {len(data)} samples are quarantined "
+                f"({self.quarantine_snapshot()[:8]}...); nothing to "
+                f"roll out"
+            )
         refill = None
         if group_filter is not None and data:
             cyc = itertools.cycle(data)
 
             def refill(n: int):
                 for _ in range(n):
-                    self.submit(next(cyc), workflow)
+                    # skip quarantined prompts, bounded by one lap over
+                    # the data so an all-quarantined cycle can't spin
+                    for _attempt in range(len(data)):
+                        if self.submit(next(cyc), workflow):
+                            break
 
+        if refill is not None:
+            # the refill machinery can top quarantine-refused slots back
+            # up with healthy prompts, so the full len(data) groups the
+            # docstring promises are deliverable
+            count = len(data)
+        else:
+            count = submitted
+            if submitted < len(data):
+                # no refill source: the batch is short and the trainer
+                # must hear about it, not discover it downstream
+                logger.warning(
+                    f"rollout_batch: {len(data) - submitted} of "
+                    f"{len(data)} samples are quarantined; returning a "
+                    f"{submitted}-group batch"
+                )
         return self.wait(
-            count=len(data), group_filter=group_filter, refill_fn=refill
+            count=count, group_filter=group_filter, refill_fn=refill
         )
 
     def prepare_batch(
@@ -226,12 +422,35 @@ class WorkflowExecutor:
     ) -> Dict[str, np.ndarray]:
         """Overlap submission with waiting: keep the pipeline full under the
         capacity gate, return as soon as one consumer batch is ready
-        (reference workflow_api.py:288-317)."""
-        if not hasattr(self, "_data_generator"):
+        (reference workflow_api.py:288-317).
+
+        Bounded-time degradation: the call carries a real deadline
+        (``durability.prepare_batch_timeout``, default request_timeout)
+        and, after ``health_probe_after`` seconds with zero accepted
+        progress, consults the engine's FleetMonitor — a fully-dead fleet
+        raises :class:`FleetUnavailableError` immediately with the fleet
+        gauges in the message instead of looping on 1-s wait timeouts
+        until the heat death of the job."""
+        # the cached endless iterator is keyed on the dataloader identity:
+        # passing a different dataloader must not silently keep iterating
+        # the first one
+        if getattr(self, "_data_generator_key", None) != id(dataloader):
             self._data_generator = cycle_dataloader(dataloader)
+            self._data_generator_key = id(dataloader)
         bs = getattr(dataloader, "batch_size", 1) or 1
-        assert self.config.consumer_batch_size % bs == 0
+        if self.config.consumer_batch_size % bs != 0:
+            # user-config error, not an invariant: asserts vanish under -O
+            raise ValueError(
+                f"consumer_batch_size ({self.config.consumer_batch_size}) "
+                f"must be divisible by the dataloader batch_size ({bs})"
+            )
+        dur = self.durability
+        deadline_s = dur.prepare_batch_timeout or self.config.request_timeout
+        start = time.monotonic()
+        last_progress = start
+        last_accepted = self.rollout_stat.accepted
         while True:
+            self._check_thread()
             # top the pipeline up whenever the staleness gate has room for
             # at least one more dataloader batch (reference :300-308)
             if (
@@ -244,33 +463,85 @@ class WorkflowExecutor:
             try:
                 return self.wait(
                     count=self.config.consumer_batch_size, timeout=1,
-                    group_filter=group_filter,
+                    group_filter=group_filter, ignore_quarantine=True,
                 )
             except TimeoutError:
+                now = time.monotonic()
+                accepted = self.rollout_stat.accepted
+                if accepted != last_accepted:
+                    last_accepted = accepted
+                    last_progress = now
+                if now - start > deadline_s:
+                    st = self.rollout_stat
+                    raise TimeoutError(
+                        f"prepare_batch exceeded its {deadline_s:.0f}s "
+                        f"deadline: {self.output_queue.qsize()}"
+                        f"/{self.config.consumer_batch_size} "
+                        f"results ready (submitted={st.submitted} "
+                        f"accepted={st.accepted} running={st.running} "
+                        f"rejected={st.rejected} "
+                        f"quarantined={st.quarantined} "
+                        f"degraded={self._degraded})"
+                    )
+                if now - last_progress >= max(0.0, dur.health_probe_after):
+                    self._probe_fleet_health(now - last_progress)
                 continue
+
+    def _probe_fleet_health(self, stalled_s: float) -> None:
+        """Fail fast when the whole fleet is gone: zero schedulable
+        servers means no episode can ever complete, so waiting out the
+        deadline would only delay the same error."""
+        fleet = getattr(self.engine, "fleet", None)
+        if fleet is None:
+            return
+        try:
+            schedulable = fleet.schedulable_addresses()
+            total = len(fleet.addresses())
+        except Exception:
+            return  # a half-built monitor must not mask the real wait
+        if total > 0 and not schedulable:
+            raise FleetUnavailableError(
+                f"no rollout progress for {stalled_s:.0f}s and 0/{total} "
+                f"generation servers are schedulable (all DEAD/DRAINING) "
+                f"— fleet is down; check server logs / the launcher"
+            )
 
     # ------------------------------------------------------------------
     def _thread_main(self):
         try:
             asyncio.run(self._run_async())
-        except Exception:
+        except BaseException as e:
+            # capture the terminal exception for the watchdog: wait()/
+            # prepare_batch() re-raise it within one poll interval — a
+            # dead loop must not leave the trainer blocking out the full
+            # request_timeout (3600 s) against a queue nobody fills
+            self._thread_exc = e
+            self._failed.set()
             logger.error(
                 "rollout thread crashed:\n" + traceback.format_exc()
             )
-            raise
 
     async def _run_async(self):
         pending: set = set()
         trace = self.config.enable_rollout_tracing
         while not self._exiting.is_set():
+            # counted chaos fault point: tests kill the loop thread on an
+            # exact iteration and assert the watchdog re-raises promptly
+            chaos.trainer_fault("rollout_loop")
             # launch as many episodes as capacity allows
             capacity = self.get_capacity()
             launched = 0
             while capacity > 0 and not self._paused.is_set():
-                try:
-                    item = self.input_queue.get_nowait()
-                except queue.Empty:
-                    break
+                # pop + running increment are one atomic step as seen by
+                # wait()'s quarantine unsatisfiability check (which reads
+                # running and the queue sizes under the same lock): an
+                # in-launch item must never be invisible to both counts
+                with self._lock:
+                    try:
+                        item = self.input_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    self.rollout_stat.running += 1
                 task = asyncio.create_task(
                     self._run_episode(item)
                 )
@@ -278,8 +549,6 @@ class WorkflowExecutor:
                 task.add_done_callback(pending.discard)
                 capacity -= 1
                 launched += 1
-                with self._lock:
-                    self.rollout_stat.running += 1
                 if trace:
                     logger.info(
                         f"launched episode (running={self.rollout_stat.running})"
@@ -297,20 +566,85 @@ class WorkflowExecutor:
             await asyncio.gather(*pending, return_exceptions=True)
 
     async def _run_episode(self, item: _WorkItem):
-        try:
-            batch = await item.workflow.arun_episode(self.engine, item.data)
-        except Exception:
-            logger.error("episode failed:\n" + traceback.format_exc())
-            batch = None
+        """One episode with bounded retry: a flaky reward/env call gets
+        ``max_episode_retries`` re-attempts under jittered exponential
+        backoff (the utils/http.py policy shape); a sample that fails
+        every attempt is quarantined — visible in stats and persisted
+        via recover — instead of silently dropped forever."""
+        dur = self.durability
+        uid = item.uid or "?"
+        batch = None
+        failed = False
+        for attempt in range(dur.max_episode_retries + 1):
+            try:
+                batch = await item.workflow.arun_episode(
+                    self.engine, item.data
+                )
+                failed = False
+                break
+            except Exception:
+                failed = True
+                self._record_outcome(failure=True)
+                logger.warning(
+                    f"episode {uid} attempt "
+                    f"{attempt + 1}/{dur.max_episode_retries + 1} "
+                    f"failed:\n" + traceback.format_exc()
+                )
+                if attempt >= dur.max_episode_retries:
+                    break
+                with self._lock:
+                    self.rollout_stat.retried += 1
+                stats_tracker.counter(**{
+                    "rollout/episode_retries_total": 1.0,
+                })
+                tracer = self._tracer()
+                if tracer is not None:
+                    tracer.instant("episode_retry", uid, attempt=attempt)
+                await asyncio.sleep(backoff_delay(
+                    attempt, dur.retry_delay, dur.max_retry_delay,
+                    dur.retry_jitter,
+                ))
+        if failed:
+            with self._lock:
+                self.rollout_stat.running -= 1
+                self.rollout_stat.quarantined += 1
+                if item.uid:
+                    self.quarantined.add(item.uid)
+                quarantined_total = self.rollout_stat.quarantined
+            stats_tracker.counter(**{
+                "rollout/quarantined_total": 1.0,
+            })
+            tracer = self._tracer()
+            if tracer is not None:
+                tracer.instant(
+                    "quarantine", uid,
+                    attempts=dur.max_episode_retries + 1,
+                )
+            logger.error(
+                f"episode {uid} QUARANTINED after "
+                f"{dur.max_episode_retries + 1} attempts "
+                f"(quarantined={quarantined_total})"
+            )
+            # no result is queued: wait()'s deliverable check (armed by
+            # rollout_stat.quarantined) sees this episode vanish from
+            # `running` and fails fast instead of blocking out its
+            # timeout on a result that can never come
+            return
+        self._record_outcome(failure=False)
         with self._lock:
-            self.rollout_stat.running -= 1
             if batch is None:
                 self.rollout_stat.rejected += 1
+                self.rollout_stat.running -= 1
                 return
             self.rollout_stat.accepted += 1
+        # the result enters the queue BEFORE `running` drops so wait()'s
+        # quarantine unsatisfiability check never misses an episode that
+        # is between "finished" and "delivered"
         self.output_queue.put_nowait(
             _ResultItem(batch, item.create_time, uid=item.uid)
         )
+        with self._lock:
+            self.rollout_stat.running -= 1
         if self.config.enable_rollout_tracing:
             logger.info(
                 f"episode done (accepted={self.rollout_stat.accepted})"

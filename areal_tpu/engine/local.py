@@ -179,8 +179,10 @@ class LocalSyncInferenceEngine(InferenceEngine):
         return self.executor.submit(_do)
 
     # ------------------------------------------------------------------
-    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
-        self.workflow_executor.submit(data, workflow)
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> bool:
+        """False when the sample is quarantined (not queued) — submit-N/
+        wait-N callers must not count it or wait() starves."""
+        return self.workflow_executor.submit(data, workflow)
 
     def wait(self, count: int, timeout: Optional[float] = None,
              group_filter=None):

@@ -801,8 +801,10 @@ class RemoteInferenceEngine(InferenceEngine):
     # ------------------------------------------------------------------
     # Rollout orchestration (delegated; reference sglang_remote.py:311-365)
     # ------------------------------------------------------------------
-    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> None:
-        self.workflow_executor.submit(data, workflow)
+    def submit(self, data: Dict[str, Any], workflow: RolloutWorkflow) -> bool:
+        """False when the sample is quarantined (not queued) — submit-N/
+        wait-N callers must not count it or wait() starves."""
+        return self.workflow_executor.submit(data, workflow)
 
     def wait(self, count: int, timeout: Optional[float] = None,
              group_filter=None):

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
 from areal_tpu.api.cli_args import BaseExperimentConfig, JaxGenConfig
 from areal_tpu.utils import logging as logging_util, network
+from areal_tpu.utils.http import backoff_delay
 from areal_tpu.utils.recover import RECOVER_ENV
 
 logger = logging_util.getLogger("LocalLauncher")
@@ -83,6 +84,30 @@ class LocalLauncher:
         proc = self._procs.get(name)
         return proc is not None and proc.poll() == 0
 
+    def alive(self, name: str) -> bool:
+        proc = self._procs.get(name)
+        return proc is not None and proc.poll() is None
+
+    def stop(self, name: str) -> None:
+        """Stop ONE job (TERM, then KILL) and forget it — the supervisor
+        restarts the trainer without tearing down live gen servers."""
+        proc = self._procs.pop(name, None)
+        if proc is None:
+            return
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            deadline = time.monotonic() + 10
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
     def stop_all(self):
         for name, proc in self._procs.items():
             if proc.poll() is None:
@@ -134,6 +159,52 @@ def launch_servers(
     return addrs
 
 
+class TrainerSupervisor:
+    """Bounded-restart policy for the trainer process (the durability
+    loop the ``RECOVER_ENV`` docstring promises): a budget of ``retries``
+    restarts with exponential backoff between attempts, refunded after a
+    healthy uptime — a long-lived service that crashes once a week must
+    not exhaust a lifetime cap, while a crash-looping trainer still stops
+    after ``retries`` tries."""
+
+    def __init__(
+        self,
+        retries: int,
+        backoff_s: float = 2.0,
+        max_backoff_s: float = 60.0,
+        healthy_uptime_s: float = 600.0,
+        attempt: int = 0,
+        jitter: float = 0.5,
+    ):
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.healthy_uptime_s = healthy_uptime_s
+        self.attempt = attempt
+        # jittered so multi-host supervised restarts don't relaunch (and
+        # re-hit shared storage / the fleet) in lockstep
+        self.jitter = jitter
+        self._started = time.monotonic()
+
+    def note_start(self) -> None:
+        self._started = time.monotonic()
+
+    def should_restart(self) -> bool:
+        if time.monotonic() - self._started >= self.healthy_uptime_s:
+            self.attempt = 0  # a long healthy run refunds the budget
+        return self.attempt < self.retries
+
+    def next_backoff(self) -> float:
+        """Consume one restart from the budget; returns the delay to
+        sleep before relaunching (the repo's one backoff policy —
+        utils/http.backoff_delay)."""
+        delay = backoff_delay(
+            self.attempt, self.backoff_s, self.max_backoff_s, self.jitter
+        )
+        self.attempt += 1
+        return delay
+
+
 def local_main(
     config: BaseExperimentConfig,
     trainer_entry: str,
@@ -141,8 +212,13 @@ def local_main(
     recover_retries: Optional[int] = None,
     _attempt: int = 0,
 ):
-    """Launch the experiment constellation; auto-restart on failure
-    (reference local.py:252-359)."""
+    """Launch the experiment constellation under a bounded-restart
+    supervisor (reference local.py:252-359). On trainer death with
+    recover enabled, the trainer is relaunched with
+    ``AREAL_TPU_RECOVER_RUN=1`` so `RecoverHandler.load` resumes from the
+    last committed checkpoint; live gen servers are kept (their compiled
+    programs survive, and load() re-pushes the recovered weights). A
+    dead server forces a full-constellation restart instead."""
     alloc = (
         AllocationMode.from_str(config.allocation_mode)
         if config.allocation_mode
@@ -163,84 +239,126 @@ def local_main(
         "auto",
         "fault",
     )
-    try:
-        env = {}
-        if _attempt > 0 and recover_enabled:
-            env[RECOVER_ENV] = "1"
-        # every subprocess (servers AND trainer) rendezvous in the same
-        # name_resolve namespace: server registration/deregistration is
-        # what drives dynamic fleet membership (inference/fleet.py), so
-        # it must land where the trainer's FleetMonitor watches
-        nr = getattr(config.cluster, "name_resolve", None)
-        if nr is not None:
-            from areal_tpu.utils.name_resolve import BACKEND_ENV
+    supervisor = TrainerSupervisor(
+        retries if recover_enabled else 0, attempt=_attempt
+    )
+    base_env: Dict[str, str] = {}
+    # every subprocess (servers AND trainer) rendezvous in the same
+    # name_resolve namespace: server registration/deregistration is
+    # what drives dynamic fleet membership (inference/fleet.py), so
+    # it must land where the trainer's FleetMonitor watches
+    nr = getattr(config.cluster, "name_resolve", None)
+    if nr is not None:
+        from areal_tpu.utils.name_resolve import BACKEND_ENV
 
-            if nr.type == "nfs":
-                env[BACKEND_ENV] = f"nfs:{nr.nfs_record_root}"
-            elif nr.type == "kv" and getattr(nr, "kv_address", ""):
-                env[BACKEND_ENV] = f"kv:{nr.kv_address}"
-        if alloc is not None and alloc.type_ in (
-            AllocationType.DECOUPLED_TRAIN,
-            AllocationType.LLM_SERVER_ONLY,
-        ):
-            server_cfg = getattr(config, "server", None) or JaxGenConfig()
-            n_servers = alloc.gen.data_parallel_size
-            # per-server tensor parallelism comes from the allocation mode
-            # (reference: SGLang tp wired at areal/launcher/local.py:277-306)
-            if alloc.gen.tensor_parallel_size > 1:
-                server_cfg.tensor_parallel_size = alloc.gen.tensor_parallel_size
-            addrs = launch_servers(launcher, server_cfg, n_servers, env)
-            env["AREAL_LLM_SERVER_ADDRS"] = ",".join(addrs)
-        n_trainers = max(
-            1, getattr(config.launcher, "trainer_processes", 1)
+        if nr.type == "nfs":
+            base_env[BACKEND_ENV] = f"nfs:{nr.nfs_record_root}"
+        elif nr.type == "kv" and getattr(nr, "kv_address", ""):
+            base_env[BACKEND_ENV] = f"kv:{nr.kv_address}"
+
+    wants_servers = alloc is not None and alloc.type_ in (
+        AllocationType.DECOUPLED_TRAIN,
+        AllocationType.LLM_SERVER_ONLY,
+    )
+    wants_trainer = (
+        alloc is None or alloc.type_ != AllocationType.LLM_SERVER_ONLY
+    )
+    n_trainers = max(1, getattr(config.launcher, "trainer_processes", 1))
+    trainer_names = [
+        f"trainer_{r}" if r else "trainer" for r in range(n_trainers)
+    ]
+    server_names: List[str] = []
+    server_addrs: List[str] = []
+
+    def start_servers(env: Dict[str, str]) -> None:
+        server_cfg = getattr(config, "server", None) or JaxGenConfig()
+        n_servers = alloc.gen.data_parallel_size
+        # per-server tensor parallelism comes from the allocation mode
+        # (reference: SGLang tp wired at areal/launcher/local.py:277-306)
+        if alloc.gen.tensor_parallel_size > 1:
+            server_cfg.tensor_parallel_size = alloc.gen.tensor_parallel_size
+        server_addrs[:] = launch_servers(launcher, server_cfg, n_servers, env)
+        server_names[:] = [f"gen_server_{i}" for i in range(n_servers)]
+
+    def start_trainers(env: Dict[str, str]) -> None:
+        if n_trainers == 1:
+            launcher.submit(
+                "trainer",
+                [sys.executable, trainer_entry] + trainer_argv,
+                env=env,
+            )
+            return
+        # one jax.distributed world of N local trainer processes
+        # (multi-host skeleton; reference: torchrun rendezvous)
+        from areal_tpu.parallel.distributed import (
+            COORDINATOR_ENV,
+            NUM_PROCESSES_ENV,
+            PROCESS_ID_ENV,
         )
-        if alloc is None or alloc.type_ != AllocationType.LLM_SERVER_ONLY:
-            if n_trainers == 1:
-                launcher.submit(
-                    "trainer",
-                    [sys.executable, trainer_entry] + trainer_argv,
-                    env=env,
-                )
-            else:
-                # one jax.distributed world of N local trainer processes
-                # (multi-host skeleton; reference: torchrun rendezvous)
-                from areal_tpu.parallel.distributed import (
-                    COORDINATOR_ENV,
-                    NUM_PROCESSES_ENV,
-                    PROCESS_ID_ENV,
-                )
 
-                port = network.find_free_ports(1)[0]
-                for rank in range(n_trainers):
-                    trainer_env = dict(env)
-                    trainer_env[COORDINATOR_ENV] = f"127.0.0.1:{port}"
-                    trainer_env[NUM_PROCESSES_ENV] = str(n_trainers)
-                    trainer_env[PROCESS_ID_ENV] = str(rank)
-                    launcher.submit(
-                        f"trainer_{rank}" if rank else "trainer",
-                        [sys.executable, trainer_entry] + trainer_argv,
-                        env=trainer_env,
-                    )
-        # watch loop
+        port = network.find_free_ports(1)[0]
+        for rank in range(n_trainers):
+            trainer_env = dict(env)
+            trainer_env[COORDINATOR_ENV] = f"127.0.0.1:{port}"
+            trainer_env[NUM_PROCESSES_ENV] = str(n_trainers)
+            trainer_env[PROCESS_ID_ENV] = str(rank)
+            launcher.submit(
+                trainer_names[rank],
+                [sys.executable, trainer_entry] + trainer_argv,
+                env=trainer_env,
+            )
+
+    try:
+        servers_up = False
         while True:
-            exc = launcher.poll()
-            if exc is not None:
+            env = dict(base_env)
+            if supervisor.attempt > 0 and recover_enabled:
+                env[RECOVER_ENV] = "1"
+            if wants_servers and not servers_up:
+                start_servers(env)
+                servers_up = True
+            if server_addrs:
+                env["AREAL_LLM_SERVER_ADDRS"] = ",".join(server_addrs)
+            if wants_trainer:
+                start_trainers(env)
+            supervisor.note_start()
+            # watch loop
+            exc: Optional[JobException] = None
+            while True:
+                exc = launcher.poll()
+                if exc is not None:
+                    break
+                if wants_trainer and launcher.finished("trainer"):
+                    logger.info("trainer finished")
+                    return
+                time.sleep(1)
+            if not (recover_enabled and supervisor.should_restart()):
                 raise exc
-            if launcher.finished("trainer"):
-                logger.info("trainer finished")
-                return
-            time.sleep(1)
-    except JobException as e:
-        launcher.stop_all()
-        if recover_enabled and _attempt < retries:
-            logger.warning(
-                f"{e}; restarting (attempt {_attempt + 1}/{retries})"
-            )
-            local_main(
-                config, trainer_entry, trainer_argv, recover_retries,
-                _attempt + 1,
-            )
-        else:
-            raise
+            delay = supervisor.next_backoff()
+            trainer_died = exc.name in trainer_names
+            servers_alive = all(launcher.alive(n) for n in server_names)
+            if trainer_died and servers_alive:
+                # trainer-only restart: keep the warm fleet, relaunch the
+                # trainer with RECOVER_ENV so it resumes from the last
+                # committed checkpoint and re-pushes weights on load()
+                logger.warning(
+                    f"{exc}; restarting trainer only "
+                    f"(attempt {supervisor.attempt}/{retries}, "
+                    f"backoff {delay:.1f}s, {len(server_names)} servers "
+                    f"kept alive)"
+                )
+                for name in trainer_names:
+                    launcher.stop(name)
+            else:
+                logger.warning(
+                    f"{exc}; restarting the full constellation "
+                    f"(attempt {supervisor.attempt}/{retries}, "
+                    f"backoff {delay:.1f}s)"
+                )
+                launcher.stop_all()
+                servers_up = False
+                server_addrs.clear()
+                server_names.clear()
+            time.sleep(delay)
     finally:
         launcher.stop_all()

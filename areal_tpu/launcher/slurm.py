@@ -42,6 +42,7 @@ class SlurmLauncher:
         time_limit: str = "24:00:00",
         container_env: Optional[Dict[str, str]] = None,
         submit: Callable[[str], str] = _default_submit,
+        trainer_restarts: int = 0,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -57,6 +58,12 @@ class SlurmLauncher:
         self.time_limit = time_limit
         self.env = dict(container_env or {})
         self.submit = submit
+        # bounded in-job trainer restarts (the batch script supervises
+        # the srun step and re-runs it with AREAL_TPU_RECOVER_RUN=1, the
+        # slurm analog of launcher/local.py's TrainerSupervisor) — no
+        # re-queue round-trip through the scheduler, so the gen-server
+        # jobs and their compiled programs stay up across a trainer crash
+        self.trainer_restarts = trainer_restarts
         self.job_ids: List[str] = []
 
     # ------------------------------------------------------------------
@@ -126,9 +133,35 @@ class SlurmLauncher:
             f"export AREAL_NUM_PROCESSES={self.trainer_nodes}",
             # the batch body runs ONCE on the head node; the per-task rank
             # must be evaluated inside each srun task, not frozen here
-            "srun bash -c "
-            + shlex.quote(f"AREAL_PROCESS_ID=$SLURM_PROCID exec {cmd}"),
         ]
+        srun = "srun bash -c " + shlex.quote(
+            f"AREAL_PROCESS_ID=$SLURM_PROCID exec {cmd}"
+        )
+        if self.trainer_restarts > 0:
+            # bounded-restart supervisor: re-run the srun step with the
+            # recover env set so RecoverHandler.load resumes from the
+            # last committed checkpoint; exponential-ish backoff keeps a
+            # crash loop from hammering shared storage
+            lines += [
+                f"max_restarts={self.trainer_restarts}",
+                "attempt=0",
+                "while true; do",
+                f"  {srun}",
+                "  code=$?",
+                "  [ $code -eq 0 ] && exit 0",
+                "  attempt=$((attempt + 1))",
+                '  if [ "$attempt" -gt "$max_restarts" ]; then',
+                '    echo "trainer failed ($code); restart budget spent"',
+                "    exit $code",
+                "  fi",
+                '  echo "trainer exited $code;'
+                ' restart $attempt/$max_restarts"',
+                "  export AREAL_TPU_RECOVER_RUN=1",
+                "  sleep $((attempt * 5))",
+                "done",
+            ]
+        else:
+            lines.append(srun)
         jid = self.submit(self._write("trainer", lines))
         self.job_ids.append(jid)
         return jid

@@ -14,8 +14,15 @@ every run. The modes mirror what a real fleet sees:
   policy, unlike 4xx).
 - ``latency``      — a fixed delay is inserted before the call proceeds
   (``latency_s`` seconds).
-- ``kill``         — the server process hard-exits (``os._exit``), the
-  SIGKILL analog; only honored on the server side.
+- ``kill``         — the process hard-exits (``os._exit``), the SIGKILL
+  analog; honored on the server side and at trainer-side fault points
+  (``side=trainer`` — e.g. ``match=recover_dump`` kills the trainer
+  between its checkpoint-weights write and the COMMIT marker, the
+  torn-checkpoint window ``utils/recover.py`` must survive).
+- ``abort``        — the call site raises :class:`ChaosAbort` instead of
+  exiting the process: the in-process analog of ``kill`` for trainer
+  faults, so tier-1 tests can crash a checkpoint dump mid-flight and
+  then drive the recovery path in the same interpreter.
 
 Rules are configured from a spec string (config, the ``AREAL_CHAOS``
 environment variable — read lazily so subprocess servers inherit it —
@@ -24,7 +31,9 @@ or at runtime via the generation server's ``POST /chaos`` endpoint)::
     mode[:key=value[,key=value...]][;mode:...]
 
 keys: ``match`` (URL/path substring, empty = all), ``side`` (``client`` |
-``server`` | ``any``), ``start`` (0-based index of the first qualifying
+``server`` | ``trainer`` | ``any``; trainer fault points are opt-in —
+only ``side=trainer`` rules match them, ``any`` covers the HTTP sides
+only), ``start`` (0-based index of the first qualifying
 call the rule fires on), ``count`` (how many qualifying calls it fires
 on; -1 = forever), ``latency_s``, ``exit_code``. Example — kill the
 server on its 3rd /generate, after injecting one 500::
@@ -40,11 +49,17 @@ own, so each call site stays in control of its error semantics.
 import dataclasses
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Union
 
 ENV_VAR = "AREAL_CHAOS"
 
-MODES = ("connect_drop", "http_500", "latency", "kill")
+MODES = ("connect_drop", "http_500", "latency", "kill", "abort")
+
+
+class ChaosAbort(RuntimeError):
+    """Raised by a trainer-side fault point when an ``abort`` rule fires —
+    the in-process crash analog (a real crash would be ``kill``)."""
 
 
 @dataclasses.dataclass
@@ -60,7 +75,14 @@ class ChaosRule:
     fired: int = dataclasses.field(default=0, compare=False)
 
     def applies(self, side: str, url: str) -> bool:
-        if self.side != "any" and self.side != side:
+        if side == "trainer":
+            # trainer fault points are opt-in: a generic HTTP rule
+            # (side=any, empty match) must not have its counted window
+            # ticked — let alone fired — by the rollout loop's
+            # per-iteration check
+            if self.side != "trainer":
+                return False
+        elif self.side != "any" and self.side != side:
             return False
         return self.match in url
 
@@ -149,6 +171,27 @@ class ChaosInjector:
                 }
                 for r in self.rules
             ]
+
+
+def trainer_fault(point: str) -> None:
+    """Consult the injector at a named trainer-side fault point (e.g.
+    ``recover_dump``: between the checkpoint-weights write and the COMMIT
+    marker). Unlike the HTTP hooks, the action is applied HERE — trainer
+    sites share one semantics: ``latency`` sleeps, ``abort`` raises
+    :class:`ChaosAbort`, ``kill`` hard-exits; the HTTP-shaped modes are
+    meaningless at a trainer point and are ignored."""
+    inj = get_injector()
+    if inj is None:
+        return
+    act = inj.check("trainer", point)
+    if act is None:
+        return
+    if act["mode"] == "latency":
+        time.sleep(act["latency_s"])
+    elif act["mode"] == "abort":
+        raise ChaosAbort(f"chaos: abort injected at {point}")
+    elif act["mode"] == "kill":
+        os._exit(act["exit_code"])
 
 
 _LOCK = threading.Lock()

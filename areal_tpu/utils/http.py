@@ -36,6 +36,21 @@ def retryable_status(status: int) -> bool:
     return status >= 500
 
 
+def backoff_delay(
+    attempt: int,
+    base: float = 0.5,
+    cap: float = 30.0,
+    jitter: float = 0.5,
+) -> float:
+    """Exponential backoff with bounded random jitter — the repo's one
+    retry-delay policy. ``attempt`` is 0-based; the jitter term keeps N
+    clients whose server (or reward backend) died under them from
+    re-converging in lockstep. Shared by the HTTP retry loop below and
+    the episode retry loop in api/workflow_api.py."""
+    delay = min(cap, base * (2**attempt))
+    return delay + random.uniform(0.0, jitter * delay)
+
+
 async def arequest_with_retry(
     session: aiohttp.ClientSession,
     url: str,
@@ -92,9 +107,9 @@ async def arequest_with_retry(
                 raise
             last_exc = e
             if attempt + 1 < max_retries:
-                delay = min(max_retry_delay, retry_delay * (2**attempt))
-                delay += random.uniform(0.0, jitter * delay)
-                await asyncio.sleep(delay)
+                await asyncio.sleep(
+                    backoff_delay(attempt, retry_delay, max_retry_delay, jitter)
+                )
     raise HttpRequestError(
         f"request to {url} failed after {max_retries} tries",
         status=getattr(last_exc, "status", None),

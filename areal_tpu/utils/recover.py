@@ -1,27 +1,57 @@
-"""Full-state checkpoint/resume (reference areal/utils/recover.py).
+"""Crash-consistent full-state checkpoint/resume (reference
+areal/utils/recover.py).
 
 `RecoverHandler.dump` persists StepInfo + saver/evaluator/stats-logger
-freq-controller states + dataloader state + engine weights+optimizer;
-`RecoverHandler.load` restores all of it and (for RL) re-uploads weights to
-the inference servers. Recover detection is env-driven
-(``AREAL_TPU_RECOVER_RUN=1`` set by the launcher on restart, analog of the
-reference's ``AREAL_RECOVER_RUN``).
+freq-controller states + dataloader state + executor quarantine + engine
+weights+optimizer; `RecoverHandler.load` restores all of it and (for RL)
+re-uploads weights to the inference servers. Recover detection is
+env-driven (``AREAL_TPU_RECOVER_RUN=1`` set by the launcher supervisor on
+restart, analog of the reference's ``AREAL_RECOVER_RUN``).
+
+Commit protocol (the crash-consistency contract):
+
+- every dump writes into a FRESH versioned directory
+  ``recover/step_<g>/`` (weights/ + recover_info.pkl), never in place —
+  a crash mid-``engine.save`` can only tear the new directory, never the
+  previous good checkpoint;
+- a ``COMMIT`` marker (fsynced, atomically renamed into place) is
+  written LAST; a directory without it is torn by definition and is
+  never loaded;
+- retention GC keeps the newest ``RecoverConfig.keep_last`` committed
+  checkpoints and removes older committed + stale torn directories;
+- ``load`` walks committed checkpoints newest-first and falls back past
+  any that fail integrity (missing/corrupt/truncated recover_info.pkl)
+  instead of crash-looping on one bad file; the pre-durability flat
+  layout (``recover/weights`` + ``recover/recover_info.pkl``) is still
+  readable as a last-resort candidate.
+
+Chaos hook: ``utils/chaos.trainer_fault("recover_dump")`` fires between
+the weights/info write and the COMMIT marker — exactly the torn-
+checkpoint window — so tier-1 tests prove kill-mid-dump resumes from the
+previous committed step.
 """
 
 import dataclasses
 import json
 import os
 import pickle
-from typing import Any, Dict, Optional
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.cli_args import RecoverConfig
 from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, WeightUpdateMeta
+from areal_tpu.utils import chaos, stats_tracker
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils.timeutil import EpochStepTimeFreqCtl
 
 logger = logging_util.getLogger("Recover")
 
 RECOVER_ENV = "AREAL_TPU_RECOVER_RUN"
+
+COMMIT_MARKER = "COMMIT"
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
 
 
 @dataclasses.dataclass
@@ -31,14 +61,49 @@ class RecoverInfo:
     evaluator_state: Dict[str, Any]
     dataloader_state: Dict[str, Any]
     model_version: int = 0
+    # poison samples the executor quarantined (exhausted episode
+    # retries); restored on resume so they are never re-admitted
+    quarantined_uids: List[str] = dataclasses.field(default_factory=list)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def write_atomic(path: str, data: bytes) -> None:
+    """tmp-write + fsync + rename: readers never see a partial file and
+    the bytes are on disk before the name exists (shared with
+    utils/saver.py's COMMIT marker)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def clear_commit_marker(dirpath: str) -> None:
+    """Remove a stale COMMIT marker before re-writing a checkpoint: the
+    re-save must start DIRTY, a leftover marker over fresh half-written
+    weights would be a lie. Rank-0-only callers — every rank racing
+    exists()/remove() on shared storage crashes the loser (one COMMIT
+    protocol for recover checkpoints and utils/saver.py saves)."""
+    try:
+        os.remove(os.path.join(dirpath, COMMIT_MARKER))
+    except FileNotFoundError:
+        pass
+
+
+def write_commit_marker(dirpath: str, payload: bytes) -> None:
+    """Write the COMMIT marker LAST (fsync + atomic rename): a directory
+    without it is torn by definition and must never be loaded."""
+    write_atomic(os.path.join(dirpath, COMMIT_MARKER), payload)
 
 
 def check_if_recover(config: RecoverConfig, recover_root: str) -> bool:
     """Should this run resume from a recover checkpoint?"""
     if config.mode == "disabled":
         return False
-    has_ckpt = os.path.exists(os.path.join(recover_root, "recover_info.pkl"))
+    has_ckpt = bool(_committed_steps(recover_root)) or os.path.exists(
+        os.path.join(recover_root, "recover_info.pkl")  # legacy flat layout
+    )
     if config.mode == "resume":
         return has_ckpt
     if config.mode in ("auto", "fault"):
@@ -46,19 +111,41 @@ def check_if_recover(config: RecoverConfig, recover_root: str) -> bool:
     return False
 
 
+def _committed_steps(recover_root: str) -> List[Tuple[int, str]]:
+    """(global_step, dir) of every COMMITTED checkpoint, ascending."""
+    out: List[Tuple[int, str]] = []
+    try:
+        entries = os.listdir(recover_root)
+    except FileNotFoundError:
+        return out
+    for name in entries:
+        m = _STEP_DIR_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(recover_root, name)
+        if os.path.exists(os.path.join(path, COMMIT_MARKER)):
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
 class RecoverHandler:
     def __init__(self, config: RecoverConfig, fileroot: str,
-                 experiment_name: str, trial_name: str):
+                 experiment_name: str, trial_name: str, tracer=None):
         self.config = config
         self.recover_root = os.path.join(
             fileroot, experiment_name, trial_name, "recover"
         )
+        # optional SpanTracer: checkpoint_dump/checkpoint_commit spans
+        # land next to the rollout-lifecycle spans on the same timeline
+        self.tracer = tracer
         self.freq_ctl = EpochStepTimeFreqCtl(
             freq_epoch=config.freq_epochs,
             freq_step=config.freq_steps,
             freq_sec=config.freq_secs,
         )
 
+    # -- legacy flat-layout paths (pre-durability dumps) ----------------
     @property
     def info_path(self) -> str:
         return os.path.join(self.recover_root, "recover_info.pkl")
@@ -66,6 +153,55 @@ class RecoverHandler:
     @property
     def weights_path(self) -> str:
         return os.path.join(self.recover_root, "weights")
+
+    # -- versioned layout ----------------------------------------------
+    def step_dir(self, global_step: int) -> str:
+        return os.path.join(self.recover_root, f"step_{global_step:08d}")
+
+    def committed_steps(self) -> List[Tuple[int, str]]:
+        return _committed_steps(self.recover_root)
+
+    def _gc(self, keep_dir: str) -> None:
+        """Retention: keep the newest ``keep_last`` committed checkpoints
+        (always including the one just written) and drop stale torn
+        directories left by earlier crashes."""
+        keep = max(1, self.config.keep_last)
+        committed = self.committed_steps()
+        for _, path in committed[:-keep]:
+            if os.path.abspath(path) == os.path.abspath(keep_dir):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            logger.info(f"recover GC: removed old checkpoint {path}")
+        if committed:
+            # a committed versioned checkpoint supersedes the legacy
+            # flat layout: GC it like any stale checkpoint — it would
+            # otherwise leak a full weights+optimizer copy for the life
+            # of the trial and linger as an arbitrarily-old load
+            # fallback if every committed pickle ever went unreadable
+            if os.path.exists(self.info_path):
+                try:
+                    os.remove(self.info_path)
+                except FileNotFoundError:
+                    pass
+                logger.info("recover GC: removed legacy flat checkpoint")
+            shutil.rmtree(self.weights_path, ignore_errors=True)
+        newest = committed[-1][0] if committed else -1
+        try:
+            entries = os.listdir(self.recover_root)
+        except FileNotFoundError:
+            return
+        for name in entries:
+            m = _STEP_DIR_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.recover_root, name)
+            if (
+                int(m.group(1)) < newest
+                and not os.path.exists(os.path.join(path, COMMIT_MARKER))
+            ):
+                # torn leftover from a crash mid-dump, already superseded
+                shutil.rmtree(path, ignore_errors=True)
+                logger.warning(f"recover GC: removed torn checkpoint {path}")
 
     def dump(
         self,
@@ -83,7 +219,13 @@ class RecoverHandler:
             return False
         if not force and not self.freq_ctl.check(epochs=0, steps=1):
             return False
-        os.makedirs(self.recover_root, exist_ok=True)
+        import jax
+
+        t_start = time.monotonic()
+        target = self.step_dir(step_info.global_step)
+        os.makedirs(target, exist_ok=True)
+        if jax.process_index() == 0:
+            clear_commit_marker(target)
         # used-data exclusion: fold the executor's consumed-sample uids
         # into the dataloader's used set BEFORE snapshotting it, so a
         # resumed run skips exactly the trained samples
@@ -103,26 +245,71 @@ class RecoverHandler:
             model_version=(
                 inference_engine.get_version() if inference_engine else 0
             ),
+            quarantined_uids=(
+                executor.quarantine_snapshot() if executor is not None
+                and hasattr(executor, "quarantine_snapshot") else []
+            ),
             extra=extra or {},
         )
         engine.save(  # collective under multi-process (rank 0 writes)
             SaveLoadMeta(
-                path=self.weights_path, weight_format="hf", with_optim=True
+                path=os.path.join(target, "weights"),
+                weight_format="hf", with_optim=True,
             )
         )
-        import jax
-
         if jax.process_index() != 0:
             return True
-        tmp = self.info_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(info, f)
-        os.replace(tmp, self.info_path)  # atomic: readers never see partial
+        write_atomic(
+            os.path.join(target, "recover_info.pkl"), pickle.dumps(info)
+        )
+        # the torn-checkpoint window: everything is on disk except the
+        # marker — a crash HERE must leave the previous committed
+        # checkpoint untouched and loadable
+        chaos.trainer_fault("recover_dump")
+        t_commit = time.monotonic()
+        write_commit_marker(
+            target,
+            json.dumps({
+                "global_step": step_info.global_step,
+                "model_version": info.model_version,
+            }).encode(),
+        )
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.record(
+                "checkpoint_dump", "__trainer__", t_start, time.monotonic(),
+                global_step=step_info.global_step,
+            )
+            self.tracer.record(
+                "checkpoint_commit", "__trainer__", t_commit,
+                time.monotonic(), global_step=step_info.global_step,
+            )
+        self._gc(target)
+        # gauge AFTER retention GC so it reports what disk actually
+        # holds, not keep_last+1 forever
+        stats_tracker.scalar(**{
+            "recover/dump_s": time.monotonic() - t_start,
+            "recover/committed_checkpoints": float(
+                len(self.committed_steps())
+            ),
+        })
         logger.info(
-            f"recover checkpoint dumped @ global step "
-            f"{step_info.global_step}"
+            f"recover checkpoint committed @ global step "
+            f"{step_info.global_step} → {target}"
         )
         return True
+
+    # ------------------------------------------------------------------
+    def _load_candidates(self) -> List[Tuple[str, str]]:
+        """(info_pkl, weights_dir) pairs, most-preferred first: committed
+        versioned checkpoints newest-first, then the legacy flat layout."""
+        cands = [
+            (os.path.join(path, "recover_info.pkl"),
+             os.path.join(path, "weights"))
+            for _, path in reversed(self.committed_steps())
+        ]
+        if os.path.exists(self.info_path):
+            cands.append((self.info_path, self.weights_path))
+        return cands
 
     def load(
         self,
@@ -133,14 +320,64 @@ class RecoverHandler:
         inference_engine=None,
         weight_update_meta: Optional[WeightUpdateMeta] = None,
     ) -> Optional[RecoverInfo]:
-        """Restore state; returns RecoverInfo or None when no checkpoint."""
-        if not os.path.exists(self.info_path):
+        """Restore state; returns RecoverInfo or None when no loadable
+        checkpoint exists. Integrity-checked: a corrupt/truncated
+        recover_info.pkl (half-written file, bad disk) logs and falls
+        back to the next-newest committed checkpoint instead of raising
+        UnpicklingError into a crash loop on every supervised restart.
+
+        Each candidate read is retried a few times before falling back:
+        the candidate walk is per-process, so under multi-process
+        training a TRANSIENT per-host read error (NFS hiccup,
+        not-yet-visible rename) must not make one rank silently resume
+        from an older checkpoint than its peers."""
+        import jax
+
+        info: Optional[RecoverInfo] = None
+        weights_dir = None
+        for info_pkl, wdir in self._load_candidates():
+            last_exc: Optional[Exception] = None
+            for read_attempt in range(3):
+                if read_attempt:
+                    time.sleep(0.5)
+                try:
+                    with open(info_pkl, "rb") as f:
+                        info = pickle.load(f)
+                    if not isinstance(info, RecoverInfo):
+                        raise TypeError(
+                            f"expected RecoverInfo, got "
+                            f"{type(info).__name__}"
+                        )
+                    last_exc = None
+                    break
+                except Exception as e:
+                    last_exc = e
+                    info = None
+            if last_exc is None and info is not None:
+                weights_dir = wdir
+                break
+            logger.warning(
+                f"recover checkpoint {info_pkl} unreadable after 3 "
+                f"attempts ({type(last_exc).__name__}: {last_exc}); "
+                f"falling back to the previous committed checkpoint"
+            )
+            if jax.process_count() > 1:
+                # per-rank fallback with no cross-rank agreement: peers
+                # that CAN read this candidate will resume from a
+                # different step — silently divergent weights/optimizer
+                logger.error(
+                    "multi-process recover fallback: ranks may now load "
+                    "DIFFERENT checkpoints; verify all hosts resumed the "
+                    "same global step before trusting this run"
+                )
+        if info is None or weights_dir is None:
+            logger.warning(
+                "no loadable recover checkpoint found; starting fresh"
+            )
             return None
-        with open(self.info_path, "rb") as f:
-            info: RecoverInfo = pickle.load(f)
         engine.load(
             SaveLoadMeta(
-                path=self.weights_path, weight_format="hf", with_optim=True
+                path=weights_dir, weight_format="hf", with_optim=True
             )
         )
         if saver is not None:
@@ -155,12 +392,23 @@ class RecoverHandler:
         engine.set_version(info.model_version)
         if inference_engine is not None:
             inference_engine.set_version(info.model_version)
+            # re-arm the quarantine BEFORE any rollout resumes: poison
+            # samples must not get one free re-admission per restart
+            # (getattr: pre-durability pickles lack the field)
+            executor = getattr(
+                inference_engine, "workflow_executor", None
+            )
+            quarantined = getattr(info, "quarantined_uids", [])
+            if executor is not None and hasattr(
+                executor, "restore_quarantine"
+            ):
+                executor.restore_quarantine(quarantined)
             if weight_update_meta is not None:
                 # push restored weights to generation servers so rollout
                 # resumes from the recovered policy
                 meta = dataclasses.replace(
                     weight_update_meta,
-                    path=self.weights_path,
+                    path=weights_dir,
                     model_version=info.model_version,
                 )
                 fut = inference_engine.update_weights(meta)

@@ -59,8 +59,17 @@ class Saver:
             epochs=int(step.epoch_step == step.steps_per_epoch - 1), steps=1
         ):
             return None
+        import jax
+
+        from areal_tpu.utils.recover import (
+            clear_commit_marker,
+            write_commit_marker,
+        )
+
         path = self.get_save_path(step, name)
         os.makedirs(path, exist_ok=True)
+        if jax.process_index() == 0:
+            clear_commit_marker(path)
         engine.save(
             SaveLoadMeta(
                 path=path,
@@ -70,10 +79,16 @@ class Saver:
                 ),
             )
         )
-        import jax
-
-        if tokenizer is not None and jax.process_index() == 0:
-            tokenizer.save_pretrained(path)
+        if jax.process_index() == 0:
+            if tokenizer is not None:
+                tokenizer.save_pretrained(path)
+            # marker LAST (one protocol with utils/recover.py):
+            # eval/inference tooling watching the checkpoints directory
+            # can skip torn dumps from a crashed trainer instead of
+            # loading half-written safetensors
+            write_commit_marker(
+                path, f"globalstep {step.global_step}\n".encode()
+            )
         logger.info(f"saved checkpoint to {path}")
         return path
 

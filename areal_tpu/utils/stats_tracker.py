@@ -108,6 +108,20 @@ class DistributedStatsTracker:
                     self._scalars[full] = vals = [float(np.mean(vals))]
                 vals.append(float(value))
 
+    def counter(self, **kwargs):
+        """Record event INCREMENTS; export sums the window (scalar()
+        would average the recorded values, under-reporting a
+        `*_total`-style counter whenever several events land in one
+        export window)."""
+        with self._lock:
+            for key, value in kwargs.items():
+                full = self._key(key)
+                self._reduce_types[full] = ReduceType.SUM
+                vals = self._scalars[full]
+                if len(vals) >= _MAX_SCALARS_PER_KEY:
+                    self._scalars[full] = vals = [float(np.sum(vals))]
+                vals.append(float(value))
+
     def stat(
         self,
         denominator: str,
@@ -143,7 +157,12 @@ class DistributedStatsTracker:
             for full, vals in self._scalars.items():
                 if key is not None and not full.startswith(key):
                     continue
-                result[full] = float(np.mean(vals)) if vals else 0.0
+                agg = (
+                    np.sum
+                    if self._reduce_types.get(full) == ReduceType.SUM
+                    else np.mean
+                )
+                result[full] = float(agg(vals)) if vals else 0.0
             for full, vals in self._stats.items():
                 if key is not None and not full.startswith(key):
                     continue
@@ -200,6 +219,7 @@ scope = DEFAULT_TRACKER.scope
 record_timing = DEFAULT_TRACKER.record_timing
 denominator = DEFAULT_TRACKER.denominator
 scalar = DEFAULT_TRACKER.scalar
+counter = DEFAULT_TRACKER.counter
 stat = DEFAULT_TRACKER.stat
 
 

@@ -158,6 +158,9 @@ def main(argv):
     recover_handler = RecoverHandler(
         config.recover, config.cluster.fileroot,
         config.experiment_name, config.trial_name,
+        # checkpoint_dump/commit spans land on the same timeline as the
+        # rollout-lifecycle spans (tools/trace_report.py --durability)
+        tracer=getattr(rollout, "tracer", None),
     )
     stats_logger = StatsLogger(
         config.experiment_name, config.trial_name, config.cluster.fileroot

@@ -1,0 +1,883 @@
+"""Training-loop durability plane (r8): crash-consistent checkpoints,
+episode retry + poison quarantine, watchdogged degradation, supervised
+restart.
+
+The acceptance chaos story: a trainer killed mid-`dump` (fault injected
+between the weights write and the COMMIT marker) resumes from the
+previous COMMITTED checkpoint with `consumed_uids` intact — zero samples
+trained twice, zero checkpoints lost; a counted-flaky workflow converges
+to a full batch via retries with quarantine + degraded metrics asserted;
+a dead-fleet `prepare_batch` raises a clean error within its configured
+deadline instead of hanging out `request_timeout`.
+"""
+
+import asyncio
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    DurabilityConfig,
+    InferenceEngineConfig,
+    RecoverConfig,
+    TracingConfig,
+)
+from areal_tpu.api.io_struct import StepInfo
+from areal_tpu.api.workflow_api import (
+    EpisodeQuarantinedError,
+    FleetUnavailableError,
+    RolloutThreadError,
+    RolloutWorkflow,
+    WorkflowExecutor,
+)
+from areal_tpu.dataset import StatefulDataLoader
+from areal_tpu.utils import chaos
+from areal_tpu.utils.chaos import ChaosAbort
+from areal_tpu.utils.recover import (
+    RECOVER_ENV,
+    RecoverHandler,
+    RecoverInfo,
+    check_if_recover,
+)
+from areal_tpu.utils.tracing import SpanTracer
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# Fakes
+# ---------------------------------------------------------------------------
+class _FakeTrainEngine:
+    """Writes one marker file per save so load() can verify which
+    checkpoint directory actually backed the restore."""
+
+    def __init__(self):
+        self.version = 0
+        self.loaded_from = None
+
+    def save(self, meta):
+        os.makedirs(meta.path, exist_ok=True)
+        with open(os.path.join(meta.path, "model.safetensors"), "w") as f:
+            f.write("weights")
+
+    def load(self, meta):
+        assert os.path.exists(
+            os.path.join(meta.path, "model.safetensors")
+        ), f"load from a dir engine.save never completed: {meta.path}"
+        self.loaded_from = meta.path
+
+    def set_version(self, v):
+        self.version = v
+
+
+class _StubInferEngine:
+    """Minimal inference-engine stand-in for the WorkflowExecutor."""
+
+    def __init__(self, fleet=None, tracer=None):
+        self.fleet = fleet
+        self.tracer = tracer
+        self.workflow_executor = None
+        self._version = 0
+
+    def get_version(self):
+        return self._version
+
+    def set_version(self, v):
+        self._version = v
+
+
+class _FakeFleet:
+    def __init__(self, addrs, schedulable):
+        self._addrs = addrs
+        self._schedulable = schedulable
+
+    def addresses(self):
+        return list(self._addrs)
+
+    def schedulable_addresses(self):
+        return list(self._schedulable)
+
+
+class _EchoWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        L = 4
+        return {
+            "input_ids": np.asarray([data["input_ids"] + [0] * 2], np.int32),
+            "attention_mask": np.ones((1, L), np.bool_),
+            "rewards": np.asarray([1.0], np.float32),
+            "qid_tag": np.asarray([int(data["qid"][1:])], np.int32),
+        }
+
+
+class _CountedFlakyWorkflow(_EchoWorkflow):
+    """Fails the first ``fails_per_uid[qid]`` attempts of each episode —
+    counted, never random, so retry convergence is exact."""
+
+    def __init__(self, fails_per_uid):
+        self.fails_per_uid = dict(fails_per_uid)
+        self.attempts = {}
+
+    async def arun_episode(self, engine, data):
+        qid = data["qid"]
+        n = self.attempts.get(qid, 0)
+        self.attempts[qid] = n + 1
+        if n < self.fails_per_uid.get(qid, 0):
+            raise RuntimeError(f"flaky backend for {qid} (attempt {n})")
+        return await super().arun_episode(engine, data)
+
+
+class _HangingWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        await asyncio.sleep(3600)
+
+
+def _items(n, base=0):
+    return [
+        {"qid": f"q{base + i}", "input_ids": [base + i, base + i + 1]}
+        for i in range(n)
+    ]
+
+
+def _fast_durability(**kw):
+    base = dict(
+        max_episode_retries=2,
+        retry_delay=0.01,
+        max_retry_delay=0.02,
+        retry_jitter=0.0,
+        failure_window=8,
+        degraded_threshold=0.5,
+        health_probe_after=0.2,
+    )
+    base.update(kw)
+    return DurabilityConfig(**base)
+
+
+def _executor(engine=None, durability=None, **cfg_kw):
+    base = dict(
+        experiment_name="dur", trial_name="t0",
+        consumer_batch_size=2, max_concurrent_rollouts=8,
+        max_head_offpolicyness=8, request_timeout=60,
+    )
+    base.update(cfg_kw)
+    cfg = InferenceEngineConfig(**base)
+    cfg.durability = durability or _fast_durability()
+    return WorkflowExecutor(cfg, engine or _StubInferEngine())
+
+
+def _handler(tmp_path, tracer=None, **rcfg_kw):
+    base = dict(mode="resume", freq_steps=1)
+    base.update(rcfg_kw)
+    return RecoverHandler(
+        RecoverConfig(**base), str(tmp_path), "e", "t", tracer=tracer
+    )
+
+
+def _step(g):
+    return StepInfo(epoch=0, epoch_step=g, global_step=g, steps_per_epoch=100)
+
+
+# ---------------------------------------------------------------------------
+# Crash-consistent checkpointing
+# ---------------------------------------------------------------------------
+class TestCommitProtocol:
+    def test_dump_writes_versioned_committed_dir(self, tmp_path):
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        assert h.dump(eng, _step(3), force=True)
+        d = h.step_dir(3)
+        assert os.path.exists(os.path.join(d, "weights", "model.safetensors"))
+        assert os.path.exists(os.path.join(d, "recover_info.pkl"))
+        assert os.path.exists(os.path.join(d, "COMMIT"))
+        assert h.committed_steps() == [(3, d)]
+        assert check_if_recover(RecoverConfig(mode="resume"), h.recover_root)
+
+        eng2 = _FakeTrainEngine()
+        info = h.load(eng2)
+        assert info.last_step_info.global_step == 3
+        assert eng2.loaded_from == os.path.join(d, "weights")
+
+    def test_kill_mid_dump_resumes_from_committed(self, tmp_path):
+        """THE acceptance chaos test: fault between weights write and
+        COMMIT marker → the torn checkpoint is invisible, resume comes
+        from the previous committed step with consumed_uids intact."""
+        items = _items(10)
+        loader = StatefulDataLoader(items, batch_size=2, shuffle=True, seed=3)
+        infer = _StubInferEngine()
+        ex = _executor(infer)
+        infer.workflow_executor = ex
+        ex.initialize()
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        try:
+            it = iter(loader)
+            for _ in range(3):
+                for item in next(it):
+                    ex.submit(item, _EchoWorkflow())
+            consumed_before = []
+            out = ex.wait(count=4)
+            consumed_before.extend(np.asarray(out["qid_tag"]).tolist())
+            # committed checkpoint: drains consumed uids into the loader
+            assert h.dump(
+                eng, _step(1), dataloader=loader,
+                inference_engine=infer, force=True,
+            )
+
+            # train two more samples, then crash INSIDE the next dump —
+            # after the weights write, before the COMMIT marker
+            out2 = ex.wait(count=2)
+            chaos.configure(
+                "abort:side=trainer,match=recover_dump,start=0,count=1"
+            )
+            with pytest.raises(ChaosAbort):
+                h.dump(
+                    eng, _step(2), dataloader=loader,
+                    inference_engine=infer, force=True,
+                )
+        finally:
+            ex.destroy()
+        # torn dir exists but is NOT committed; committed step survives
+        assert os.path.exists(h.step_dir(2))
+        assert not os.path.exists(os.path.join(h.step_dir(2), "COMMIT"))
+        assert [s for s, _ in h.committed_steps()] == [1]
+
+        # --- supervised restart: fresh process state ---
+        eng2 = _FakeTrainEngine()
+        loader2 = StatefulDataLoader(
+            items, batch_size=2, shuffle=True, seed=3
+        )
+        info = _handler(tmp_path).load(eng2, dataloader=loader2)
+        assert info.last_step_info.global_step == 1
+        assert eng2.loaded_from == os.path.join(h.step_dir(1), "weights")
+        resumed = [it["qid"] for batch in loader2 for it in batch]
+        before_qids = {f"q{t}" for t in consumed_before}
+        # zero samples trained twice: everything consumed before the
+        # committed dump stays excluded...
+        assert not (set(resumed) & before_qids)
+        # ...and everything else (including the two consumed after the
+        # commit, whose training the crash rolled back) is re-yielded
+        all_qids = {it["qid"] for it in items}
+        assert set(resumed) == all_qids - before_qids
+        del out2
+
+    def test_retention_gc_keeps_last_k(self, tmp_path):
+        h = _handler(tmp_path, keep_last=2)
+        eng = _FakeTrainEngine()
+        for g in range(4):
+            assert h.dump(eng, _step(g), force=True)
+        assert [s for s, _ in h.committed_steps()] == [2, 3]
+        assert not os.path.exists(h.step_dir(0))
+        assert not os.path.exists(h.step_dir(1))
+
+    def test_gc_sweeps_stale_torn_dirs(self, tmp_path):
+        h = _handler(tmp_path, keep_last=2)
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(0), force=True)
+        chaos.configure(
+            "abort:side=trainer,match=recover_dump,start=0,count=1"
+        )
+        with pytest.raises(ChaosAbort):
+            h.dump(eng, _step(1), force=True)
+        chaos.disable()
+        # next successful dump GCs the torn step_1 leftover
+        h.dump(eng, _step(2), force=True)
+        assert not os.path.exists(h.step_dir(1))
+        assert [s for s, _ in h.committed_steps()] == [0, 2]
+
+    def test_redump_same_step_clears_stale_commit(self, tmp_path):
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(1), force=True)
+        # crash on the re-dump of the SAME step: the stale marker must
+        # not vouch for the new half-written content
+        chaos.configure(
+            "abort:side=trainer,match=recover_dump,start=0,count=1"
+        )
+        with pytest.raises(ChaosAbort):
+            h.dump(eng, _step(1), force=True)
+        assert h.committed_steps() == []
+
+    def test_corrupt_info_falls_back_to_previous_committed(self, tmp_path):
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(1), force=True)
+        h.dump(eng, _step(2), force=True)
+        # truncated/garbage pickle in the NEWEST committed checkpoint
+        with open(
+            os.path.join(h.step_dir(2), "recover_info.pkl"), "wb"
+        ) as f:
+            f.write(b"\x80\x04 definitely not a pickle")
+        eng2 = _FakeTrainEngine()
+        info = h.load(eng2)  # must not raise UnpicklingError
+        assert info is not None
+        assert info.last_step_info.global_step == 1
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(1), force=True)
+        with open(
+            os.path.join(h.step_dir(1), "recover_info.pkl"), "wb"
+        ) as f:
+            f.write(b"junk")
+        assert _handler(tmp_path).load(_FakeTrainEngine()) is None
+
+    def test_legacy_flat_layout_still_loads(self, tmp_path):
+        h = _handler(tmp_path)
+        os.makedirs(h.weights_path, exist_ok=True)
+        with open(
+            os.path.join(h.weights_path, "model.safetensors"), "w"
+        ) as f:
+            f.write("w")
+        info = RecoverInfo(
+            last_step_info=_step(7), saver_state={}, evaluator_state={},
+            dataloader_state={}, model_version=7,
+        )
+        with open(h.info_path, "wb") as f:
+            pickle.dump(info, f)
+        assert check_if_recover(RecoverConfig(mode="resume"), h.recover_root)
+        eng = _FakeTrainEngine()
+        loaded = h.load(eng)
+        assert loaded.last_step_info.global_step == 7
+        assert eng.loaded_from == h.weights_path
+        assert eng.version == 7
+
+    def test_gc_removes_legacy_flat_layout_once_committed(self, tmp_path):
+        """The flat pre-durability layout is superseded (and GC'd) by the
+        first committed versioned dump — it must not leak a full
+        weights+optimizer copy for the life of the trial, nor linger as
+        an arbitrarily-old load fallback."""
+        h = _handler(tmp_path)
+        os.makedirs(h.weights_path, exist_ok=True)
+        with open(
+            os.path.join(h.weights_path, "model.safetensors"), "w"
+        ) as f:
+            f.write("w")
+        info = RecoverInfo(
+            last_step_info=_step(7), saver_state={}, evaluator_state={},
+            dataloader_state={}, model_version=7,
+        )
+        with open(h.info_path, "wb") as f:
+            pickle.dump(info, f)
+        eng = _FakeTrainEngine()
+        assert h.dump(eng, _step(8), force=True)
+        assert not os.path.exists(h.info_path)
+        assert not os.path.exists(h.weights_path)
+        loaded = h.load(_FakeTrainEngine())
+        assert loaded.last_step_info.global_step == 8
+
+    def test_pre_durability_pickle_without_quarantine_field(self, tmp_path):
+        h = _handler(tmp_path)
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(1), force=True)
+        # simulate an old-format pickle: strip the new field
+        pkl = os.path.join(h.step_dir(1), "recover_info.pkl")
+        with open(pkl, "rb") as f:
+            info = pickle.load(f)
+        info.__dict__.pop("quarantined_uids")
+        with open(pkl, "wb") as f:
+            pickle.dump(info, f)
+        infer = _StubInferEngine()
+        ex = _executor(infer)
+        infer.workflow_executor = ex  # never initialized: no thread needed
+        loaded = h.load(_FakeTrainEngine(), inference_engine=infer)
+        assert loaded is not None and ex.quarantine_snapshot() == []
+
+    def test_quarantine_roundtrips_through_recover(self, tmp_path):
+        infer = _StubInferEngine()
+        ex = _executor(infer)
+        infer.workflow_executor = ex
+        ex.restore_quarantine(["qid:poison"])
+        h = _handler(tmp_path, tracer=SpanTracer(TracingConfig(enabled=True)))
+        eng = _FakeTrainEngine()
+        h.dump(eng, _step(1), inference_engine=infer, force=True)
+        # dump traced the checkpoint protocol
+        names = {s.name for s in h.tracer.snapshot()}
+        assert {"checkpoint_dump", "checkpoint_commit"} <= names
+
+        infer2 = _StubInferEngine()
+        ex2 = _executor(infer2)
+        infer2.workflow_executor = ex2
+        h.load(_FakeTrainEngine(), inference_engine=infer2)
+        assert ex2.quarantine_snapshot() == ["qid:poison"]
+        # the restore also arms wait()'s fast-fail gate
+        assert ex2.rollout_stat.quarantined == 1
+        # the restored quarantine refuses re-admission
+        assert not ex2.submit(
+            {"qid": "poison", "input_ids": [1, 2]}, _EchoWorkflow()
+        )
+        assert ex2.rollout_stat.quarantine_skipped == 1
+
+    def test_check_if_recover_env_gate(self, tmp_path, monkeypatch):
+        h = _handler(tmp_path)
+        h.dump(_FakeTrainEngine(), _step(0), force=True)
+        cfg = RecoverConfig(mode="auto")
+        monkeypatch.delenv(RECOVER_ENV, raising=False)
+        assert not check_if_recover(cfg, h.recover_root)
+        monkeypatch.setenv(RECOVER_ENV, "1")
+        assert check_if_recover(cfg, h.recover_root)
+
+
+# ---------------------------------------------------------------------------
+# Episode retry, quarantine, degraded
+# ---------------------------------------------------------------------------
+class TestRetryQuarantine:
+    def test_flaky_episodes_converge_via_retries(self, tmp_path):
+        items = _items(4)
+        # q0 fails twice (budget is 2 retries → succeeds on the 3rd
+        # attempt), q2 fails once; the rest are clean
+        wf = _CountedFlakyWorkflow({"q0": 2, "q2": 1})
+        ex = _executor()
+        ex.initialize()
+        try:
+            for item in items:
+                ex.submit(item, wf)
+            out = ex.wait(count=4, timeout=30)
+            tags = sorted(np.asarray(out["qid_tag"]).tolist())
+            assert tags == [0, 1, 2, 3]  # full batch, nothing dropped
+            assert ex.rollout_stat.retried == 3
+            assert ex.rollout_stat.quarantined == 0
+            assert not ex.degraded
+        finally:
+            ex.destroy()
+
+    def test_poison_sample_quarantined_batch_converges(self, tmp_path):
+        items = _items(5)
+        wf = _CountedFlakyWorkflow({"q3": 10_000})  # q3 never succeeds
+        ex = _executor()
+        ex.initialize()
+        try:
+            for item in items:
+                ex.submit(item, wf)
+            out = ex.wait(count=4, timeout=30)
+            tags = sorted(np.asarray(out["qid_tag"]).tolist())
+            assert tags == [0, 1, 2, 4]
+            deadline = time.monotonic() + 10
+            while (
+                ex.rollout_stat.quarantined < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert ex.rollout_stat.quarantined == 1
+            assert ex.quarantine_snapshot() == ["qid:q3"]
+            # 1 first try + 2 retries, all burned
+            assert wf.attempts["q3"] == 3
+            # re-admission refused
+            assert not ex.submit(items[3], wf)
+            # an all-quarantined rollout_batch raises instead of
+            # returning a silently empty batch
+            with pytest.raises(RuntimeError, match="quarantined"):
+                ex.rollout_batch([items[3]], wf)
+        finally:
+            ex.destroy()
+
+    def test_quarantine_unblocks_bare_wait(self):
+        """A bare submit-N/wait-N caller whose batch can never complete
+        (one of the N quarantined) fails promptly with the quarantined
+        uid, instead of hanging out the full wait timeout on N-1
+        results."""
+        wf = _CountedFlakyWorkflow({"q1": 10_000})  # q1 never succeeds
+        ex = _executor()
+        ex.initialize()
+        try:
+            for item in _items(2):
+                ex.submit(item, wf)
+            t0 = time.monotonic()
+            with pytest.raises(EpisodeQuarantinedError, match="q1"):
+                ex.wait(count=2, timeout=30)
+            assert time.monotonic() - t0 < 10  # not the 30 s timeout
+        finally:
+            ex.destroy()
+
+    def test_quarantine_fastfail_survives_successful_wait(self):
+        """The fast-fail is executor STATE (rollout_stat.quarantined +
+        the deliverable count), not a queue token a successful wait()
+        could consume: a later bare wait still counting on the
+        quarantined episode (submit() accepted it before the quarantine)
+        keeps the fast-fail instead of hanging out request_timeout."""
+        from areal_tpu.api.workflow_api import _ResultItem
+
+        ex = _executor()
+        ex.rollout_stat.quarantined = 1  # as if quarantined earlier
+        batch = {
+            "input_ids": np.zeros((1, 4), np.int32),
+            "attention_mask": np.ones((1, 4), np.bool_),
+            "rewards": np.ones((1,), np.float32),
+        }
+        ex.output_queue.put_nowait(_ResultItem(batch, 1.0, uid="qid:g"))
+        out = ex.wait(count=1, timeout=5)  # satisfiable: must succeed
+        assert np.asarray(out["rewards"]).size == 1
+        t0 = time.monotonic()
+        with pytest.raises(EpisodeQuarantinedError, match="quarantined=1"):
+            ex.wait(count=1, timeout=30)
+        assert time.monotonic() - t0 < 10
+
+    def test_restored_quarantine_arms_fastfail(self):
+        """Post-restart: a rollout_batch whose data includes a RESTORED
+        poison sample converges via refill instead of hanging out
+        request_timeout waiting on the refused submission."""
+        ex = _executor()
+        ex.restore_quarantine(["qid:q0"])
+        ex.initialize()
+        try:
+            out = ex.rollout_batch(
+                _items(2), _EchoWorkflow(), group_filter=lambda b: True
+            )
+            assert np.asarray(out["rewards"]).size == 2
+        finally:
+            ex.destroy()
+
+    def test_no_phantom_refill_after_quarantine(self):
+        """A quarantine during one rollout_batch must not leak phantom
+        submissions or stale results into the next: each later batch
+        rolls exactly its own prompts and drains the queue."""
+        wf = _CountedFlakyWorkflow({"q0": 10_000})
+        ex = _executor()
+        ex.initialize()
+        try:
+            # batch 1: q0 poisoned; refill backfills to the full 3 groups
+            out = ex.rollout_batch(
+                _items(3), wf, group_filter=lambda b: True
+            )
+            assert np.asarray(out["rewards"]).size == 3
+            # batch 2: all healthy — exactly 3 results, each prompt ran
+            # exactly once, nothing left behind in the output queue
+            out2 = ex.rollout_batch(
+                _items(3, base=10), wf, group_filter=lambda b: True
+            )
+            assert np.asarray(out2["rewards"]).size == 3
+            assert ex.output_queue.qsize() == 0
+            for i in range(10, 13):
+                assert wf.attempts[f"q{i}"] == 1
+        finally:
+            ex.destroy()
+
+    def test_all_quarantined_refill_fails_fast(self):
+        """A group_filter rollout_batch whose every prompt ends up
+        quarantined: the refill lap can submit nothing, so the wait must
+        fail fast via the unsatisfiability check, not silently hang out
+        request_timeout."""
+        wf = _CountedFlakyWorkflow({"q0": 10_000, "q1": 10_000})
+        ex = _executor()
+        ex.initialize()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(EpisodeQuarantinedError):
+                ex.rollout_batch(
+                    _items(2), wf, group_filter=lambda b: True
+                )
+            assert time.monotonic() - t0 < 10
+        finally:
+            ex.destroy()
+
+    def test_degraded_flips_and_clears(self):
+        ex = _executor(durability=_fast_durability(
+            max_episode_retries=0, failure_window=8
+        ))
+        ex.initialize()
+        try:
+            bad = _CountedFlakyWorkflow({f"q{i}": 10_000 for i in range(8)})
+            for item in _items(8):
+                ex.submit(item, bad)
+            deadline = time.monotonic() + 10
+            while (
+                ex.rollout_stat.quarantined < 8
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert ex.degraded  # 8/8 failures in the window
+            # healthy traffic washes the window clean
+            good = _EchoWorkflow()
+            for item in _items(8, base=100):
+                ex.submit(item, good)
+            ex.wait(count=8, timeout=30)
+            assert not ex.degraded
+        finally:
+            ex.destroy()
+
+    def test_retry_and_quarantine_traced(self):
+        tracer = SpanTracer(TracingConfig(enabled=True))
+        infer = _StubInferEngine(tracer=tracer)
+        ex = _executor(infer)
+        ex.initialize()
+        try:
+            ex.submit(
+                _items(1)[0], _CountedFlakyWorkflow({"q0": 10_000})
+            )
+            deadline = time.monotonic() + 10
+            while (
+                ex.rollout_stat.quarantined < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+        finally:
+            ex.destroy()
+        names = [s.name for s in tracer.snapshot()]
+        assert names.count("episode_retry") == 2
+        assert names.count("quarantine") == 1
+
+
+# ---------------------------------------------------------------------------
+# Watchdog + bounded-time degradation
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def test_thread_death_raises_within_a_second(self):
+        # counted chaos rule kills the asyncio loop thread on its 3rd
+        # iteration; wait() must surface the captured exception promptly,
+        # not after request_timeout (60 s here, 3600 s in production)
+        chaos.configure("abort:side=trainer,match=rollout_loop,start=2")
+        ex = _executor()
+        ex.initialize()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RolloutThreadError) as ei:
+                ex.wait(count=1, timeout=60)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, f"watchdog took {elapsed:.1f}s"
+            assert isinstance(ei.value.__cause__, ChaosAbort)
+        finally:
+            ex.destroy()
+
+    def test_thread_death_raises_from_prepare_batch(self):
+        chaos.configure("abort:side=trainer,match=rollout_loop,start=2")
+        ex = _executor()
+        ex.initialize()
+        loader = StatefulDataLoader(_items(8), batch_size=2, shuffle=False)
+        try:
+            with pytest.raises(RolloutThreadError):
+                ex.prepare_batch(loader, _HangingWorkflow())
+        finally:
+            ex.destroy()
+
+    def test_dead_fleet_raises_clean_error_fast(self):
+        infer = _StubInferEngine(
+            fleet=_FakeFleet(["a:1", "b:2"], schedulable=[])
+        )
+        ex = _executor(infer, durability=_fast_durability(
+            health_probe_after=0.2, prepare_batch_timeout=30
+        ))
+        ex.initialize()
+        loader = StatefulDataLoader(_items(8), batch_size=2, shuffle=False)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(FleetUnavailableError, match="0/2"):
+                ex.prepare_batch(loader, _HangingWorkflow())
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            ex.destroy()
+
+    def test_prepare_batch_deadline_names_the_stats(self):
+        # fleet=None on the stub engine: no health probe, pure deadline
+        ex = _executor(durability=_fast_durability(
+            prepare_batch_timeout=1.5, health_probe_after=3600
+        ))
+        ex.initialize()
+        loader = StatefulDataLoader(_items(8), batch_size=2, shuffle=False)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="deadline"):
+                ex.prepare_batch(loader, _HangingWorkflow())
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            ex.destroy()
+
+
+# ---------------------------------------------------------------------------
+# prepare_batch satellites
+# ---------------------------------------------------------------------------
+class TestPrepareBatchSatellites:
+    def test_generator_rekeys_on_new_dataloader(self):
+        ex = _executor()
+        ex.initialize()
+        try:
+            a = StatefulDataLoader(_items(8), batch_size=2, shuffle=False)
+            b = StatefulDataLoader(
+                _items(8, base=100), batch_size=2, shuffle=False
+            )
+            ex.prepare_batch(a, _EchoWorkflow())
+            assert ex._data_generator_key == id(a)
+            # passing a DIFFERENT dataloader must rebuild the generator
+            # (the old bug kept iterating `a` forever)
+            tags = []
+            deadline = time.monotonic() + 20
+            while (
+                not any(t >= 100 for t in tags)
+                and time.monotonic() < deadline
+            ):
+                out = ex.prepare_batch(b, _EchoWorkflow())
+                tags.extend(np.asarray(out["qid_tag"]).tolist())
+            assert ex._data_generator_key == id(b)
+            assert any(t >= 100 for t in tags), tags
+        finally:
+            ex.destroy()
+
+    def test_consumer_batch_size_mismatch_is_value_error(self):
+        ex = _executor(consumer_batch_size=4)
+        loader = StatefulDataLoader(_items(9), batch_size=3, shuffle=False)
+        with pytest.raises(ValueError, match="divisible"):
+            ex.prepare_batch(loader, _EchoWorkflow())
+
+
+# ---------------------------------------------------------------------------
+# Supervised restart
+# ---------------------------------------------------------------------------
+class TestSupervisedRestart:
+    def test_supervisor_budget_and_backoff(self):
+        from areal_tpu.launcher.local import TrainerSupervisor
+
+        s = TrainerSupervisor(retries=2, backoff_s=1.0, max_backoff_s=3.0,
+                              healthy_uptime_s=3600, jitter=0.0)
+        assert s.should_restart()
+        assert s.next_backoff() == 1.0
+        assert s.should_restart()
+        assert s.next_backoff() == 2.0
+        assert not s.should_restart()  # budget spent
+        # jittered by default (utils/http.backoff_delay policy)
+        j = TrainerSupervisor(retries=1, backoff_s=1.0, max_backoff_s=3.0)
+        assert 1.0 <= j.next_backoff() <= 1.5
+
+    def test_supervisor_healthy_uptime_refunds_budget(self):
+        from areal_tpu.launcher.local import TrainerSupervisor
+
+        s = TrainerSupervisor(retries=1, healthy_uptime_s=0.0)
+        s.next_backoff()
+        assert s.attempt == 1
+        # uptime ≥ healthy_uptime_s (0 here) refunds the budget
+        assert s.should_restart() and s.attempt == 0
+
+    def test_local_main_relaunches_trainer_with_recover_env(
+        self, tmp_path, monkeypatch
+    ):
+        import areal_tpu.launcher.local as local_mod
+        from areal_tpu.api.cli_args import BaseExperimentConfig
+
+        real = local_mod.TrainerSupervisor
+        monkeypatch.setattr(
+            local_mod, "TrainerSupervisor",
+            lambda retries, attempt=0: real(
+                retries, backoff_s=0.05, attempt=attempt
+            ),
+        )
+        monkeypatch.delenv(RECOVER_ENV, raising=False)
+        script = tmp_path / "trainer.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.exit(0 if os.environ.get({RECOVER_ENV!r}) == '1' else 7)\n"
+        )
+        cfg = BaseExperimentConfig(
+            experiment_name="sup", trial_name="t0",
+        )
+        cfg.cluster.fileroot = str(tmp_path)
+        cfg.recover.mode = "auto"
+        cfg.recover.retries = 2
+        # first run exits 7; the supervisor relaunches with RECOVER_ENV=1
+        # and the trainer exits 0 — local_main returns instead of raising
+        local_mod.local_main(cfg, str(script), [])
+        log = os.path.join(str(tmp_path), "sup", "t0", "logs", "trainer.log")
+        assert os.path.exists(log)
+
+    def test_local_main_budget_exhaustion_raises(self, tmp_path, monkeypatch):
+        import areal_tpu.launcher.local as local_mod
+        from areal_tpu.api.cli_args import BaseExperimentConfig
+        from areal_tpu.launcher.local import JobException
+
+        real = local_mod.TrainerSupervisor
+        monkeypatch.setattr(
+            local_mod, "TrainerSupervisor",
+            lambda retries, attempt=0: real(
+                retries, backoff_s=0.05, attempt=attempt
+            ),
+        )
+        script = tmp_path / "trainer.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        cfg = BaseExperimentConfig(experiment_name="sup2", trial_name="t0")
+        cfg.cluster.fileroot = str(tmp_path)
+        cfg.recover.mode = "auto"
+        cfg.recover.retries = 1
+        with pytest.raises(JobException):
+            local_mod.local_main(cfg, str(script), [])
+
+    def test_slurm_trainer_script_embeds_restart_loop(self, tmp_path):
+        from areal_tpu.launcher.slurm import SlurmLauncher
+
+        submitted = []
+        lau = SlurmLauncher(
+            "e", "t", fileroot=str(tmp_path), trainer_nodes=1,
+            submit=lambda p: submitted.append(p) or "1",
+            trainer_restarts=2,
+        )
+        lau.launch_trainer(["python", "train.py"])
+        body = open(submitted[-1]).read()
+        assert "max_restarts=2" in body
+        assert f"export {RECOVER_ENV}=1" in body
+        assert "srun bash -c" in body
+
+        lau0 = SlurmLauncher(
+            "e", "t2", fileroot=str(tmp_path), trainer_nodes=1,
+            submit=lambda p: submitted.append(p) or "2",
+        )
+        lau0.launch_trainer(["python", "train.py"])
+        assert RECOVER_ENV not in open(submitted[-1]).read()
+
+
+# ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+class TestDurabilityReport:
+    def test_trace_report_durability(self, tmp_path):
+        import tools.trace_report as tr
+
+        tracer = SpanTracer(TracingConfig(enabled=True))
+        now = time.monotonic()
+        tracer.record("checkpoint_dump", "__trainer__", now, now + 0.25,
+                      global_step=4)
+        tracer.record("checkpoint_commit", "__trainer__", now + 0.24,
+                      now + 0.25, global_step=4)
+        tracer.instant("episode_retry", "qid:q1", attempt=0)
+        tracer.instant("episode_retry", "qid:q1", attempt=1)
+        tracer.instant("quarantine", "qid:q1", attempts=3)
+        path = str(tmp_path / "trace.jsonl")
+        tracer.export_jsonl(path)
+
+        du = tr.durability_summary(tr.load_spans(path))
+        assert du["dumps"] == 1
+        assert du["retries"] == 2
+        assert du["retry_attempt_hist"] == {"0": 1, "1": 1}
+        assert du["quarantined_samples"] == ["qid:q1"]
+        assert abs(du["dump_p50_s"] - 0.25) < 0.02
+        assert tr.main([path, "--durability", "--json"]) == 0
+
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        assert tr.main([empty, "--durability"]) == 1
+
+    def test_stats_gauges_exported(self):
+        from areal_tpu.utils import stats_tracker
+
+        stats_tracker.export_all(reset=True)
+        ex = _executor()
+        ex.initialize()
+        try:
+            ex.submit(
+                _items(1)[0], _CountedFlakyWorkflow({"q0": 10_000})
+            )
+            deadline = time.monotonic() + 10
+            while (
+                ex.rollout_stat.quarantined < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+        finally:
+            ex.destroy()
+        stats = stats_tracker.export_all(reset=True)
+        assert stats.get("rollout/episode_retries_total", 0) >= 1.0
+        assert stats.get("rollout/quarantined_total", 0) >= 1.0

@@ -26,6 +26,13 @@ lifetime totals, mean occupancy, and a rows-per-chunk histogram.
 ``spec_verify`` instants carry per-round drafted/accepted counts, and
 the report prints the accept-rate histogram, draft-length distribution,
 and verified-tokens/s over the spec window.
+
+``--durability`` switches to the trainer-durability report (r8):
+``checkpoint_dump``/``checkpoint_commit`` spans (utils/recover.py) give
+dump/commit latency percentiles, and ``episode_retry``/``quarantine``
+instants (api/workflow_api.py) give the retry-attempt histogram and the
+quarantined-sample list — the first-look answer to "what is the
+checkpoint tax and how sick are my reward/env backends".
 """
 
 import argparse
@@ -282,6 +289,68 @@ def format_failover(fo: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def durability_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Trainer-durability report: checkpoint dump/commit latency from
+    ``checkpoint_dump``/``checkpoint_commit`` spans plus the episode
+    retry/quarantine picture from the executor's instants."""
+    spans = list(spans)
+    dump_durs = sorted(
+        float(s.get("dur", 0.0))
+        for s in spans if s.get("name") == "checkpoint_dump"
+    )
+    commit_durs = sorted(
+        float(s.get("dur", 0.0))
+        for s in spans if s.get("name") == "checkpoint_commit"
+    )
+    retries = [s for s in spans if s.get("name") == "episode_retry"]
+    quarantines = [s for s in spans if s.get("name") == "quarantine"]
+    # histogram of retry ATTEMPT index (attempt=0 is the first re-try):
+    # a tall tail means samples are burning their whole budget
+    attempt_hist: Dict[str, int] = {}
+    for s in retries:
+        a = str((s.get("attrs") or {}).get("attempt", "?"))
+        attempt_hist[a] = attempt_hist.get(a, 0) + 1
+    return {
+        "dumps": len(dump_durs),
+        "dump_p50_s": _percentile(dump_durs, 0.50),
+        "dump_p95_s": _percentile(dump_durs, 0.95),
+        "dump_max_s": dump_durs[-1] if dump_durs else 0.0,
+        "commit_p50_s": _percentile(commit_durs, 0.50),
+        "retries": len(retries),
+        "retried_samples": len({s.get("rid", "") for s in retries}),
+        # numeric order ("2" before "10"); unparseable attempts last
+        "retry_attempt_hist": dict(sorted(
+            attempt_hist.items(),
+            key=lambda kv: (0, int(kv[0])) if kv[0].isdigit() else (1, 0),
+        )),
+        "quarantined": len(quarantines),
+        "quarantined_samples": sorted(
+            {str(s.get("rid", "?")) for s in quarantines}
+        ),
+    }
+
+
+def format_durability(du: Dict[str, Any]) -> str:
+    rows = [
+        f"checkpoint dumps     {du['dumps']}",
+        f"dump latency         p50 {du['dump_p50_s'] * 1e3:.1f}ms  "
+        f"p95 {du['dump_p95_s'] * 1e3:.1f}ms  "
+        f"max {du['dump_max_s'] * 1e3:.1f}ms",
+        f"commit latency       p50 {du['commit_p50_s'] * 1e3:.1f}ms",
+        f"episode retries      {du['retries']} "
+        f"(over {du['retried_samples']} samples)",
+        f"quarantined          {du['quarantined']}",
+    ]
+    if du["retry_attempt_hist"]:
+        rows += ["", f"{'retry attempt':<16}{'count':>7}"]
+        for attempt, count in du["retry_attempt_hist"].items():
+            rows.append(f"{attempt:<16}{count:>7}")
+    if du["quarantined_samples"]:
+        rows += ["", "quarantined samples:"]
+        rows += [f"  {u}" for u in du["quarantined_samples"]]
+    return "\n".join(rows)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -327,8 +396,28 @@ def main(argv=None) -> int:
         "from engine/remote.py) instead of the latency table; exit 1 "
         "when the trace carries none",
     )
+    p.add_argument(
+        "--durability", action="store_true",
+        help="summarize trainer durability (checkpoint_dump/commit "
+        "spans + episode_retry/quarantine instants) instead of the "
+        "latency table; exit 1 when the trace carries none",
+    )
     args = p.parse_args(argv)
     spans = load_spans(args.trace)
+    if args.durability:
+        du = durability_summary(spans)
+        if args.json:
+            print(json.dumps(du, indent=2))
+        else:
+            print(format_durability(du))
+        if du["dumps"] == 0 and du["retries"] == 0 and du["quarantined"] == 0:
+            print(
+                "no durability spans in trace (tracing off, or an "
+                "uneventful trainer)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     if args.spec:
         sp = spec_summary(spans)
         if args.json:
