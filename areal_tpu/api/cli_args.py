@@ -238,6 +238,25 @@ class InferenceEngineConfig:
     durability: "DurabilityConfig" = dataclasses.field(
         default_factory=lambda: DurabilityConfig()
     )
+    # fleet telemetry hub (utils/telemetry.TelemetryCollector): when
+    # enabled, the remote engine starts a collector over its fleet
+    # (FleetMonitor membership + the executor's lineage ledger) and
+    # serves the consolidated /metrics + /manifest hub endpoint
+    telemetry: "TelemetryConfig" = dataclasses.field(
+        default_factory=lambda: TelemetryConfig()
+    )
+    # router-scheduled mode: when set ("host:port"), agenerate asks the
+    # fronting router's POST /schedule_request for a server each chunk
+    # (qid affinity + global load view) instead of the client-local
+    # policy, forwarding the trace context so the router lands on the
+    # same stitched timeline; empty = client-local choose_server
+    router_addr: str = ""
+    # trajectory lineage ledger (utils/telemetry.LineageLedger): consumed
+    # records are appended here as JSONL when set (the in-memory ledger
+    # is always on; recover checkpoints snapshot it either way)
+    lineage_path: str = ""
+    # bounded in-memory lineage records (oldest consumed drop first)
+    lineage_max_records: int = 8192
 
 
 @dataclasses.dataclass
@@ -435,6 +454,43 @@ class TracingConfig:
     # optional JSONL sink written by flush()/export helpers (empty = only
     # in-memory draining via GET /trace or tracer.drain())
     export_path: str = ""
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    """Fleet telemetry hub (utils/telemetry.TelemetryCollector): a
+    background thread scrapes every generation server's ``/metrics`` and
+    drains ``/trace``, computes fleet-wide rollups (queue-wait p95, KV
+    utilization, accept rate, staleness distribution), runs the
+    deterministic anomaly rules below (gauge flip + ERROR log, cleared
+    symmetrically), and serves the consolidated ``GET /metrics`` + a
+    run-manifest JSON — the inputs a queue-wait/KV-util-driven
+    autoscaler consumes."""
+
+    enabled: bool = False
+    scrape_interval_s: float = 2.0
+    # also drain each server's GET /trace per sweep (keeps the spans of
+    # a later-killed server; feeds the stitched fleet timeline and the
+    # queue-wait rollup). Off = metrics-only scraping.
+    drain_traces: bool = True
+    # spans kept per server for rollups/stitching (bounded ring)
+    span_window: int = 4096
+    # --- anomaly rules (all deterministic; each drives one 0/1 gauge) ---
+    # decode stall: a server reports running_requests > 0 with
+    # decode_tokens_per_sec == 0 for this many consecutive scrapes
+    decode_stall_scrapes: int = 3
+    # queue-wait breach: fleet queue_wait p95 over the span window
+    queue_wait_p95_s: float = 30.0
+    # accept-rate collapse: spec is enabled somewhere but the fleet
+    # accept rate sits below this floor (after min_draft_tokens drafted)
+    accept_rate_floor: float = 0.05
+    min_draft_tokens: int = 256
+    # staleness runaway: max staleness-at-consumption in the lineage
+    # ledger exceeds this many versions
+    staleness_max: int = 8
+    # consolidated hub endpoint (serve() binds here; port 0 = auto)
+    host: str = "127.0.0.1"
+    port: int = 0
 
 
 @dataclasses.dataclass

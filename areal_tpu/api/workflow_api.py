@@ -34,7 +34,7 @@ from areal_tpu.api.io_struct import RolloutStat
 from areal_tpu.utils import chaos
 from areal_tpu.utils import data as data_utils
 from areal_tpu.utils import logging as logging_util
-from areal_tpu.utils import stats_tracker
+from areal_tpu.utils import stats_tracker, telemetry
 from areal_tpu.utils.http import backoff_delay
 
 logger = logging_util.getLogger("WorkflowExecutor")
@@ -115,6 +115,20 @@ class WorkflowExecutor:
         self.durability: DurabilityConfig = (
             getattr(config, "durability", None) or DurabilityConfig()
         )
+        # trajectory lineage ledger: per-sample records (attempts,
+        # servers, per-segment weight versions, reward, staleness at
+        # consumption, consuming step) assembled from the episode
+        # contexts agenerate fills in; always on in memory, appended to
+        # config.lineage_path as JSONL when one is set
+        self.lineage = telemetry.LineageLedger(
+            path=getattr(config, "lineage_path", "") or "",
+            max_records=getattr(config, "lineage_max_records", 8192),
+        )
+        # consuming-step attribution: the trainer announces its global
+        # step via set_train_step; otherwise consumption is numbered by
+        # wait() returns
+        self._train_step = -1
+        self._consume_seq = 0
         # sliding window of episode-attempt outcomes (True = failure)
         # driving the DEGRADED state
         self._outcomes: "collections.deque[bool]" = collections.deque(
@@ -143,6 +157,12 @@ class WorkflowExecutor:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+
+    def set_train_step(self, step: int) -> None:
+        """Announce the trainer's global step so lineage records carry
+        the TRUE consuming step g (train loops call this once per step;
+        without it, consumption is numbered by wait() returns)."""
+        self._train_step = int(step)
 
     def pause(self):
         """Stop launching new episodes (weight-update window; reference
@@ -353,6 +373,18 @@ class WorkflowExecutor:
         random.shuffle(results)
         with self._lock:
             self.consumed_uids.extend(r.uid for r in results if r.uid)
+            step = self._train_step
+            if step < 0:
+                step = self._consume_seq
+            self._consume_seq += 1
+        # lineage: stamp the consuming step + staleness-at-consumption
+        # on every sample handed to the trainer (appends to the JSONL
+        # sink when one is configured)
+        self.lineage.mark_consumed(
+            [r.uid for r in results if r.uid],
+            step=step,
+            trainer_version=self.engine.get_version(),
+        )
         return data_utils.concat_padded_tensors([r.batch for r in results])
 
     def drain_consumed_uids(self) -> List[str]:
@@ -575,35 +607,48 @@ class WorkflowExecutor:
         uid = item.uid or "?"
         batch = None
         failed = False
-        for attempt in range(dur.max_episode_retries + 1):
-            try:
-                batch = await item.workflow.arun_episode(
-                    self.engine, item.data
-                )
-                failed = False
-                break
-            except Exception:
-                failed = True
-                self._record_outcome(failure=True)
-                logger.warning(
-                    f"episode {uid} attempt "
-                    f"{attempt + 1}/{dur.max_episode_retries + 1} "
-                    f"failed:\n" + traceback.format_exc()
-                )
-                if attempt >= dur.max_episode_retries:
+        # lineage/trace context: one trace id for the WHOLE episode —
+        # retries and suffix-resume migrations stay on one timeline.
+        # agenerate (running in this task's context, or child tasks that
+        # inherit it) appends each request's server/version path here.
+        episode = telemetry.EpisodeLineage(uid=item.uid or uid)
+        ctx_token = telemetry.set_episode(episode)
+        try:
+            for attempt in range(dur.max_episode_retries + 1):
+                episode.attempt = attempt
+                try:
+                    batch = await item.workflow.arun_episode(
+                        self.engine, item.data
+                    )
+                    failed = False
                     break
-                with self._lock:
-                    self.rollout_stat.retried += 1
-                stats_tracker.counter(**{
-                    "rollout/episode_retries_total": 1.0,
-                })
-                tracer = self._tracer()
-                if tracer is not None:
-                    tracer.instant("episode_retry", uid, attempt=attempt)
-                await asyncio.sleep(backoff_delay(
-                    attempt, dur.retry_delay, dur.max_retry_delay,
-                    dur.retry_jitter,
-                ))
+                except Exception:
+                    failed = True
+                    self._record_outcome(failure=True)
+                    logger.warning(
+                        f"episode {uid} attempt "
+                        f"{attempt + 1}/{dur.max_episode_retries + 1} "
+                        f"failed:\n" + traceback.format_exc()
+                    )
+                    if attempt >= dur.max_episode_retries:
+                        break
+                    with self._lock:
+                        self.rollout_stat.retried += 1
+                    stats_tracker.counter(**{
+                        "rollout/episode_retries_total": 1.0,
+                    })
+                    tracer = self._tracer()
+                    if tracer is not None:
+                        tracer.instant(
+                            "episode_retry", uid, attempt=attempt,
+                            trace=episode.trace_id,
+                        )
+                    await asyncio.sleep(backoff_delay(
+                        attempt, dur.retry_delay, dur.max_retry_delay,
+                        dur.retry_jitter,
+                    ))
+        finally:
+            telemetry.reset_episode(ctx_token)
         if failed:
             with self._lock:
                 self.rollout_stat.running -= 1
@@ -614,11 +659,13 @@ class WorkflowExecutor:
             stats_tracker.counter(**{
                 "rollout/quarantined_total": 1.0,
             })
+            self.lineage.record_episode(episode, status="quarantined")
             tracer = self._tracer()
             if tracer is not None:
                 tracer.instant(
                     "quarantine", uid,
                     attempts=dur.max_episode_retries + 1,
+                    trace=episode.trace_id,
                 )
             logger.error(
                 f"episode {uid} QUARANTINED after "
@@ -635,8 +682,16 @@ class WorkflowExecutor:
             if batch is None:
                 self.rollout_stat.rejected += 1
                 self.rollout_stat.running -= 1
+                self.lineage.record_episode(episode, status="rejected")
                 return
             self.rollout_stat.accepted += 1
+        rewards = None
+        r = batch.get("rewards") if hasattr(batch, "get") else None
+        if r is not None:
+            rewards = [float(x) for x in np.asarray(r).reshape(-1)]
+        self.lineage.record_episode(
+            episode, status="collected", rewards=rewards
+        )
         # the result enters the queue BEFORE `running` drops so wait()'s
         # quarantine unsatisfiability check never misses an episode that
         # is between "finished" and "delivered"

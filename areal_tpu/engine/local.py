@@ -89,43 +89,74 @@ class LocalSyncInferenceEngine(InferenceEngine):
         versions: List[int] = []
         stop_reason = None
         ttft = None
-        while (
-            stop_reason not in ("stop", "length")
-            and len(accumulated) < gconfig.max_new_tokens
-        ):
-            payload_extra = (
-                {"mm": req.mm} if getattr(req, "mm", None) is not None else {}
-            )
-            fut = self.engine.submit(
-                {
-                    "rid": req.rid,
-                    "input_ids": list(req.input_ids) + accumulated,
-                    **payload_extra,
-                    "sampling_params": {
-                        "max_new_tokens": gconfig.max_new_tokens
-                        - len(accumulated),
-                        "min_new_tokens": max(
-                            0, gconfig.min_new_tokens - len(accumulated)
-                        ),
-                        "temperature": gconfig.temperature,
-                        "top_p": gconfig.top_p,
-                        "top_k": gconfig.top_k,
-                        "greedy": gconfig.greedy,
-                        "stop_token_ids": gconfig.stop_token_ids,
-                    },
-                }
-            )
-            result = await asyncio.wrap_future(fut)
-            if ttft is None and result["output_ids"]:
-                # engine-side ttft, re-based onto this call's clock
-                meta = result["meta_info"]
-                ttft = (time.monotonic() - start) - meta["latency"] + meta["ttft"]
-            accumulated.extend(result["output_ids"])
-            logprobs.extend(result["output_logprobs"])
-            versions.extend(result["output_versions"])
-            stop_reason = result["meta_info"]["finish_reason"]["type"]
-            if stop_reason == "abort":
-                await asyncio.sleep(self.config.pause_grace_period or 0.05)
+        # lineage + trace context, same shape as the remote engine so
+        # ledgers/dashboards don't care about deployment mode (the one
+        # "server" is the in-process engine)
+        from areal_tpu.utils import telemetry as _telemetry
+
+        episode = _telemetry.current_episode()
+        lineage = _telemetry.RequestLineage(
+            rid=req.rid,
+            attempt=episode.attempt if episode is not None else 0,
+        )
+        if episode is not None:
+            self.engine.tracer.bind_trace(req.rid, episode.trace_id)
+        try:
+            while (
+                stop_reason not in ("stop", "length")
+                and len(accumulated) < gconfig.max_new_tokens
+            ):
+                payload_extra = (
+                    {"mm": req.mm}
+                    if getattr(req, "mm", None) is not None else {}
+                )
+                fut = self.engine.submit(
+                    {
+                        "rid": req.rid,
+                        "input_ids": list(req.input_ids) + accumulated,
+                        **payload_extra,
+                        "sampling_params": {
+                            "max_new_tokens": gconfig.max_new_tokens
+                            - len(accumulated),
+                            "min_new_tokens": max(
+                                0, gconfig.min_new_tokens - len(accumulated)
+                            ),
+                            "temperature": gconfig.temperature,
+                            "top_p": gconfig.top_p,
+                            "top_k": gconfig.top_k,
+                            "greedy": gconfig.greedy,
+                            "stop_token_ids": gconfig.stop_token_ids,
+                        },
+                    }
+                )
+                result = await asyncio.wrap_future(fut)
+                if ttft is None and result["output_ids"]:
+                    # engine-side ttft, re-based onto this call's clock
+                    meta = result["meta_info"]
+                    ttft = (
+                        (time.monotonic() - start)
+                        - meta["latency"] + meta["ttft"]
+                    )
+                if result["output_ids"]:
+                    lineage.add_segment(
+                        "local", len(result["output_ids"]),
+                        result["output_versions"],
+                    )
+                accumulated.extend(result["output_ids"])
+                logprobs.extend(result["output_logprobs"])
+                versions.extend(result["output_versions"])
+                stop_reason = result["meta_info"]["finish_reason"]["type"]
+                if stop_reason == "abort":
+                    await asyncio.sleep(
+                        self.config.pause_grace_period or 0.05
+                    )
+        finally:
+            # a mid-generation exception must still unbind the rid and
+            # hand the partial path to the episode record (same contract
+            # as the remote engine's finally block)
+            if episode is not None:
+                self.engine.tracer.unbind_trace(req.rid)
+                episode.add_request(lineage)
         if versions:
             # generation-time staleness vs the trainer (same keys as the
             # remote engine so dashboards don't care about deployment mode)

@@ -21,6 +21,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import aiohttp
@@ -69,9 +70,13 @@ from areal_tpu.api.io_struct import (
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
 from areal_tpu.inference.fleet import FleetMonitor
 from areal_tpu.utils import logging as logging_util, name_resolve, names
-from areal_tpu.utils import stats_tracker
+from areal_tpu.utils import stats_tracker, telemetry
 from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
-from areal_tpu.utils.tracing import SpanTracer
+from areal_tpu.utils.tracing import (
+    SpanTracer,
+    new_trace_id,
+    trace_headers,
+)
 
 logger = logging_util.getLogger("RemoteInferenceEngine")
 
@@ -87,14 +92,25 @@ class RemoteInferenceEngine(InferenceEngine):
         self.config = config
         self.addresses: List[str] = []
         self._server_idx = 0
-        self._rid_to_address: Dict[str, str] = {}
+        # rid → server affinity, LRU-bounded: eviction must drop the
+        # LEAST-recently-touched rid, not the oldest insertion — a hot
+        # resumed rid keeps its KV locality (mirrors the router's
+        # bounded qid cache)
+        self._rid_to_address: "OrderedDict[str, str]" = OrderedDict()
         self._version = 0
+        # last scheduling version the fronting router reported (when
+        # config.router_addr is set): the stickiness key its
+        # previous_server fast path checks against
+        self._router_version = -1
         self._lock = threading.Lock()
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
         # fleet resilience plane (built in initialize once addresses are
         # known): health state machine + circuit breaker + membership
         self.fleet: Optional[FleetMonitor] = None
+        # fleet telemetry hub (utils/telemetry.TelemetryCollector):
+        # started in initialize when config.telemetry.enabled
+        self.telemetry = None
         self._discovered = False  # addrs came from name_resolve (not env/
         # explicit) — only then may the membership watch shrink the fleet
         # last successful disk-path weight push (path, version): the
@@ -103,7 +119,9 @@ class RemoteInferenceEngine(InferenceEngine):
         # client-side request lifecycle spans (submit → first-token →
         # complete; weight-update pause windows) — no-op unless
         # config.tracing.enabled
-        self.tracer = SpanTracer(getattr(config, "tracing", None))
+        self.tracer = SpanTracer(
+            getattr(config, "tracing", None), service="client"
+        )
         # one session PER event loop: a session is bound to its creating
         # loop, and this engine is legitimately driven from several (the
         # WorkflowExecutor's background loop + per-sweep asyncio.run loops
@@ -163,6 +181,17 @@ class RemoteInferenceEngine(InferenceEngine):
             self.fleet.start()
         self.workflow_executor = WorkflowExecutor(self.config, self)
         self.workflow_executor.initialize()
+        tel_cfg = getattr(self.config, "telemetry", None)
+        if tel_cfg is not None and tel_cfg.enabled:
+            # the hub rides the SAME membership the resilience plane
+            # watches, and reads staleness from the executor's ledger
+            self.telemetry = telemetry.TelemetryCollector(
+                addresses=list(self.addresses),
+                fleet=self.fleet,
+                config=tel_cfg,
+                ledger=self.workflow_executor.lineage,
+            ).start()
+            self.telemetry.serve()
         return self
 
     # -- fleet callbacks (fleet lock NOT held here) --------------------
@@ -273,6 +302,8 @@ class RemoteInferenceEngine(InferenceEngine):
     def destroy(self):
         if self.workflow_executor is not None:
             self.workflow_executor.destroy()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.fleet is not None:
             self.fleet.stop()
         self.executor.shutdown(wait=False)
@@ -354,6 +385,9 @@ class RemoteInferenceEngine(InferenceEngine):
             if rid is not None and rid in self._rid_to_address:
                 addr = self._rid_to_address[rid]
                 if usable(addr):
+                    # LRU touch: a hot resumed rid must not be the next
+                    # eviction victim just because it was inserted early
+                    self._rid_to_address.move_to_end(rid)
                     return addr
                 del self._rid_to_address[rid]
             candidates = [a for a in self.addresses if usable(a)]
@@ -383,11 +417,64 @@ class RemoteInferenceEngine(InferenceEngine):
                 self._server_idx += 1
             if rid is not None:
                 self._rid_to_address[rid] = addr
-                if len(self._rid_to_address) > 16384:
-                    self._rid_to_address.pop(
-                        next(iter(self._rid_to_address))
-                    )
+                self._rid_to_address.move_to_end(rid)
+                while len(self._rid_to_address) > 16384:
+                    # evict least-recently-USED, not first-inserted
+                    self._rid_to_address.popitem(last=False)
             return addr
+
+    async def _schedule_via_router(
+        self, session, req: ModelRequest, failed: set, headers
+    ) -> Optional[str]:
+        """Router-scheduled mode (config.router_addr): ask the fronting
+        router for a server, forwarding the trace context so the
+        router's `route` span lands on the same stitched timeline.
+        Returns None (→ local choose_server fallback) when no router is
+        configured, the router is unreachable, or it answered with a
+        server this request already failed on."""
+        router = getattr(self.config, "router_addr", "")
+        if not router:
+            return None
+        with self._lock:
+            prev = self._rid_to_address.get(req.rid)
+            prev_version = self._router_version
+        meta = {
+            "rid": req.rid,
+            "qid": str(req.metadata.get("qid") or req.rid),
+            "prompt_len": len(req.input_ids),
+            "new_token_budget": req.gconfig.max_new_tokens,
+            "exclude": sorted(failed),
+        }
+        if prev is not None and prev not in failed:
+            meta["previous_server"] = prev
+            meta["previous_version"] = prev_version
+        try:
+            out = await arequest_with_retry(
+                session,
+                f"http://{router}/schedule_request",
+                meta,
+                max_retries=2,
+                timeout=30.0,
+                headers=headers,
+            )
+        except Exception as e:
+            logger.warning(
+                f"router schedule for {req.rid} failed ({e}); "
+                f"falling back to the client-local policy"
+            )
+            return None
+        addr = out.get("url")
+        if not addr or addr in failed:
+            return None
+        with self._lock:
+            self._router_version = int(
+                out.get("version", self._router_version)
+            )
+            self._rid_to_address[req.rid] = addr
+            self._rid_to_address.move_to_end(req.rid)
+            while len(self._rid_to_address) > 16384:
+                self._rid_to_address.popitem(last=False)
+        return addr
 
     async def _get_session(self) -> aiohttp.ClientSession:
         loop = asyncio.get_running_loop()
@@ -428,6 +515,23 @@ class RemoteInferenceEngine(InferenceEngine):
             fleet_cfg.max_failovers_per_request if fleet_cfg else 8
         )
         chunk = self.config.new_tokens_per_chunk or 0
+        # trace context: one trace id per EPISODE (the workflow
+        # executor's lineage context — asyncio child tasks inherit it),
+        # surviving retries and suffix-resume migrations; standalone
+        # callers get a per-request id. Propagated to router + servers
+        # via the X-Areal-Trace/X-Areal-Rid headers and bound onto this
+        # client's own spans.
+        episode = telemetry.current_episode()
+        trace_id = (
+            episode.trace_id if episode is not None
+            else str(req.metadata.get("trace_id") or new_trace_id())
+        )
+        hdrs = trace_headers(trace_id, req.rid)
+        self.tracer.bind_trace(req.rid, trace_id)
+        lineage = telemetry.RequestLineage(
+            rid=req.rid,
+            attempt=episode.attempt if episode is not None else 0,
+        )
         try:
             while (
                 stop_reason not in ("stop", "length")
@@ -438,7 +542,9 @@ class RemoteInferenceEngine(InferenceEngine):
                     # the exclusions (one may have recovered) rather than
                     # fail closed; max_failovers still bounds total hops
                     failed.clear()
-                server = self.choose_server(req.rid, exclude=failed)
+                server = await self._schedule_via_router(
+                    session, req, failed, hdrs
+                ) or self.choose_server(req.rid, exclude=failed)
                 remaining = gconfig.max_new_tokens - len(accumulated)
                 ask = min(remaining, chunk) if chunk > 0 else remaining
                 payload = {
@@ -491,6 +597,7 @@ class RemoteInferenceEngine(InferenceEngine):
                         payload,
                         max_retries=self.config.request_retries,
                         timeout=self.config.request_timeout,
+                        headers=hdrs,
                     )
                 except HttpRequestError as e:
                     # retries exhausted against THIS server. 4xx means
@@ -511,6 +618,9 @@ class RemoteInferenceEngine(InferenceEngine):
                     failed.add(server)
                     n_failovers += 1
                     migrated = len(accumulated) > 0
+                    lineage.failovers += 1
+                    if migrated:
+                        lineage.migrations += 1
                     if self.fleet is not None:
                         self.fleet.record_failover(migrated)
                     if self.tracer.enabled:
@@ -550,6 +660,14 @@ class RemoteInferenceEngine(InferenceEngine):
                     )
                 if ttft is None and result["output_ids"]:
                     ttft = time.monotonic() - start
+                if result["output_ids"]:
+                    # lineage: which server produced this token segment
+                    # at which weight version(s)
+                    lineage.add_segment(
+                        server,
+                        len(result["output_ids"]),
+                        result["output_versions"],
+                    )
                 accumulated.extend(result["output_ids"])
                 logprobs.extend(result["output_logprobs"])
                 versions.extend(result["output_versions"])
@@ -576,18 +694,27 @@ class RemoteInferenceEngine(InferenceEngine):
             # entry pinning this rid to a server it will never revisit
             with self._lock:
                 self._rid_to_address.pop(req.rid, None)
+            self.tracer.unbind_trace(req.rid)
+            # hand the request's path to the episode's lineage record
+            # even on failure — a half-generated, exception-killed
+            # request is exactly what the ledger must explain
+            if episode is not None:
+                episode.add_request(lineage)
         now = time.monotonic()
         if self.tracer.enabled:
+            # recorded after the finally-block unbind: carry the trace
+            # attr explicitly so the lifecycle spans still stitch
             if ttft is not None:
                 self.tracer.record(
                     "submit_to_first_token", req.rid, start, start + ttft,
+                    trace=trace_id,
                 )
             self.tracer.record(
                 "rollout_request", req.rid, start, now,
                 output_tokens=len(accumulated),
                 stop_reason=stop_reason or "length",
                 n_calls=n_calls, n_aborts=n_aborts,
-                n_failovers=n_failovers,
+                n_failovers=n_failovers, trace=trace_id,
             )
         # generation-time staleness: how far each produced token already
         # lags the trainer at COMPLETION time (the consumed-batch lag is

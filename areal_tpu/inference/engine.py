@@ -28,6 +28,7 @@ registry serves the already-cached prefix back without recompute.
 """
 
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -504,6 +505,15 @@ class GenerationEngine:
         # pause-window bookkeeping: pause() stamps, continue_generation()
         # records the span (the weight-update window the client sits out)
         self._pause_start: Optional[float] = None
+        # on-demand jax.profiler capture (POST /profile → request_profile):
+        # (n_busy_steps, PhaseProfiler) armed here, consumed on the loop
+        # thread — the profiler must bracket the device dispatches, which
+        # only the loop thread issues. The lock makes arm-vs-arm (HTTP
+        # handler threads) and arm-vs-consume (loop thread) atomic.
+        self._profile_lock = threading.Lock()
+        self._profile_pending: Optional[tuple] = None
+        self._profile_stack = None
+        self._profile_left = 0
 
     def _place_params(self, params: Params) -> Params:
         """Host or device pytree → this engine's param placement."""
@@ -555,6 +565,12 @@ class GenerationEngine:
     def submit(self, payload: Dict[str, Any]) -> Future:
         fut: Future = Future()
         req = _parse_request(payload, fut)
+        trace_ctx = payload.get("trace_ctx")
+        if trace_ctx:
+            # incoming cross-process trace context (X-Areal-Trace): every
+            # span this engine records for the rid joins the originating
+            # episode's timeline
+            self.tracer.bind_trace(req.rid, str(trace_ctx))
         bs = self.cache_config.page_size
         if len(req.input_ids) >= self.config.max_model_len:
             fut.set_exception(
@@ -653,6 +669,9 @@ class GenerationEngine:
             model_version=self.model_version,
             paused=float(self._paused.is_set()),
             trace_spans=len(self.tracer) if self.tracer.enabled else 0,
+            # ring-buffer overflow count: a truncated trace must be
+            # VISIBLY truncated, not silently missing its oldest spans
+            tracing_dropped_spans_total=float(self.tracer.dropped),
         )
         if self._spec_configured:
             # spec gauges exist ONLY when speculation is configured —
@@ -676,16 +695,85 @@ class GenerationEngine:
     # ------------------------------------------------------------------
     def _loop(self):
         while self._running:
+            self._maybe_start_profile()
             did_work = self._drain_commands()
             if not self._paused.is_set():
                 did_work |= self._admit()
                 did_work |= self._decode()
+            self._maybe_stop_profile(did_work)
             if not did_work:
                 # idle/pause gap: the decode-rate EWMA must not absorb it
                 # (the next chunk's dt would span the whole quiet period
                 # and crater the gauge)
                 self._last_decode_mark = None
                 time.sleep(0.001)
+        self._maybe_stop_profile(did_work=True, force=True)
+
+    # ------------------------------------------------------------------
+    # On-demand profiler capture (POST /profile)
+    # ------------------------------------------------------------------
+    def request_profile(self, steps: int, out_dir: Optional[str] = None) -> str:
+        """Arm a jax.profiler capture of the next ``steps`` BUSY engine
+        loop iterations (admission/decode/command work; idle spins don't
+        count). Returns the directory the XPlane trace will land in.
+        One capture at a time — a second request while armed/running is
+        an error, not a silent re-arm."""
+        from areal_tpu.api.cli_args import ProfilingConfig
+        from areal_tpu.utils.profiling import PhaseProfiler
+
+        if steps <= 0:
+            raise ValueError(f"profile steps must be positive, got {steps}")
+        if out_dir is None:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="areal_tpu_profile_")
+        prof = PhaseProfiler(
+            ProfilingConfig(enabled=True, steps=[0]), out_dir, "", ""
+        )
+        trace_dir = os.path.join(prof.trace_root, "step0")
+        with self._profile_lock:
+            # check-and-arm atomically: concurrent POST /profile handler
+            # threads must not silently overwrite each other's capture
+            if (
+                self._profile_pending is not None
+                or self._profile_stack is not None
+            ):
+                raise RuntimeError(
+                    "a profile capture is already in progress"
+                )
+            self._profile_pending = (int(steps), prof)
+        return trace_dir
+
+    def _maybe_start_profile(self):
+        with self._profile_lock:
+            if (
+                self._profile_pending is None
+                or self._profile_stack is not None
+            ):
+                return
+            steps, prof = self._profile_pending
+            # pending → running in one critical section: request_profile
+            # sees exactly one of the two slots occupied at all times
+            import contextlib
+
+            stack = contextlib.ExitStack()
+            self._profile_stack = stack
+            self._profile_pending = None
+            self._profile_left = steps
+        stack.enter_context(prof.step(0))
+
+    def _maybe_stop_profile(self, did_work: bool, force: bool = False):
+        if self._profile_stack is None:  # loop thread owns the stack
+            return
+        if did_work:
+            self._profile_left -= 1
+        if force or self._profile_left <= 0:
+            with self._profile_lock:
+                stack, self._profile_stack = self._profile_stack, None
+            try:
+                stack.close()
+            except Exception as e:  # profiling must never kill serving
+                logger.warning(f"profiler stop failed: {e}")
 
     def _drain_commands(self) -> bool:
         did = False
@@ -1903,6 +1991,9 @@ class GenerationEngine:
                 completion_tokens=len(req.output_ids), reason=reason,
                 model_version=self.model_version,
             )
+        # drop the rid's trace binding (an aborted request that resumes
+        # re-binds from its next /generate call's header)
+        self.tracer.unbind_trace(req.rid)
         result = {
             "output_ids": req.output_ids,
             "output_logprobs": req.output_logprobs,

@@ -42,10 +42,16 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from areal_tpu.api.cli_args import FleetConfig
+from areal_tpu.api.cli_args import FleetConfig, TracingConfig
 from areal_tpu.inference.fleet import FleetMonitor, ServerState
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils import name_resolve, names, network
+from areal_tpu.utils.tracing import (
+    RID_HEADER,
+    TRACE_HEADER,
+    SpanTracer,
+    trace_response,
+)
 
 logger = logging_util.getLogger("Router")
 
@@ -59,6 +65,7 @@ class RouterState:
         max_concurrent_rollouts: int = 10**9,
         schedule_policy: str = "least_token_usage",
         qid_cache_size: int = 8192,
+        tracing: Optional[TracingConfig] = None,
     ):
         self.lock = threading.Lock()
         self.addresses = list(addresses)
@@ -93,21 +100,49 @@ class RouterState:
         # unhealthy server (sticky/affinity target no longer schedulable)
         self.requests_migrated_total = 0  # affinity entries evicted from
         # a DEAD server — in-flight work forced to move
+        # router-side request spans: one `route` span per schedule
+        # decision, carrying the forwarded trace context so the router
+        # lands on the same stitched timeline as client and servers
+        self.tracer = SpanTracer(tracing, service="router")
 
     # -- scheduling ----------------------------------------------------
     def _schedulable(self, addr: str) -> bool:
         return self.fleet is None or self.fleet.is_schedulable(addr)
 
     def schedule(self, meta: Dict) -> Dict:
+        t0 = time.monotonic()
+        out = self._schedule(meta)
+        if self.tracer.enabled:
+            rid = str(meta.get("rid") or meta.get("qid") or "")
+            attrs = {
+                "server": out.get("url", ""),
+                "policy": self.schedule_policy,
+            }
+            trace = meta.get("trace_ctx")
+            if trace:
+                attrs["trace"] = str(trace)
+            if meta.get("exclude"):
+                attrs["excluded"] = list(meta["exclude"])
+            self.tracer.record("route", rid, t0, time.monotonic(), **attrs)
+        return out
+
+    def _schedule(self, meta: Dict) -> Dict:
+        # per-request exclusions: servers the CLIENT already failed this
+        # request on — never schedulable for it, even failing open
+        excl = set(meta.get("exclude") or ())
         with self.lock:
             self.sched_total += 1
             qid = str(meta.get("qid") or meta.get("rid") or "")
-            candidates = [a for a in self.addresses if self._schedulable(a)]
+            candidates = [
+                a for a in self.addresses
+                if a not in excl and self._schedulable(a)
+            ]
             if not candidates:
                 # fail open: a wholly-unhealthy verdict is likelier a
                 # probe outage than a fleet-wide loss; routing somewhere
-                # beats routing nowhere
-                candidates = list(self.addresses)
+                # beats routing nowhere — but never onto a server this
+                # request already failed on
+                candidates = [a for a in self.addresses if a not in excl]
             if not candidates:
                 # every server deregistered/drained away — an explicit
                 # error beats a 500 from an empty min()/modulo
@@ -369,6 +404,7 @@ class RouterState:
                 "qid_affinity_entries": len(self._qid_server),
                 "failovers_total": self.failovers_total,
                 "requests_migrated_total": self.requests_migrated_total,
+                "tracing_dropped_spans_total": float(self.tracer.dropped),
             }
         if self.fleet is not None:
             own.update(self.fleet.state_metrics())
@@ -459,6 +495,19 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/servers":
             self._send_json({"servers": self.state.addresses,
                              "version": self.state.version})
+        elif self.path.startswith("/trace"):
+            # drain the router's own span buffer (route spans), same
+            # contract as the generation server's GET /trace
+            import urllib.parse as _up
+
+            body, ctype = trace_response(
+                self.state.tracer, _up.urlparse(self.path).query
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/fleet":
             fleet = self.state.fleet
             self._send_json({
@@ -472,6 +521,14 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             payload = self._read_json()
             if self.path == "/schedule_request":
+                # forward the trace context riding the headers into the
+                # schedule decision (body wins when both are present)
+                trace_id = self.headers.get(TRACE_HEADER)
+                if trace_id and "trace_ctx" not in payload:
+                    payload["trace_ctx"] = trace_id
+                header_rid = self.headers.get(RID_HEADER)
+                if header_rid and "rid" not in payload:
+                    payload["rid"] = header_rid
                 self._send_json(self.state.schedule(payload))
             elif self.path == "/allocate_rollout":
                 self._send_json(self.state.allocate())
@@ -506,6 +563,7 @@ def serve_router(
     background: bool = True,
     fleet_config: Optional[FleetConfig] = None,
     probe_interval_s: float = 0.0,
+    tracing: Optional[TracingConfig] = None,
     **state_kwargs,
 ) -> ThreadingHTTPServer:
     """Start the router; discovers servers from name_resolve when
@@ -523,7 +581,7 @@ def serve_router(
         addresses = sorted(name_resolve.get_subtree(key))
     if not addresses:
         raise ValueError("router needs at least one generation server")
-    state = RouterState(addresses, **state_kwargs)
+    state = RouterState(addresses, tracing=tracing, **state_kwargs)
     cfg = fleet_config
     if cfg is None:
         cfg = FleetConfig(enabled=probe_interval_s > 0)
@@ -582,6 +640,10 @@ def main(argv=None):
         help="health-probe period in seconds (0 disables active probing)",
     )
     p.add_argument("--qid-cache-size", type=int, default=8192)
+    p.add_argument(
+        "--trace", action="store_true",
+        help="record per-schedule route spans (drain via GET /trace)",
+    )
     args = p.parse_args(argv)
     # rendezvous in the launcher's namespace (AREAL_NAME_RESOLVE): server
     # discovery AND the live membership watch both read that subtree
@@ -598,6 +660,7 @@ def main(argv=None):
         schedule_policy=args.schedule_policy,
         probe_interval_s=args.probe_interval,
         qid_cache_size=args.qid_cache_size,
+        tracing=TracingConfig(enabled=True) if args.trace else None,
     )
 
 

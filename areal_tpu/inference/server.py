@@ -12,7 +12,13 @@ work all happens on the engine's single loop thread.
 Observability endpoints: ``GET /metrics`` serves the engine gauges and
 counters in Prometheus text-exposition format; ``GET /trace`` DRAINS the
 engine's span buffer as Chrome trace-event JSON (``?format=jsonl`` for the
-line format `tools/trace_report.py` consumes).
+line format `tools/trace_report.py` consumes). ``/generate`` honors the
+``X-Areal-Trace`` / ``X-Areal-Rid`` trace-context headers: the incoming
+trace id is bound onto this server's spans so a rollout's client, router,
+and server(s) stitch into one timeline (utils/telemetry.py).
+``POST /profile?steps=N`` arms an on-demand jax.profiler capture of the
+next N busy engine-loop iterations (gated by ``--enable-profile`` on the
+CLI path, exactly like ``POST /chaos``).
 
 Resilience plane: ``POST /drain`` puts the server in drain mode — new
 ``/generate`` calls get 503, in-flight requests run to completion, and
@@ -40,7 +46,12 @@ from areal_tpu.inference.engine import GenerationEngine
 from areal_tpu.utils import chaos
 from areal_tpu.utils import logging as logging_util, names, network
 from areal_tpu.utils import name_resolve
-from areal_tpu.utils.tracing import render_prometheus
+from areal_tpu.utils.tracing import (
+    RID_HEADER,
+    TRACE_HEADER,
+    render_prometheus,
+    trace_response,
+)
 
 logger = logging_util.getLogger("GenServer")
 
@@ -103,6 +114,10 @@ _METRIC_HELP = {
     "spec_draft_tokens_total": "draft tokens proposed to verify dispatches",
     "spec_accepted_tokens_total": "draft tokens accepted by the model",
     "spec_chunks_total": "multi-token verify dispatches run",
+    "trace_spans": "spans currently buffered (drained by GET /trace)",
+    "tracing_dropped_spans_total": (
+        "spans lost to ring-buffer overflow (the trace is truncated)"
+    ),
 }
 
 
@@ -114,6 +129,10 @@ class _Handler(BaseHTTPRequestHandler):
     # bench harnesses) leaves it open. An open /chaos is a remote kill
     # switch — it must be an operator's opt-in, never a default.
     chaos_endpoint: bool = True
+    # same gating story for POST /profile: an open profiler endpoint
+    # lets anyone stall the engine loop under jax.profiler overhead, so
+    # the CLI path requires --enable-profile
+    profile_endpoint: bool = True
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet default access logs
@@ -202,15 +221,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/trace":
             # drains the engine's span buffer: a scraper polling /trace
             # assembles the full timeline without unbounded server memory
-            q = urllib.parse.parse_qs(url.query)
-            spans = eng.tracer.drain()
-            if q.get("format", [""])[0] == "jsonl":
-                body = "".join(
-                    json.dumps(s.to_dict()) + "\n" for s in spans
-                ).encode()
-                self._send_text(body, "application/jsonl")
-            else:
-                self._send_json(eng.tracer.to_chrome_trace(spans))
+            body, ctype = trace_response(eng.tracer, url.query)
+            self._send_text(body, ctype)
         else:
             self._send_json({"error": f"unknown path {self.path}"}, 404)
 
@@ -229,8 +241,38 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": "draining"}, 503)
                     return
                 payload = self._read_json()
+                # incoming trace context: bind the originating episode's
+                # trace id (and rid, when the body doesn't carry one)
+                # onto this server's spans so the fleet timeline stitches
+                header_rid = self.headers.get(RID_HEADER)
+                if header_rid and "rid" not in payload:
+                    payload["rid"] = header_rid
+                trace_id = self.headers.get(TRACE_HEADER)
+                if trace_id and "trace_ctx" not in payload:
+                    payload["trace_ctx"] = trace_id
                 result = eng.generate(payload)
                 self._send_json(result)
+            elif self.path.startswith("/profile"):
+                if not self.profile_endpoint:
+                    self._send_json(
+                        {"error": "profile endpoint disabled "
+                         "(start the server with --enable-profile)"}, 403
+                    )
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                payload = self._read_json()
+                steps = int(
+                    payload.get("steps", q.get("steps", ["1"])[0])
+                )
+                trace_dir = eng.request_profile(
+                    steps, payload.get("out_dir") or None
+                )
+                self._send_json(
+                    {"success": True, "steps": steps,
+                     "trace_dir": trace_dir}
+                )
             elif self.path == "/drain":
                 self._read_json()  # drain takes no arguments; drain the body
                 if self.control is None:
@@ -291,14 +333,20 @@ def serve(
     background: bool = False,
     router_addr: str = "",
     chaos_endpoint: bool = True,
+    profile_endpoint: bool = True,
 ) -> ThreadingHTTPServer:
     if port == 0:
         port = network.find_free_ports(1)[0]
+    tracer = getattr(engine, "tracer", None)  # stub engines have none
+    if tracer is not None and not tracer.service:
+        # label this process's spans for the stitched fleet timeline
+        tracer.service = f"server:{host}:{port}"
     control = ServerControl(engine)
     handler = type(
         "Handler", (_Handler,),
         {"engine": engine, "control": control,
-         "chaos_endpoint": chaos_endpoint},
+         "chaos_endpoint": chaos_endpoint,
+         "profile_endpoint": profile_endpoint},
     )
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
@@ -378,6 +426,11 @@ def main(argv: Optional[list] = None):
         help="open the runtime POST /chaos fault-injection endpoint "
         "(resilience testing only — it can hard-kill the server)",
     )
+    p.add_argument(
+        "--enable-profile", action="store_true",
+        help="open POST /profile?steps=N (on-demand jax.profiler "
+        "capture of the next N busy engine-loop iterations)",
+    )
     args = p.parse_args(argv)
     # subprocess servers rendezvous in the launcher's namespace: the
     # launcher exports AREAL_NAME_RESOLVE (e.g. "nfs:/shared/root") so
@@ -412,6 +465,7 @@ def main(argv: Optional[list] = None):
         server_index=args.server_index,
         router_addr=args.router_addr,
         chaos_endpoint=args.enable_chaos,
+        profile_endpoint=args.enable_profile,
     )
 
 

@@ -61,6 +61,7 @@ async def arequest_with_retry(
     retry_delay: float = 0.5,
     max_retry_delay: float = 30.0,
     jitter: float = 0.5,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Any]:
     last_exc: Optional[Exception] = None
     for attempt in range(max_retries):
@@ -82,7 +83,9 @@ async def arequest_with_retry(
                         )
             t = aiohttp.ClientTimeout(total=timeout)
             if method.upper() == "POST":
-                async with session.post(url, json=payload, timeout=t) as resp:
+                async with session.post(
+                    url, json=payload, timeout=t, headers=headers
+                ) as resp:
                     if resp.status != 200:
                         body = await resp.text()
                         raise HttpRequestError(
@@ -91,7 +94,9 @@ async def arequest_with_retry(
                         )
                     return await resp.json()
             else:
-                async with session.get(url, timeout=t) as resp:
+                async with session.get(
+                    url, timeout=t, headers=headers
+                ) as resp:
                     if resp.status != 200:
                         body = await resp.text()
                         raise HttpRequestError(

@@ -262,6 +262,16 @@ class RecoverHandler:
         write_atomic(
             os.path.join(target, "recover_info.pkl"), pickle.dumps(info)
         )
+        # trajectory lineage snapshot rides inside the commit protocol:
+        # a resumed run (or an offline `trace_report --lineage`) can
+        # reconstruct every sample's path as of this checkpoint
+        ledger = getattr(executor, "lineage", None)
+        if ledger is not None:
+            try:
+                n = ledger.dump_jsonl(os.path.join(target, "lineage.jsonl"))
+                logger.info(f"lineage snapshot: {n} record(s)")
+            except Exception as e:  # lineage must never block a commit
+                logger.warning(f"lineage snapshot failed: {e}")
         # the torn-checkpoint window: everything is on disk except the
         # marker — a crash HERE must leave the previous committed
         # checkpoint untouched and loadable

@@ -1,0 +1,887 @@
+"""End-to-end trajectory lineage + fleet telemetry hub.
+
+Three pieces, all feeding the same question — *what happened to this
+sample, and what is the fleet doing right now*:
+
+1. **Episode lineage context.** ``WorkflowExecutor._run_episode`` opens
+   an :class:`EpisodeLineage` in a contextvar before calling the
+   workflow; every ``agenerate`` inside the episode (asyncio child tasks
+   inherit the context) appends a :class:`RequestLineage` — which
+   servers served which token segments at which weight versions, and
+   how many failovers/migrations it took. The episode's ``trace_id`` is
+   the cross-process trace context: it survives retries and
+   suffix-resume migrations, so one chaos-y episode is still ONE
+   stitched timeline.
+
+2. **Lineage ledger.** :class:`LineageLedger` turns finished episodes
+   into per-sample records (uid → attempts, servers, per-segment weight
+   versions, reward, staleness at consumption, consuming step) that are
+   appended as JSONL on consumption and snapshotted alongside recover
+   checkpoints. ``tools/trace_report.py --lineage`` renders it.
+
+3. **Telemetry hub.** :class:`TelemetryCollector` scrapes every
+   generation server's ``/metrics`` and drains ``/trace`` on a thread
+   (reusing ``FleetMonitor`` membership when given one), computes
+   fleet-wide rollups (queue-wait p95, KV utilization, accept rate,
+   staleness distribution), runs deterministic anomaly rules (decode
+   stall, queue-wait breach, accept-rate collapse, staleness runaway —
+   each one 0/1 gauge + ERROR log, cleared symmetrically), and serves
+   the consolidated ``GET /metrics`` + run-manifest JSON — the inputs a
+   queue-wait/KV-util-driven autoscaler consumes. Fetchers and the
+   clock are injectable so the rules are unit-testable without sockets.
+
+:func:`stitch_chrome_traces` merges per-process trace exports (each
+with its own monotonic epoch) into one Perfetto-loadable timeline: one
+named process per source, clocks re-based via ``epoch_unix_s``, and
+flow arrows linking a migrated request's spans across servers.
+"""
+
+import contextvars
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from areal_tpu.api.cli_args import TelemetryConfig
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.tracing import (
+    SpanTracer,
+    new_trace_id,
+    parse_prometheus,
+    render_prometheus,
+)
+
+logger = logging_util.getLogger("telemetry")
+
+
+# --------------------------------------------------------------------------
+# Episode lineage context (producer side: remote-engine agenerate)
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class RequestLineage:
+    """One generation request's path through the fleet."""
+
+    rid: str
+    attempt: int = 0
+    # one entry per /generate chunk, consecutive same-server/same-version
+    # chunks merged: {"server", "versions": [..], "tokens"}
+    segments: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    failovers: int = 0
+    migrations: int = 0
+
+    def add_segment(
+        self, server: str, tokens: int, versions: Iterable[int]
+    ) -> None:
+        vs = sorted(set(int(v) for v in versions))
+        if (
+            self.segments
+            and self.segments[-1]["server"] == server
+            and self.segments[-1]["versions"] == vs
+        ):
+            self.segments[-1]["tokens"] += int(tokens)
+            return
+        self.segments.append(
+            {"server": server, "versions": vs, "tokens": int(tokens)}
+        )
+
+    @property
+    def servers(self) -> List[str]:
+        out: List[str] = []
+        for s in self.segments:
+            if not out or out[-1] != s["server"]:
+                out.append(s["server"])
+        return out
+
+    @property
+    def weight_versions(self) -> List[int]:
+        vs: set = set()
+        for s in self.segments:
+            vs.update(s["versions"])
+        return sorted(vs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rid": self.rid,
+            "attempt": self.attempt,
+            "servers": self.servers,
+            "weight_versions": self.weight_versions,
+            "segments": list(self.segments),
+            "failovers": self.failovers,
+            "migrations": self.migrations,
+            "output_tokens": sum(s["tokens"] for s in self.segments),
+        }
+
+
+class EpisodeLineage:
+    """Per-episode accumulation: the trace context plus every request's
+    lineage, across all retry attempts. Mutated only from the executor's
+    asyncio loop thread; read by the executor thread after the episode
+    settles (happens-after via the task result)."""
+
+    def __init__(self, uid: str, trace_id: Optional[str] = None):
+        self.uid = uid
+        self.trace_id = trace_id or new_trace_id()
+        self.attempt = 0  # current attempt (0-based), bumped per retry
+        self.requests: List[RequestLineage] = []
+
+    def add_request(self, rl: RequestLineage) -> None:
+        self.requests.append(rl)
+
+
+_EPISODE: "contextvars.ContextVar[Optional[EpisodeLineage]]" = (
+    contextvars.ContextVar("areal_episode_lineage", default=None)
+)
+
+
+def current_episode() -> Optional[EpisodeLineage]:
+    return _EPISODE.get()
+
+
+def set_episode(ep: Optional[EpisodeLineage]):
+    """Install the episode context; returns the reset token."""
+    return _EPISODE.set(ep)
+
+
+def reset_episode(token) -> None:
+    _EPISODE.reset(token)
+
+
+# --------------------------------------------------------------------------
+# Lineage ledger (assembled by WorkflowExecutor)
+# --------------------------------------------------------------------------
+class LineageLedger:
+    """Bounded per-sample lineage records, keyed by uid. A record is
+    created when the episode settles (collected / rejected /
+    quarantined) and completed when wait() hands the sample to the
+    trainer (consuming step + staleness at consumption); consumed
+    records are appended to ``path`` as JSONL when one is set."""
+
+    def __init__(self, path: str = "", max_records: int = 8192):
+        self.path = path
+        self.max_records = max(1, max_records)
+        self._lock = threading.Lock()
+        self._records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def record_episode(
+        self,
+        ep: EpisodeLineage,
+        status: str,
+        rewards: Optional[List[float]] = None,
+    ) -> Dict[str, Any]:
+        servers: List[str] = []
+        versions: set = set()
+        for rl in ep.requests:
+            for s in rl.servers:
+                if s not in servers:
+                    servers.append(s)
+            versions.update(rl.weight_versions)
+        rec = {
+            "uid": ep.uid,
+            "trace_id": ep.trace_id,
+            "status": status,
+            "attempts": ep.attempt + 1,
+            "requests": [rl.to_dict() for rl in ep.requests],
+            "servers": servers,
+            "weight_versions": sorted(versions),
+            "failovers": sum(rl.failovers for rl in ep.requests),
+            "migrations": sum(rl.migrations for rl in ep.requests),
+            "rewards": (
+                [float(r) for r in rewards] if rewards is not None else None
+            ),
+        }
+        with self._lock:
+            self._records[ep.uid] = rec
+            self._records.move_to_end(ep.uid)
+            while len(self._records) > self.max_records:
+                self._records.popitem(last=False)
+        return rec
+
+    def mark_consumed(
+        self, uids: Iterable[str], step: int, trainer_version: int
+    ) -> int:
+        """Stamp the consuming train step + staleness-at-consumption on
+        the named records; append them to the JSONL sink. Returns how
+        many records were stamped (uids without a record — e.g. evicted
+        under the bound — are skipped, not invented)."""
+        stamped: List[Dict[str, Any]] = []
+        with self._lock:
+            for uid in uids:
+                rec = self._records.get(uid)
+                if rec is None or rec.get("consumed_step") is not None:
+                    continue
+                rec["consumed_step"] = int(step)
+                rec["consumed_version"] = int(trainer_version)
+                vs = rec["weight_versions"]
+                rec["staleness_max"] = (
+                    int(trainer_version) - min(vs) if vs else 0
+                )
+                rec["staleness_min"] = (
+                    int(trainer_version) - max(vs) if vs else 0
+                )
+                stamped.append(dict(rec))
+        if stamped and self.path:
+            try:
+                with open(self.path, "a") as f:
+                    for rec in stamped:
+                        f.write(json.dumps(rec) + "\n")
+            except OSError as e:  # the ledger must never kill training
+                logger.warning(f"lineage append to {self.path} failed: {e}")
+        return len(stamped)
+
+    def get(self, uid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._records.get(uid)
+            return dict(rec) if rec is not None else None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._records.values()]
+
+    def staleness_values(self) -> List[int]:
+        """Staleness-at-consumption of every consumed record still in
+        the window (the hub's staleness-runaway input)."""
+        with self._lock:
+            return [
+                int(r["staleness_max"])
+                for r in self._records.values()
+                if r.get("consumed_step") is not None
+                and r.get("staleness_max") is not None
+            ]
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write EVERY current record (consumed or not) — the recover
+        checkpoint snapshot."""
+        recs = self.snapshot()
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+        return len(recs)
+
+
+# --------------------------------------------------------------------------
+# Cross-process trace stitching
+# --------------------------------------------------------------------------
+def _spans_from_chrome(doc: Dict[str, Any]) -> Tuple[List[Dict], float, str]:
+    """Chrome trace doc → (span dicts with monotonic ts, epoch, service)."""
+    other = doc.get("otherData", {}) or {}
+    epoch = float(other.get("epoch_unix_s", 0.0))
+    service = str(other.get("service", ""))
+    spans = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {}) or {}
+        spans.append(
+            {
+                "name": e.get("name", ""),
+                "rid": str(args.get("rid", "")),
+                "ts": float(e.get("ts", 0.0)) / 1e6,
+                "dur": float(e.get("dur", 0.0)) / 1e6,
+                "attrs": {k: v for k, v in args.items() if k != "rid"},
+            }
+        )
+    return spans, epoch, service
+
+
+def normalize_source(source: Any, label: str = "") -> Dict[str, Any]:
+    """Accepts a SpanTracer, a chrome trace doc, or (spans, epoch) and
+    returns ``{"label", "spans", "epoch"}`` with span dicts."""
+    if isinstance(source, SpanTracer):
+        spans = [s.to_dict() for s in source.snapshot()]
+        for d in spans:
+            d.setdefault("attrs", {})
+        return {
+            "label": label or source.service or "tracer",
+            "spans": spans,
+            "epoch": source.epoch_unix_s,
+        }
+    if isinstance(source, dict) and "traceEvents" in source:
+        spans, epoch, service = _spans_from_chrome(source)
+        return {
+            "label": label or service or "trace",
+            "spans": spans,
+            "epoch": epoch,
+        }
+    spans, epoch = source
+    out = []
+    for s in spans:
+        d = s.to_dict() if hasattr(s, "to_dict") else dict(s)
+        d.setdefault("attrs", {})
+        out.append(d)
+    return {"label": label or "trace", "spans": out, "epoch": float(epoch)}
+
+
+def stitch_chrome_traces(
+    sources: List[Tuple[str, Any]]
+) -> Dict[str, Any]:
+    """Merge per-process traces into ONE Perfetto-loadable document.
+
+    ``sources`` is ``[(label, source), ...]`` where each source is a
+    SpanTracer, a chrome trace doc (``GET /trace`` body), or a
+    ``(spans, epoch_unix_s)`` pair. Each source becomes its own named
+    process (pid); every span's monotonic timestamp is re-based through
+    its source's unix epoch onto one shared timeline. Migration flow
+    arrows (``ph:"s"/"f"``) link (a) a rid's server-side ``request``
+    spans across different processes — the suffix-resume hop — and (b) a
+    client ``migration`` instant to the first post-hop
+    ``generate_call``."""
+    norm = [normalize_source(src, label) for label, src in sources]
+    base = None
+    for src in norm:
+        for s in src["spans"]:
+            t = s["ts"] + src["epoch"]
+            base = t if base is None or t < base else base
+    base = base or 0.0
+    events: List[Dict[str, Any]] = []
+    # (rid, pid) placements of server `request` spans + client hops, for
+    # the flow pass below. Entries: (t_start_us, dur_us, pid, tid, attrs)
+    req_spans: Dict[str, List[Tuple[float, float, int, int, Dict]]] = {}
+    mig_instants: Dict[str, List[Tuple[float, int, int]]] = {}
+    gen_calls: Dict[str, List[Tuple[float, float, int, int, Dict]]] = {}
+    for pid, src in enumerate(norm, start=1):
+        tids: Dict[str, int] = {}
+        for s in src["spans"]:
+            rid = s.get("rid", "")
+            tid = tids.setdefault(rid, len(tids) + 1)
+            ts_us = (s["ts"] + src["epoch"] - base) * 1e6
+            dur_us = max(0.0, s.get("dur", 0.0)) * 1e6
+            attrs = s.get("attrs", {}) or {}
+            events.append(
+                {
+                    "name": s["name"],
+                    "cat": "areal_tpu",
+                    "ph": "X",
+                    "ts": ts_us,
+                    "dur": dur_us,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"rid": rid, **attrs},
+                }
+            )
+            if s["name"] == "request":
+                req_spans.setdefault(rid, []).append(
+                    (ts_us, dur_us, pid, tid, attrs)
+                )
+            elif s["name"] == "migration":
+                mig_instants.setdefault(rid, []).append((ts_us, pid, tid))
+            elif s["name"] == "generate_call":
+                gen_calls.setdefault(rid, []).append(
+                    (ts_us, dur_us, pid, tid, attrs)
+                )
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": src["label"]},
+            }
+        )
+        for rid, tid in tids.items():
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": rid},
+                }
+            )
+    flow_id = 0
+    # (a) the same rid served by request spans in DIFFERENT processes:
+    # chain them in time order — the migration, visible as an arrow
+    for rid, spans in req_spans.items():
+        # sort on the numeric prefix only — the trailing attrs dicts are
+        # not comparable, and ties would otherwise TypeError
+        spans.sort(key=lambda x: x[:4])
+        for a, b in zip(spans, spans[1:]):
+            if a[2] == b[2]:
+                continue  # same process: a resume, not a migration
+            flow_id += 1
+            events.append(
+                {
+                    "name": "migration", "cat": "areal_tpu", "ph": "s",
+                    "id": flow_id, "pid": a[2], "tid": a[3],
+                    "ts": a[0] + a[1],
+                }
+            )
+            events.append(
+                {
+                    "name": "migration", "cat": "areal_tpu", "ph": "f",
+                    "bp": "e", "id": flow_id, "pid": b[2], "tid": b[3],
+                    "ts": b[0],
+                }
+            )
+    # (b) client migration instant → first generate_call after it
+    for rid, migs in mig_instants.items():
+        calls = sorted(gen_calls.get(rid, []), key=lambda x: x[:4])
+        for ts_us, pid, tid in migs:
+            nxt = next((c for c in calls if c[0] >= ts_us), None)
+            if nxt is None:
+                continue
+            flow_id += 1
+            events.append(
+                {
+                    "name": "resume", "cat": "areal_tpu", "ph": "s",
+                    "id": flow_id, "pid": pid, "tid": tid, "ts": ts_us,
+                }
+            )
+            events.append(
+                {
+                    "name": "resume", "cat": "areal_tpu", "ph": "f",
+                    "bp": "e", "id": flow_id, "pid": nxt[2], "tid": nxt[3],
+                    "ts": nxt[0],
+                }
+            )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "stitched": True,
+            "services": [src["label"] for src in norm],
+            "base_unix_s": base,
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# Telemetry hub
+# --------------------------------------------------------------------------
+def _default_fetch_metrics(addr: str, timeout: float) -> Dict[str, float]:
+    with urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=timeout
+    ) as r:
+        return parse_prometheus(r.read().decode(), prefix="areal_tpu_gen_")
+
+
+def _default_fetch_trace(
+    addr: str, timeout: float
+) -> Tuple[List[Dict], float, int]:
+    """Drain one server's span buffer: (span dicts, epoch, dropped)."""
+    with urllib.request.urlopen(
+        f"http://{addr}/trace", timeout=timeout
+    ) as r:
+        doc = json.loads(r.read())
+    spans, epoch, _ = _spans_from_chrome(doc)
+    dropped = int((doc.get("otherData", {}) or {}).get("dropped_spans", 0))
+    return spans, epoch, dropped
+
+
+class _ServerScrape:
+    __slots__ = (
+        "metrics", "ok", "stall_scrapes", "scrape_failures", "spans",
+        "epoch", "dropped_spans",
+    )
+
+    def __init__(self, span_window: int):
+        self.metrics: Dict[str, float] = {}
+        self.ok = False  # last sweep reached the server
+        self.stall_scrapes = 0  # consecutive decode-stall observations
+        self.scrape_failures = 0
+        self.spans: "deque[Dict]" = deque(maxlen=span_window)
+        self.epoch = 0.0
+        self.dropped_spans = 0
+
+
+# which anomaly gauge each rule drives (all exported even when 0, so a
+# dashboard alert can key on the name before the first incident)
+ANOMALIES = (
+    "anomaly_decode_stall",
+    "anomaly_queue_wait",
+    "anomaly_accept_collapse",
+    "anomaly_staleness",
+)
+
+
+class TelemetryCollector:
+    """Fleet-wide scrape → rollup → anomaly plane (one per run)."""
+
+    def __init__(
+        self,
+        addresses: Optional[List[str]] = None,
+        fleet=None,  # FleetMonitor: live membership + health states
+        config: Optional[TelemetryConfig] = None,
+        ledger: Optional[LineageLedger] = None,
+        fetch_metrics_fn: Optional[Callable[[str], Dict[str, float]]] = None,
+        fetch_trace_fn: Optional[
+            Callable[[str], Tuple[List[Dict], float, int]]
+        ] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or TelemetryConfig()
+        self._static_addresses = list(addresses or [])
+        self.fleet = fleet
+        self.ledger = ledger
+        timeout = max(1.0, self.config.scrape_interval_s)
+        self._fetch_metrics = fetch_metrics_fn or (
+            lambda a: _default_fetch_metrics(a, timeout)
+        )
+        self._fetch_trace = fetch_trace_fn or (
+            lambda a: _default_fetch_trace(a, timeout)
+        )
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._servers: Dict[str, _ServerScrape] = {}
+        self._anomalies: Dict[str, bool] = {a: False for a in ANOMALIES}
+        self.scrapes_total = 0
+        self.scrape_failures_total = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- membership ----------------------------------------------------
+    def addresses(self) -> List[str]:
+        """Scrape set: FleetMonitor membership when given one (the hub
+        follows joins/leaves live), else the static seed list."""
+        if self.fleet is not None:
+            addrs = list(self.fleet.addresses())
+            for a in self._static_addresses:
+                if a not in addrs:
+                    addrs.append(a)
+            return addrs
+        return list(self._static_addresses)
+
+    # -- scraping ------------------------------------------------------
+    def scrape_once(self) -> None:
+        addrs = self.addresses()
+        with self._lock:
+            # forget departed servers (their history must not pin
+            # anomaly state for a fleet they left)
+            for gone in set(self._servers) - set(addrs):
+                del self._servers[gone]
+            for a in addrs:
+                if a not in self._servers:
+                    self._servers[a] = _ServerScrape(
+                        self.config.span_window
+                    )
+        for addr in addrs:
+            try:
+                m = self._fetch_metrics(addr)
+                ok = True
+            except Exception:
+                m, ok = {}, False
+            spans: List[Dict] = []
+            epoch = None
+            dropped = None
+            if ok and self.config.drain_traces:
+                try:
+                    spans, epoch, dropped = self._fetch_trace(addr)
+                except Exception:
+                    pass  # trace drain is best-effort; metrics landed
+            with self._lock:
+                st = self._servers.get(addr)
+                if st is None:  # left the fleet mid-sweep
+                    continue
+                st.ok = ok
+                if ok:
+                    st.metrics = m
+                    stalled = (
+                        m.get("running_requests", 0) > 0
+                        and m.get("decode_tokens_per_sec", 0) <= 0
+                    )
+                    st.stall_scrapes = st.stall_scrapes + 1 if stalled else 0
+                else:
+                    st.scrape_failures += 1
+                    self.scrape_failures_total += 1
+                st.spans.extend(spans)
+                if epoch is not None:
+                    st.epoch = epoch
+                if dropped is not None:
+                    st.dropped_spans = dropped
+        with self._lock:
+            self.scrapes_total += 1
+        self._evaluate_anomalies()
+
+    # -- rollups -------------------------------------------------------
+    @staticmethod
+    def _pctl(vals: List[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+        return vals[idx]
+
+    def rollup(self) -> Dict[str, float]:
+        """Fleet-wide gauges from the last sweep's per-server scrapes
+        (plus the bounded span window for latency percentiles)."""
+        with self._lock:
+            servers = dict(self._servers)
+            scraped = [s for s in servers.values() if s.ok]
+            qws = [
+                float(sp.get("dur", 0.0))
+                for s in servers.values()
+                for sp in s.spans
+                if sp.get("name") == "queue_wait"
+            ]
+            anomalies = dict(self._anomalies)
+            out = {
+                "servers_total": float(len(servers)),
+                "servers_scraped": float(len(scraped)),
+                "scrapes_total": float(self.scrapes_total),
+                "scrape_failures_total": float(self.scrape_failures_total),
+            }
+
+        def ssum(key: str) -> float:
+            return float(sum(s.metrics.get(key, 0.0) for s in scraped))
+
+        utils = [
+            s.metrics["kv_page_utilization"]
+            for s in scraped
+            if "kv_page_utilization" in s.metrics
+        ]
+        out.update(
+            running_requests=ssum("running_requests"),
+            queued_requests=ssum("queued_requests"),
+            decode_tokens_per_sec=ssum("decode_tokens_per_sec"),
+            prefill_tokens_per_sec=ssum("prefill_tokens_per_sec"),
+            generated_tokens_total=ssum("total_generated_tokens"),
+            preemptions_total=ssum("total_preemptions"),
+            kv_page_utilization_mean=(
+                float(sum(utils) / len(utils)) if utils else 0.0
+            ),
+            kv_page_utilization_max=float(max(utils)) if utils else 0.0,
+            queue_wait_p50_s=self._pctl(qws, 0.50),
+            queue_wait_p95_s=self._pctl(qws, 0.95),
+            queue_wait_samples=float(len(qws)),
+            # ring-overflow visibility across the fleet (satellite:
+            # truncated traces must not read as complete)
+            tracing_dropped_spans_total=float(
+                sum(s.dropped_spans for s in servers.values())
+            ),
+        )
+        drafted = ssum("spec_draft_tokens_total")
+        accepted = ssum("spec_accepted_tokens_total")
+        out.update(
+            spec_enabled_servers=ssum("spec_enabled"),
+            spec_draft_tokens_total=drafted,
+            spec_accepted_tokens_total=accepted,
+            spec_accept_rate=(accepted / drafted) if drafted else 0.0,
+        )
+        if self.ledger is not None:
+            st = [float(v) for v in self.ledger.staleness_values()]
+            out.update(
+                staleness_p50=self._pctl(st, 0.50),
+                staleness_max=float(max(st)) if st else 0.0,
+                staleness_samples=float(len(st)),
+            )
+        for name, active in anomalies.items():
+            out[name] = float(active)
+        return out
+
+    # -- anomaly rules (deterministic; symmetric set/clear) ------------
+    def _evaluate_anomalies(self) -> None:
+        cfg = self.config
+        with self._lock:
+            servers = dict(self._servers)
+            scraped = {a: s for a, s in servers.items() if s.ok}
+            qws = [
+                float(sp.get("dur", 0.0))
+                for s in servers.values()
+                for sp in s.spans
+                if sp.get("name") == "queue_wait"
+            ]
+        stalled = [
+            a
+            for a, s in scraped.items()
+            if s.stall_scrapes >= max(1, cfg.decode_stall_scrapes)
+        ]
+        self._set_anomaly(
+            "anomaly_decode_stall",
+            bool(stalled),
+            f"decode stalled on {stalled}: running_requests > 0 with "
+            f"decode_tokens_per_sec == 0 for >= "
+            f"{cfg.decode_stall_scrapes} scrapes",
+        )
+        p95 = self._pctl(qws, 0.95)
+        self._set_anomaly(
+            "anomaly_queue_wait",
+            bool(qws) and p95 > cfg.queue_wait_p95_s,
+            f"fleet queue-wait p95 {p95:.2f}s > {cfg.queue_wait_p95_s}s",
+        )
+        drafted = sum(
+            s.metrics.get("spec_draft_tokens_total", 0.0)
+            for s in scraped.values()
+        )
+        accepted = sum(
+            s.metrics.get("spec_accepted_tokens_total", 0.0)
+            for s in scraped.values()
+        )
+        spec_on = any(
+            s.metrics.get("spec_enabled", 0.0) > 0 for s in scraped.values()
+        )
+        rate = (accepted / drafted) if drafted else 1.0
+        self._set_anomaly(
+            "anomaly_accept_collapse",
+            spec_on
+            and drafted >= cfg.min_draft_tokens
+            and rate < cfg.accept_rate_floor,
+            f"fleet accept rate {rate:.3f} < {cfg.accept_rate_floor} "
+            f"over {int(drafted)} drafted tokens",
+        )
+        st_max = 0
+        if self.ledger is not None:
+            vals = self.ledger.staleness_values()
+            st_max = max(vals) if vals else 0
+        self._set_anomaly(
+            "anomaly_staleness",
+            st_max > cfg.staleness_max,
+            f"staleness at consumption reached {st_max} versions "
+            f"(> {cfg.staleness_max})",
+        )
+
+    def _set_anomaly(self, name: str, active: bool, detail: str) -> None:
+        with self._lock:
+            changed = self._anomalies[name] != active
+            self._anomalies[name] = active
+        if not changed:
+            return
+        if active:
+            logger.error(f"ANOMALY {name}: {detail}")
+        else:
+            logger.info(f"anomaly cleared: {name}")
+
+    def anomalies(self) -> Dict[str, bool]:
+        with self._lock:
+            return dict(self._anomalies)
+
+    # -- exports -------------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        return self.rollup()
+
+    def render_metrics(self) -> str:
+        return render_prometheus(self.rollup(), prefix="areal_tpu_fleet_")
+
+    def manifest(self) -> Dict[str, Any]:
+        """Run manifest: the consolidated fleet view as one JSON doc
+        (what ``trace_report --fleet`` renders and an autoscaler reads)."""
+        with self._lock:
+            servers = {
+                a: {
+                    "reachable": s.ok,
+                    "scrape_failures": s.scrape_failures,
+                    "stall_scrapes": s.stall_scrapes,
+                    "dropped_spans": s.dropped_spans,
+                    "metrics": dict(s.metrics),
+                }
+                for a, s in self._servers.items()
+            }
+        if self.fleet is not None:
+            try:
+                for a, info in self.fleet.per_server().items():
+                    servers.setdefault(a, {})["state"] = info["state"]
+            except Exception:
+                pass
+        return {
+            "servers": servers,
+            "rollup": self.rollup(),
+            "anomalies": self.anomalies(),
+            "lineage_records": len(self.ledger) if self.ledger else 0,
+        }
+
+    def stitched_trace(
+        self, extra_sources: Optional[List[Tuple[str, Any]]] = None
+    ) -> Dict[str, Any]:
+        """One Perfetto doc over every server's drained spans (bounded
+        window) plus any extra sources (client/router tracers)."""
+        with self._lock:
+            sources: List[Tuple[str, Any]] = [
+                (f"server:{a}", (list(s.spans), s.epoch))
+                for a, s in self._servers.items()
+                if s.spans
+            ]
+        sources.extend(extra_sources or [])
+        return stitch_chrome_traces(sources)
+
+    # -- background loop + hub endpoint --------------------------------
+    def start(self) -> "TelemetryCollector":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="telemetry-collector"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+
+    def _loop(self) -> None:
+        interval = max(0.05, self.config.scrape_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self.scrape_once()
+            except Exception as e:  # the hub must never die
+                logger.error(f"telemetry sweep failed: {e}")
+
+    def serve(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> ThreadingHTTPServer:
+        """Expose the consolidated plane: ``GET /metrics`` (Prometheus,
+        ``areal_tpu_fleet_`` prefix), ``GET /manifest`` (run-manifest
+        JSON), ``GET /trace`` (stitched fleet timeline), ``/health``."""
+        collector = self
+
+        class _HubHandler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(
+                        collector.render_metrics().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif self.path == "/manifest":
+                    self._send(
+                        json.dumps(collector.manifest()).encode(),
+                        "application/json",
+                    )
+                elif self.path == "/trace":
+                    self._send(
+                        json.dumps(collector.stitched_trace()).encode(),
+                        "application/json",
+                    )
+                elif self.path == "/health":
+                    self._send(b'{"status": "ok"}', "application/json")
+                else:
+                    self._send(
+                        json.dumps(
+                            {"error": f"unknown path {self.path}"}
+                        ).encode(),
+                        "application/json",
+                        404,
+                    )
+
+        host = host if host is not None else self.config.host
+        port = port if port is not None else self.config.port
+        if port == 0:
+            from areal_tpu.utils import network
+
+            port = network.find_free_ports(1)[0]
+        httpd = ThreadingHTTPServer((host, port), _HubHandler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        logger.info(f"telemetry hub on {host}:{port}")
+        return httpd

@@ -23,16 +23,43 @@ Design constraints, in order:
    per span, rows grouped per rid via stable tids).
 
 Span times are `time.monotonic()` seconds; exports convert to the
-microseconds the trace-event format wants.
+microseconds the trace-event format wants. Each tracer also remembers
+the unix time of its monotonic epoch (``epoch_unix_s``) so traces from
+DIFFERENT processes — each with its own monotonic zero — can be shifted
+onto one shared timeline (``utils/telemetry.stitch_chrome_traces``).
+
+Cross-process trace context (r9): requests propagate a trace id over
+HTTP via the ``X-Areal-Trace`` / ``X-Areal-Rid`` headers. A receiving
+process calls ``bind_trace(rid, trace_id)`` and every span it records
+for that rid carries a ``trace`` attr — the join key that stitches
+client, router, and server spans into one end-to-end timeline.
 """
 
 import json
 import threading
 import time
-from collections import deque
+import uuid
+from collections import OrderedDict, deque
 from typing import Any, Dict, Iterable, List, Optional
 
 from areal_tpu.api.cli_args import TracingConfig
+
+# HTTP propagation headers: the trace id (one per rollout episode,
+# surviving retries and suffix-resume migrations) and the request id
+TRACE_HEADER = "X-Areal-Trace"
+RID_HEADER = "X-Areal-Rid"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def trace_headers(trace_id: str, rid: str = "") -> Dict[str, str]:
+    """Outbound header dict for one traced request."""
+    h = {TRACE_HEADER: trace_id}
+    if rid:
+        h[RID_HEADER] = rid
+    return h
 
 
 class Span:
@@ -115,17 +142,55 @@ class _LiveSpanCtx:
 class SpanTracer:
     """Thread-safe bounded span recorder; strict no-op when disabled."""
 
-    def __init__(self, config: Optional[TracingConfig] = None):
+    # rid → trace-id bindings kept at most this many at a time (the live
+    # request set, not history — completed requests unbind)
+    MAX_TRACE_BINDINGS = 8192
+
+    def __init__(
+        self, config: Optional[TracingConfig] = None, service: str = ""
+    ):
         self.config = config or TracingConfig()
+        # which process/role recorded these spans ("client", "router",
+        # "server:<addr>"): stitched multi-process exports group rows
+        # under one named track per service
+        self.service = service
+        # unix time of this process's monotonic zero: ts_unix = ts + epoch
+        self.epoch_unix_s = time.time() - time.monotonic()
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(
             maxlen=max(1, self.config.max_spans)
         )
+        # incoming trace context per live rid (LRU-bounded)
+        self._trace_ids: "OrderedDict[str, str]" = OrderedDict()
         self.dropped = 0
 
     @property
     def enabled(self) -> bool:
         return self.config.enabled
+
+    # ------------------------------------------------------------------
+    # Cross-process trace context
+    # ------------------------------------------------------------------
+    def bind_trace(self, rid: str, trace_id: str) -> None:
+        """Attach an incoming trace id to a rid: every span recorded for
+        that rid until ``unbind_trace`` carries a ``trace`` attr."""
+        if not self.config.enabled or not trace_id:
+            return
+        with self._lock:
+            self._trace_ids[rid] = trace_id
+            self._trace_ids.move_to_end(rid)
+            while len(self._trace_ids) > self.MAX_TRACE_BINDINGS:
+                self._trace_ids.popitem(last=False)
+
+    def unbind_trace(self, rid: str) -> None:
+        if not self.config.enabled:
+            return
+        with self._lock:
+            self._trace_ids.pop(rid, None)
+
+    def trace_of(self, rid: str) -> Optional[str]:
+        with self._lock:
+            return self._trace_ids.get(rid)
 
     # ------------------------------------------------------------------
     # Recording
@@ -137,7 +202,13 @@ class SpanTracer:
         if not self.config.enabled:
             return
         with self._lock:
+            tr = self._trace_ids.get(rid)
+            if tr is not None and "trace" not in attrs:
+                attrs["trace"] = tr
             if len(self._spans) == self._spans.maxlen:
+                # ring overflow: the oldest span silently vanishing would
+                # make a truncated trace read as a complete one — count it
+                # (exported as tracing_dropped_spans_total on /metrics)
                 self.dropped += 1
             self._spans.append(Span(name, rid, t_start, t_end, attrs))
 
@@ -204,7 +275,26 @@ class SpanTracer:
             }
             for rid, tid in tids.items()
         ]
-        return {"traceEvents": events + meta, "displayTimeUnit": "ms"}
+        if self.service:
+            meta.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "args": {"name": self.service},
+                }
+            )
+        return {
+            "traceEvents": events + meta,
+            "displayTimeUnit": "ms",
+            # cross-process stitching needs to re-base each process's
+            # monotonic clock; dropped makes ring truncation visible
+            "otherData": {
+                "service": self.service,
+                "epoch_unix_s": self.epoch_unix_s,
+                "dropped_spans": self.dropped,
+            },
+        }
 
     def export_chrome(self, path: str, drain: bool = False) -> None:
         spans = self.drain() if drain else self.snapshot()
@@ -226,8 +316,57 @@ class SpanTracer:
 
 
 # --------------------------------------------------------------------------
+# HTTP export helpers
+# --------------------------------------------------------------------------
+def trace_response(tracer: "SpanTracer", query: str):
+    """The one GET /trace contract (generation server AND router):
+    DRAIN the tracer's buffer; ``?format=jsonl`` yields the line format
+    ``tools/trace_report.py`` consumes, anything else the Chrome
+    trace-event document. Returns ``(body_bytes, content_type)``."""
+    import urllib.parse
+
+    spans = tracer.drain()
+    fmt = urllib.parse.parse_qs(query).get("format", [""])[0]
+    if fmt == "jsonl":
+        body = "".join(
+            json.dumps(s.to_dict()) + "\n" for s in spans
+        ).encode()
+        return body, "application/jsonl"
+    return (
+        json.dumps(tracer.to_chrome_trace(spans)).encode(),
+        "application/json",
+    )
+
+
+# --------------------------------------------------------------------------
 # Prometheus text exposition
 # --------------------------------------------------------------------------
+def parse_prometheus(text: str, prefix: str = "") -> Dict[str, float]:
+    """Inverse of ``render_prometheus`` for scrape aggregation: flat
+    ``{name: value}`` from text exposition. HELP/TYPE preambles are
+    skipped; a label suffix (``name{...}``) is stripped to the base name
+    (last sample wins); ``prefix`` is removed from matching names and
+    non-matching names are kept verbatim."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            continue
+        if "{" in key:
+            key = key[: key.index("{")]
+        if prefix and key.startswith(prefix):
+            key = key[len(prefix):]
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+
 def _prom_type(name: str, types: Optional[Dict[str, str]]) -> str:
     if types and name in types:
         return types[name]

@@ -25,14 +25,20 @@ def main():
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
     cfg = tiny_config("qwen2")
     params = init_params(cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
-    eng = GenerationEngine(
-        JaxGenConfig(
-            dtype="float32", max_num_seqs=4, max_model_len=64,
-            prefill_chunk=16,
-        ),
-        model_config=cfg,
-        params=params,
-    ).start()
+    gcfg = JaxGenConfig(
+        dtype="float32", max_num_seqs=4, max_model_len=64,
+        prefill_chunk=16,
+    )
+    if os.environ.get("AREAL_WORKER_TRACE"):
+        # request-lifecycle spans for stitched cross-process trace tests
+        gcfg.tracing.enabled = True
+    eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+    # lineage tests label servers with distinct weight VERSIONS while
+    # keeping identical seed-0 weights (version is an accounting label;
+    # greedy token streams stay comparable across the pair)
+    init_version = os.environ.get("AREAL_INIT_VERSION")
+    if init_version:
+        eng.model_version = int(init_version)
     httpd = serve(eng, host="127.0.0.1", port=0, background=True)
     print(f"PORT {httpd.server_address[1]}", flush=True)
     sys.stdin.read()  # parent closes stdin to stop us

@@ -64,27 +64,61 @@ def _wait_port(proc, lines, deadline):
 
 
 @pytest.fixture(scope="module")
-def two_servers():
-    """(victim_addr, survivor_addr): same seed-0 weights; the victim
-    carries the chaos kill rule in its environment."""
-    victim, vlines = _spawn_worker({"AREAL_CHAOS": VICTIM_CHAOS})
-    survivor, slines = _spawn_worker()
-    deadline = time.monotonic() + 240
+def survivor_server():
+    """One long-lived survivor shared by BOTH chaos tests (each test
+    brings its own victim): tracing on (needed by the stitch test,
+    harmless to the kill test) and weight-version LABEL 1 over the same
+    seed-0 weights (versions are accounting, not tokens — the kill
+    test's token-exact assertion is version-blind). Yields a LAZY
+    getter so each test's victim boots concurrently with it — the
+    fixture body spawns and returns immediately; the first getter call
+    blocks for the port."""
+    survivor, slines = _spawn_worker(
+        {"AREAL_WORKER_TRACE": "1", "AREAL_INIT_VERSION": "1"}
+    )
+    holder = {}
+
+    def get_addr() -> str:
+        if "addr" not in holder:
+            sport = _wait_port(survivor, slines, time.monotonic() + 240)
+            holder["addr"] = f"127.0.0.1:{sport}"
+        return holder["addr"]
+
+    yield get_addr
+    _reap(survivor)
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=15)
+        except Exception:
+            proc.kill()
+
+
+def _victim_and_survivor(env_extra, survivor_getter):
+    """Spawn a victim with its chaos rules, booting concurrently with
+    the (possibly still starting) shared survivor."""
+    victim, vlines = _spawn_worker(env_extra)
     try:
-        vport = _wait_port(victim, vlines, deadline)
-        sport = _wait_port(survivor, slines, deadline)
+        vport = _wait_port(victim, vlines, time.monotonic() + 240)
+        survivor_addr = survivor_getter()
     except Exception:
         victim.kill()
-        survivor.kill()
         raise
-    yield f"127.0.0.1:{vport}", f"127.0.0.1:{sport}"
-    for proc in (victim, survivor):
-        if proc.poll() is None:
-            try:
-                proc.stdin.close()
-                proc.wait(timeout=15)
-            except Exception:
-                proc.kill()
+    return victim, f"127.0.0.1:{vport}", survivor_addr
+
+
+@pytest.fixture()
+def two_servers(survivor_server):
+    """(victim_addr, survivor_addr): same seed-0 weights; the victim
+    carries the chaos kill rule in its environment."""
+    victim, victim_addr, survivor_addr = _victim_and_survivor(
+        {"AREAL_CHAOS": VICTIM_CHAOS}, survivor_server
+    )
+    yield victim_addr, survivor_addr
+    _reap(victim)
 
 
 PROMPTS = [[7, 6, 5, 4], [1, 2, 3], [9, 8, 7], [2, 4, 6, 8]]
@@ -217,6 +251,284 @@ def test_hard_kill_migrates_inflight_rollouts_token_exact(two_servers):
         assert "areal_tpu_router_fleet_circuit_open 1" in text
         assert "areal_tpu_router_failovers_total" in text
         assert "areal_tpu_router_requests_migrated_total" in text
+    finally:
+        client.destroy()
+        router.shutdown()
+
+
+# ==========================================================================
+# End-to-end lineage + cross-process trace stitching through a real kill
+# ==========================================================================
+# victim call schedule (0-based /generate index): wave A's rid runs its 3
+# chunks (idx 0-2); wave B's victim rid prefills at idx 3, its second
+# chunk (idx 4) is delayed 1.2 s — the deterministic window in which the
+# test drains the victim's span buffer — and its third chunk (idx 5) hard
+# -kills the process mid-wave, so the migrated request resumes 8 tokens
+# deep on the survivor
+LINEAGE_CHAOS = (
+    "latency:side=server,match=/generate,start=4,count=1,latency_s=1.2;"
+    "kill:side=server,match=/generate,start=5"
+)
+
+
+@pytest.fixture()
+def lineage_servers(survivor_server):
+    """(victim, survivor), both tracing, with distinct weight-version
+    LABELS (identical seed-0 weights): victim serves v0, survivor v1 —
+    so a migrated sample's ledger must show two weight versions."""
+    victim, victim_addr, survivor_addr = _victim_and_survivor(
+        {
+            "AREAL_CHAOS": LINEAGE_CHAOS,
+            "AREAL_WORKER_TRACE": "1",
+            "AREAL_INIT_VERSION": "0",
+        },
+        survivor_server,
+    )
+    yield victim_addr, survivor_addr
+    _reap(victim)
+
+
+@pytest.mark.chaos
+def test_lineage_ledger_and_stitched_trace_across_kill(
+    lineage_servers, tmp_path
+):
+    """The tentpole contract: one kill-one-of-two chaos run yields (a) a
+    lineage ledger that reconstructs the migrated sample's full path —
+    two servers, two weight versions, the consuming step — and (b) ONE
+    stitched Perfetto timeline where client, router, and server spans
+    share the episode's trace id, with the migration linked."""
+    import json as _json
+
+    import numpy as np
+
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        TelemetryConfig,
+        TracingConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest, unique_rid
+    from areal_tpu.api.workflow_api import RolloutWorkflow
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+    from areal_tpu.inference.router import serve_router
+    from areal_tpu.utils.telemetry import TelemetryCollector
+
+    victim_addr, survivor_addr = lineage_servers
+    router = serve_router(
+        addresses=[victim_addr, survivor_addr],
+        fleet_config=FleetConfig(
+            probe_interval_s=0.3, probe_timeout_s=2.0, dead_threshold=2,
+            halfopen_interval_s=60.0, watch_membership=False,
+        ),
+        tracing=TracingConfig(enabled=True, max_spans=100_000),
+        schedule_policy="round_robin",
+    )
+    router_addr = f"127.0.0.1:{router.server_address[1]}"
+    lineage_path = str(tmp_path / "lineage.jsonl")
+    client = RemoteInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="lineage", trial_name="t0",
+            consumer_batch_size=2, max_concurrent_rollouts=8,
+            # the trainer version never moves in this test: without a
+            # loose staleness gate, wave B would never be admitted
+            max_head_offpolicyness=100,
+            request_timeout=60, request_retries=2, setup_timeout=120,
+            schedule_policy="round_robin",
+            new_tokens_per_chunk=4,
+            tracing=TracingConfig(enabled=True, max_spans=100_000),
+            fleet=FleetConfig(
+                probe_interval_s=0.3, probe_timeout_s=2.0,
+                dead_threshold=2, halfopen_interval_s=60.0,
+            ),
+            router_addr=router_addr,
+            lineage_path=lineage_path,
+        )
+    ).initialize(addrs=[victim_addr, survivor_addr])
+    collector = TelemetryCollector(
+        addresses=[victim_addr, survivor_addr],
+        config=TelemetryConfig(),  # scraped manually: no thread, no races
+    )
+
+    gconfig = GenerationHyperparameters(
+        n_samples=1, max_new_tokens=MAX_NEW, greedy=True
+    )
+
+    class _OneRequest(RolloutWorkflow):
+        async def arun_episode(self, engine, data):
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=unique_rid(),
+                    input_ids=list(data["input_ids"]),
+                    gconfig=gconfig.new(n_samples=1),
+                )
+            )
+            seq = list(data["input_ids"]) + resp.output_tokens
+            return {
+                "input_ids": np.asarray([seq], np.int32),
+                "attention_mask": np.ones((1, len(seq)), np.bool_),
+                "rewards": np.asarray([1.0], np.float32),
+            }
+
+    workflow = _OneRequest()
+    executor = client.workflow_executor
+    try:
+        # -- wave A: uneventful; lands one full rollout on EACH server --
+        for i, prompt in enumerate(PROMPTS[:2]):
+            assert client.submit(
+                {"qid": f"wavea-{i}", "input_ids": prompt}, workflow
+            )
+        client.wait(2, timeout=120)
+        collector.scrape_once()
+        rollup_a = collector.rollup()
+        # the hub aggregated two LIVE servers' /metrics into fleet gauges
+        assert rollup_a["servers_scraped"] == 2.0
+        assert rollup_a["generated_tokens_total"] >= 2 * MAX_NEW
+        assert rollup_a["queue_wait_samples"] >= 2
+
+        # -- wave B: the victim dies on its 3rd wave-B call, mid-wave --
+        for i, prompt in enumerate(PROMPTS[2:4]):
+            assert client.submit(
+                {"qid": f"waveb-{i}", "input_ids": prompt}, workflow
+            )
+        deadline = time.monotonic() + 120
+        while True:
+            # keep draining /trace while the wave runs: the victim's
+            # spans survive its death up to the last scrape (the 1.2 s
+            # latency window makes one pre-kill drain deterministic)
+            collector.scrape_once()
+            try:
+                client.wait(2, timeout=0.3)
+                break
+            except TimeoutError:
+                assert time.monotonic() < deadline, "wave B never finished"
+
+        # -- lineage: the migrated sample's full path, ledger-only -----
+        records = {
+            r["uid"]: r for r in executor.lineage.snapshot()
+        }
+        assert len(records) == 4
+        migrated = [
+            r for r in records.values()
+            if r["uid"].startswith("qid:waveb") and len(r["servers"]) > 1
+        ]
+        assert len(migrated) == 1, (
+            f"exactly one wave-B sample must migrate: "
+            f"{[(r['uid'], r['servers']) for r in records.values()]}"
+        )
+        mig = migrated[0]
+        assert mig["status"] == "collected"
+        assert mig["servers"] == [victim_addr, survivor_addr]
+        assert mig["weight_versions"] == [0, 1]  # two weight versions
+        assert mig["migrations"] >= 1
+        assert mig["attempts"] == 1  # failover is not an episode retry
+        assert mig["consumed_step"] is not None
+        assert mig["rewards"] == [1.0]
+        segs = mig["requests"][0]["segments"]
+        assert segs[0]["server"] == victim_addr
+        assert segs[0]["versions"] == [0] and segs[0]["tokens"] == 8
+        assert segs[-1]["server"] == survivor_addr
+        assert segs[-1]["versions"] == [1] and segs[-1]["tokens"] == 4
+        # the un-migrated wave-B sibling stayed single-server
+        other = [
+            r for r in records.values()
+            if r["uid"].startswith("qid:waveb") and r is not mig
+        ][0]
+        assert other["servers"] == [survivor_addr]
+
+        # -- ONE stitched Perfetto timeline across all four processes --
+        doc = collector.stitched_trace(
+            extra_sources=[
+                ("client", client.tracer),
+                ("router", router.router_state.tracer),
+            ]
+        )
+        procs = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert procs == {
+            f"server:{victim_addr}", f"server:{survivor_addr}",
+            "client", "router",
+        }
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        mig_rid = mig["requests"][0]["rid"]
+        mig_events = [e for e in xs if e["args"].get("rid") == mig_rid]
+        mig_pids = {e["pid"] for e in mig_events}
+        # client + router + at least the survivor carry the migrated rid
+        assert len(mig_pids) >= 3, mig_events
+        # ...and every trace-tagged span of that rid shares ONE trace id
+        mig_traces = {
+            e["args"]["trace"]
+            for e in mig_events
+            if "trace" in e["args"]
+        }
+        assert mig_traces == {mig["trace_id"]}
+        assert any(e["name"] == "route" for e in mig_events)
+        assert any(e["name"] == "migration" for e in mig_events)
+        # migration is LINKED: flow arrows pair up by id
+        flows = [
+            e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")
+        ]
+        starts = {e["id"] for e in flows if e["ph"] == "s"}
+        finishes = {e["id"] for e in flows if e["ph"] == "f"}
+        assert starts and starts == finishes
+        # wave A's victim-served rollout is on the same timeline with a
+        # client↔server shared trace id (drained before the kill)
+        victim_pid = next(
+            e["pid"] for e in doc["traceEvents"]
+            if e.get("name") == "process_name"
+            and e["args"]["name"] == f"server:{victim_addr}"
+        )
+        victim_traces = {
+            e["args"]["trace"]
+            for e in xs
+            if e["pid"] == victim_pid and "trace" in e["args"]
+        }
+        wavea_traces = {
+            records[f"qid:wavea-{i}"]["trace_id"] for i in range(2)
+        }
+        assert victim_traces & wavea_traces
+
+        # -- post-kill fleet view + the report tooling ------------------
+        collector.scrape_once()
+        rollup_b = collector.rollup()
+        assert rollup_b["servers_scraped"] == 1.0
+        assert rollup_b["scrape_failures_total"] >= 1.0
+
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(__file__), "..", "tools"),
+        )
+        import trace_report
+
+        # the ledger ALONE reconstructs the migrated sample's path
+        assert trace_report.main([lineage_path, "--lineage"]) == 0
+        ln = trace_report.lineage_summary(
+            trace_report.load_lineage(lineage_path)
+        )
+        assert ln["consumed"] == 4
+        assert ln["migrated"] == 1
+        assert ln["multi_server"] == 1 and ln["multi_version"] == 1
+
+        manifest_path = str(tmp_path / "manifest.json")
+        with open(manifest_path, "w") as f:
+            _json.dump(collector.manifest(), f)
+        assert trace_report.main([manifest_path, "--fleet"]) == 0
+
+        # CI smoke: the new span names are required-present in the
+        # client+router span stream
+        spans_path = str(tmp_path / "client_router.jsonl")
+        client.tracer.export_jsonl(spans_path)
+        router.router_state.tracer.export_jsonl(spans_path)
+        assert trace_report.main(
+            [
+                spans_path,
+                "--require",
+                "route,generate_call,rollout_request,failover,migration",
+            ]
+        ) == 0
     finally:
         client.destroy()
         router.shutdown()

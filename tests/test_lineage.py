@@ -1,0 +1,552 @@
+"""Trajectory lineage + telemetry hub units (no devices, no sockets):
+episode-context propagation through asyncio child tasks, segment
+merging, ledger consumption stamping + JSONL persistence, trace-id
+binding on the tracer, multi-process trace stitching, the telemetry
+collector's rollups and deterministic anomaly rules (injected fetchers,
+symmetric set/clear), and the trace_report --lineage/--fleet modes."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.cli_args import TelemetryConfig, TracingConfig
+from areal_tpu.utils import telemetry
+from areal_tpu.utils import tracing as tracing_util
+from areal_tpu.utils.telemetry import (
+    EpisodeLineage,
+    LineageLedger,
+    RequestLineage,
+    TelemetryCollector,
+    stitch_chrome_traces,
+)
+from areal_tpu.utils.tracing import SpanTracer
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import trace_report  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# Lineage primitives
+# --------------------------------------------------------------------------
+class TestRequestLineage:
+    def test_consecutive_same_server_segments_merge(self):
+        rl = RequestLineage(rid="r1")
+        rl.add_segment("a:1", 4, [0])
+        rl.add_segment("a:1", 4, [0])
+        rl.add_segment("b:2", 4, [1])
+        rl.add_segment("a:1", 2, [1])
+        assert len(rl.segments) == 3
+        assert rl.segments[0] == {
+            "server": "a:1", "versions": [0], "tokens": 8
+        }
+        assert rl.servers == ["a:1", "b:2", "a:1"]
+        assert rl.weight_versions == [0, 1]
+        assert rl.to_dict()["output_tokens"] == 14
+
+    def test_version_change_on_same_server_splits_segment(self):
+        rl = RequestLineage(rid="r1")
+        rl.add_segment("a:1", 4, [0])
+        rl.add_segment("a:1", 4, [1])
+        assert len(rl.segments) == 2
+        assert rl.weight_versions == [0, 1]
+        # same server resumed across a weight update is NOT a migration
+        assert rl.servers == ["a:1"]
+
+
+class TestEpisodeContext:
+    def test_child_tasks_inherit_episode_context(self):
+        """asyncio.gather children (the RLVR n-samples fan-out shape)
+        must see the episode their parent coroutine installed."""
+        ep = EpisodeLineage(uid="qid:7")
+        seen = []
+
+        async def child(i):
+            cur = telemetry.current_episode()
+            seen.append(cur)
+            cur.add_request(RequestLineage(rid=f"r{i}"))
+
+        async def episode_body():
+            token = telemetry.set_episode(ep)
+            try:
+                await asyncio.gather(*[child(i) for i in range(3)])
+            finally:
+                telemetry.reset_episode(token)
+            assert telemetry.current_episode() is None
+
+        asyncio.run(episode_body())
+        assert all(c is ep for c in seen)
+        assert len(ep.requests) == 3
+        assert ep.trace_id  # auto-originated
+
+    def test_no_context_outside_episode(self):
+        assert telemetry.current_episode() is None
+
+
+class TestLineageLedger:
+    def _episode(self, uid="qid:1", servers=("a:1", "b:2")):
+        ep = EpisodeLineage(uid=uid)
+        rl = RequestLineage(rid="r0")
+        rl.add_segment(servers[0], 4, [0])
+        if len(servers) > 1:
+            rl.add_segment(servers[1], 8, [1])
+            rl.failovers = 1
+            rl.migrations = 1
+        ep.add_request(rl)
+        return ep
+
+    def test_record_and_consume_roundtrip(self, tmp_path):
+        path = str(tmp_path / "lineage.jsonl")
+        ledger = LineageLedger(path=path)
+        ep = self._episode()
+        ledger.record_episode(ep, status="collected", rewards=[1.0, 0.0])
+        rec = ledger.get("qid:1")
+        assert rec["servers"] == ["a:1", "b:2"]
+        assert rec["weight_versions"] == [0, 1]
+        assert rec["migrations"] == 1
+        assert rec["attempts"] == 1
+        assert rec["trace_id"] == ep.trace_id
+        assert rec.get("consumed_step") is None
+
+        assert ledger.mark_consumed(["qid:1", "missing"], 7, 3) == 1
+        rec = ledger.get("qid:1")
+        assert rec["consumed_step"] == 7
+        assert rec["staleness_max"] == 3 - 0
+        assert rec["staleness_min"] == 3 - 1
+        assert ledger.staleness_values() == [3]
+        # consumed record landed in the JSONL sink
+        lines = [json.loads(x) for x in open(path) if x.strip()]
+        assert len(lines) == 1 and lines[0]["uid"] == "qid:1"
+        # double consumption does not re-append
+        assert ledger.mark_consumed(["qid:1"], 8, 4) == 0
+        assert len(open(path).readlines()) == 1
+
+    def test_bounded_records_evict_oldest(self):
+        ledger = LineageLedger(max_records=2)
+        for i in range(4):
+            ledger.record_episode(
+                self._episode(uid=f"qid:{i}", servers=("a:1",)),
+                status="collected",
+            )
+        assert len(ledger) == 2
+        assert ledger.get("qid:0") is None
+        assert ledger.get("qid:3") is not None
+
+    def test_snapshot_dump(self, tmp_path):
+        ledger = LineageLedger()
+        ledger.record_episode(self._episode(), status="quarantined")
+        out = str(tmp_path / "snap.jsonl")
+        assert ledger.dump_jsonl(out) == 1
+        rec = json.loads(open(out).read())
+        assert rec["status"] == "quarantined"
+
+
+# --------------------------------------------------------------------------
+# Tracer trace-context binding
+# --------------------------------------------------------------------------
+class TestTraceBinding:
+    def test_bound_rid_spans_carry_trace_attr(self):
+        t = SpanTracer(TracingConfig(enabled=True))
+        t.bind_trace("r1", "trace-abc")
+        t.record("generate_call", "r1", 0.0, 1.0)
+        t.record("generate_call", "r2", 0.0, 1.0)
+        spans = {s.rid: s for s in t.snapshot()}
+        assert spans["r1"].attrs["trace"] == "trace-abc"
+        assert "trace" not in spans["r2"].attrs
+        t.unbind_trace("r1")
+        t.record("late", "r1", 1.0, 2.0)
+        assert "trace" not in t.snapshot()[-1].attrs
+
+    def test_binding_map_is_lru_bounded(self, monkeypatch):
+        monkeypatch.setattr(SpanTracer, "MAX_TRACE_BINDINGS", 2)
+        t = SpanTracer(TracingConfig(enabled=True))
+        t.bind_trace("a", "ta")
+        t.bind_trace("b", "tb")
+        t.bind_trace("a", "ta")  # touch: a is now most-recent
+        t.bind_trace("c", "tc")  # evicts b, not a
+        assert t.trace_of("a") == "ta"
+        assert t.trace_of("b") is None
+        assert t.trace_of("c") == "tc"
+
+    def test_disabled_tracer_binding_is_noop(self):
+        t = SpanTracer(TracingConfig(enabled=False))
+        t.bind_trace("r", "x")
+        assert t.trace_of("r") is None
+
+    def test_dropped_spans_counted_on_overflow(self):
+        t = SpanTracer(TracingConfig(enabled=True, max_spans=2))
+        for i in range(5):
+            t.record("s", f"r{i}", 0.0, 1.0)
+        assert t.dropped == 3
+        assert t.to_chrome_trace()["otherData"]["dropped_spans"] == 3
+
+
+# --------------------------------------------------------------------------
+# Cross-process stitching
+# --------------------------------------------------------------------------
+class TestStitch:
+    def _tracer(self, service, epoch, spans):
+        t = SpanTracer(TracingConfig(enabled=True), service=service)
+        t.epoch_unix_s = epoch
+        for name, rid, ts, dur, attrs in spans:
+            t.record(name, rid, ts, ts + dur, **attrs)
+        return t
+
+    def test_stitch_rebases_clocks_and_names_processes(self):
+        # client's monotonic zero is 100s before the server's
+        client = self._tracer(
+            "client", 1000.0,
+            [("generate_call", "r1", 5.0, 1.0, {"trace": "T"})],
+        )
+        server = self._tracer(
+            "server:a", 1100.0,
+            [("request", "r1", 5.2 - 100.0, 0.8, {"trace": "T"})],
+        )
+        doc = stitch_chrome_traces([("client", client), ("srv-a", server)])
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == {1, 2}
+        names = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert names == {"client", "srv-a"}
+        # after re-basing, the server span starts 0.2s into the client's
+        by_pid = {e["pid"]: e for e in xs}
+        assert by_pid[2]["ts"] - by_pid[1]["ts"] == pytest.approx(
+            0.2e6, rel=1e-3
+        )
+        assert doc["otherData"]["stitched"] is True
+
+    def test_migration_flow_links_request_spans_across_processes(self):
+        a = self._tracer(
+            "server:a", 0.0, [("request", "r1", 1.0, 1.0, {})]
+        )
+        b = self._tracer(
+            "server:b", 0.0, [("request", "r1", 3.0, 1.0, {})]
+        )
+        doc = stitch_chrome_traces([("a", a), ("b", b)])
+        starts = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "s" and e["name"] == "migration"
+        ]
+        finishes = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "f" and e["name"] == "migration"
+        ]
+        assert len(starts) == 1 and len(finishes) == 1
+        assert starts[0]["id"] == finishes[0]["id"]
+        assert starts[0]["pid"] != finishes[0]["pid"]
+
+    def test_migration_instant_links_to_next_generate_call(self):
+        client = self._tracer(
+            "client", 0.0,
+            [
+                ("generate_call", "r1", 1.0, 0.5, {"server": "a"}),
+                ("migration", "r1", 2.0, 0.0, {}),
+                ("generate_call", "r1", 2.1, 0.5, {"server": "b"}),
+            ],
+        )
+        doc = stitch_chrome_traces([("client", client)])
+        resumes = [
+            e for e in doc["traceEvents"] if e.get("name") == "resume"
+        ]
+        assert {e["ph"] for e in resumes} == {"s", "f"}
+
+    def test_accepts_chrome_doc_source(self):
+        t = self._tracer("server:x", 50.0, [("decode", "r", 0.0, 1.0, {})])
+        doc = stitch_chrome_traces([("x", t.to_chrome_trace())])
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 1 and xs[0]["name"] == "decode"
+
+
+# --------------------------------------------------------------------------
+# Telemetry collector: rollups + anomaly rules (injected fetchers)
+# --------------------------------------------------------------------------
+def _healthy(running=2.0, tps=50.0, kv=0.25, **extra):
+    m = {
+        "running_requests": running,
+        "queued_requests": 1.0,
+        "decode_tokens_per_sec": tps,
+        "prefill_tokens_per_sec": 100.0,
+        "kv_page_utilization": kv,
+        "total_generated_tokens": 1000.0,
+        "total_preemptions": 0.0,
+    }
+    m.update(extra)
+    return m
+
+
+def _collector(metrics_by_addr, spans_by_addr=None, config=None, ledger=None):
+    spans_by_addr = spans_by_addr or {}
+    return TelemetryCollector(
+        addresses=sorted(metrics_by_addr),
+        config=config or TelemetryConfig(decode_stall_scrapes=2),
+        ledger=ledger,
+        fetch_metrics_fn=lambda a: dict(metrics_by_addr[a]),
+        fetch_trace_fn=lambda a: (list(spans_by_addr.get(a, [])), 0.0, 0),
+    )
+
+
+class TestCollectorRollup:
+    def test_aggregates_two_servers(self):
+        mets = {
+            "a:1": _healthy(running=2.0, tps=40.0, kv=0.2),
+            "b:2": _healthy(running=3.0, tps=60.0, kv=0.6),
+        }
+        spans = {
+            "a:1": [{"name": "queue_wait", "rid": "r", "ts": 0, "dur": 0.1}],
+            "b:2": [{"name": "queue_wait", "rid": "r", "ts": 0, "dur": 0.3}],
+        }
+        c = _collector(mets, spans)
+        c.scrape_once()
+        r = c.rollup()
+        assert r["servers_total"] == 2.0
+        assert r["servers_scraped"] == 2.0
+        assert r["running_requests"] == 5.0
+        assert r["decode_tokens_per_sec"] == 100.0
+        assert r["kv_page_utilization_mean"] == pytest.approx(0.4)
+        assert r["kv_page_utilization_max"] == pytest.approx(0.6)
+        assert r["queue_wait_p95_s"] == pytest.approx(0.3)
+        assert all(r[a] == 0.0 for a in telemetry.ANOMALIES)
+
+    def test_unreachable_server_counts_failures(self):
+        mets = {"a:1": _healthy()}
+
+        def fetch(addr):
+            raise ConnectionError("down")
+
+        c = TelemetryCollector(
+            addresses=["a:1"],
+            config=TelemetryConfig(),
+            fetch_metrics_fn=fetch,
+            fetch_trace_fn=lambda a: ([], 0.0, 0),
+        )
+        c.scrape_once()
+        r = c.rollup()
+        assert r["servers_scraped"] == 0.0
+        assert r["scrape_failures_total"] == 1.0
+
+    def test_manifest_shape(self):
+        c = _collector({"a:1": _healthy()})
+        c.scrape_once()
+        man = c.manifest()
+        assert "a:1" in man["servers"]
+        assert man["servers"]["a:1"]["reachable"] is True
+        assert set(man["anomalies"]) == set(telemetry.ANOMALIES)
+        assert man["rollup"]["servers_total"] == 1.0
+
+
+class TestAnomalyRules:
+    def test_decode_stall_flips_and_clears_symmetrically(self):
+        state = {"m": _healthy(running=4.0, tps=0.0)}
+        c = TelemetryCollector(
+            addresses=["a:1"],
+            config=TelemetryConfig(decode_stall_scrapes=2),
+            fetch_metrics_fn=lambda a: dict(state["m"]),
+            fetch_trace_fn=lambda a: ([], 0.0, 0),
+        )
+        c.scrape_once()
+        assert c.anomalies()["anomaly_decode_stall"] is False  # 1 < 2
+        c.scrape_once()
+        assert c.anomalies()["anomaly_decode_stall"] is True
+        assert c.rollup()["anomaly_decode_stall"] == 1.0
+        # decode moves again → the gauge clears on the next sweep
+        state["m"] = _healthy(running=4.0, tps=80.0)
+        c.scrape_once()
+        assert c.anomalies()["anomaly_decode_stall"] is False
+        assert c.rollup()["anomaly_decode_stall"] == 0.0
+
+    def test_idle_server_is_not_a_stall(self):
+        c = _collector(
+            {"a:1": _healthy(running=0.0, tps=0.0)},
+            config=TelemetryConfig(decode_stall_scrapes=1),
+        )
+        c.scrape_once()
+        assert c.anomalies()["anomaly_decode_stall"] is False
+
+    def test_queue_wait_breach(self):
+        spans = {
+            "a:1": [
+                {"name": "queue_wait", "rid": "r", "ts": 0, "dur": 5.0}
+            ] * 10
+        }
+        c = _collector(
+            {"a:1": _healthy()},
+            spans,
+            config=TelemetryConfig(queue_wait_p95_s=1.0, span_window=10),
+        )
+        c.scrape_once()
+        assert c.anomalies()["anomaly_queue_wait"] is True
+        # a full window of short waits pushes the breach out → clears
+        spans["a:1"] = [
+            {"name": "queue_wait", "rid": "r", "ts": 0, "dur": 0.01}
+        ] * 10
+        c.scrape_once()
+        assert c.anomalies()["anomaly_queue_wait"] is False
+
+    def test_accept_rate_collapse_needs_spec_enabled_and_volume(self):
+        bad = _healthy(
+            spec_enabled=1.0,
+            spec_draft_tokens_total=1000.0,
+            spec_accepted_tokens_total=10.0,
+        )
+        c = _collector(
+            {"a:1": bad},
+            config=TelemetryConfig(
+                accept_rate_floor=0.05, min_draft_tokens=256
+            ),
+        )
+        c.scrape_once()
+        assert c.anomalies()["anomaly_accept_collapse"] is True
+        # same numbers with spec auto-disabled: not an anomaly (the gate
+        # already acted)
+        bad["spec_enabled"] = 0.0
+        c.scrape_once()
+        assert c.anomalies()["anomaly_accept_collapse"] is False
+
+    def test_staleness_runaway_from_ledger(self):
+        ledger = LineageLedger()
+        ep = EpisodeLineage(uid="u1")
+        rl = RequestLineage(rid="r")
+        rl.add_segment("a:1", 4, [0])
+        ep.add_request(rl)
+        ledger.record_episode(ep, status="collected")
+        ledger.mark_consumed(["u1"], step=1, trainer_version=20)
+        c = _collector(
+            {"a:1": _healthy()},
+            config=TelemetryConfig(staleness_max=8),
+            ledger=ledger,
+        )
+        c.scrape_once()
+        assert c.anomalies()["anomaly_staleness"] is True
+        assert c.rollup()["staleness_max"] == 20.0
+
+
+# --------------------------------------------------------------------------
+# trace_report --lineage / --fleet
+# --------------------------------------------------------------------------
+class TestTraceReportModes:
+    def _ledger_file(self, tmp_path):
+        ledger = LineageLedger(path=str(tmp_path / "lineage.jsonl"))
+        migrated = EpisodeLineage(uid="qid:mig")
+        rl = RequestLineage(rid="r0")
+        rl.add_segment("a:1", 4, [0])
+        rl.add_segment("b:2", 8, [1])
+        rl.failovers = rl.migrations = 1
+        migrated.add_request(rl)
+        ledger.record_episode(migrated, status="collected", rewards=[1.0])
+        plain = EpisodeLineage(uid="qid:ok")
+        rp = RequestLineage(rid="r1")
+        rp.add_segment("b:2", 12, [1])
+        plain.add_request(rp)
+        ledger.record_episode(plain, status="collected", rewards=[0.0])
+        ledger.mark_consumed(["qid:mig", "qid:ok"], 3, 1)
+        return str(tmp_path / "lineage.jsonl")
+
+    def test_lineage_report(self, tmp_path, capsys):
+        path = self._ledger_file(tmp_path)
+        assert trace_report.main([path, "--lineage", "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["samples"] == 2
+        assert out["migrated"] == 1
+        assert out["multi_server"] == 1
+        assert out["multi_version"] == 1
+        rows = {r["uid"]: r for r in out["rows"]}
+        assert rows["qid:mig"]["servers"] == ["a:1", "b:2"]
+        assert rows["qid:mig"]["weight_versions"] == [0, 1]
+        assert rows["qid:mig"]["consumed_step"] == 3
+        # human table renders too
+        assert trace_report.main([path, "--lineage"]) == 0
+
+    def test_lineage_report_empty_fails(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert trace_report.main([str(p), "--lineage"]) == 1
+
+    def test_fleet_report(self, tmp_path, capsys):
+        c = _collector(
+            {"a:1": _healthy(), "b:2": _healthy(running=0.0)}
+        )
+        c.scrape_once()
+        path = tmp_path / "manifest.json"
+        path.write_text(json.dumps(c.manifest()))
+        assert trace_report.main([str(path), "--fleet", "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert set(out["servers"]) == {"a:1", "b:2"}
+        assert out["anomalies_active"] == []
+        assert trace_report.main([str(path), "--fleet"]) == 0
+
+    def test_fleet_report_no_servers_fails(self, tmp_path):
+        path = tmp_path / "manifest.json"
+        path.write_text(json.dumps({"servers": {}, "rollup": {}}))
+        assert trace_report.main([str(path), "--fleet"]) == 1
+
+
+class TestFleetMembership:
+    def test_collector_follows_fleet_monitor_membership(self):
+        """ISSUE contract: the hub reuses FleetMonitor membership — a
+        server joining or leaving the fleet joins/leaves the scrape set
+        (and departed servers stop pinning anomaly state)."""
+        from areal_tpu.api.cli_args import FleetConfig
+        from areal_tpu.inference.fleet import FleetMonitor
+
+        fm = FleetMonitor(["a:1"], FleetConfig(enabled=False))
+        c = TelemetryCollector(
+            fleet=fm,
+            config=TelemetryConfig(),
+            fetch_metrics_fn=lambda a: _healthy(),
+            fetch_trace_fn=lambda a: ([], 0.0, 0),
+        )
+        c.scrape_once()
+        assert c.rollup()["servers_total"] == 1.0
+        fm.add_server("b:2")
+        c.scrape_once()
+        assert c.rollup()["servers_total"] == 2.0
+        fm.remove_server("a:1")
+        c.scrape_once()
+        r = c.rollup()
+        assert r["servers_total"] == 1.0
+        assert "a:1" not in c.manifest()["servers"]
+
+
+class TestHubEndpoint:
+    def test_hub_serves_metrics_manifest_and_trace(self):
+        import urllib.request
+
+        c = _collector(
+            {"a:1": _healthy()},
+            {"a:1": [{"name": "decode", "rid": "r", "ts": 0.0, "dur": 1.0}]},
+        )
+        c.scrape_once()
+        httpd = c.serve(host="127.0.0.1", port=0)
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=10
+            ) as r:
+                text = r.read().decode()
+            assert "areal_tpu_fleet_servers_total 1" in text
+            assert "areal_tpu_fleet_anomaly_decode_stall 0" in text
+            parsed = tracing_util.parse_prometheus(
+                text, prefix="areal_tpu_fleet_"
+            )
+            assert parsed["running_requests"] == 2.0
+            with urllib.request.urlopen(
+                f"http://{addr}/manifest", timeout=10
+            ) as r:
+                man = json.loads(r.read())
+            assert "a:1" in man["servers"]
+            with urllib.request.urlopen(
+                f"http://{addr}/trace", timeout=10
+            ) as r:
+                doc = json.loads(r.read())
+            assert any(
+                e.get("ph") == "X" for e in doc["traceEvents"]
+            )
+        finally:
+            c.stop()

@@ -286,6 +286,157 @@ class TestSpecObservability:
         assert "areal_tpu_gen_spec_" not in text
 
 
+class TestTraceContext:
+    def test_generate_binds_trace_header_onto_spans(self, traced_engine):
+        """X-Areal-Trace/X-Areal-Rid on /generate: the server's spans
+        for that rid carry the episode's trace id (the stitch key)."""
+        import urllib.request as _rq
+
+        eng, addr, _, _ = traced_engine
+        eng.tracer.drain()
+        req = _rq.Request(
+            f"http://{addr}/generate",
+            data=json.dumps(
+                {
+                    "input_ids": [1, 2, 3],
+                    "sampling_params": {"max_new_tokens": 2},
+                }
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Areal-Trace": "trace-e2e",
+                "X-Areal-Rid": "rid-hdr",
+            },
+        )
+        with _rq.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert len(out["output_ids"]) == 2
+        spans = [s for s in eng.tracer.snapshot() if s.rid == "rid-hdr"]
+        assert spans, "header rid must name the request's spans"
+        by_name = {s.name: s for s in spans}
+        assert by_name["request"].attrs["trace"] == "trace-e2e"
+        assert by_name["queue_wait"].attrs["trace"] == "trace-e2e"
+        # completion unbinds: an unrelated later request is clean
+        _generate(eng, "rid-hdr-2", max_new=2)
+        later = [s for s in eng.tracer.snapshot() if s.rid == "rid-hdr-2"]
+        assert later and all("trace" not in s.attrs for s in later)
+
+    def test_dropped_spans_surface_on_metrics(self):
+        """Satellite: ring overflow is counted and exported, so a
+        truncated trace is visibly truncated."""
+        from areal_tpu.utils.tracing import render_prometheus
+
+        cfg = tiny_config("qwen2")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+                tracing=TracingConfig(enabled=True, max_spans=4),
+            ),
+            model_config=cfg, params=params,
+        ).start()
+        try:
+            _generate(eng, "rid-overflow", max_new=8)
+            m = eng.metrics()
+            assert m["tracing_dropped_spans_total"] >= 1
+            text = render_prometheus(m, prefix="areal_tpu_gen_")
+            assert (
+                "# TYPE areal_tpu_gen_tracing_dropped_spans_total counter"
+                in text
+            )
+        finally:
+            eng.stop()
+
+
+class TestTelemetryHubLive:
+    """The collector aggregates ≥2 LIVE server endpoints' /metrics into
+    fleet-wide gauges, draining their /trace buffers along the way.
+    (Two real server PROCESSES are covered end-to-end by
+    test_failover.py::test_lineage_ledger_and_stitched_trace_across_kill;
+    here a second HTTP shell fronts the same engine to keep tier-1
+    cheap.)"""
+
+    def test_collector_aggregates_two_live_servers(self, traced_engine):
+        from areal_tpu.api.cli_args import TelemetryConfig
+        from areal_tpu.utils.telemetry import TelemetryCollector
+
+        eng1, addr1, _, _ = traced_engine
+        httpd2 = serve(eng1, host="127.0.0.1", port=0, background=True)
+        addr2 = f"127.0.0.1:{httpd2.server_address[1]}"
+        try:
+            _generate(eng1, "rid-hub-1", max_new=4)
+            collector = TelemetryCollector(
+                addresses=[addr1, addr2], config=TelemetryConfig()
+            )
+            collector.scrape_once()
+            r = collector.rollup()
+            assert r["servers_total"] == 2.0
+            assert r["servers_scraped"] == 2.0
+            assert r["generated_tokens_total"] >= 8
+            assert 0.0 <= r["kv_page_utilization_mean"] <= 1.0
+            assert r["queue_wait_samples"] >= 1  # /trace drained
+            man = collector.manifest()
+            assert set(man["servers"]) >= {addr1, addr2}
+        finally:
+            httpd2.shutdown()
+
+
+class TestProfileEndpoint:
+    def test_profile_captures_and_gates(self, traced_engine, tmp_path):
+        """POST /profile?steps=N arms a jax.profiler capture of the next
+        N busy loop iterations; the CLI gate (no --enable-profile)
+        answers 403 — same contract as POST /chaos."""
+        import urllib.error as _err
+        import urllib.request as _rq
+
+        eng, addr, _, _ = traced_engine
+        out_dir = str(tmp_path / "prof")
+        req = _rq.Request(
+            f"http://{addr}/profile?steps=2",
+            data=json.dumps({"out_dir": out_dir}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["success"] and out["steps"] == 2
+        assert out["trace_dir"].startswith(out_dir)
+        # drive busy iterations so the capture opens and closes
+        _generate(eng, "rid-profiled", max_new=4)
+        deadline = __import__("time").monotonic() + 30
+        while eng._profile_stack is not None or eng._profile_pending:
+            assert __import__("time").monotonic() < deadline
+            __import__("time").sleep(0.05)
+        # engine still serves, and a second capture can be armed
+        _generate(eng, "rid-after-profile", max_new=2)
+
+        # double-arm while pending is an explicit error
+        eng._profile_pending = (1, None)
+        try:
+            with pytest.raises(RuntimeError):
+                eng.request_profile(1)
+        finally:
+            eng._profile_pending = None
+
+        # gated server: 403, nothing armed
+        httpd = serve(
+            eng, host="127.0.0.1", port=0, background=True,
+            profile_endpoint=False,
+        )
+        gated = f"127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = _rq.Request(
+                f"http://{gated}/profile?steps=1", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(_err.HTTPError) as ei:
+                _rq.urlopen(req, timeout=30)
+            assert ei.value.code == 403
+            assert eng._profile_pending is None
+        finally:
+            httpd.shutdown()
+
+
 class TestDisabledNoOp:
     @pytest.fixture(scope="class")
     def plain_engine(self):

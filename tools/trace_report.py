@@ -33,6 +33,18 @@ dump/commit latency percentiles, and ``episode_retry``/``quarantine``
 instants (api/workflow_api.py) give the retry-attempt histogram and the
 quarantined-sample list — the first-look answer to "what is the
 checkpoint tax and how sick are my reward/env backends".
+
+``--lineage`` reads a lineage-ledger JSONL (r9:
+``utils/telemetry.LineageLedger`` — the per-sample records
+``WorkflowExecutor`` appends on consumption and snapshots into recover
+checkpoints) instead of a span trace: one row per sample with attempts,
+servers, weight versions, migrations, staleness at consumption, and the
+consuming step — the full reconstruction of a trajectory's life from
+the ledger alone.
+
+``--fleet`` reads a telemetry-hub run-manifest JSON (r9:
+``TelemetryCollector.manifest`` / ``GET /manifest``) and prints the
+fleet rollup, the anomaly table, and a per-server line.
 """
 
 import argparse
@@ -351,6 +363,157 @@ def format_durability(du: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def load_lineage(path: str) -> List[Dict[str, Any]]:
+    """Lineage-ledger JSONL → list of per-sample records."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def lineage_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-sample lineage table + fleet-shape aggregates: how many
+    samples migrated mid-generation, how many needed retries, and the
+    staleness-at-consumption distribution."""
+    rows = []
+    staleness: List[int] = []
+    for r in records:
+        rewards = r.get("rewards") or []
+        st = r.get("staleness_max")
+        if st is not None:
+            staleness.append(int(st))
+        rows.append(
+            {
+                "uid": str(r.get("uid", "?")),
+                "status": str(r.get("status", "?")),
+                "attempts": int(r.get("attempts", 1)),
+                "requests": len(r.get("requests", [])),
+                "servers": list(r.get("servers", [])),
+                "weight_versions": list(r.get("weight_versions", [])),
+                "failovers": int(r.get("failovers", 0)),
+                "migrations": int(r.get("migrations", 0)),
+                "staleness_max": st,
+                "consumed_step": r.get("consumed_step"),
+                "reward_mean": (
+                    round(sum(rewards) / len(rewards), 4)
+                    if rewards else None
+                ),
+            }
+        )
+    staleness.sort()
+    return {
+        "samples": len(rows),
+        "consumed": sum(
+            1 for r in rows if r["consumed_step"] is not None
+        ),
+        "migrated": sum(1 for r in rows if r["migrations"] > 0),
+        "multi_server": sum(1 for r in rows if len(r["servers"]) > 1),
+        "multi_version": sum(
+            1 for r in rows if len(r["weight_versions"]) > 1
+        ),
+        "retried": sum(1 for r in rows if r["attempts"] > 1),
+        "quarantined": sum(
+            1 for r in rows if r["status"] == "quarantined"
+        ),
+        "staleness_p50": _percentile(staleness, 0.50),
+        "staleness_max": staleness[-1] if staleness else 0,
+        "rows": rows,
+    }
+
+
+def format_lineage(ln: Dict[str, Any]) -> str:
+    out = [
+        f"samples              {ln['samples']} "
+        f"(consumed {ln['consumed']}, quarantined {ln['quarantined']})",
+        f"migrated mid-gen     {ln['migrated']} "
+        f"(multi-server {ln['multi_server']}, "
+        f"multi-version {ln['multi_version']})",
+        f"retried episodes     {ln['retried']}",
+        f"staleness            p50 {ln['staleness_p50']}  "
+        f"max {ln['staleness_max']}",
+        "",
+        f"{'uid':<22}{'st':<4}{'att':>4}{'req':>4}{'srv':>4}"
+        f"{'vers':<12}{'mig':>4}{'stale':>6}{'step':>6}{'reward':>8}",
+    ]
+    for r in ln["rows"]:
+        vers = ",".join(str(v) for v in r["weight_versions"]) or "-"
+        out.append(
+            f"{r['uid'][:21]:<22}{r['status'][:3]:<4}"
+            f"{r['attempts']:>4}{r['requests']:>4}"
+            f"{len(r['servers']):>4}{vers[:11]:<12}"
+            f"{r['migrations']:>4}"
+            f"{r['staleness_max'] if r['staleness_max'] is not None else '-':>6}"
+            f"{r['consumed_step'] if r['consumed_step'] is not None else '-':>6}"
+            f"{r['reward_mean'] if r['reward_mean'] is not None else '-':>8}"
+        )
+    return "\n".join(out)
+
+
+def fleet_summary(manifest: Dict[str, Any]) -> Dict[str, Any]:
+    rollup = manifest.get("rollup", {})
+    anomalies = manifest.get("anomalies", {})
+    servers = manifest.get("servers", {})
+    return {
+        "servers": {
+            a: {
+                "reachable": bool(s.get("reachable")),
+                "state": s.get("state", "?"),
+                "running": s.get("metrics", {}).get(
+                    "running_requests", 0.0
+                ),
+                "decode_tps": s.get("metrics", {}).get(
+                    "decode_tokens_per_sec", 0.0
+                ),
+                "kv_util": s.get("metrics", {}).get(
+                    "kv_page_utilization", 0.0
+                ),
+                "stall_scrapes": s.get("stall_scrapes", 0),
+            }
+            for a, s in sorted(servers.items())
+        },
+        "rollup": rollup,
+        "anomalies": anomalies,
+        "anomalies_active": sorted(
+            a for a, v in anomalies.items() if v
+        ),
+    }
+
+
+def format_fleet(fl: Dict[str, Any]) -> str:
+    r = fl["rollup"]
+    out = [
+        f"servers              {int(r.get('servers_total', 0))} "
+        f"(scraped {int(r.get('servers_scraped', 0))})",
+        f"running requests     {r.get('running_requests', 0.0):.0f} "
+        f"(queued {r.get('queued_requests', 0.0):.0f})",
+        f"decode tok/s         {r.get('decode_tokens_per_sec', 0.0):.1f}",
+        f"kv utilization       mean "
+        f"{r.get('kv_page_utilization_mean', 0.0) * 100:.1f}%  max "
+        f"{r.get('kv_page_utilization_max', 0.0) * 100:.1f}%",
+        f"queue wait           p50 "
+        f"{r.get('queue_wait_p50_s', 0.0) * 1e3:.1f}ms  p95 "
+        f"{r.get('queue_wait_p95_s', 0.0) * 1e3:.1f}ms",
+        f"spec accept rate     {r.get('spec_accept_rate', 0.0):.3f}",
+        f"dropped trace spans  "
+        f"{int(r.get('tracing_dropped_spans_total', 0))}",
+        f"anomalies active     {fl['anomalies_active'] or 'none'}",
+        "",
+        f"{'server':<24}{'up':<4}{'state':<12}{'run':>5}"
+        f"{'tok/s':>9}{'kv%':>7}{'stall':>6}",
+    ]
+    for addr, s in fl["servers"].items():
+        out.append(
+            f"{addr:<24}{'y' if s['reachable'] else 'n':<4}"
+            f"{str(s['state']):<12}{s['running']:>5.0f}"
+            f"{s['decode_tps']:>9.1f}{s['kv_util'] * 100:>6.1f}%"
+            f"{s['stall_scrapes']:>6}"
+        )
+    return "\n".join(out)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -402,7 +565,40 @@ def main(argv=None) -> int:
         "spans + episode_retry/quarantine instants) instead of the "
         "latency table; exit 1 when the trace carries none",
     )
+    p.add_argument(
+        "--lineage", action="store_true",
+        help="treat the input as a lineage-ledger JSONL "
+        "(WorkflowExecutor per-sample records) and print the "
+        "attempt/migration/staleness table; exit 1 when it is empty",
+    )
+    p.add_argument(
+        "--fleet", action="store_true",
+        help="treat the input as a telemetry-hub run-manifest JSON "
+        "(GET /manifest) and print the fleet rollup + anomaly table; "
+        "exit 1 when no server was ever scraped",
+    )
     args = p.parse_args(argv)
+    if args.lineage:
+        ln = lineage_summary(load_lineage(args.trace))
+        if args.json:
+            print(json.dumps(ln, indent=2))
+        else:
+            print(format_lineage(ln))
+        if ln["samples"] == 0:
+            print("no lineage records in file", file=sys.stderr)
+            return 1
+        return 0
+    if args.fleet:
+        with open(args.trace) as f:
+            fl = fleet_summary(json.load(f))
+        if args.json:
+            print(json.dumps(fl, indent=2))
+        else:
+            print(format_fleet(fl))
+        if not fl["servers"]:
+            print("manifest names no servers", file=sys.stderr)
+            return 1
+        return 0
     spans = load_spans(args.trace)
     if args.durability:
         du = durability_summary(spans)
