@@ -527,6 +527,56 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class EnvServiceConfig:
+    """Environment service plane (env/service.py): sessionful env workers
+    behind HTTP, health-probed/circuit-broken by the same FleetMonitor
+    machinery as the generation fleet, with client-side failover. A
+    ``RemoteEnv`` journals ``(reset_kwargs, action log)`` per session and,
+    when a worker dies mid-episode, deterministically replays the journal
+    onto a healthy worker (envs declare ``replay_safe``; non-replayable
+    envs surface :class:`EnvSessionLostError` into the executor's episode
+    retry/quarantine path instead of hanging the rollout thread)."""
+
+    enabled: bool = False
+    # workers the launcher spawns (python -m areal_tpu.env.service)
+    n_workers: int = 1
+    # env served by each worker: "module:attr" where attr is a zero-arg
+    # factory (or Env subclass) producing one Env instance per session,
+    # e.g. "areal_tpu.env.service:countdown_env"
+    env_spec: str = ""
+    host: str = "127.0.0.1"
+    # concurrent sessions one worker admits before /reset answers 429
+    max_sessions: int = 512
+    # idle seconds before a worker expires a leaked session (crashed
+    # client, failed best-effort close); <= 0 disables the sweeper
+    session_ttl_s: float = 3600.0
+    # --- client-side call bounds (RemoteEnv) ---
+    reset_timeout_s: float = 30.0
+    call_timeout_s: float = 30.0
+    # transient-retry budget per worker per call (utils/http policy:
+    # connect/timeout/5xx retry with jittered backoff; 4xx never retry)
+    call_retries: int = 3
+    # first transient-retry backoff, doubled per attempt (jittered)
+    retry_delay_s: float = 0.5
+    # worker hops one session may make before the failure propagates
+    max_failovers: int = 4
+    # compare replayed (observation, reward, done) against the journal
+    # and fail the session on divergence — a worker pair that disagrees
+    # is a determinism bug, not a resumable state
+    verify_replay: bool = True
+    # --- workflow-side tool bound (satellite: bounded in-process tools;
+    # a timeout/exception becomes an error observation, not a crash) ---
+    tool_timeout_s: float = 30.0
+    # env workers the local launcher will respawn after a crash before
+    # giving up (replacements re-register; membership finds them)
+    max_worker_respawns: int = 8
+    # health/circuit parameters for the env fleet monitor
+    fleet: "FleetConfig" = dataclasses.field(
+        default_factory=lambda: FleetConfig()
+    )
+
+
+@dataclasses.dataclass
 class DurabilityConfig:
     """Training-loop durability plane (api/workflow_api.py
     `WorkflowExecutor`): a flaky reward/env call must not silently drop a
@@ -680,6 +730,11 @@ class GRPOConfig(BaseExperimentConfig):
     )
     rollout: InferenceEngineConfig = dataclasses.field(default_factory=InferenceEngineConfig)
     server: JaxGenConfig = dataclasses.field(default_factory=JaxGenConfig)
+    # environment service plane (env/service.py): remote sessionful env
+    # workers with replay-based failover for agentic rollouts
+    env_service: EnvServiceConfig = dataclasses.field(
+        default_factory=EnvServiceConfig
+    )
     actor: PPOActorConfig = dataclasses.field(default_factory=PPOActorConfig)
     ref: Optional[PPOActorConfig] = None
 

@@ -4,8 +4,49 @@ import abc
 from typing import Any, Dict, Tuple
 
 
+class EnvServiceError(RuntimeError):
+    """Base class for environment-service-plane failures (the episode
+    itself is lost: worker death, fleet down). Lives next to the Env
+    contract so workflow code can type-match without importing the
+    service implementation (env/service.py and its HTTP stack)."""
+
+
+class EnvWorkerUnavailableError(EnvServiceError):
+    """No env worker could serve the call (whole pool unreachable or the
+    failover budget is spent). Typed so the executor's episode
+    retry/quarantine machinery owns it instead of a bare stack trace."""
+
+
+class EnvSessionLostError(EnvServiceError):
+    """A session's worker died and the env is not replay-safe (or the
+    replay diverged): the episode cannot be resumed. Routes the episode
+    into retry/quarantine — never silently resumed on divergent state."""
+
+
+class EnvActionError(RuntimeError):
+    """The ENV raised while executing an action (worker answered 422) —
+    the infrastructure is fine, the action was poison. Deliberately NOT
+    an EnvServiceError: workflows convert it into an error observation
+    (exactly like a local ``env.call`` raising), never a failover."""
+
+
 class Env(abc.ABC):
-    """Async environment for agentic workflows."""
+    """Async environment for agentic workflows.
+
+    ``replay_safe`` is the env's determinism declaration for the
+    environment service plane (env/service.py): a replay-safe env
+    guarantees that re-running ``areset(**kwargs)`` followed by the same
+    action sequence reproduces the same observations, rewards, and done
+    flags — so when a remote env worker dies mid-episode, the client may
+    reconstruct the session on a healthy worker by replaying its journal.
+    Envs with hidden nondeterminism (wall-clock state, external mutation,
+    unseeded randomness) must leave it False; their in-flight episodes
+    route into the executor's episode-retry/quarantine path on worker
+    death instead of being silently resumed against divergent state.
+    """
+
+    #: deterministic (reset_kwargs, actions) -> trajectory; see class doc
+    replay_safe: bool = False
 
     async def areset(self, **kwargs) -> Any:
         """Start an episode; returns the initial observation."""

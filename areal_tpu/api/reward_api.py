@@ -7,10 +7,17 @@ async rollout loop must not stall on them, so they run in a thread pool.
 
 import asyncio
 import concurrent.futures
+import contextvars
 import functools
 from typing import Callable, Optional
 
 _DEFAULT_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+class RewardTimeoutError(RuntimeError):
+    """A reward call exceeded its time budget. Typed (rather than a bare
+    asyncio.TimeoutError) so the executor's episode retry/quarantine
+    machinery can tell a sick reward backend from a cancelled task."""
 
 
 def _pool() -> concurrent.futures.ThreadPoolExecutor:
@@ -24,15 +31,37 @@ def _pool() -> concurrent.futures.ThreadPoolExecutor:
 
 class AsyncRewardWrapper:
     """Wrap a sync ``reward_fn(prompt, completion, prompt_ids,
-    completion_ids, **data) -> float`` for use inside ``arun_episode``."""
+    completion_ids, **data) -> float`` for use inside ``arun_episode``.
 
-    def __init__(self, reward_fn: Callable[..., float]):
+    ``timeout_s`` bounds each call: a reward backend that hangs (remote
+    verifier wedged, sandbox deadlock) raises :class:`RewardTimeoutError`
+    after the budget instead of pinning the episode task forever. The
+    worker thread itself cannot be interrupted — the bound is on the
+    episode's wait, which is what keeps the rollout pipeline live."""
+
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        timeout_s: Optional[float] = None,
+    ):
         self.reward_fn = reward_fn
+        self.timeout_s = timeout_s
 
     async def __call__(self, *args, **kwargs) -> float:
         loop = asyncio.get_running_loop()
-        return float(
-            await loop.run_in_executor(
-                _pool(), functools.partial(self.reward_fn, *args, **kwargs)
-            )
+        # propagate the episode-lineage contextvar into the worker thread
+        # (trace headers on remote verifier calls depend on it)
+        ctx = contextvars.copy_context()
+        fut = loop.run_in_executor(
+            _pool(),
+            ctx.run,
+            functools.partial(self.reward_fn, *args, **kwargs),
         )
+        if self.timeout_s:
+            try:
+                return float(await asyncio.wait_for(fut, self.timeout_s))
+            except asyncio.TimeoutError:
+                raise RewardTimeoutError(
+                    f"reward_fn did not return within {self.timeout_s}s"
+                ) from None
+        return float(await fut)

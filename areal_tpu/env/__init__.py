@@ -1,3 +1,27 @@
 """Environment implementations for agentic workflows."""
 
 from areal_tpu.env.math_code_env import MathCodeSingleStepEnv  # noqa: F401
+
+# env/service.py (the environment service plane) is exported lazily: it
+# pulls in the HTTP client stack, which module-level importers of this
+# package (and every env-worker subprocess) shouldn't pay for unless
+# they actually touch the remote plane.
+_SERVICE_EXPORTS = (
+    "EnvServiceError",
+    "EnvSessionLostError",
+    "EnvWorkerUnavailableError",
+    "RemoteEnv",
+    "RemoteToolEnv",
+    "ToolEnvAdapter",
+    "serve_env",
+)
+
+__all__ = ["MathCodeSingleStepEnv", *_SERVICE_EXPORTS]
+
+
+def __getattr__(name):
+    if name in _SERVICE_EXPORTS:
+        from areal_tpu.env import service
+
+        return getattr(service, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -12,10 +12,14 @@ Query metadata decides the verifier per reset:
 ``verifier_addrs`` (or env AREAL_TPU_VERIFIER_ADDRS, comma-separated)
 routes verification to a remote pool (reward/verifier_service — the
 reference's FUNCTIONCALL_SERVICE_DOMAIN mode, functioncall/base/call.py:21)
-so interpreters never run on the trainer host.
+so interpreters never run on the trainer host. In remote mode an
+unreachable pool raises ``VerifierUnavailableError`` out of ``astep`` —
+the executor's episode retry/quarantine machinery owns it; a fabricated
+0.0 reward would silently poison training.
 """
 
 import asyncio
+import contextvars
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -29,6 +33,11 @@ _REMOTE_POOL = ThreadPoolExecutor(max_workers=128, thread_name_prefix="verif")
 
 
 class MathCodeSingleStepEnv(Env):
+    # one pure verification step per episode: replaying (reset kwargs,
+    # the single action) on another worker reproduces the same reward,
+    # so a dead env worker can resume this env's sessions
+    replay_safe = True
+
     def __init__(
         self,
         timeout_s: float = 15.0,
@@ -45,8 +54,9 @@ class MathCodeSingleStepEnv(Env):
         if addrs:
             from areal_tpu.reward.verifier_service import RemoteVerifier
 
-            # explicit remote mode: NEVER run interpreters on this host,
-            # even if the pool is down (score 0 + warning instead)
+            # explicit remote mode: NEVER run interpreters on this host.
+            # A dead pool raises VerifierUnavailableError into episode
+            # retry/quarantine — not a silent 0.0 score
             self._remote = RemoteVerifier(addrs, local_fallback=False)
 
     async def areset(self, **kwargs) -> Any:
@@ -76,8 +86,12 @@ class MathCodeSingleStepEnv(Env):
                     "timeout": self.timeout_s,
                 }
             )
+            # carry the episode-lineage contextvar into the worker thread
+            # (run_in_executor does not propagate context): the verifier
+            # client reads it for X-Areal-Trace header propagation
+            ctx = contextvars.copy_context()
             reward = await loop.run_in_executor(
-                _REMOTE_POOL, lambda: self._remote.verify(item)
+                _REMOTE_POOL, ctx.run, lambda: self._remote.verify(item)
             )
             return None, float(reward), True, {"task": task}
         if task == "code":

@@ -1,0 +1,1261 @@
+"""Environment service plane: sessionful env workers + failover client.
+
+ROADMAP open item 5 ("environment-as-a-service"): environments used to run
+in-process with the rollout thread, so one hung or crashing tool call
+stalled or killed an episode, and env-worker loss had no story at all.
+This module gives env/reward execution the same independent-failure-domain
+treatment the generation fleet got in PR 4 (ROLL Flash's agentic
+asynchrony and Laminar's decoupled trajectory workers make the same
+separation):
+
+**Worker** (``serve_env`` / ``python -m areal_tpu.env.service``): a
+threaded HTTP service hosting one :class:`areal_tpu.api.env_api.Env`
+instance per session over the session protocol
+
+    POST /reset  {"kwargs": {...}}          -> {"session", "observation",
+                                                "replay_safe"}
+    POST /step   {"session", "action"}      -> {"observation", "reward",
+                                                "done", "info"}
+    POST /close  {"session"}                -> {"closed"}
+    GET  /health                            -> {"status": "ok"|"draining"}
+    GET  /metrics (Prometheus)   GET /trace (span drain)
+    POST /drain  (stop admitting; deregister when sessions empty)
+    POST /chaos  (runtime fault injection, utils/chaos.py grammar)
+
+Workers self-register under the name_resolve ``env_servers`` subtree, so
+the same :class:`areal_tpu.inference.fleet.FleetMonitor` state machine
+that probes generation servers health-probes and circuit-breaks env
+workers (``env_fleet_monitor``), and ``/health`` draining is classified
+out-of-rotation without opening a circuit.
+
+**Client** (:class:`RemoteEnv`): implements the ``Env`` contract with
+per-call timeouts and the ``utils/http`` retry policy (connect/timeout/
+5xx-only retries, bounded-jitter backoff, client-side chaos hooks), and
+**deterministic episode replay on worker death**: each session journals
+``(reset_kwargs, [(action, observation, reward, done), ...])`` and, when
+its worker goes DEAD mid-episode, replays the journal onto a healthy
+worker to reconstruct the session — token-exactly for ``replay_safe``
+envs (replayed observations are verified against the journal). Envs that
+do NOT declare ``replay_safe`` raise :class:`EnvSessionLostError`
+instead, which the workflow lets propagate so the executor's episode
+retry/quarantine machinery (PR 6) owns the failure — the rollout thread
+never hangs and never silently trains on divergent state.
+
+:class:`RemoteToolEnv` adapts a remote session to the tool-env protocol
+``AgenticToolWorkflow`` speaks (``tools`` / ``prompt()`` / ``acall()`` /
+``done`` / ``reward``), and :class:`ToolEnvAdapter` is the server-side
+inverse (hosts a tool env behind the gym contract), so the shipped
+countdown game runs remote end-to-end (``countdown_env``).
+"""
+
+import asyncio
+import contextlib
+import importlib
+import json
+import os
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from areal_tpu.api.cli_args import EnvServiceConfig
+from areal_tpu.api.env_api import (
+    Env,
+    EnvActionError,
+    EnvServiceError,
+    EnvSessionLostError,
+    EnvWorkerUnavailableError,
+)
+from areal_tpu.utils import chaos, name_resolve, names, telemetry
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
+from areal_tpu.utils.tracing import (
+    TRACE_HEADER,
+    SpanTracer,
+    TracingConfig,
+    render_prometheus,
+    trace_headers,
+    trace_response,
+)
+
+logger = logging_util.getLogger("EnvService")
+
+# env var the launcher exports so trainer processes can find the workers
+# without a shared name_resolve (comma-separated host:port)
+ADDRS_ENV = "AREAL_ENV_SERVER_ADDRS"
+
+
+# The typed error family lives in api/env_api.py (next to the Env
+# contract, so workflows type-match without importing this HTTP stack);
+# re-exported here for the service plane's callers.
+__all__ = [
+    "EnvActionError",
+    "EnvServiceError",
+    "EnvSessionLostError",
+    "EnvWorkerUnavailableError",
+    "RemoteEnv",
+    "RemoteToolEnv",
+    "ToolEnvAdapter",
+    "serve_env",
+]
+
+
+def _is_infra_error(e: Exception) -> bool:
+    """Whether an exception raised INSIDE a hosted env means "a backend
+    this env depends on is down" rather than "the action was poison".
+    Infra errors must answer 500 (worker-failure semantics → client
+    failover → episode retry/quarantine when the whole plane is sick);
+    mapping them to 422 would convert e.g. a dead verifier pool back
+    into error-observation rows — the silent poisoning this PR removes."""
+    from areal_tpu.api.reward_api import RewardTimeoutError
+    from areal_tpu.reward.verifier_service import VerifierUnavailableError
+
+    return isinstance(
+        e, (EnvServiceError, VerifierUnavailableError, RewardTimeoutError)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Hosted-env resolution
+# ---------------------------------------------------------------------------
+def resolve_env_factory(spec: str) -> Callable[[], Env]:
+    """``module:attr`` -> zero-arg factory producing one Env per session.
+    ``attr`` may already be such a factory (or an Env subclass)."""
+    mod, _, attr = spec.partition(":")
+    if not mod or not attr:
+        raise ValueError(
+            f"env spec {spec!r} must look like 'package.module:attr'"
+        )
+    obj = getattr(importlib.import_module(mod), attr)
+    if not callable(obj):
+        raise TypeError(f"env spec {spec!r} resolved to non-callable {obj!r}")
+    return obj
+
+
+class ToolEnvAdapter(Env):
+    """Host a tool-style env (``tools``/``prompt()``/``call()``/``done``/
+    ``reward`` — the protocol AgenticToolWorkflow speaks) behind the gym
+    Env contract so the service can serve it sessionfully. The reset
+    observation carries the prompt and tool schemas; an action is one
+    parsed tool call ``{"name", "arguments"}``; reward is delivered when
+    the tool env reports done.
+
+    ``replay_safe`` is the FACTORY AUTHOR'S promise about the wrapped
+    env (the adapter cannot know): default True fits pure state machines
+    of their call log (the shipped countdown); wrap a tool env with
+    hidden nondeterminism (web lookups, unseeded randomness) with
+    ``replay_safe=False`` so worker death quarantines instead of
+    silently resuming a divergent trajectory."""
+
+    def __init__(
+        self,
+        factory: Callable[[Dict[str, Any]], Any],
+        replay_safe: bool = True,
+    ):
+        self._factory = factory
+        self._env = None
+        self.replay_safe = replay_safe
+
+    async def areset(self, **kwargs) -> Any:
+        self._env = self._factory(dict(kwargs))
+        return {"prompt": self._env.prompt(), "tools": self._env.tools}
+
+    async def astep(
+        self, action: Any
+    ) -> Tuple[Any, float, bool, Dict[str, Any]]:
+        name = str(action.get("name", ""))
+        arguments = action.get("arguments", "")
+        # tool call() is sync and possibly slow (sandboxes, subprocesses):
+        # run it on the executor so one hung tool cannot wedge the
+        # worker's shared env loop — every other session keeps stepping
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, self._env.call, name, arguments
+        )
+        done = bool(self._env.done)
+        reward = float(getattr(self._env, "reward", 0.0)) if done else 0.0
+        info = {"detail": str(getattr(self._env, "detail", ""))}
+        return result, reward, done, info
+
+    async def aclose(self):
+        self._env = None
+
+
+def countdown_env() -> ToolEnvAdapter:
+    """Factory for serving the countdown game (env/countdown.py) as a
+    remote tool env: reset kwargs are {"numbers": [...], "target": n}."""
+    from areal_tpu.env.countdown import CountdownEnv
+
+    return ToolEnvAdapter(
+        lambda kw: CountdownEnv(
+            numbers=[int(x) for x in kw["numbers"]], target=int(kw["target"])
+        )
+    )
+
+
+def math_code_env() -> Env:
+    """Factory for serving the single-step verifiable-reward env."""
+    from areal_tpu.env.math_code_env import MathCodeSingleStepEnv
+
+    return MathCodeSingleStepEnv()
+
+
+# ---------------------------------------------------------------------------
+# Worker (server side)
+# ---------------------------------------------------------------------------
+class _Session:
+    __slots__ = (
+        "sid", "env", "lock", "steps", "created", "last_active",
+        "last_action", "last_response",
+    )
+
+    def __init__(self, sid: str, env: Env, t: float):
+        self.sid = sid
+        self.env = env
+        # steps within one session are serialized (envs are stateful);
+        # different sessions run concurrently on the handler threads
+        self.lock = threading.Lock()
+        self.steps = 0
+        self.created = t
+        self.last_active = t
+        # idempotency cache for the LAST applied step: a client whose
+        # response was lost in flight re-POSTs (seq, action) and gets the
+        # cached answer back instead of double-applying the action
+        self.last_action: Any = None
+        self.last_response: Optional[Dict[str, Any]] = None
+
+
+class EnvWorkerState:
+    """Everything the handler shares: the env factory, live sessions, a
+    dedicated asyncio loop thread the Env coroutines run on, counters,
+    drain mode, and the name_resolve registration to tear down."""
+
+    def __init__(
+        self,
+        factory: Callable[[], Env],
+        max_sessions: int = 512,
+        tracer: Optional[SpanTracer] = None,
+        session_ttl_s: float = 3600.0,
+    ):
+        self.factory = factory
+        self.max_sessions = max_sessions
+        self.session_ttl_s = session_ttl_s
+        # `is not None`, not truthiness: SpanTracer defines __len__, so
+        # a fresh (empty) tracer is falsy and `or` would discard it
+        self.tracer = (
+            tracer if tracer is not None
+            else SpanTracer(TracingConfig(enabled=False))
+        )
+        self.sessions: Dict[str, _Session] = {}
+        # resets reserved but not yet inserted (counts against capacity
+        # and keeps _watch_drain honest about in-flight sessions)
+        self.pending_resets = 0
+        self.lock = threading.Lock()
+        self.draining = threading.Event()
+        self.registration_key: Optional[str] = None
+        self.counters = {
+            "resets_total": 0.0,
+            "steps_total": 0.0,
+            "closes_total": 0.0,
+            "errors_total": 0.0,
+            "rejected_draining_total": 0.0,
+            "rejected_capacity_total": 0.0,
+            "sessions_expired_total": 0.0,
+        }
+        # step-latency EWMA (seconds) — the per-worker health signal the
+        # telemetry hub can scrape without draining traces
+        self.step_latency_ewma_s = 0.0
+        # env coroutines run on ONE loop thread (handler threads submit
+        # via run_coroutine_threadsafe): envs may hold loop-bound state
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True, name="env-loop"
+        )
+        self._loop_thread.start()
+        self._drain_watcher: Optional[threading.Thread] = None
+        # idle-session TTL sweeper: crashed clients, failed best-effort
+        # closes, and abandoned replays leak sessions; without a GC they
+        # ratchet sessions_active up to max_sessions (every reset 429s)
+        # and a drain never completes. TTL <= 0 disables (tests).
+        if session_ttl_s > 0:
+            threading.Thread(
+                target=self._sweep_expired, daemon=True, name="env-ttl"
+            ).start()
+
+    def _sweep_expired(self) -> None:
+        interval = max(0.05, self.session_ttl_s / 4.0)
+        while True:
+            time.sleep(interval)
+            now = time.monotonic()
+            with self.lock:
+                expired = [
+                    (sid, s) for sid, s in self.sessions.items()
+                    if now - s.last_active > self.session_ttl_s
+                ]
+                for sid, _ in expired:
+                    self.sessions.pop(sid, None)
+            for sid, sess in expired:
+                logger.warning(
+                    f"session {sid} expired after "
+                    f"{self.session_ttl_s:.0f}s idle (client gone?)"
+                )
+                with sess.lock:
+                    try:
+                        self.run(sess.env.aclose(), timeout=30)
+                    except Exception as e:
+                        logger.warning(f"expired aclose {sid}: {e}")
+                self.bump("sessions_expired_total")
+                if self.tracer.enabled:
+                    self.tracer.unbind_trace(sid)
+
+    def run(self, coro, timeout: Optional[float] = None):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            timeout
+        )
+
+    def bump(self, key: str, n: float = 1.0) -> None:
+        with self.lock:
+            self.counters[key] = self.counters.get(key, 0.0) + n
+
+    def metrics(self) -> Dict[str, float]:
+        with self.lock:
+            out = dict(self.counters)
+            out["sessions_active"] = float(len(self.sessions))
+            out["draining"] = float(self.draining.is_set())
+            out["step_latency_ewma_s"] = self.step_latency_ewma_s
+        t = self.tracer
+        if t.enabled:
+            out["trace_spans"] = float(len(t))
+            out["tracing_dropped_spans_total"] = float(t.dropped)
+        return out
+
+    def deregister(self) -> None:
+        key, self.registration_key = self.registration_key, None
+        if key is None:
+            return
+        try:
+            name_resolve.delete(key)
+            logger.info(f"env worker deregistered {key}")
+        except Exception as e:
+            logger.warning(f"env worker deregister failed: {e}")
+
+    def start_drain(self) -> int:
+        """Enter drain mode; in-flight sessions may step to completion,
+        new /reset calls get 503. Returns the live-session count."""
+        self.draining.set()
+        with self.lock:
+            n = len(self.sessions)
+        if self._drain_watcher is None or not self._drain_watcher.is_alive():
+            self._drain_watcher = threading.Thread(
+                target=self._watch_drain, daemon=True
+            )
+            self._drain_watcher.start()
+        return n
+
+    def _watch_drain(self) -> None:
+        while True:
+            with self.lock:
+                if not self.sessions and self.pending_resets == 0:
+                    break
+            time.sleep(0.2)
+        self.deregister()
+        logger.info("env drain complete: no live sessions, deregistered")
+
+
+_METRIC_HELP = {
+    "sessions_active": "env sessions currently live on this worker",
+    "resets_total": "sessions created (POST /reset)",
+    "steps_total": "env steps executed (POST /step)",
+    "closes_total": "sessions closed (POST /close)",
+    "errors_total": "env calls that raised (answered 500)",
+    "rejected_draining_total": "resets refused while draining (503)",
+    "rejected_capacity_total": "resets refused at max_sessions (429)",
+    "draining": "1 while this worker is draining",
+    "step_latency_ewma_s": "EWMA of env step execution latency",
+    "trace_spans": "spans currently buffered (drained by GET /trace)",
+    "tracing_dropped_spans_total": (
+        "spans lost to ring-buffer overflow (the trace is truncated)"
+    ),
+}
+
+
+class _EnvHandler(BaseHTTPRequestHandler):
+    state: EnvWorkerState = None  # set by serve_env()
+    chaos_endpoint: bool = True  # CLI path gates behind --enable-chaos
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- plumbing (inference/server.py idiom) ---------------------------
+    def _send_json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, body: bytes, content_type: str):
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _apply_chaos(self) -> bool:
+        """Server-side chaos (shared dispatch, utils/chaos.py): how the
+        chaos test makes an env worker die mid-episode, deterministically."""
+        return chaos.apply_server_chaos(self, self._send_json)
+
+    # -- endpoints ------------------------------------------------------
+    def do_GET(self):
+        if self._apply_chaos():
+            return
+        st = self.state
+        url = urllib.parse.urlparse(self.path)
+        if url.path == "/health":
+            self._send_json(
+                {"status": "draining" if st.draining.is_set() else "ok"}
+            )
+        elif url.path == "/metrics":
+            body = render_prometheus(
+                st.metrics(), prefix="areal_tpu_env_",
+                help_text=_METRIC_HELP,
+            ).encode()
+            self._send_text(body, "text/plain; version=0.0.4")
+        elif url.path == "/trace":
+            body, ctype = trace_response(st.tracer, url.query)
+            self._send_text(body, ctype)
+        else:
+            self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_POST(self):
+        if self._apply_chaos():
+            return
+        st = self.state
+        try:
+            payload = self._read_json()
+        except json.JSONDecodeError:
+            self._send_json({"error": "bad json"}, 400)
+            return
+        try:
+            if self.path == "/reset":
+                self._do_reset(payload)
+            elif self.path == "/step":
+                self._do_step(payload)
+            elif self.path == "/close":
+                self._do_close(payload)
+            elif self.path == "/drain":
+                n = st.start_drain()
+                self._send_json({"status": "draining", "sessions": n})
+            elif self.path == "/chaos":
+                if not self.chaos_endpoint:
+                    self._send_json(
+                        {"error": "chaos endpoint disabled "
+                         "(start the worker with --enable-chaos)"}, 403
+                    )
+                    return
+                inj = chaos.configure(payload.get("spec") or None)
+                self._send_json({
+                    "success": True,
+                    "rules": inj.stats() if inj else [],
+                })
+            else:
+                self._send_json({"error": f"unknown path {self.path}"}, 404)
+        except Exception as e:  # env bugs become 500s, never worker death
+            st.bump("errors_total")
+            logger.error(f"{self.path} failed: {type(e).__name__}: {e}")
+            self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def _bind_trace(self, sid: str) -> None:
+        trace_id = self.headers.get(TRACE_HEADER)
+        if trace_id and self.state.tracer.enabled:
+            self.state.tracer.bind_trace(sid, trace_id)
+
+    def _do_reset(self, payload: dict) -> None:
+        st = self.state
+        # admission is one atomic reservation: draining + capacity are
+        # checked and the slot claimed under a single lock hold, so two
+        # racing resets cannot overshoot max_sessions and a drain that
+        # starts mid-reset cannot report complete (and deregister) while
+        # this session is still materializing (_watch_drain also counts
+        # pending_resets)
+        with st.lock:
+            if st.draining.is_set():
+                reject: Optional[Tuple[dict, int]] = (
+                    {"error": "draining"}, 503,
+                )
+            elif len(st.sessions) + st.pending_resets >= st.max_sessions:
+                reject = ({"error": f"at max_sessions={st.max_sessions}"},
+                          429)
+            else:
+                reject = None
+                st.pending_resets += 1
+        if reject is not None:
+            st.bump(
+                "rejected_draining_total" if reject[1] == 503
+                else "rejected_capacity_total"
+            )
+            self._send_json(*reject)
+            return
+        try:
+            kwargs = payload.get("kwargs") or {}
+            env = st.factory()
+            sid = uuid.uuid4().hex[:16]
+            self._bind_trace(sid)
+            try:
+                with st.tracer.span("env_reset", sid):
+                    obs = st.run(env.areset(**kwargs))
+            except Exception as e:
+                if _is_infra_error(e):
+                    raise  # backend failure inside the env → 500
+                # the ENV rejected the reset — infrastructure is fine,
+                # the kwargs were poison: 422 is the client's "action
+                # error" signal (episode-level error, never a failover)
+                st.bump("errors_total")
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, 422
+                )
+                return
+            sess = _Session(sid, env, time.monotonic())
+            with st.lock:
+                st.sessions[sid] = sess
+        finally:
+            with st.lock:
+                st.pending_resets -= 1
+        st.bump("resets_total")
+        self._send_json({
+            "session": sid,
+            "observation": obs,
+            "replay_safe": bool(getattr(env, "replay_safe", False)),
+            "info": {},
+        })
+
+    def _do_step(self, payload: dict) -> None:
+        st = self.state
+        sid = str(payload.get("session", ""))
+        with st.lock:
+            sess = st.sessions.get(sid)
+        if sess is None:
+            # 404 is the session-loss signal: a client whose worker
+            # restarted under it must replay, not blind-retry (4xx is
+            # never retried by the http policy)
+            self._send_json({"error": f"unknown session {sid!r}"}, 404)
+            return
+        action = payload.get("action")
+        seq = payload.get("seq")
+        t0 = time.monotonic()
+        with sess.lock:
+            # step idempotency: /step is a non-idempotent POST behind a
+            # retrying client, so each step carries its journal index.
+            # A retry of the step just applied (response lost in flight)
+            # replays the cached answer; any other mismatch is a
+            # journal/session desync and answers 409 — the client
+            # treats it as session loss and rebuilds via replay,
+            # keeping its journal the single source of truth.
+            if seq is not None:
+                seq = int(seq)
+                if seq == sess.steps - 1:
+                    if action == sess.last_action and (
+                        sess.last_response is not None
+                    ):
+                        self._send_json(sess.last_response)
+                        return
+                    self._send_json(
+                        {"error": f"seq {seq} was applied with a "
+                         f"different action (session desynced)"}, 409
+                    )
+                    return
+                if seq != sess.steps:
+                    self._send_json(
+                        {"error": f"seq {seq} != expected {sess.steps} "
+                         f"(session desynced)"}, 409
+                    )
+                    return
+            try:
+                with st.tracer.span("env_step", sid, step=sess.steps):
+                    obs, reward, done, info = st.run(
+                        sess.env.astep(action)
+                    )
+            except Exception as e:
+                if _is_infra_error(e):
+                    raise  # backend failure inside the env → 500
+                # env-raised ≠ worker-dead: 422 tells the client the
+                # action was poison (error observation for the model),
+                # where a 500 would read as infrastructure failure and
+                # trigger a pointless replay storm across healthy
+                # workers. Step count and cache are untouched — the
+                # journal still matches the session.
+                st.bump("errors_total")
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, 422
+                )
+                return
+            resp = {
+                "observation": obs,
+                "reward": float(reward),
+                "done": bool(done),
+                "info": info or {},
+            }
+            sess.steps += 1
+            sess.last_active = time.monotonic()
+            sess.last_action = action
+            sess.last_response = resp
+        dt = time.monotonic() - t0
+        with st.lock:
+            st.step_latency_ewma_s = (
+                dt if st.step_latency_ewma_s == 0.0
+                else 0.9 * st.step_latency_ewma_s + 0.1 * dt
+            )
+        st.bump("steps_total")
+        self._send_json(resp)
+
+    def _do_close(self, payload: dict) -> None:
+        st = self.state
+        sid = str(payload.get("session", ""))
+        with st.lock:
+            sess = st.sessions.pop(sid, None)
+        if sess is None:
+            self._send_json({"closed": False})
+            return
+        with sess.lock:
+            try:
+                st.run(sess.env.aclose(), timeout=30)
+            except Exception as e:
+                logger.warning(f"aclose for {sid} failed: {e}")
+        if st.tracer.enabled:
+            st.tracer.unbind_trace(sid)
+        st.bump("closes_total")
+        self._send_json({"closed": True})
+
+
+def serve_env(
+    env_factory: Callable[[], Env],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    experiment_name: str = "",
+    trial_name: str = "",
+    max_sessions: int = 512,
+    background: bool = False,
+    tracer: Optional[SpanTracer] = None,
+    chaos_endpoint: bool = True,
+    session_ttl_s: float = 3600.0,
+) -> ThreadingHTTPServer:
+    """Start one env worker; returns the server (``server_address``
+    carries the bound port, ``env_state`` the worker state). Registers
+    under the name_resolve ``env_servers`` subtree when experiment/trial
+    names are given, so FleetMonitor membership discovers it."""
+    if tracer is None:
+        tracer = SpanTracer(TracingConfig(enabled=True, max_spans=20_000))
+    state = EnvWorkerState(
+        env_factory, max_sessions, tracer, session_ttl_s=session_ttl_s
+    )
+    handler = type(
+        "EnvHandler", (_EnvHandler,),
+        {"state": state, "chaos_endpoint": chaos_endpoint},
+    )
+    # port 0 goes straight to the kernel (no find-then-bind TOCTOU);
+    # server_address carries the assignment
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    port = httpd.server_address[1]
+    if not tracer.service:
+        tracer.service = f"env:{host}:{port}"
+    httpd.env_state = state  # for tests/introspection
+    if experiment_name and trial_name:
+        state.registration_key = name_resolve.add_subentry(
+            names.env_servers(experiment_name, trial_name),
+            f"{host}:{port}",
+        )
+    logger.info(f"env worker listening on {host}:{port}")
+    if background:
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="env-http"
+        ).start()
+    else:
+        httpd.serve_forever()
+    return httpd
+
+
+# ---------------------------------------------------------------------------
+# Fleet membership helpers
+# ---------------------------------------------------------------------------
+def discover_env_workers(
+    experiment_name: str = "", trial_name: str = ""
+) -> List[str]:
+    """Worker addresses: the name_resolve env_servers subtree when
+    experiment/trial are known, else the launcher's ADDRS_ENV export."""
+    if experiment_name and trial_name:
+        try:
+            addrs = name_resolve.get_subtree(
+                names.env_servers(experiment_name, trial_name)
+            )
+            if addrs:
+                return sorted(addrs)
+        except Exception as e:
+            logger.warning(f"env worker discovery failed: {e}")
+    return [a for a in os.environ.get(ADDRS_ENV, "").split(",") if a]
+
+
+def env_fleet_monitor(
+    config: EnvServiceConfig,
+    addresses: Optional[Sequence[str]] = None,
+    experiment_name: str = "",
+    trial_name: str = "",
+    **kwargs,
+):
+    """A FleetMonitor over the env-worker fleet: same state machine,
+    circuit breaker, and drain classification as the generation fleet,
+    watching the ``env_servers`` subtree for dynamic membership."""
+    from areal_tpu.inference.fleet import FleetMonitor
+
+    membership_key = None
+    if experiment_name and trial_name:
+        membership_key = names.env_servers(experiment_name, trial_name)
+    seeded = list(addresses) if addresses else discover_env_workers(
+        experiment_name, trial_name
+    )
+    return FleetMonitor(
+        seeded,
+        config=config.fleet,
+        membership_key=membership_key,
+        seed_source="seed" if addresses else "discovered",
+        service="env",
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+class RemoteEnv(Env):
+    """Env-contract client over the worker fleet, with journaled replay.
+
+    One RemoteEnv is one session (one episode): ``areset`` opens it on a
+    schedulable worker, ``astep`` drives it, ``aclose`` releases it (and
+    the HTTP session). Worker death mid-episode is handled here: for
+    ``replay_safe`` envs the journal is replayed onto a healthy worker
+    (verified step-for-step when ``verify_replay``); otherwise
+    :class:`EnvSessionLostError` propagates into episode retry/quarantine.
+    ``replay_safe`` on this class mirrors what the WORKER declared at
+    reset time, so journaling/replay policy follows the hosted env."""
+
+    def __init__(
+        self,
+        addrs: Optional[Sequence[str]] = None,
+        monitor=None,
+        config: Optional[EnvServiceConfig] = None,
+        tracer: Optional[SpanTracer] = None,
+        rr_offset: int = 0,
+        experiment_name: str = "",
+        trial_name: str = "",
+    ):
+        self.config = config or EnvServiceConfig()
+        self.monitor = monitor
+        self._experiment_name = experiment_name
+        self._trial_name = trial_name
+        self._addrs = [a for a in (addrs or [])]
+        # a discovered pool may be refreshed when it goes fully dark
+        # (launcher-respawned workers re-register under new ports; an
+        # explicit addr list or a monitor is the caller's to maintain)
+        self._discovered = not self._addrs and monitor is None
+        if not self._addrs and monitor is not None:
+            self._addrs = monitor.addresses()
+        if not self._addrs:
+            self._addrs = discover_env_workers(experiment_name, trial_name)
+        if not self._addrs:
+            raise ValueError("RemoteEnv needs at least one worker address")
+        self.tracer = tracer
+        # starting index into the worker pool. One RemoteEnv = one
+        # episode, so a fresh instance's default 0 would land EVERY
+        # parallel episode on worker[0]; factories stripe episodes by
+        # passing a shared counter's next value (tests pass 0 for a
+        # deterministic first-worker session)
+        self._rr = int(rr_offset)
+        self._http: Optional["aiohttp.ClientSession"] = None  # noqa: F821
+        # session state + journal
+        self._addr: Optional[str] = None
+        self._sid: Optional[str] = None
+        self.replay_safe = False
+        self._reset_kwargs: Dict[str, Any] = {}
+        self._journal: List[Tuple[Any, Any, float, bool]] = []
+        # counters (trace_report --env reads the spans; these feed tests
+        # and the bench cell directly)
+        self.stats = {"resets": 0, "steps": 0, "replays": 0, "failovers": 0}
+
+    # -- plumbing -------------------------------------------------------
+    async def _session(self):
+        import aiohttp
+
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        return self._http
+
+    def _headers(self) -> Optional[Dict[str, str]]:
+        ep = telemetry.current_episode()
+        if ep is None:
+            return None
+        return trace_headers(ep.trace_id, rid=self._sid or "")
+
+    def _candidates(self, exclude: Optional[str] = None) -> List[str]:
+        """Schedulable workers (monitor view when there is one), round-
+        robined so parallel episodes spread, minus the dead one."""
+        pool = self._addrs
+        if self.monitor is not None:
+            sched = [a for a in self.monitor.schedulable_addresses()]
+            # the monitor may know workers we were not seeded with
+            pool = sched or pool
+        pool = [a for a in pool if a != exclude]
+        if not pool:
+            return []
+        k = self._rr % len(pool)
+        self._rr += 1
+        return pool[k:] + pool[:k]
+
+    async def _post(
+        self, addr: str, path: str, payload: Dict[str, Any], timeout: float
+    ) -> Dict[str, Any]:
+        sess = await self._session()
+        return await arequest_with_retry(
+            sess, f"http://{addr}{path}", payload,
+            max_retries=self.config.call_retries, timeout=timeout,
+            retry_delay=self.config.retry_delay_s,
+            headers=self._headers(),
+        )
+
+    def _span(self, name: str, **attrs):
+        t = self.tracer
+        if t is None:
+            return contextlib.nullcontext()
+        return t.span(name, self._sid or "env", **attrs)
+
+    def _worker_failed(self, addr: str) -> None:
+        self.stats["failovers"] += 1
+        if self.monitor is not None:
+            self.monitor.report_failure(addr)
+        ep = telemetry.current_episode()
+        if ep is not None:
+            ep.env_failovers += 1
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant(
+                "env_failover", self._sid or "env", addr=addr
+            )
+
+    # -- Env contract ---------------------------------------------------
+    async def areset(self, **kwargs) -> Any:
+        self._reset_kwargs = dict(kwargs)
+        self._journal = []
+        try:
+            obs, _ = await self._open_session(kwargs)
+        except EnvWorkerUnavailableError:
+            # a DISCOVERED pool that went fully dark may have been
+            # replaced under us (launcher respawns register new ports):
+            # refresh the registry view once before giving up
+            if not self._discovered:
+                raise
+            fresh = discover_env_workers(
+                self._experiment_name, self._trial_name
+            )
+            if not fresh or set(fresh) == set(self._addrs):
+                raise
+            logger.info(
+                f"env pool refreshed from discovery: {fresh}"
+            )
+            self._addrs = fresh
+            obs, _ = await self._open_session(kwargs)
+        self.stats["resets"] += 1
+        return obs
+
+    async def _open_session(self, kwargs: Dict[str, Any]) -> Tuple[Any, str]:
+        last: Optional[Exception] = None
+        for addr in self._candidates():
+            t0 = time.monotonic()
+            try:
+                out = await self._post(
+                    addr, "/reset", {"kwargs": kwargs},
+                    self.config.reset_timeout_s,
+                )
+            except HttpRequestError as e:
+                if e.status == 422:
+                    raise EnvActionError(str(e)) from e
+                if e.status is not None and 400 <= e.status < 500:
+                    raise  # the reset itself is wrong; no worker fixes it
+                last = e
+                self._worker_failed(addr)
+                continue
+            self._addr = addr
+            self._sid = str(out["session"])
+            self.replay_safe = bool(out.get("replay_safe", False))
+            # recorded AFTER the session id exists so the span carries
+            # the real rid (trace_report --env counts sessions by
+            # distinct env_reset rids)
+            if self.tracer is not None:
+                self.tracer.record(
+                    "env_reset", self._sid, t0, time.monotonic(),
+                    addr=addr,
+                )
+            if self.monitor is not None:
+                self.monitor.report_success(addr)
+            return out.get("observation"), addr
+        raise EnvWorkerUnavailableError(
+            f"no env worker reachable for reset (tried {self._addrs})"
+        ) from last
+
+    async def astep(
+        self, action: Any
+    ) -> Tuple[Any, float, bool, Dict[str, Any]]:
+        if self._sid is None:
+            raise EnvServiceError("astep before areset")
+        for hop in range(self.config.max_failovers + 1):
+            addr = self._addr
+            try:
+                with self._span("env_step", addr=addr):
+                    out = await self._post(
+                        addr, "/step",
+                        {
+                            "session": self._sid,
+                            "action": action,
+                            # journal index: lets the worker detect a
+                            # retried POST of an already-applied step
+                            # (cached response) vs a desynced session
+                            # (409) — /step retries stay exactly-once
+                            "seq": len(self._journal),
+                        },
+                        self.config.call_timeout_s,
+                    )
+            except HttpRequestError as e:
+                if e.status == 422:
+                    # the ENV rejected the action (raised server-side):
+                    # surface it as an action error — the workflow turns
+                    # it into an error observation, exactly like a local
+                    # env.call raising; failing over would just re-run
+                    # the poison action across every healthy worker
+                    raise EnvActionError(str(e)) from e
+                # 404: the worker doesn't know the session (it restarted
+                # or expired it). 409: it knows a DIFFERENT history than
+                # our journal (e.g. a cancelled call half-applied). Both
+                # mean "this session object is unusable" — but the
+                # worker itself is alive and replay-eligible.
+                session_lost = e.status in (404, 409)
+                if (
+                    e.status is not None
+                    and 400 <= e.status < 500
+                    and not session_lost
+                ):
+                    raise  # malformed action — not a worker failure
+                if not session_lost:
+                    # connect error / timeout / exhausted 5xx: the worker
+                    # is gone (or rebooted, which loses sessions anyway)
+                    self._worker_failed(addr)
+                if not self.replay_safe:
+                    raise EnvSessionLostError(
+                        f"env worker {addr} lost session {self._sid} and "
+                        f"the env is not replay_safe; episode must retry "
+                        f"from reset"
+                    ) from e
+                if e.status == 409:
+                    # the desynced session still exists server-side:
+                    # release it so it doesn't squat a slot until TTL
+                    with contextlib.suppress(Exception):
+                        await self._post(
+                            addr, "/close", {"session": self._sid},
+                            self.config.call_timeout_s,
+                        )
+                # a RESPONDING worker (404/409) stays eligible as the
+                # replay target — with a single-worker pool, excluding
+                # it would fail every episode a restart could save
+                await self._replay(
+                    exclude=None if session_lost else addr
+                )
+                continue
+            obs = out.get("observation")
+            reward = float(out.get("reward", 0.0))
+            done = bool(out.get("done", False))
+            info = out.get("info") or {}
+            if self.monitor is not None:
+                self.monitor.report_success(self._addr)
+            self._journal.append((action, obs, reward, done))
+            self.stats["steps"] += 1
+            return obs, reward, done, info
+        raise EnvWorkerUnavailableError(
+            f"session {self._sid} exceeded max_failovers="
+            f"{self.config.max_failovers} worker hops"
+        )
+
+    async def _replay(self, exclude: Optional[str]) -> None:
+        """Reconstruct the session on a healthy worker: re-reset with the
+        journaled kwargs, re-apply every journaled action, and (when
+        ``verify_replay``) check the replayed trajectory is bit-identical
+        to what the episode already saw — divergence means the env lied
+        about ``replay_safe`` and the session is unrecoverable."""
+        last: Optional[Exception] = None
+        for addr in self._candidates(exclude=exclude):
+            sid = None
+            t0 = time.monotonic()
+            try:
+                out = await self._post(
+                    addr, "/reset", {"kwargs": self._reset_kwargs},
+                    self.config.reset_timeout_s,
+                )
+                sid = str(out["session"])
+                if self.tracer is not None:
+                    self.tracer.record(
+                        "env_reset", sid, t0, time.monotonic(),
+                        addr=addr, replay=True,
+                    )
+                for i, (action, obs, reward, done) in enumerate(
+                    self._journal
+                ):
+                    rep = await self._post(
+                        addr, "/step",
+                        {"session": sid, "action": action, "seq": i},
+                        self.config.call_timeout_s,
+                    )
+                    if self.config.verify_replay and (
+                        rep.get("observation") != obs
+                        or float(rep.get("reward", 0.0)) != reward
+                        or bool(rep.get("done", False)) != done
+                    ):
+                        raise EnvSessionLostError(
+                            f"replay diverged at step {i} on {addr}: "
+                            f"env declared replay_safe but reproduced a "
+                            f"different trajectory"
+                        )
+            except EnvSessionLostError:
+                # divergence: release the half-built session before the
+                # episode routes to retry/quarantine (TTL is the backstop)
+                if sid is not None:
+                    with contextlib.suppress(Exception):
+                        await self._post(
+                            addr, "/close", {"session": sid},
+                            self.config.call_timeout_s,
+                        )
+                raise
+            except HttpRequestError as e:
+                if e.status == 422:
+                    # a journaled action that SUCCEEDED before now makes
+                    # the env raise: that's divergence, not worker death
+                    if sid is not None:
+                        with contextlib.suppress(Exception):
+                            await self._post(
+                                addr, "/close", {"session": sid},
+                                self.config.call_timeout_s,
+                            )
+                    raise EnvSessionLostError(
+                        f"replay diverged on {addr}: journaled action "
+                        f"now raises ({e})"
+                    ) from e
+                last = e
+                self._worker_failed(addr)
+                continue
+            self._addr = addr
+            self._sid = sid
+            self.stats["replays"] += 1
+            ep = telemetry.current_episode()
+            if ep is not None:
+                ep.env_replays += 1
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant(
+                    "env_replay", sid, addr=addr,
+                    steps=len(self._journal),
+                )
+            if self.monitor is not None:
+                self.monitor.report_success(addr)
+            logger.info(
+                f"session replayed onto {addr} "
+                f"({len(self._journal)} steps)"
+            )
+            return
+        raise EnvWorkerUnavailableError(
+            f"no healthy worker to replay session onto "
+            f"(journal of {len(self._journal)} steps)"
+        ) from last
+
+    async def aclose(self):
+        try:
+            if self._sid is not None and self._addr is not None:
+                try:
+                    await self._post(
+                        self._addr, "/close", {"session": self._sid},
+                        self.config.call_timeout_s,
+                    )
+                except Exception:
+                    pass  # best-effort; the worker GC owns leaked sessions
+        finally:
+            self._sid = None
+            self._addr = None
+            if self._http is not None and not self._http.closed:
+                await self._http.close()
+            self._http = None
+
+
+class RemoteToolEnv:
+    """Tool-env facade over a remote session, for AgenticToolWorkflow:
+    ``astart()`` opens the session and pulls prompt/tools; ``acall``
+    steps it (the workflow awaits it under its tool timeout); ``done``/
+    ``reward`` mirror the remote env once it reports done."""
+
+    def __init__(self, remote: RemoteEnv, reset_kwargs: Dict[str, Any]):
+        self._remote = remote
+        self._reset_kwargs = dict(reset_kwargs)
+        self._prompt = ""
+        self._tools: List[Dict[str, Any]] = []
+        self.done = False
+        self.reward = 0.0
+        self.detail = ""
+
+    @property
+    def tools(self) -> List[Dict[str, Any]]:
+        return self._tools
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return self._remote.stats
+
+    def prompt(self) -> str:
+        return self._prompt
+
+    async def astart(self) -> None:
+        obs = await self._remote.areset(**self._reset_kwargs)
+        if not isinstance(obs, dict):
+            raise EnvServiceError(
+                f"tool env reset observation must be a dict with "
+                f"prompt/tools, got {type(obs).__name__}"
+            )
+        self._prompt = str(obs.get("prompt", ""))
+        self._tools = list(obs.get("tools", []))
+
+    async def acall(self, name: str, arguments: str) -> str:
+        obs, reward, done, info = await self._remote.astep(
+            {"name": name, "arguments": arguments}
+        )
+        if done:
+            self.done = True
+            self.reward = float(reward)
+            self.detail = str((info or {}).get("detail", ""))
+        return str(obs)
+
+    async def aclose(self) -> None:
+        await self._remote.aclose()
+
+
+def make_remote_tool_env_factory(
+    addrs: Optional[Sequence[str]] = None,
+    monitor=None,
+    config: Optional[EnvServiceConfig] = None,
+    tracer: Optional[SpanTracer] = None,
+    reset_keys: Optional[Sequence[str]] = None,
+):
+    """``env_factory`` for AgenticToolWorkflow over the remote plane: each
+    episode gets its own session. ``reset_keys`` selects which dataset
+    fields become reset kwargs (None = every JSON-serializable field the
+    hosted env's factory expects is the caller's contract)."""
+
+    import itertools
+
+    stripe = itertools.count()
+
+    def factory(data: Dict[str, Any]) -> RemoteToolEnv:
+        kwargs = (
+            {k: data[k] for k in reset_keys if k in data}
+            if reset_keys is not None
+            else dict(data)
+        )
+        return RemoteToolEnv(
+            RemoteEnv(
+                addrs=addrs, monitor=monitor, config=config, tracer=tracer,
+                # stripe parallel episodes across the pool (a fresh
+                # RemoteEnv per episode would otherwise always start at
+                # worker[0])
+                rr_offset=next(stripe),
+            ),
+            reset_kwargs=kwargs,
+        )
+
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv: Optional[list] = None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--env", required=True,
+        help="hosted env: 'module:attr' zero-arg factory "
+        "(e.g. areal_tpu.env.service:countdown_env)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-sessions", type=int, default=512)
+    p.add_argument(
+        "--session-ttl", type=float, default=3600.0,
+        help="idle seconds before a leaked session is expired "
+        "(<= 0 disables the sweeper)",
+    )
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument(
+        "--enable-chaos", action="store_true",
+        help="open the runtime POST /chaos fault-injection endpoint "
+        "(resilience testing only — it can hard-kill the worker)",
+    )
+    args = p.parse_args(argv)
+    # subprocess workers rendezvous in the launcher's namespace
+    name_resolve.reconfigure_from_env()
+    factory = resolve_env_factory(args.env)
+    httpd = serve_env(
+        factory,
+        host=args.host,
+        port=args.port,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        max_sessions=args.max_sessions,
+        background=True,
+        chaos_endpoint=args.enable_chaos,
+        session_ttl_s=args.session_ttl,
+    )
+    # announce the bound port on stdout (the spawn idiom tests/bench use)
+    print(f"PORT {httpd.server_address[1]}", flush=True)
+    # lifetime: when a parent holds our stdin as a PIPE (tests, bench),
+    # its death/close is the shutdown signal. Under a launcher or daemon,
+    # stdin is /dev/null or closed — read() would return EOF IMMEDIATELY
+    # and the worker would exit 0 an instant after booting (invisible to
+    # the supervisor, which only reacts to nonzero exits) — so anything
+    # that isn't a live pipe/tty means "serve until killed".
+    import stat as _stat
+    import sys
+
+    hold_on_stdin = False
+    try:
+        mode = os.fstat(sys.stdin.fileno()).st_mode
+        hold_on_stdin = (
+            _stat.S_ISFIFO(mode)
+            or _stat.S_ISSOCK(mode)
+            or os.isatty(sys.stdin.fileno())
+        )
+    except (OSError, ValueError):
+        pass
+    if hold_on_stdin:
+        try:
+            sys.stdin.read()
+        except Exception:
+            pass
+    else:
+        threading.Event().wait()
+    httpd.env_state.deregister()
+    httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

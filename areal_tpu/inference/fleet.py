@@ -112,8 +112,13 @@ class FleetMonitor:
         on_dead: Optional[Callable[[str], None]] = None,
         on_recover: Optional[Callable[[str], None]] = None,
         seed_source: str = "seed",
+        service: str = "gen",
     ):
         self.config = config or FleetConfig()
+        # which plane this monitor watches ("gen" | "env" | "verifier"):
+        # log lines and per_server() carry it so one process fronting
+        # several fleets stays debuggable
+        self.service = service
         self._probe_fn = probe_fn or (
             lambda a: default_probe(a, self.config.probe_timeout_s)
         )
@@ -159,7 +164,7 @@ class FleetMonitor:
             if addr in self._servers:
                 return False
             self._servers[addr] = ServerHealth(addr, source, self._time())
-        logger.info(f"fleet join: {addr} ({source})")
+        logger.info(f"{self.service} fleet join: {addr} ({source})")
         if self.on_join:
             self.on_join(addr)
         return True
@@ -168,7 +173,7 @@ class FleetMonitor:
         with self._lock:
             if self._servers.pop(addr, None) is None:
                 return False
-        logger.info(f"fleet leave: {addr}")
+        logger.info(f"{self.service} fleet leave: {addr}")
         if self.on_leave:
             self.on_leave(addr)
         return True
@@ -218,7 +223,10 @@ class FleetMonitor:
         """Returns the addr to fire on_dead for (outside the lock)."""
         if h.state is to:
             return None
-        logger.info(f"fleet: {h.addr} {h.state.value} -> {to.value}")
+        logger.info(
+            f"{self.service} fleet: {h.addr} "
+            f"{h.state.value} -> {to.value}"
+        )
         h.state = to
         h.last_transition = self._time()
         return h.addr if to is ServerState.DEAD else None
@@ -434,6 +442,7 @@ class FleetMonitor:
         with self._lock:
             return {
                 a: {
+                    "service": self.service,
                     "state": h.state.value,
                     "probe_latency_s": h.probe_latency_s,
                     "consecutive_failures": float(h.fails),

@@ -139,36 +139,11 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _apply_chaos(self) -> bool:
-        """Honor server-side chaos rules for this request. Returns True
-        when a response was already produced (caller must return)."""
-        inj = chaos.get_injector()
-        if inj is None:
-            return False
-        act = inj.check("server", self.path)
-        if act is None:
-            return False
-        mode = act["mode"]
-        if mode == "latency":
-            time.sleep(act["latency_s"])
-            return False  # delayed, then served normally
-        if mode == "http_500":
-            self._send_json({"error": "chaos injected"}, 500)
-            return True
-        if mode == "connect_drop":
-            # die without a response: the client sees a reset socket
-            try:
-                self.connection.close()
-            except Exception:
-                pass
-            return True
-        if mode == "kill":
-            # the SIGKILL analog: no cleanup, no flush — the process is
-            # simply gone (what a preempted VM / OOM-killed server does)
-            logger.error(
-                f"chaos: hard-killing server (exit {act['exit_code']})"
-            )
-            os._exit(act["exit_code"])
-        return False
+        """Honor server-side chaos rules for this request (shared
+        dispatch, utils/chaos.py — one copy of the drop/kill semantics
+        across generation servers, env workers, and verifiers). Returns
+        True when a response was already produced (caller must return)."""
+        return chaos.apply_server_chaos(self, self._send_json)
 
     def _send_json(self, obj, code: int = 200):
         body = json.dumps(obj).encode()

@@ -13,6 +13,7 @@ on a single-chip host the colocated mode (no server subprocesses, trainer
 owns the chip) is the default and this launcher simply execs the trainer.
 """
 
+import dataclasses
 import os
 import signal
 import subprocess
@@ -159,6 +160,42 @@ def launch_servers(
     return addrs
 
 
+def launch_env_workers(
+    launcher: LocalLauncher,
+    env_cfg,
+    base_env: Optional[Dict[str, str]] = None,
+    name_offset: int = 0,
+) -> List[str]:
+    """Start env-service worker subprocesses (env/service.py); returns
+    host:port addrs. Workers self-register under name_resolve
+    env_servers, so FleetMonitor membership and name_resolve discovery
+    also find RESPAWNED replacements (new ports). The
+    AREAL_ENV_SERVER_ADDRS export is a boot-time snapshot only — a
+    running trainer's env var cannot be updated, so clients that must
+    survive worker replacement discover via name_resolve (pass
+    experiment/trial to RemoteEnv, or give it an env_fleet_monitor)."""
+    n = max(1, int(env_cfg.n_workers))
+    ports = network.find_free_ports(n)
+    addrs = []
+    for i in range(n):
+        host = env_cfg.host or "127.0.0.1"
+        cmd = [
+            sys.executable,
+            "-m",
+            "areal_tpu.env.service",
+            f"--env={env_cfg.env_spec}",
+            f"--host={host}",
+            f"--port={ports[i]}",
+            f"--max-sessions={env_cfg.max_sessions}",
+            f"--session-ttl={env_cfg.session_ttl_s}",
+            f"--experiment-name={launcher.experiment_name}",
+            f"--trial-name={launcher.trial_name}",
+        ]
+        launcher.submit(f"env_worker_{name_offset + i}", cmd, env=base_env)
+        addrs.append(f"{host}:{ports[i]}")
+    return addrs
+
+
 class TrainerSupervisor:
     """Bounded-restart policy for the trainer process (the durability
     loop the ``RECOVER_ENV`` docstring promises): a budget of ``retries``
@@ -269,6 +306,26 @@ def local_main(
     ]
     server_names: List[str] = []
     server_addrs: List[str] = []
+    env_cfg = getattr(config, "env_service", None)
+    wants_env_workers = bool(
+        env_cfg is not None
+        and getattr(env_cfg, "enabled", False)
+        and getattr(env_cfg, "env_spec", "")
+    )
+    env_worker_names: List[str] = []
+    env_worker_addrs: Dict[str, str] = {}  # name -> addr (live view)
+    env_respawns = {"n": 0}
+    env_worker_seq = {"n": 0}
+
+    def start_env_workers(env: Dict[str, str]) -> None:
+        addrs = launch_env_workers(
+            launcher, env_cfg, env, name_offset=env_worker_seq["n"]
+        )
+        for i, addr in enumerate(addrs):
+            name = f"env_worker_{env_worker_seq['n'] + i}"
+            env_worker_names.append(name)
+            env_worker_addrs[name] = addr
+        env_worker_seq["n"] += len(addrs)
 
     def start_servers(env: Dict[str, str]) -> None:
         server_cfg = getattr(config, "server", None) or JaxGenConfig()
@@ -317,8 +374,14 @@ def local_main(
             if wants_servers and not servers_up:
                 start_servers(env)
                 servers_up = True
+            if wants_env_workers and not env_worker_names:
+                start_env_workers(env)
             if server_addrs:
                 env["AREAL_LLM_SERVER_ADDRS"] = ",".join(server_addrs)
+            if env_worker_addrs:
+                env["AREAL_ENV_SERVER_ADDRS"] = ",".join(
+                    env_worker_addrs.values()
+                )
             if wants_trainer:
                 start_trainers(env)
             supervisor.note_start()
@@ -326,6 +389,41 @@ def local_main(
             exc: Optional[JobException] = None
             while True:
                 exc = launcher.poll()
+                if exc is not None and exc.name in env_worker_names:
+                    # env-worker death is survivable BY DESIGN (the env
+                    # service plane replays sessions onto healthy
+                    # workers) — replace the worker in place instead of
+                    # tearing down the constellation, up to a bounded
+                    # respawn budget; the replacement re-registers and
+                    # membership finds it
+                    launcher.stop(exc.name)
+                    env_worker_names.remove(exc.name)
+                    env_worker_addrs.pop(exc.name, None)
+                    if (
+                        env_respawns["n"]
+                        < getattr(env_cfg, "max_worker_respawns", 8)
+                    ):
+                        env_respawns["n"] += 1
+                        logger.warning(
+                            f"{exc}; respawning env worker "
+                            f"({env_respawns['n']}/"
+                            f"{env_cfg.max_worker_respawns})"
+                        )
+                        one = dataclasses.replace(env_cfg, n_workers=1)
+                        addr = launch_env_workers(
+                            launcher, one, env,
+                            name_offset=env_worker_seq["n"],
+                        )[0]
+                        name = f"env_worker_{env_worker_seq['n']}"
+                        env_worker_seq["n"] += 1
+                        env_worker_names.append(name)
+                        env_worker_addrs[name] = addr
+                        exc = None
+                        continue
+                    logger.error(
+                        f"{exc}; env-worker respawn budget spent "
+                        f"({env_cfg.max_worker_respawns}) — escalating"
+                    )
                 if exc is not None:
                     break
                 if wants_trainer and launcher.finished("trainer"):
@@ -359,6 +457,8 @@ def local_main(
                 servers_up = False
                 server_addrs.clear()
                 server_names.clear()
+                env_worker_names.clear()
+                env_worker_addrs.clear()
             time.sleep(delay)
     finally:
         launcher.stop_all()

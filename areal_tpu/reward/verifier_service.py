@@ -11,28 +11,53 @@ samples a local-subprocess verifier starves rollout. This module provides
       POST /verify_code {code|completion, test_cases?, test_code?, timeout?}
       POST /verify_math {completion, answer}
       POST /batch      {items: [one of the above + kind]}
-      GET  /health
+      GET  /health     (draining semantics)   GET /metrics (Prometheus)
+      POST /drain      POST /chaos (runtime fault injection)
   Each request runs through the same sandboxed verifiers training uses
   (reward/code_verifier, reward/math_parser), bounded by a worker
-  semaphore so a burst cannot fork-bomb the verifier host.
+  semaphore so a burst cannot fork-bomb the verifier host. Workers
+  self-register under the name_resolve ``verifier_servers`` subtree —
+  the same service plane env workers live on (env/service.py), so the
+  FleetMonitor machinery probes and circuit-breaks them identically.
 
-- ``RemoteVerifier``: round-robin client with retry and (optional) local
-  fallback, plus reward-fn factories with the workflow signature.
+- ``RemoteVerifier``: pool client on the ``utils/http`` retry policy
+  (connect/timeout/5xx-only retries with bounded-jitter backoff; 4xx
+  raise immediately — re-POSTing wrong bytes cannot succeed), with
+  per-address failover, optional FleetMonitor integration, and
+  ``X-Areal-Trace``/``X-Areal-Rid`` header propagation so verifier calls
+  land on the stitched fleet timelines (utils/telemetry.py).
 
-The reward functions stay pure functions of (prompt, completion, meta) —
-swapping local for remote verification changes no training code
-(env/math_code_env.py and the RLVR workflows accept either).
+**No silent reward poisoning**: with ``local_fallback=False`` an
+unreachable pool raises :class:`VerifierUnavailableError` — typed so the
+executor's episode retry/quarantine machinery (api/workflow_api.py) owns
+the failure — instead of fabricating 0.0 rewards that would train the
+policy on lies.
 """
 
 import json
+import os
 import threading
-import urllib.request
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence
 
+from areal_tpu.utils import chaos, name_resolve, names, telemetry
 from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.http import HttpRequestError, request_with_retry
+from areal_tpu.utils.tracing import trace_headers
 
 logger = logging_util.getLogger("verifier_service")
+
+
+class VerifierUnavailableError(RuntimeError):
+    """The whole verifier pool is unreachable (or failed past the retry
+    budget) and local fallback is disabled. Callers must NOT coerce this
+    to a 0.0 reward: it routes into episode retry/quarantine."""
+
+    def __init__(self, message: str, addrs: Optional[Sequence[str]] = None):
+        super().__init__(message)
+        self.addrs = list(addrs or [])
 
 
 # ---------------------------------------------------------------------------
@@ -77,14 +102,28 @@ def _verify_one(item: Dict[str, Any]) -> Dict[str, Any]:
         return {"reward": 0.0, "error": f"{type(e).__name__}: {e}"}
 
 
+_METRIC_HELP = {
+    "requests_total": "verification HTTP requests served",
+    "items_total": "items verified (batch items count individually)",
+    "errors_total": "items whose verifier raised (scored 0 with error)",
+    "rejected_draining_total": "requests refused while draining (503)",
+    "busy_workers": "sandbox slots currently occupied",
+    "draining": "1 while this worker is draining",
+}
+
+
 def serve_verifier(
     host: str = "0.0.0.0",
     port: int = 0,
     max_workers: int = 8,
     background: bool = False,
+    experiment_name: str = "",
+    trial_name: str = "",
 ) -> ThreadingHTTPServer:
     """Start the verifier HTTP service; returns the server (its
-    ``server_address`` carries the bound port)."""
+    ``server_address`` carries the bound port). Registers under the
+    name_resolve ``verifier_servers`` subtree when experiment/trial names
+    are given (deregistered when a drain completes)."""
     from concurrent.futures import ThreadPoolExecutor
 
     gate = threading.Semaphore(max_workers)
@@ -93,11 +132,46 @@ def serve_verifier(
     # still bounds TOTAL concurrent interpreters across all requests
     pool = ThreadPoolExecutor(max_workers=max_workers)
 
+    state_lock = threading.Lock()
+    counters: Dict[str, float] = {
+        "requests_total": 0.0,
+        "items_total": 0.0,
+        "errors_total": 0.0,
+        "rejected_draining_total": 0.0,
+        "busy_workers": 0.0,
+    }
+    draining = threading.Event()
+    registration = {"key": None}
+
+    def bump(key: str, n: float = 1.0):
+        with state_lock:
+            counters[key] = counters.get(key, 0.0) + n
+
     def run_gated(item):
         with gate:
-            return _verify_one(item)
+            bump("busy_workers")
+            try:
+                out = _verify_one(item)
+            finally:
+                bump("busy_workers", -1.0)
+        bump("items_total")
+        if "error" in out:
+            bump("errors_total")
+        return out
+
+    def deregister():
+        key, registration["key"] = registration["key"], None
+        if key is None:
+            return
+        try:
+            name_resolve.delete(key)
+            logger.info(f"verifier deregistered {key}")
+        except Exception as e:
+            logger.warning(f"verifier deregister failed: {e}")
 
     class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):  # quiet
             pass
 
@@ -109,34 +183,83 @@ def serve_verifier(
             self.end_headers()
             self.wfile.write(body)
 
+        def _apply_chaos(self) -> bool:
+            """Server-side chaos rules (shared dispatch, utils/chaos.py
+            — same harness as env workers and generation servers)."""
+            return chaos.apply_server_chaos(self, self._send)
+
         def do_GET(self):
-            if self.path == "/health":
-                self._send({"status": "ok"})
+            if self._apply_chaos():
+                return
+            path = urllib.parse.urlparse(self.path).path
+            if path == "/health":
+                self._send(
+                    {"status": "draining" if draining.is_set() else "ok"}
+                )
+            elif path == "/metrics":
+                from areal_tpu.utils.tracing import render_prometheus
+
+                with state_lock:
+                    m = dict(counters)
+                m["draining"] = float(draining.is_set())
+                body = render_prometheus(
+                    m, prefix="areal_tpu_verifier_", help_text=_METRIC_HELP
+                ).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send({"error": "not found"}, 404)
 
         def do_POST(self):
+            if self._apply_chaos():
+                return
             n = int(self.headers.get("Content-Length", 0))
             try:
                 payload = json.loads(self.rfile.read(n) or b"{}")
             except json.JSONDecodeError:
                 self._send({"error": "bad json"}, 400)
                 return
+            if self.path == "/drain":
+                # unlike env workers (sessionful: they deregister only
+                # once live sessions finish), the verifier is stateless
+                # per request — deregistering immediately is correct;
+                # in-flight requests still run to completion
+                draining.set()
+                deregister()
+                self._send({"status": "draining"})
+                return
+            if draining.is_set():
+                bump("rejected_draining_total")
+                self._send({"error": "draining"}, 503)
+                return
             if self.path == "/batch":
                 items = payload.get("items", [])
                 out = list(pool.map(run_gated, items))
+                bump("requests_total")
                 self._send({"results": out})
             elif self.path in ("/verify_code", "/verify_math"):
                 payload.setdefault(
                     "kind", "math" if self.path.endswith("math") else "code"
                 )
-                with gate:
-                    self._send(_verify_one(payload))
+                out = run_gated(payload)
+                bump("requests_total")
+                self._send(out)
             else:
                 self._send({"error": "not found"}, 404)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True
+    if experiment_name and trial_name:
+        reg_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        registration["key"] = name_resolve.add_subentry(
+            names.verifier_servers(experiment_name, trial_name),
+            f"{reg_host}:{httpd.server_address[1]}",
+        )
     if background:
         threading.Thread(
             target=httpd.serve_forever, daemon=True, name="verifier-http"
@@ -150,10 +273,18 @@ def serve_verifier(
 # client
 # ---------------------------------------------------------------------------
 class RemoteVerifier:
-    """Round-robin client over a verifier pool with per-address failover.
+    """Pool client with per-address failover on the utils/http policy.
 
-    ``local_fallback=True`` degrades to in-host verification when the whole
-    pool is unreachable (the reference's local verifier mode)."""
+    Each call tries one lap over the pool; on each address the transport
+    retries transient failures (connect/timeout/5xx) ``retries`` times
+    under jittered backoff, while 4xx responses raise immediately —
+    re-sending a malformed request N times just multiplies the error.
+    ``local_fallback=True`` degrades to in-host verification when the
+    whole pool is unreachable (the reference's local verifier mode);
+    ``local_fallback=False`` raises :class:`VerifierUnavailableError`
+    instead of fabricating 0.0 rewards. An optional FleetMonitor
+    receives per-address outcome reports (the verifier fleet shares the
+    env plane's health machinery)."""
 
     def __init__(
         self,
@@ -161,6 +292,9 @@ class RemoteVerifier:
         timeout: float = 60.0,
         retries: int = 2,
         local_fallback: bool = True,
+        monitor=None,
+        tracer=None,
+        retry_delay: float = 0.5,
     ):
         if not addrs:
             raise ValueError("need at least one verifier address")
@@ -168,47 +302,100 @@ class RemoteVerifier:
             a if a.startswith("http") else f"http://{a}" for a in addrs
         ]
         self.timeout = timeout
-        self.retries = retries
+        self.retries = max(1, retries)
+        self.retry_delay = retry_delay
         self.local_fallback = local_fallback
+        self.monitor = monitor
+        self.tracer = tracer
         self._rr = 0
         self._lock = threading.Lock()
 
-    def _next_addr(self) -> str:
+    def _ordered_addrs(self) -> List[str]:
+        """One failover lap: all addresses, rotated round-robin; DEAD
+        addresses (monitor view) sink to the end rather than vanish —
+        when everything is circuit-open, trying is still better than
+        inventing rewards."""
         with self._lock:
-            a = self.addrs[self._rr % len(self.addrs)]
+            k = self._rr % len(self.addrs)
             self._rr += 1
-            return a
+        lap = self.addrs[k:] + self.addrs[:k]
+        if self.monitor is not None:
+            lap.sort(
+                key=lambda a: not self.monitor.is_schedulable(
+                    a.split("//", 1)[-1]
+                )
+            )
+        return lap
+
+    def _headers(self) -> Optional[Dict[str, str]]:
+        ep = telemetry.current_episode()
+        if ep is None:
+            return None
+        return trace_headers(ep.trace_id, rid=ep.uid)
+
+    def _report(self, addr: str, ok: bool) -> None:
+        if self.monitor is None:
+            return
+        bare = addr.split("//", 1)[-1]
+        if ok:
+            self.monitor.report_success(bare)
+        else:
+            self.monitor.report_failure(bare)
 
     def _post(
         self, path: str, payload: Dict[str, Any], timeout: Optional[float] = None
-    ) -> Optional[Dict]:
-        body = json.dumps(payload).encode()
-        for _ in range(self.retries * len(self.addrs)):
-            addr = self._next_addr()
+    ) -> Dict[str, Any]:
+        """POST with transient retry per address and failover across the
+        pool. Raises :class:`HttpRequestError` on 4xx (the request is
+        wrong — no other server fixes it) and
+        :class:`VerifierUnavailableError` when every address failed."""
+        headers = self._headers()
+        last: Optional[Exception] = None
+        t0 = time.monotonic()
+        for addr in self._ordered_addrs():
             try:
-                req = urllib.request.Request(
+                out = request_with_retry(
                     addr + path,
-                    data=body,
-                    headers={"Content-Type": "application/json"},
+                    payload,
+                    max_retries=self.retries,
+                    timeout=timeout or self.timeout,
+                    retry_delay=self.retry_delay,
+                    headers=headers,
                 )
-                with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout
-                ) as r:
-                    return json.loads(r.read())
-            except Exception as e:
-                logger.warning("verifier %s failed: %s", addr, e)
-        return None
+            except HttpRequestError as e:
+                if e.status is not None and 400 <= e.status < 500:
+                    raise  # typed 4xx: malformed request, do not fail over
+                logger.warning(f"verifier {addr} failed: {e}")
+                self._report(addr, ok=False)
+                last = e
+                continue
+            self._report(addr, ok=True)
+            if self.tracer is not None and self.tracer.enabled:
+                ep = telemetry.current_episode()
+                self.tracer.record(
+                    "verify", ep.uid if ep else path, t0, time.monotonic(),
+                    addr=addr, path=path,
+                    **({"trace": ep.trace_id} if ep else {}),
+                )
+            return out
+        raise VerifierUnavailableError(
+            f"verifier pool unreachable for {path} "
+            f"(tried {len(self.addrs)} addrs x {self.retries} retries)",
+            addrs=self.addrs,
+        ) from last
 
     def verify(self, item: Dict[str, Any]) -> float:
-        out = self._post(
-            "/verify_math" if item.get("kind") == "math" else "/verify_code",
-            item,
-        )
-        if out is not None:
-            return float(out.get("reward", 0.0))
-        if self.local_fallback:
-            return float(_verify_one(item)["reward"])
-        return 0.0
+        try:
+            out = self._post(
+                "/verify_math" if item.get("kind") == "math"
+                else "/verify_code",
+                item,
+            )
+        except VerifierUnavailableError:
+            if self.local_fallback:
+                return float(_verify_one(item)["reward"])
+            raise
+        return float(out.get("reward", 0.0))
 
     def verify_batch(self, items: List[Dict[str, Any]]) -> List[float]:
         # batch wall time scales with items / server parallelism: a fixed
@@ -217,12 +404,13 @@ class RemoteVerifier:
             (float(it.get("timeout", 5.0)) for it in items), default=5.0
         )
         budget = self.timeout + per_item * max(1, len(items)) / 4.0
-        out = self._post("/batch", {"items": items}, timeout=budget)
-        if out is not None:
-            return [float(r.get("reward", 0.0)) for r in out["results"]]
-        if self.local_fallback:
-            return [float(_verify_one(it)["reward"]) for it in items]
-        return [0.0] * len(items)
+        try:
+            out = self._post("/batch", {"items": items}, timeout=budget)
+        except VerifierUnavailableError:
+            if self.local_fallback:
+                return [float(_verify_one(it)["reward"]) for it in items]
+            raise
+        return [float(r.get("reward", 0.0)) for r in out["results"]]
 
     # -- workflow-signature reward fns ---------------------------------
     def math_reward_fn(self):
@@ -251,6 +439,23 @@ class RemoteVerifier:
         return fn
 
 
+def discover_verifiers(
+    experiment_name: str, trial_name: str
+) -> List[str]:
+    """Verifier addresses from the name_resolve verifier_servers subtree
+    (the service-plane discovery path; env var AREAL_TPU_VERIFIER_ADDRS
+    remains the explicit override, see env/math_code_env.py)."""
+    try:
+        return sorted(
+            name_resolve.get_subtree(
+                names.verifier_servers(experiment_name, trial_name)
+            )
+        )
+    except Exception as e:
+        logger.warning(f"verifier discovery failed: {e}")
+        return []
+
+
 def main():
     import argparse
 
@@ -258,9 +463,16 @@ def main():
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8190)
     p.add_argument("--max-workers", type=int, default=8)
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
     args = p.parse_args()
+    name_resolve.reconfigure_from_env()
     logger.info("verifier service on %s:%d", args.host, args.port)
-    serve_verifier(args.host, args.port, args.max_workers)
+    serve_verifier(
+        args.host, args.port, args.max_workers,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+    )
 
 
 if __name__ == "__main__":

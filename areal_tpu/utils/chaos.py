@@ -15,7 +15,9 @@ every run. The modes mirror what a real fleet sees:
 - ``latency``      — a fixed delay is inserted before the call proceeds
   (``latency_s`` seconds).
 - ``kill``         — the process hard-exits (``os._exit``), the SIGKILL
-  analog; honored on the server side and at trainer-side fault points
+  analog; honored on the server side (generation servers, env-service
+  workers, and reward verifiers all apply ``side=server`` rules — one
+  grammar drives chaos across every plane) and at trainer-side fault points
   (``side=trainer`` — e.g. ``match=recover_dump`` kills the trainer
   between its checkpoint-weights write and the COMMIT marker, the
   torn-checkpoint window ``utils/recover.py`` must survive).
@@ -171,6 +173,52 @@ class ChaosInjector:
                 }
                 for r in self.rules
             ]
+
+
+def apply_server_chaos(handler, send_json) -> bool:
+    """Shared server-side chaos dispatch for the HTTP handlers of every
+    plane (generation server, env-service worker, reward verifier):
+    returns True when a response was already produced — the caller must
+    return without serving. ``latency`` sleeps then serves normally;
+    ``http_500`` answers via ``send_json(obj, code)``; ``connect_drop``
+    tears the socket down with ``shutdown(SHUT_RDWR)`` first — ``close()``
+    alone leaves the fd open through the handler's rfile/wfile dups, so
+    the client would block out its timeout instead of seeing the drop;
+    ``kill`` hard-exits the process (the SIGKILL analog)."""
+    inj = get_injector()
+    if inj is None:
+        return False
+    act = inj.check("server", handler.path)
+    if act is None:
+        return False
+    mode = act["mode"]
+    if mode == "latency":
+        time.sleep(act["latency_s"])
+        return False  # delayed, then served normally
+    if mode == "http_500":
+        send_json({"error": "chaos injected"}, 500)
+        return True
+    if mode == "connect_drop":
+        import socket
+
+        try:
+            handler.connection.shutdown(socket.SHUT_RDWR)
+        except Exception:
+            pass
+        try:
+            handler.connection.close()
+        except Exception:
+            pass
+        return True
+    if mode == "kill":
+        import sys
+
+        print(
+            f"chaos: hard-killing server (exit {act['exit_code']})",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(act["exit_code"])
+    return False
 
 
 def trainer_fault(point: str) -> None:

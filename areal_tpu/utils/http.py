@@ -14,7 +14,11 @@ so resilience tests exercise this function's real control flow.
 """
 
 import asyncio
+import json
 import random
+import time
+import urllib.error
+import urllib.request
 from typing import Any, Dict, Optional
 
 import aiohttp
@@ -115,6 +119,84 @@ async def arequest_with_retry(
                 await asyncio.sleep(
                     backoff_delay(attempt, retry_delay, max_retry_delay, jitter)
                 )
+    raise HttpRequestError(
+        f"request to {url} failed after {max_retries} tries",
+        status=getattr(last_exc, "status", None),
+    ) from last_exc
+
+
+def request_with_retry(
+    url: str,
+    payload: Optional[Dict[str, Any]] = None,
+    method: str = "POST",
+    max_retries: int = 3,
+    timeout: float = 60.0,
+    retry_delay: float = 0.5,
+    max_retry_delay: float = 30.0,
+    jitter: float = 0.5,
+    headers: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Synchronous twin of :func:`arequest_with_retry` for callers that
+    live on plain threads (the verifier client runs inside a thread-pool
+    executor, not an event loop). Same policy, same chaos hooks:
+    connect errors / timeouts / 5xx retry under jittered exponential
+    backoff; 4xx raise immediately — re-POSTing wrong bytes cannot
+    succeed. Transport is stdlib urllib (no aiohttp session to manage
+    per thread)."""
+    last_exc: Optional[Exception] = None
+    for attempt in range(max_retries):
+        try:
+            inj = chaos.get_injector()
+            if inj is not None:
+                act = inj.check("client", url)
+                if act is not None:
+                    if act["mode"] == "latency":
+                        time.sleep(act["latency_s"])
+                    elif act["mode"] == "connect_drop":
+                        raise urllib.error.URLError(
+                            "chaos: connection dropped"
+                        )
+                    elif act["mode"] == "http_500":
+                        raise HttpRequestError(
+                            f"{method.upper()} {url} -> 500: chaos injected",
+                            status=500,
+                        )
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            data = (
+                json.dumps(payload).encode()
+                if payload is not None and method.upper() != "GET"
+                else None
+            )
+            req = urllib.request.Request(
+                url, data=data, headers=hdrs, method=method.upper()
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # read the body before the connection closes (error detail)
+            try:
+                body = e.read().decode(errors="replace")[:500]
+            except Exception:
+                body = ""
+            err = HttpRequestError(
+                f"{method.upper()} {url} -> {e.code}: {body}", status=e.code
+            )
+            if not retryable_status(e.code):
+                raise err from None
+            last_exc = err
+        except (
+            urllib.error.URLError, TimeoutError, OSError, HttpRequestError,
+        ) as e:
+            status = getattr(e, "status", None)
+            if status is not None and not retryable_status(status):
+                raise
+            last_exc = e
+        if attempt + 1 < max_retries:
+            time.sleep(
+                backoff_delay(attempt, retry_delay, max_retry_delay, jitter)
+            )
     raise HttpRequestError(
         f"request to {url} failed after {max_retries} tries",
         status=getattr(last_exc, "status", None),

@@ -20,6 +20,19 @@ def gen_server_manager(experiment_name: str, trial_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/gen_server_manager"
 
 
+def env_servers(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which each environment-service worker registers its
+    address (the env plane's analog of gen_servers — FleetMonitor watches
+    it for dynamic membership)."""
+    return f"{_root(experiment_name, trial_name)}/env_servers"
+
+
+def verifier_servers(experiment_name: str, trial_name: str) -> str:
+    """Subtree under which each reward-verifier worker registers its
+    address (reward/verifier_service.py — same plane as env_servers)."""
+    return f"{_root(experiment_name, trial_name)}/verifier_servers"
+
+
 def update_weights_from_disk(experiment_name: str, trial_name: str, model_version: int) -> str:
     return f"{_root(experiment_name, trial_name)}/update_weights_from_disk/{model_version}"
 

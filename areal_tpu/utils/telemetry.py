@@ -127,6 +127,11 @@ class EpisodeLineage:
         self.trace_id = trace_id or new_trace_id()
         self.attempt = 0  # current attempt (0-based), bumped per retry
         self.requests: List[RequestLineage] = []
+        # env service plane (env/service.py RemoteEnv): worker hops and
+        # journaled session replays this episode survived — the ledger
+        # answers "which samples rode out an env-worker death"
+        self.env_failovers = 0
+        self.env_replays = 0
 
     def add_request(self, rl: RequestLineage) -> None:
         self.requests.append(rl)
@@ -193,6 +198,8 @@ class LineageLedger:
             "weight_versions": sorted(versions),
             "failovers": sum(rl.failovers for rl in ep.requests),
             "migrations": sum(rl.migrations for rl in ep.requests),
+            "env_failovers": ep.env_failovers,
+            "env_replays": ep.env_replays,
             "rewards": (
                 [float(r) for r in rewards] if rewards is not None else None
             ),

@@ -11,8 +11,22 @@ turns — each turn becomes one training row.
 The workflow is generic over any environment object exposing
 ``tools`` (OpenAI schemas), ``prompt()``, ``call(name, arguments) -> str``,
 ``done`` and ``reward`` — see env/countdown.py for the shipped instance.
+Remote environments (env/service.py ``RemoteToolEnv``) extend the
+protocol with ``astart()``/``acall()``/``aclose()`` coroutines; both
+shapes are driven here.
+
+**Bounded tool execution**: every tool call runs under
+``tool_timeout_s``. A timeout or raised exception becomes a STRUCTURED
+ERROR OBSERVATION in the tool message — the model sees what failed and
+the episode continues — instead of an unhandled exception killing the
+episode task. The exceptions that mean "this episode cannot continue"
+(env worker died with a non-replayable session, whole env fleet down)
+stay fatal: they propagate so the executor's episode retry/quarantine
+machinery owns them.
 """
 
+import asyncio
+import json
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -20,10 +34,25 @@ import numpy as np
 from areal_tpu.api.cli_args import GenerationHyperparameters
 from areal_tpu.api.openai_client import ArealOpenAI, hermes_tool_parser
 from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.api.env_api import EnvServiceError
 from areal_tpu.utils import data as data_utils
 from areal_tpu.utils import logging as logging_util
 
 logger = logging_util.getLogger("AgenticToolWorkflow")
+
+
+def tool_error_observation(
+    tool: str, kind: str, message: str, timeout_s: Optional[float] = None
+) -> str:
+    """The structured error a failed/timed-out tool call feeds back to
+    the model (instead of crashing the episode): JSON so downstream
+    parsing — and the model — can distinguish error shape from output."""
+    err: Dict[str, Any] = {"type": kind, "tool": tool}
+    if message:
+        err["message"] = message[:200]
+    if timeout_s is not None:
+        err["timeout_s"] = timeout_s
+    return json.dumps({"error": err})
 
 
 class AgenticToolWorkflow(RolloutWorkflow):
@@ -36,6 +65,7 @@ class AgenticToolWorkflow(RolloutWorkflow):
         turn_discount: float = 0.9,
         tool_parser=hermes_tool_parser,
         system_prompt: Optional[str] = None,
+        tool_timeout_s: Optional[float] = 30.0,
     ):
         assert gconfig.n_samples == 1, (
             "agentic episodes are single-trajectory; group sampling happens "
@@ -48,11 +78,82 @@ class AgenticToolWorkflow(RolloutWorkflow):
         self.turn_discount = turn_discount
         self.tool_parser = tool_parser
         self.system_prompt = system_prompt
+        # per-call bound on tool execution (None/0 = unbounded, the old
+        # behavior — one hung tool call stalls the episode forever)
+        self.tool_timeout_s = tool_timeout_s
+
+    async def _call_tool(self, env, name: str, arguments: str):
+        """One bounded tool execution. Local sync envs run on a worker
+        thread (so a slow tool cannot block the rollout loop's other
+        episodes) under ``tool_timeout_s``; remote envs are bounded by
+        their OWN retry/failover budget instead. Failures become error
+        observations EXCEPT the env-service-plane errors that mean the
+        episode itself is lost — those must reach the retry/quarantine
+        machinery, not the model."""
+        acall = getattr(env, "acall", None)
+        try:
+            if acall is not None:
+                # remote sessions already carry their own bound: per-
+                # attempt timeout x retries x failover hops
+                # (EnvServiceConfig). Racing an outer wait_for against
+                # that budget would cancel the call mid-retry or mid-
+                # replay — BEFORE the plane's hung-worker recovery runs
+                # — feeding the model a spurious timeout while the
+                # session stays pointed at the wedged worker. The call
+                # is bounded; let it finish or fail typed.
+                out = await acall(name, arguments)
+            elif self.tool_timeout_s:
+                out = await asyncio.wait_for(
+                    asyncio.to_thread(env.call, name, arguments),
+                    self.tool_timeout_s,
+                )
+            else:
+                out = await asyncio.to_thread(env.call, name, arguments)
+            return str(out), False
+        except asyncio.TimeoutError:
+            logger.warning(
+                f"tool {name} timed out after {self.tool_timeout_s}s; "
+                f"feeding the timeout back as an observation"
+            )
+            return tool_error_observation(
+                name, "ToolTimeout",
+                "tool call did not return within the budget",
+                timeout_s=self.tool_timeout_s,
+            ), True
+        except (EnvServiceError, asyncio.CancelledError):
+            # worker death / fleet-down / shutdown: episode-fatal
+            raise
+        except Exception as e:
+            logger.warning(
+                f"tool {name} raised {type(e).__name__}: {e}; feeding the "
+                f"error back as an observation"
+            )
+            return tool_error_observation(
+                name, type(e).__name__, str(e)
+            ), True
 
     async def arun_episode(
         self, engine, data: Dict[str, Any]
     ) -> Optional[Dict[str, np.ndarray]]:
         env = self.env_factory(data)
+        try:
+            # remote envs open their session here (and surface
+            # fleet-unavailable as an episode-level failure)
+            astart = getattr(env, "astart", None)
+            if astart is not None:
+                await astart()
+            return await self._run_with_env(engine, env)
+        finally:
+            aclose = getattr(env, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception as e:  # cleanup must not mask the result
+                    logger.warning(f"env aclose failed: {e}")
+
+    async def _run_with_env(
+        self, engine, env
+    ) -> Optional[Dict[str, np.ndarray]]:
         client = ArealOpenAI(
             engine,
             self.tokenizer,
@@ -65,6 +166,7 @@ class AgenticToolWorkflow(RolloutWorkflow):
         messages.append({"role": "user", "content": env.prompt()})
         last_id = None
         calls_per_turn: List[int] = []
+        errors_per_turn: List[int] = []
         for _ in range(self.max_tool_rounds):
             resp = await client.chat.completions.create(
                 messages=messages, tools=env.tools, tool_choice="auto"
@@ -75,6 +177,7 @@ class AgenticToolWorkflow(RolloutWorkflow):
                 {"role": "assistant", "content": choice.message.content}
             )
             calls_per_turn.append(0)
+            errors_per_turn.append(0)
             if choice.finish_reason != "tool_calls":
                 break
             for tc in choice.message.tool_calls:
@@ -82,8 +185,12 @@ class AgenticToolWorkflow(RolloutWorkflow):
                     # a submit ends the episode; a trailing call in the same
                     # completion must not overwrite the recorded outcome
                     break
-                result = env.call(tc.function.name, tc.function.arguments)
+                result, is_error = await self._call_tool(
+                    env, tc.function.name, tc.function.arguments
+                )
                 calls_per_turn[-1] += 1
+                if is_error:
+                    errors_per_turn[-1] += 1
                 # real chat templates (qwen2/Hermes) expect structured tool
                 # messages — tool_call_id + name let the template pair the
                 # result with its call. A template-less tokenizer (the toy
@@ -115,11 +222,16 @@ class AgenticToolWorkflow(RolloutWorkflow):
             for c in client.export_completions(self.turn_discount).values()
         ]
         batch = data_utils.concat_padded_tensors(rows)
-        # per-row stat: parsed tool calls executed for THAT completion
+        # per-row stats: parsed tool calls executed for THAT completion,
+        # and how many of them came back as error observations
         # (export order is creation order, i.e. turn order)
-        batch["tool_calls"] = np.asarray(
-            calls_per_turn[: len(rows)]
-            + [0] * max(0, len(rows) - len(calls_per_turn)),
-            np.int32,
-        )
+        def _per_row(counts: List[int]) -> np.ndarray:
+            return np.asarray(
+                counts[: len(rows)]
+                + [0] * max(0, len(rows) - len(counts)),
+                np.int32,
+            )
+
+        batch["tool_calls"] = _per_row(calls_per_turn)
+        batch["tool_errors"] = _per_row(errors_per_turn)
         return batch

@@ -5,6 +5,12 @@ the model answers; if wrong, an amendment prompt is appended and it retries,
 up to ``max_turns``. The final reward is discounted by the number of turns
 taken; feedback/user tokens are loss-masked (trained only on its own
 completions), and the whole conversation becomes ONE training sequence.
+
+Bounded reward execution (the multi_turn analog of the agentic
+workflow's bounded tool calls): each per-turn reward check runs under
+``reward_timeout_s``; a wedged reward backend raises the typed
+``RewardTimeoutError`` into the executor's episode retry/quarantine
+machinery instead of pinning the episode task forever.
 """
 
 from typing import Any, Dict, List, Optional
@@ -31,12 +37,20 @@ class MultiTurnWorkflow(RolloutWorkflow):
         feedback_text: str = (
             "Your answer is either wrong or not parsable. Please try again."
         ),
+        # opt-in: must be sized ABOVE the reward backend's own worst-case
+        # failover budget (RemoteVerifier: timeout x retries x addrs) or
+        # a merely-degraded pool gets converted into fabricated episode
+        # failures — the exact class of lie this plane removes. None
+        # leaves bounding to the backend's internal timeouts.
+        reward_timeout_s: Optional[float] = None,
     ):
         assert gconfig.n_samples == 1, (
             "multi-turn episodes are single-trajectory; group sampling "
             "happens at the prompt level"
         )
-        self.reward_fn = AsyncRewardWrapper(reward_fn)
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, timeout_s=reward_timeout_s
+        )
         self.gconfig = gconfig
         self.tokenizer = tokenizer
         self.max_turns = max_turns

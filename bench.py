@@ -231,6 +231,118 @@ def _resilience_phase() -> dict:
                     proc.kill()
 
 
+def _env_resilience_phase() -> dict:
+    """Kill-one-of-two ENV WORKERS under the chaos harness, measured.
+    Two env-service subprocesses host the countdown tool env; a wave of
+    sessions is driven directly through RemoteEnv (no model — this
+    measures the env plane, not generation), then /chaos arms a
+    deterministic hard-kill on one worker mid-wave and every session
+    must finish via journaled replay on the survivor. Reports episode
+    completion rate and the replay/failover counts."""
+    import asyncio
+    import subprocess
+    import urllib.request as _rq
+
+    from areal_tpu.api.cli_args import EnvServiceConfig
+    from areal_tpu.env.service import RemoteEnv
+
+    def spawn():
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "areal_tpu.env.service",
+                "--env", "areal_tpu.env.service:countdown_env",
+                "--port", "0", "--enable-chaos",
+            ],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
+        line = proc.stdout.readline()
+        if not line.startswith("PORT "):
+            proc.kill()
+            raise RuntimeError(f"env worker never reported a port: {line!r}")
+        return proc, f"127.0.0.1:{int(line.split()[1])}"
+
+    procs = []
+    try:
+        vproc, victim = spawn()
+        procs.append(vproc)
+        sproc, survivor = spawn()
+        procs.append(sproc)
+        cfg = EnvServiceConfig(
+            call_retries=2, call_timeout_s=10, reset_timeout_s=10
+        )
+        n_wave, n_steps = 8, 4
+
+        async def episode(i: int, addrs):
+            env = RemoteEnv(addrs=addrs, config=cfg)
+            try:
+                await env.areset(numbers=[3, 5, 2], target=21)
+                for _ in range(n_steps - 1):
+                    await env.astep({
+                        "name": "eval_expression",
+                        "arguments": json.dumps({"expression": "3*7"}),
+                    })
+                _, reward, done, _ = await env.astep({
+                    "name": "submit_expression",
+                    "arguments": json.dumps({"expression": "3*(5+2)"}),
+                })
+                return reward if done else None, env.stats
+            finally:
+                await env.aclose()
+
+        async def wave(addrs):
+            return await asyncio.gather(
+                *[episode(i, addrs) for i in range(n_wave)],
+                return_exceptions=True,
+            )
+
+        t0 = time.perf_counter()
+        base = asyncio.run(wave([survivor]))
+        base_dt = time.perf_counter() - t0
+        base_done = sum(
+            1 for o in base
+            if not isinstance(o, Exception) and o[0] == 1.0
+        )
+        # arm the kill: the victim dies on its (n_wave)th /step — mid-
+        # wave by construction (each episode steps n_steps times)
+        req = _rq.Request(
+            f"http://{victim}/chaos",
+            data=json.dumps({
+                "spec": f"kill:side=server,match=/step,start={n_wave}"
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=10) as r:
+            r.read()
+        t0 = time.perf_counter()
+        out = asyncio.run(wave([victim, survivor]))
+        chaos_dt = time.perf_counter() - t0
+        done = [
+            o for o in out
+            if not isinstance(o, Exception) and o[0] == 1.0
+        ]
+        replays = sum(st["replays"] for _, st in done)
+        failovers = sum(st["failovers"] for _, st in done)
+        return {
+            "env_kill_completion_rate": round(len(done) / n_wave, 4),
+            "env_kill_baseline_completion_rate": round(
+                base_done / n_wave, 4
+            ),
+            "env_kill_replays": int(replays),
+            "env_kill_failovers": int(failovers),
+            "env_kill_baseline_wave_s": round(base_dt, 3),
+            "env_kill_chaos_wave_s": round(chaos_dt, 3),
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1139,6 +1251,27 @@ def main():
                 "resilience_completion_rate": None,
                 "resilience_added_latency_s": None,
                 "error": extra["resilience_error"],
+            },
+        )
+
+    # --- env-worker-kill resilience sub-phase: two env-service worker
+    # subprocesses host the countdown tool env; a deterministic chaos
+    # kill takes one down mid-wave and every in-flight session must
+    # replay onto the survivor (env/service.py journaled replay). The
+    # numbers of record are episode completion rate with a worker lost
+    # and the replay/failover counts. Same graceful-degradation rule ---
+    try:
+        env_resil = _env_resilience_phase()
+        extra.update(env_resil)
+        emit_phase("env_kill", env_resil)
+    except Exception as e:
+        extra["env_kill_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "env_kill",
+            {
+                "env_kill_completion_rate": None,
+                "env_kill_replays": None,
+                "error": extra["env_kill_error"],
             },
         )
 

@@ -107,6 +107,12 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--episodes-per-step", type=int, default=8)
     p.add_argument("--max-new-tokens", type=int, default=48)
+    p.add_argument(
+        "--tool-timeout", type=float, default=30.0,
+        help="per-tool-call execution bound; a timeout becomes an error "
+        "observation in the tool message (EnvServiceConfig.tool_timeout_s "
+        "is the config-tree equivalent for launcher-driven runs)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -209,6 +215,7 @@ def main(argv=None):
         max_tool_rounds=3,
         turn_discount=0.9,
         tool_parser=toy_tool_parser,
+        tool_timeout_s=args.tool_timeout,
     )
 
     rng = np.random.default_rng(0)
@@ -220,6 +227,7 @@ def main(argv=None):
             items.append({"numbers": env.numbers, "target": env.target})
         batch = rollout.rollout_batch(items, workflow)
         tool_calls = batch.pop("tool_calls", np.zeros(1))
+        tool_errors = batch.pop("tool_errors", np.zeros(1))
         adv = actor.compute_advantages(dict(batch))
         stats = actor.ppo_update(adv)
         rollout.pause()
@@ -232,6 +240,7 @@ def main(argv=None):
         print(
             f"[countdown] step {step}: rows={batch['input_ids'].shape[0]} "
             f"tool_calls/turn={float(np.mean(tool_calls)):.2f} "
+            f"tool_errors/turn={float(np.mean(tool_errors)):.2f} "
             f"reward_mean={float(np.mean(batch['rewards'])):.3f} "
             f"loss={stats[0]['loss']:.4f} ({time.time()-t0:.1f}s)",
             flush=True,

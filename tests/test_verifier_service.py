@@ -94,13 +94,20 @@ def test_failover_and_local_fallback(service):
     assert v2.verify(
         {"kind": "math", "completion": "\\boxed{3}", "answer": "3"}
     ) == 1.0
-    # entirely dead pool, no fallback: scores 0, never raises
+    # entirely dead pool, no fallback: raises the TYPED unavailability
+    # error (episode retry/quarantine handles it) — fabricating a 0.0
+    # reward here would silently poison training
     v3 = VS.RemoteVerifier(
         ["127.0.0.1:1"], retries=1, timeout=0.5, local_fallback=False
     )
-    assert v3.verify(
-        {"kind": "math", "completion": "\\boxed{3}", "answer": "3"}
-    ) == 0.0
+    with pytest.raises(VS.VerifierUnavailableError):
+        v3.verify(
+            {"kind": "math", "completion": "\\boxed{3}", "answer": "3"}
+        )
+    with pytest.raises(VS.VerifierUnavailableError):
+        v3.verify_batch(
+            [{"kind": "math", "completion": "\\boxed{3}", "answer": "3"}]
+        )
 
 
 def test_env_routes_through_remote(service):

@@ -301,6 +301,83 @@ def format_failover(fo: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def env_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Env-service-plane report: per-operation latency percentiles from
+    ``env_reset``/``env_step``/``verify`` spans (client- or worker-side)
+    plus the failover picture (``env_replay``/``env_failover`` instants)
+    — the first-look answer to "how slow are my environments and how
+    often did sessions hop workers"."""
+    spans = list(spans)
+    ops: Dict[str, List[float]] = {}
+    by_addr: Dict[str, List[float]] = {}
+    for s in spans:
+        name = s.get("name", "")
+        if name not in ("env_reset", "env_step", "env_close", "verify"):
+            continue
+        ops.setdefault(name, []).append(float(s.get("dur", 0.0)))
+        if name == "env_step":
+            addr = str((s.get("attrs") or {}).get("addr", "?"))
+            by_addr.setdefault(addr, []).append(float(s.get("dur", 0.0)))
+    replays = [s for s in spans if s.get("name") == "env_replay"]
+    failovers = [s for s in spans if s.get("name") == "env_failover"]
+    replayed_steps = sum(
+        int((s.get("attrs") or {}).get("steps", 0)) for s in replays
+    )
+    out: Dict[str, Any] = {
+        "steps": len(ops.get("env_step", [])),
+        "sessions": len({
+            s.get("rid", "") for s in spans
+            if s.get("name") == "env_reset"
+        }),
+        "replays": len(replays),
+        "replayed_steps": replayed_steps,
+        "failovers": len(failovers),
+        "ops": {},
+        "step_by_worker": {},
+    }
+    for name, durs in sorted(ops.items()):
+        durs.sort()
+        out["ops"][name] = {
+            "count": len(durs),
+            "p50_s": _percentile(durs, 0.50),
+            "p95_s": _percentile(durs, 0.95),
+            "max_s": durs[-1] if durs else 0.0,
+        }
+    for addr, durs in sorted(by_addr.items()):
+        durs.sort()
+        out["step_by_worker"][addr] = {
+            "count": len(durs),
+            "p50_s": _percentile(durs, 0.50),
+            "p95_s": _percentile(durs, 0.95),
+        }
+    return out
+
+
+def format_env(ev: Dict[str, Any]) -> str:
+    rows = [
+        f"sessions             {ev['sessions']}",
+        f"env steps            {ev['steps']}",
+        f"session replays      {ev['replays']} "
+        f"({ev['replayed_steps']} journaled steps re-applied)",
+        f"worker failovers     {ev['failovers']}",
+        "",
+        f"{'op':<14}{'count':>7}{'p50 s':>10}{'p95 s':>10}{'max s':>10}",
+    ]
+    for name, st in ev["ops"].items():
+        rows.append(
+            f"{name:<14}{st['count']:>7}{st['p50_s']:>10.4f}"
+            f"{st['p95_s']:>10.4f}{st['max_s']:>10.4f}"
+        )
+    if ev["step_by_worker"]:
+        rows += ["", f"{'worker':<24}{'steps':>7}{'p50 s':>10}{'p95 s':>10}"]
+        for addr, st in ev["step_by_worker"].items():
+            rows.append(
+                f"{addr:<24}{st['count']:>7}{st['p50_s']:>10.4f}"
+                f"{st['p95_s']:>10.4f}"
+            )
+    return "\n".join(rows)
+
+
 def durability_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Trainer-durability report: checkpoint dump/commit latency from
     ``checkpoint_dump``/``checkpoint_commit`` spans plus the episode
@@ -395,6 +472,8 @@ def lineage_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "weight_versions": list(r.get("weight_versions", [])),
                 "failovers": int(r.get("failovers", 0)),
                 "migrations": int(r.get("migrations", 0)),
+                "env_failovers": int(r.get("env_failovers", 0)),
+                "env_replays": int(r.get("env_replays", 0)),
                 "staleness_max": st,
                 "consumed_step": r.get("consumed_step"),
                 "reward_mean": (
@@ -418,6 +497,9 @@ def lineage_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "quarantined": sum(
             1 for r in rows if r["status"] == "quarantined"
         ),
+        # env service plane: samples that rode out an env-worker death
+        "env_replayed": sum(1 for r in rows if r["env_replays"] > 0),
+        "env_failovers": sum(r["env_failovers"] for r in rows),
         "staleness_p50": _percentile(staleness, 0.50),
         "staleness_max": staleness[-1] if staleness else 0,
         "rows": rows,
@@ -432,6 +514,8 @@ def format_lineage(ln: Dict[str, Any]) -> str:
         f"(multi-server {ln['multi_server']}, "
         f"multi-version {ln['multi_version']})",
         f"retried episodes     {ln['retried']}",
+        f"env sessions replayed {ln['env_replayed']} "
+        f"({ln['env_failovers']} env-worker failovers)",
         f"staleness            p50 {ln['staleness_p50']}  "
         f"max {ln['staleness_max']}",
         "",
@@ -554,6 +638,13 @@ def main(argv=None) -> int:
         "1 when the trace carries no verify rounds",
     )
     p.add_argument(
+        "--env", action="store_true",
+        help="summarize the environment service plane (env_reset/"
+        "env_step/verify span latencies + env_replay/env_failover "
+        "instants) instead of the latency table; exit 1 when the trace "
+        "carries no env spans",
+    )
+    p.add_argument(
         "--failover", action="store_true",
         help="summarize resilience events (failover/migration spans "
         "from engine/remote.py) instead of the latency table; exit 1 "
@@ -624,6 +715,20 @@ def main(argv=None) -> int:
             print(
                 "no spec_verify spans in trace (tracing off, or "
                 "speculation never engaged)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    if args.env:
+        ev = env_summary(spans)
+        if args.json:
+            print(json.dumps(ev, indent=2))
+        else:
+            print(format_env(ev))
+        if ev["steps"] == 0 and ev["sessions"] == 0:
+            print(
+                "no env spans in trace (tracing off, or no remote "
+                "environments ran)",
                 file=sys.stderr,
             )
             return 1
