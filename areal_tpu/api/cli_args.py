@@ -310,10 +310,17 @@ class JaxGenConfig:
     # lax.top_k candidate count for truncated sampling (raised to the max
     # requested per-slot top_k); 0 would force the exact full-vocab sort
     sample_topk_bound: int = 64
-    # reuse freed requests' cached KV (prefix registry) when >= this many
+    # reuse freed requests' cached KV (prefix cache) when >= this many
     # prompt tokens match (0 disables prefix reuse); matches are shared at
     # page granularity by refcount, not copied
     prefix_reuse_min: int = 16
+    # prefix-cache implementation: "radix" (r9 default — refcounted radix
+    # tree over the paged pool, O(prompt) descent, publish-at-prefill-
+    # commit so GRPO siblings/agentic turns claim a live request's prompt
+    # pages, COW claims for divergence within a partial tail page) or
+    # "flat" (the r1-r8 free-time-only linear-scan registry, kept as the
+    # bench A/B baseline). prefix_reuse_min=0 disables both.
+    prefix_cache_mode: str = "radix"
     # --- paged KV pool (the radix/paged-cache analog) ---
     page_size: int = 256  # tokens per KV page
     # total pages in the pool; 0 = auto (full provisioning: every slot can
@@ -392,6 +399,10 @@ class JaxGenConfig:
             args.append(
                 f"--compilation-cache-dir={config.compilation_cache_dir}"
             )
+        args += [
+            f"--prefix-cache-mode={config.prefix_cache_mode}",
+            f"--prefix-reuse-min={config.prefix_reuse_min}",
+        ]
         if config.spec.enabled:
             args += [
                 "--spec",

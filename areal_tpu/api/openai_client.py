@@ -265,6 +265,7 @@ class _ChatCompletions:
             input_ids=list(input_ids),
             gconfig=gconfig,
             rid=f"chatcmpl-{uuid.uuid4().hex}",
+            metadata={"qid": c.session_id},
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
@@ -321,11 +322,19 @@ class ArealOpenAI:
         tokenizer,
         gconfig: Optional[GenerationHyperparameters] = None,
         tool_parser: Callable[[str], List[ToolCall]] = hermes_tool_parser,
+        session_id: Optional[str] = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.gconfig = gconfig or GenerationHyperparameters()
         self.tool_parser = tool_parser
+        # session/affinity key stamped into every request's metadata
+        # ("qid"): all of an agentic episode's turns steer to one
+        # server, where each turn's growing history rides the previous
+        # turn's radix-cached pages
+        from areal_tpu.api.io_struct import unique_rid
+
+        self.session_id = session_id or unique_rid("sess")
         self._cache: Dict[str, CompletionWithTokenLogpReward] = {}
         self.chat = _Chat(self)
 

@@ -97,6 +97,12 @@ class RemoteInferenceEngine(InferenceEngine):
         # resumed rid keeps its KV locality (mirrors the router's
         # bounded qid cache)
         self._rid_to_address: "OrderedDict[str, str]" = OrderedDict()
+        # qid → server affinity (group/session key): GRPO siblings and a
+        # multi-turn episode's successive turns land on the server whose
+        # radix cache holds their shared prefix — without this every
+        # request scatters round-robin and cross-request KV reuse is
+        # structurally impossible
+        self._qid_to_address: "OrderedDict[str, str]" = OrderedDict()
         self._version = 0
         # last scheduling version the fronting router reported (when
         # config.router_addr is set): the stickiness key its
@@ -297,6 +303,10 @@ class RemoteInferenceEngine(InferenceEngine):
         stale = [r for r, a in self._rid_to_address.items() if a == addr]
         for r in stale:
             del self._rid_to_address[r]
+        for q in [
+            q for q, a in self._qid_to_address.items() if a == addr
+        ]:
+            del self._qid_to_address[q]
         return len(stale)
 
     def destroy(self):
@@ -363,17 +373,26 @@ class RemoteInferenceEngine(InferenceEngine):
 
     def set_version(self, version: int):
         with self._lock:
+            if version != self._version:
+                # fresh weights flushed every server's prefix cache —
+                # group affinity to the old cached prefixes is moot (and
+                # a stale map would pin whole groups to one cold server)
+                self._qid_to_address.clear()
             self._version = version
 
     # ------------------------------------------------------------------
     def choose_server(
-        self, rid: Optional[str] = None, exclude: Optional[set] = None
+        self, rid: Optional[str] = None, exclude: Optional[set] = None,
+        qid: Optional[str] = None,
     ) -> str:
-        """rid-affinity first (KV locality on resume), else scheduling
-        policy (reference sglang_remote.py:158-168) — over the HEALTHY
-        fleet only. ``exclude`` is the per-request failover set: servers
-        this request already failed on. An affinity entry pointing at an
-        excluded/unhealthy server is evicted, not honored."""
+        """rid-affinity first (KV locality on resume), then qid-affinity
+        (the group/session key — GRPO siblings and multi-turn turns
+        steer to the server holding their shared radix prefix), else
+        scheduling policy (reference sglang_remote.py:158-168) — over
+        the HEALTHY fleet only. ``exclude`` is the per-request failover
+        set: servers this request already failed on. An affinity entry
+        pointing at an excluded/unhealthy server is evicted, not
+        honored."""
         with self._lock:
             fleet = self.fleet
 
@@ -390,6 +409,15 @@ class RemoteInferenceEngine(InferenceEngine):
                     self._rid_to_address.move_to_end(rid)
                     return addr
                 del self._rid_to_address[rid]
+            if qid and qid in self._qid_to_address:
+                addr = self._qid_to_address[qid]
+                if usable(addr):
+                    self._qid_to_address.move_to_end(qid)
+                    if rid is not None:
+                        self._rid_to_address[rid] = addr
+                        self._rid_to_address.move_to_end(rid)
+                    return addr
+                del self._qid_to_address[qid]
             candidates = [a for a in self.addresses if usable(a)]
             if not candidates:
                 # fail open on health (a stale SUSPECT/DEAD verdict must
@@ -421,10 +449,16 @@ class RemoteInferenceEngine(InferenceEngine):
                 while len(self._rid_to_address) > 16384:
                     # evict least-recently-USED, not first-inserted
                     self._rid_to_address.popitem(last=False)
+            if qid:
+                self._qid_to_address[qid] = addr
+                self._qid_to_address.move_to_end(qid)
+                while len(self._qid_to_address) > 16384:
+                    self._qid_to_address.popitem(last=False)
             return addr
 
     async def _schedule_via_router(
-        self, session, req: ModelRequest, failed: set, headers
+        self, session, req: ModelRequest, failed: set, headers,
+        qid: Optional[str] = None,
     ) -> Optional[str]:
         """Router-scheduled mode (config.router_addr): ask the fronting
         router for a server, forwarding the trace context so the
@@ -438,13 +472,19 @@ class RemoteInferenceEngine(InferenceEngine):
         with self._lock:
             prev = self._rid_to_address.get(req.rid)
             prev_version = self._router_version
+        # group/session key: workflows stamp metadata["qid"] (GRPO group
+        # id / episode id) and agenerate falls back to the episode's
+        # lineage uid — only a standalone call degrades to the rid,
+        # which scatters siblings and forfeits cross-request KV reuse
         meta = {
             "rid": req.rid,
-            "qid": str(req.metadata.get("qid") or req.rid),
+            "qid": str(qid or req.rid),
             "prompt_len": len(req.input_ids),
             "new_token_budget": req.gconfig.max_new_tokens,
             "exclude": sorted(failed),
         }
+        if req.metadata.get("group_size"):
+            meta["group_size"] = int(req.metadata["group_size"])
         if prev is not None and prev not in failed:
             meta["previous_server"] = prev
             meta["previous_version"] = prev_version
@@ -526,6 +566,14 @@ class RemoteInferenceEngine(InferenceEngine):
             episode.trace_id if episode is not None
             else str(req.metadata.get("trace_id") or new_trace_id())
         )
+        # affinity key for prefix-cache steering: the workflow's stamped
+        # group/session id, else the episode uid (stable across a GRPO
+        # group's sibling requests AND a multi-turn episode's turns —
+        # both run inside one episode context)
+        ep_uid = episode.uid if episode is not None else ""
+        if ep_uid == "?":
+            ep_uid = ""  # uid-less episodes must not all glue together
+        qid = str(req.metadata.get("qid") or ep_uid or "") or None
         hdrs = trace_headers(trace_id, req.rid)
         self.tracer.bind_trace(req.rid, trace_id)
         lineage = telemetry.RequestLineage(
@@ -543,8 +591,8 @@ class RemoteInferenceEngine(InferenceEngine):
                     # fail closed; max_failovers still bounds total hops
                     failed.clear()
                 server = await self._schedule_via_router(
-                    session, req, failed, hdrs
-                ) or self.choose_server(req.rid, exclude=failed)
+                    session, req, failed, hdrs, qid=qid
+                ) or self.choose_server(req.rid, exclude=failed, qid=qid)
                 remaining = gconfig.max_new_tokens - len(accumulated)
                 ask = min(remaining, chunk) if chunk > 0 else remaining
                 payload = {

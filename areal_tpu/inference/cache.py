@@ -12,10 +12,16 @@ sees dynamic shapes:
 
 - ``PageManager`` — refcounted allocator. Pages are *shared* between
   sequences (GRPO siblings share prompt pages; concurrent requests share
-  any cached prefix), the radix-tree benefit without the tree.
-- ``PrefixRegistry`` — freed sequences park their full pages here with the
-  token string they cache; new requests claim the longest matching prefix
-  by bumping refcounts (no copy). LRU-evicted when the pool runs short.
+  any cached prefix).
+- ``RadixPrefixCache`` — the real radix tree (r9 default): one node per
+  page, O(prompt) longest-prefix descent, publish-at-prefill-commit (the
+  first GRPO sibling's prompt pages are claimable the moment prefill
+  lands, while the owner is still decoding), and copy-on-write claims
+  for divergence *within* a partial tail page (grain = the pool's
+  token-packed row, so mid-page resumes never need a pool read).
+- ``PrefixRegistry`` — the r1-r8 flat registry (``prefix_cache_mode=
+  "flat"``): free-time-only parking, full-page-only matching,
+  O(entries×tokens) scan. Kept as the bench A/B baseline.
 
 Capacity discipline: admission reserves only the pages a prompt needs now;
 decode allocates pages as sequences grow. When the pool runs dry the engine
@@ -122,9 +128,19 @@ class PrefixRegistry:
         self.page_size = page_size
         self.min_match = min_match
         self._entries: List[Tuple[np.ndarray, Tuple[int, ...], float]] = []
+        # lifetime claim accounting (same surface as RadixPrefixCache)
+        self.claims = 0
+        self.hits = 0
+        self.cow_claims = 0
+        self.evicted_pages = 0
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    @property
+    def pages(self) -> int:
+        """Pool pages the registry currently holds a reference on."""
+        return sum(len(p) for _, p, _ in self._entries)
 
     def add(
         self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
@@ -149,6 +165,7 @@ class PrefixRegistry:
         """Longest full-page prefix match; shares the matched pages.
         Returns (pages, cached_tokens). At least one prompt token must
         remain uncached (to produce next-token logits)."""
+        self.claims += 1
         if self.min_match <= 0 or not self._entries:
             return [], 0
         prompt_arr = np.asarray(prompt, np.int32)
@@ -165,6 +182,7 @@ class PrefixRegistry:
                 best_len, best, best_i = match, pages, i
         if best is None or best_len < max(self.min_match, 1):
             return [], 0
+        self.hits += 1
         # refresh the hit's LRU stamp: hot shared prefixes (system prompts)
         # must outlive cold one-off entries under eviction pressure
         tokens, pages, _ = self._entries[best_i]
@@ -185,6 +203,7 @@ class PrefixRegistry:
             _, pages, _ = self._entries.pop(0)
             pm.release(pages)
             evicted += 1
+            self.evicted_pages += len(pages)
         return evicted
 
     def flush(self, pm: PageManager) -> None:
@@ -192,3 +211,324 @@ class PrefixRegistry:
         for _, pages, _ in self._entries:
             pm.release(pages)
         self._entries.clear()
+
+
+class _RadixNode:
+    """One page of cached tokens. ``tokens`` holds the page's cached
+    content (== page_size for full/interior nodes; shorter only for a
+    tail leaf, whose owner may still be decoding into the same physical
+    page — tails are therefore claimable only by COPY, never by share).
+    Children are keyed by their first token for O(1) descent."""
+
+    __slots__ = ("page", "tokens", "children", "parent", "stamp")
+
+    def __init__(self, page: Optional[int], tokens: np.ndarray, parent):
+        self.page = page
+        self.tokens = tokens
+        self.children: Dict[int, List["_RadixNode"]] = {}
+        self.parent = parent
+        self.stamp = 0
+
+
+class RadixPrefixCache:
+    """Refcounted radix tree over the paged pool (one node = one page).
+
+    Replaces ``PrefixRegistry``'s linear scan with an O(prompt) descent,
+    and its free-time-only parking with **publish-at-prefill-commit**:
+    the engine inserts a prompt's pages into the tree the moment its
+    prefill dispatch is issued, so GRPO siblings admitted in later waves
+    — and turn N of a multi-turn episode riding turn N-1's pages — claim
+    the shared prefix while the owner is still decoding.
+
+    Ownership: the tree holds exactly ONE PageManager reference per
+    node. ``publish`` is non-owning (it ``share``s every page it
+    inserts); ``add`` is the owning free-time transfer (publish, then
+    release the caller's references — pages whose content is already in
+    the tree are thereby deduplicated away).
+
+    Claims: full-page matches are shared by refcount (no copy). A match
+    that continues *into* a node's page (divergence within the page, or
+    a partial tail) is served copy-on-write: ``claim_cow`` returns the
+    source page (with a protective reference the caller must release
+    after its device copy is dispatched) and the match length floored to
+    ``grain`` — the pool's token-packed row size, which keeps the
+    resumed prefill row-aligned so the KV merge never needs to read the
+    pool (model_runner.assemble_rows consults last_rows only for
+    mid-row starts).
+
+    Eviction is LRU-leaf-first: only childless nodes are evictable (an
+    interior node's page is a live prefix), and dropping the tree's
+    reference never frees a page a live claimant still holds.
+    """
+
+    def __init__(self, page_size: int, min_match: int, grain: int = 1):
+        self.page_size = page_size
+        self.min_match = min_match
+        self.grain = max(1, grain)
+        self.root = _RadixNode(None, np.empty(0, np.int32), None)
+        self.node_count = 0
+        self._clock = 0
+        # lifetime counters (engine /metrics)
+        self.claims = 0
+        self.hits = 0
+        self.cow_claims = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    def __len__(self) -> int:
+        return self.node_count
+
+    @property
+    def pages(self) -> int:
+        """Pool pages the tree holds a reference on (== nodes)."""
+        return self.node_count
+
+    # -- internals -----------------------------------------------------
+    def _touch(self, node: _RadixNode) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def _children(self, node: _RadixNode, first: int) -> List[_RadixNode]:
+        return node.children.get(int(first), [])
+
+    def _insert_node(
+        self, pm: PageManager, parent: _RadixNode, page: int,
+        tokens: np.ndarray,
+    ) -> _RadixNode:
+        child = _RadixNode(int(page), tokens, parent)
+        parent.children.setdefault(int(tokens[0]), []).append(child)
+        pm.share([page])
+        self.node_count += 1
+        self._touch(child)
+        return child
+
+    def _remove_leaf(self, pm: PageManager, node: _RadixNode) -> None:
+        assert not node.children and node.parent is not None
+        key = int(node.tokens[0])
+        sibs = node.parent.children[key]
+        sibs.remove(node)
+        if not sibs:
+            del node.parent.children[key]
+        node.parent = None
+        pm.release([node.page])
+        self.node_count -= 1
+
+    # -- publish / add -------------------------------------------------
+    def publish(
+        self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
+    ) -> int:
+        """Insert ``tokens`` (cached in ``pages``, page-major) into the
+        tree WITHOUT taking ownership: every newly inserted page gets its
+        own reference via ``pm.share``. Pages whose content an existing
+        node already caches are skipped. Returns pages inserted.
+
+        Re-publishing a grown sequence (free-time, after the commit-time
+        publish) extends its own tail node in place — same physical
+        page, the owner wrote the extra tokens — and continues into the
+        decode pages beyond it."""
+        if self.min_match <= 0:
+            return 0
+        arr = np.asarray(tokens, np.int32)
+        bs = self.page_size
+        n_pages = min(len(pages), -(-len(arr) // bs)) if len(arr) else 0
+        node = self.root
+        inserted = 0
+        depth = 0
+        for pi in range(n_pages):
+            block = arr[depth : depth + bs]
+            page = int(pages[pi])
+            if len(block) == bs:
+                nxt = None
+                upgrade = None
+                for child in self._children(node, block[0]):
+                    ct = child.tokens
+                    if len(ct) == bs and np.array_equal(ct, block):
+                        nxt = child
+                        break
+                    if (
+                        child.page == page
+                        and len(ct) < bs
+                        and np.array_equal(ct, block[: len(ct)])
+                    ):
+                        upgrade = child
+                if nxt is None and upgrade is not None:
+                    # tail → full in place (same physical page)
+                    upgrade.tokens = block.copy()
+                    nxt = upgrade
+                if nxt is not None:
+                    self._touch(nxt)
+                    node = nxt
+                    depth += bs
+                    continue
+                node = self._insert_node(pm, node, page, block.copy())
+                inserted += 1
+                depth += bs
+                continue
+            # partial tail block (< bs): terminal by construction
+            if len(block) == 0:
+                break
+            placed = False
+            for child in self._children(node, block[0]):
+                ct = child.tokens
+                m = min(len(ct), len(block))
+                if not np.array_equal(ct[:m], block[:m]):
+                    continue  # diverges inside the page → sibling tail
+                if len(ct) >= len(block):
+                    # an existing node already caches at least this much
+                    self._touch(child)
+                    placed = True
+                    break
+                if child.page == page:
+                    child.tokens = block.copy()  # same-page extension
+                    self._touch(child)
+                    placed = True
+                    break
+                if not child.children:
+                    # longer content on a different page: replace the
+                    # tail's page (tails are never SHARED by claimants —
+                    # COW copies keep their own pages — so swapping the
+                    # tree's reference is safe)
+                    pm.release([child.page])
+                    pm.share([page])
+                    child.page = page
+                    child.tokens = block.copy()
+                    self._touch(child)
+                    placed = True
+                    break
+            if not placed:
+                self._insert_node(pm, node, page, block.copy())
+                inserted += 1
+            break
+        self.inserted_pages += inserted
+        return inserted
+
+    def add(
+        self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
+    ) -> None:
+        """Free-time park (ownership transfer, the PrefixRegistry.add
+        contract): publish, then release the caller's references —
+        pages that duplicated existing tree content are freed."""
+        if self.min_match > 0 and len(tokens) > 0:
+            self.publish(pm, tokens, pages)
+        pm.release(pages)
+
+    # -- claim ---------------------------------------------------------
+    def claim(
+        self, pm: PageManager, prompt: Sequence[int]
+    ) -> Tuple[List[int], int]:
+        """PrefixRegistry-compatible claim: full shared pages only."""
+        pages, off, _, _ = self.claim_cow(pm, prompt, allow_cow=False)
+        return pages, off
+
+    def claim_cow(
+        self, pm: PageManager, prompt: Sequence[int], allow_cow: bool = True
+    ) -> Tuple[List[int], int, Optional[int], int]:
+        """Longest-prefix claim. Returns ``(shared_pages, cached_tokens,
+        cow_src_page, cow_tokens)``:
+
+        - ``shared_pages`` — full pages matched along the descent, each
+          with a fresh reference (the claimant owns them).
+        - ``cached_tokens`` — total tokens served from cache, i.e.
+          ``len(shared_pages)*page_size + cow_tokens``; always leaves at
+          least one prompt token uncached (next-token logits).
+        - ``cow_src_page`` — when the match continues into a node's page
+          (partial tail, or divergence within a full page): the page to
+          device-copy into the claimant's next fresh page. Carries a
+          protective reference the CALLER must release once its copy is
+          dispatched (eviction between claim and copy must not free it).
+        - ``cow_tokens`` — match length inside that page, floored to
+          ``grain`` (row-aligned resume, see class docstring).
+        """
+        self.claims += 1
+        if self.min_match <= 0 or self.node_count == 0:
+            return [], 0, None, 0
+        arr = np.asarray(prompt, np.int32)
+        limit = len(arr) - 1
+        bs = self.page_size
+        node = self.root
+        path: List[_RadixNode] = []
+        depth = 0
+        while depth + bs <= limit:
+            block = arr[depth : depth + bs]
+            nxt = None
+            for child in self._children(node, block[0]):
+                if len(child.tokens) == bs and np.array_equal(
+                    child.tokens, block
+                ):
+                    nxt = child
+                    break
+            if nxt is None:
+                break
+            path.append(nxt)
+            node = nxt
+            depth += bs
+        cow_node: Optional[_RadixNode] = None
+        cow_len = 0
+        if allow_cow and depth < limit:
+            rest = arr[depth:limit]
+            for child in self._children(node, rest[0]):
+                n = min(len(child.tokens), len(rest))
+                eq = child.tokens[:n] == rest[:n]
+                m = n if eq.all() else int(np.argmin(eq))
+                m = (m // self.grain) * self.grain
+                if m > cow_len:
+                    cow_len, cow_node = m, child
+            if cow_len <= 0:
+                cow_node = None
+        total = depth + cow_len
+        if total < max(self.min_match, 1):
+            return [], 0, None, 0
+        self.hits += 1
+        pages = [nd.page for nd in path]
+        pm.share(pages)
+        for nd in path:
+            self._touch(nd)
+        if cow_node is not None:
+            pm.share([cow_node.page])
+            self._touch(cow_node)
+            self.cow_claims += 1
+            return pages, total, cow_node.page, cow_len
+        return pages, total, None, 0
+
+    # -- eviction / flush ---------------------------------------------
+    def evict(self, pm: PageManager, pages_needed: int) -> int:
+        """LRU-leaf-first eviction until the allocator could satisfy
+        ``pages_needed`` (or the tree is empty). Dropping a node only
+        drops the TREE's reference — pages shared by live claimants
+        survive. Returns pages evicted."""
+        import heapq
+
+        evicted = 0
+        if self.node_count == 0 or pm.n_free >= pages_needed:
+            return 0
+        heap: List[tuple] = []
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            for lst in nd.children.values():
+                stack.extend(lst)
+            if nd is not self.root and not nd.children:
+                heapq.heappush(heap, (nd.stamp, id(nd), nd))
+        while heap and pm.n_free < pages_needed:
+            stamp, _, nd = heapq.heappop(heap)
+            if nd.children or nd.parent is None or nd.stamp != stamp:
+                continue  # stale heap entry (touched or already removed)
+            parent = nd.parent
+            self._remove_leaf(pm, nd)
+            evicted += 1
+            if parent is not self.root and not parent.children:
+                heapq.heappush(heap, (parent.stamp, id(parent), parent))
+        self.evicted_pages += evicted
+        return evicted
+
+    def flush(self, pm: PageManager) -> None:
+        """Drop everything (weight update → cached KV is stale)."""
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            for lst in nd.children.values():
+                stack.extend(lst)
+            if nd is not self.root:
+                pm.release([nd.page])
+        self.root = _RadixNode(None, np.empty(0, np.int32), None)
+        self.node_count = 0

@@ -7,15 +7,20 @@ and interleaves admissions (prefill) with fused multi-step decode. Every
 device program is compiled once per shape bucket — continuous batching
 never recompiles.
 
-Memory model (the paged/radix-cache analog, inference/cache.py):
+Memory model (the radix prefix cache, inference/cache.py):
 - prompts and generations live in refcounted pages; GRPO siblings *share*
   full prompt pages (one prefill, no copy) and copy at most one partial
-  tail page; finished requests park their pages in a prefix registry that
-  later requests claim by refcount — so identical system prompts and
-  interrupted-generation resubmits pay only the unseen suffix.
+  tail page. A refcounted RADIX TREE over the pool (r9 default) is
+  populated at PREFILL COMMIT — the first sibling's prompt pages are
+  claimable the moment its prefill dispatch lands, so siblings/turns
+  arriving in later waves ride them while the owner is still decoding —
+  and extended at free time with the full generated sequence. Claims
+  descend the tree in O(prompt): full pages share by refcount; a match
+  that diverges *within* a page (partial tails included) is served
+  copy-on-write at the pool's row grain, and prefill resumes mid-page.
 - decode allocates pages lazily as sequences grow. When the pool runs dry
-  the engine evicts the registry LRU-first and then *preempts* the
-  youngest running requests: their pages move to the registry and the
+  the engine evicts the tree leaf-LRU-first and then *preempts* the
+  youngest running requests: their pages move to the tree and the
   request transparently re-queues (it usually re-claims its own pages, so
   preemption costs one partial-page re-prefill at most). This is what lets
   max_model_len be 16k+ without reserving 16k tokens per slot.
@@ -45,6 +50,7 @@ from areal_tpu.inference.cache import (
     CacheConfig,
     PageManager,
     PrefixRegistry,
+    RadixPrefixCache,
     init_kv_pool,
 )
 from areal_tpu.models import hf_io
@@ -322,9 +328,25 @@ class GenerationEngine:
             )()
         # page 0 is the trash target for dropped merge rows — reserved
         self.pm = PageManager(num_pages, reserve_first=True)
-        self.registry = PrefixRegistry(
-            bs, config.prefix_reuse_min
-        )
+        cache_mode = getattr(config, "prefix_cache_mode", "radix")
+        if cache_mode not in ("radix", "flat"):
+            raise ValueError(
+                f"prefix_cache_mode={cache_mode!r}: expected radix | flat"
+            )
+        if cache_mode == "radix":
+            # COW grain = the token-packed row (pack_factor tokens): a
+            # multiple of BOTH layouts' tokens-per-row, so mid-page
+            # claim resumes stay row-aligned (assemble_rows never reads
+            # the pool) and cached-token counts are layout-independent
+            from areal_tpu.ops.paged_attention import pack_factor
+
+            self.registry = RadixPrefixCache(
+                bs, config.prefix_reuse_min,
+                grain=pack_factor(model_config.head_dim),
+            )
+        else:
+            self.registry = PrefixRegistry(bs, config.prefix_reuse_min)
+        self._radix = cache_mode == "radix"
         s = config.max_num_seqs
         self._free_slots: List[int] = list(range(s - 1, -1, -1))
         self._tables = np.full(
@@ -492,6 +514,7 @@ class GenerationEngine:
         self.total_generated_tokens = 0
         self.total_prompt_tokens = 0
         self.total_cached_prompt_tokens = 0  # prompt tokens served from KV reuse
+        self.total_cow_copies = 0  # COW page copies for mid-page claims
         self.total_requests = 0
         self.total_aborted = 0
         self.total_preemptions = 0
@@ -663,6 +686,26 @@ class GenerationEngine:
             total_generated_tokens=self.total_generated_tokens,
             total_prompt_tokens=self.total_prompt_tokens,
             total_cached_prompt_tokens=self.total_cached_prompt_tokens,
+            # prefix-cache observability (radix and flat modes alike):
+            # token-level hit rate is the sibling-dedup + claim signal,
+            # claim-level is the tree's match success rate
+            prefix_cache_hit_rate=round(
+                self.total_cached_prompt_tokens
+                / max(1, self.total_prompt_tokens), 4
+            ),
+            prefix_cached_tokens_total=self.total_cached_prompt_tokens,
+            prefix_claim_hit_rate=round(
+                getattr(self.registry, "hits", 0)
+                / max(1, getattr(self.registry, "claims", 0)), 4
+            ),
+            prefix_cache_nodes=len(self.registry),
+            prefix_cache_pages=getattr(
+                self.registry, "pages", len(self.registry)
+            ),
+            prefix_cow_copies_total=self.total_cow_copies,
+            prefix_evicted_pages_total=getattr(
+                self.registry, "evicted_pages", 0
+            ),
             total_requests=self.total_requests,
             total_aborted=self.total_aborted,
             total_preemptions=self.total_preemptions,
@@ -1041,11 +1084,18 @@ class GenerationEngine:
         offsets: List[int] = []
         rep_pages: List[List[int]] = []
         admitted_groups: List[List[_Request]] = []
+        cow_src: List[int] = []
+        cow_dst: List[int] = []
         for rep, group in zip(reps, groups.values()):
             prompt = rep.all_tokens
+            src = None
             if rep.mm is not None:
                 # pixel-conditioned KV: no token-keyed prefix reuse
                 shared, off = [], 0
+            elif self._radix:
+                shared, off, src, _cow_n = self.registry.claim_cow(
+                    self.pm, prompt
+                )
             else:
                 shared, off = self.registry.claim(self.pm, prompt)
             need = -(-len(prompt) // bs) - len(shared)
@@ -1053,8 +1103,17 @@ class GenerationEngine:
             if fresh is None:
                 # pool exhausted — return the whole group to pending
                 self.pm.release(shared)
+                if src is not None:
+                    self.pm.release([src])
                 self._pending = group + self._pending
                 continue
+            if src is not None:
+                # COW claim: the match extends into a cached page (a
+                # partial tail, or divergence within a full page) —
+                # copy it into the claimant's first fresh page and
+                # resume prefill mid-page from the row-aligned offset
+                cow_src.append(src)
+                cow_dst.append(fresh[0])
             slot = self._free_slots.pop()
             pages = shared + fresh
             rep_slots.append(slot)
@@ -1062,9 +1121,32 @@ class GenerationEngine:
             rep_pages.append(pages)
             admitted_groups.append(group)
         if not rep_slots:
+            # a COW claim with no admitted rep cannot happen (the claim
+            # only survives when its rep allocates), but release holds
+            # defensively if a future edit changes that
+            assert not cow_src
             return False
+        if cow_src:
+            # dispatch the COW copies BEFORE the wave prefill: the
+            # claimants' prefix-window attention reads the copied pages.
+            # Device program order also protects the sources against
+            # reallocation — any later write lands after this copy.
+            pad = data_utils.next_bucket_size(len(cow_src), 8)
+            src_np = np.zeros(pad, np.int32)
+            dst_np = np.full(pad, num_pages, np.int32)
+            src_np[: len(cow_src)] = cow_src
+            dst_np[: len(cow_dst)] = cow_dst
+            self.cache = model_runner.copy_pages(
+                self.cache, jnp.asarray(src_np), jnp.asarray(dst_np)
+            )
+            self.total_cow_copies += len(cow_src)
+            # the claim's protective refs on the sources: the copy is
+            # now ordered before any later pool write, so registry
+            # eviction can no longer race it
+            self.pm.release(cow_src)
 
-        # suffix bucket (offsets are page-aligned and < prompt len)
+        # suffix bucket (offsets are pool-ROW-aligned — page-aligned for
+        # full-page claims, mid-page for COW claims — and < prompt len)
         tp = self._prefill_bucket(
             max(
                 len(g[0].all_tokens) - off
@@ -1172,18 +1254,33 @@ class GenerationEngine:
             embeds=pf_embeds,
             pos3=pf_pos3,
         )
+        if self._radix:
+            # publish-at-prefill-commit: the wave's prompt pages enter
+            # the radix tree NOW (the merge dispatch is already ordered
+            # on device), so siblings/turns arriving in later waves
+            # claim them while these owners are still decoding — the
+            # flat registry only ever parked pages at free time
+            for group, pages in zip(admitted_groups, rep_pages):
+                if group[0].mm is None:
+                    self.registry.publish(
+                        self.pm,
+                        np.asarray(group[0].all_tokens, np.int32),
+                        pages,
+                    )
 
         # --- sibling fan-out: share full prompt pages, copy the partial
         # tail page (if any) ---
         copy_src: List[int] = []
         copy_dst: List[int] = []
         admitted: List[tuple] = []  # (req, slot, logits_row)
+        adm_cached: List[int] = []  # cache-served prompt tokens per req
         for i, (group, slot, pages) in enumerate(
             zip(admitted_groups, rep_slots, rep_pages)
         ):
             plen = len(group[0].all_tokens)
             self._install(group[0], slot, pages, plen)
             admitted.append((group[0], slot, i))
+            adm_cached.append(int(offsets[i]))
             n_full = plen // bs
             for sib in group[1:]:
                 if not self._free_slots:
@@ -1205,6 +1302,7 @@ class GenerationEngine:
                 sslot = self._free_slots.pop()
                 self._install(sib, sslot, sib_pages, plen)
                 admitted.append((sib, sslot, i))
+                adm_cached.append(plen)
                 self.total_cached_prompt_tokens += plen
         if copy_src:
             pad = data_utils.next_bucket_size(len(copy_src), 8)
@@ -1313,7 +1411,7 @@ class GenerationEngine:
                 else 0.8 * self._prefill_tps + 0.2 * inst
             )
         if self.tracer.enabled:
-            for req, slot, row in admitted:
+            for (req, slot, row), ctok in zip(admitted, adm_cached):
                 self.tracer.record(
                     "queue_wait", req.rid, req.submit_time, t_pf_start,
                     preemptions=req.preemptions,
@@ -1325,6 +1423,11 @@ class GenerationEngine:
                     # token, so the prefilled length is one shy of all_tokens
                     prompt_tokens=len(req.all_tokens) - 1,
                     cached_offset=int(offsets[row]),
+                    # prompt tokens THIS request served from cache (a
+                    # sibling's whole prompt rode the representative's
+                    # prefill; a claimant's = its claim offset) —
+                    # trace_report --cache aggregates these
+                    cached_tokens=int(ctok),
                 )
         return True
 

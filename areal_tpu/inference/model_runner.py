@@ -373,7 +373,11 @@ def prefill_forward(
     read+write dispatch pays a full pool copy on this backend).
 
     Host contract: tables cover ceil((offset+Tp)/BS) pages per real row;
-    ``prefix_bound`` >= every row's offset; offsets are page-aligned.
+    ``prefix_bound`` >= every row's offset; offsets are POOL-ROW-aligned
+    (page-aligned for full-page prefix claims; mid-page for the radix
+    cache's COW claims — the per-token window masks below are exact for
+    any offset, and row alignment is what the MERGE needs, since
+    assemble_rows consults last_rows only for mid-row starts).
     """
     n, tp = tokens.shape
     d = cfg.head_dim
@@ -412,7 +416,7 @@ def prefill_forward(
     vrows_all = _rows_view(cache["v"])
 
     if mb0 > 0:
-        npg = -(-mb0 // page_size)  # window pages (offsets page-aligned)
+        npg = -(-mb0 // page_size)  # window pages (covers every offset)
         wr = npg * prow  # window rows
         # page-run gather: one dynamic_slice per (row, page) — index-array
         # gathers serialize per index on TPU, DS runs at copy speed

@@ -87,9 +87,15 @@ class RouterState:
         self._tokens: Dict[str, float] = {a: 0.0 for a in addresses}
         # rid/qid-affinity effectiveness: hits land a request back on the
         # server holding its cached KV (the whole point of affinity) —
-        # the hit RATE is the sibling-dedup health signal on /metrics
+        # the hit RATE is the sibling-dedup health signal on /metrics.
+        # Split (r9): rid-resume hits (a resumed/interrupted request
+        # returning to its previous server) vs qid-steer hits (a group
+        # sibling / episode turn steered to the server holding the
+        # shared radix prefix); sched_affinity_hits stays as their sum.
         self.sched_total = 0
         self.sched_affinity_hits = 0
+        self.sched_rid_affinity_hits = 0
+        self.sched_qid_affinity_hits = 0
         # resilience plane: set by serve_router (monitor needs `self` for
         # its on_dead callback); None = every address is trusted
         self.fleet: Optional[FleetMonitor] = None
@@ -158,6 +164,7 @@ class RouterState:
                 # resubmits reuse the server's cached prefix)
                 if prev in cset:
                     self.sched_affinity_hits += 1
+                    self.sched_rid_affinity_hits += 1
                     return {"url": prev, "version": self.version}
                 redirected = True  # sticky target unhealthy → reroute
             if qid and qid in self._qid_server:
@@ -168,6 +175,7 @@ class RouterState:
                         # the group already migrated — still a redirect
                         self.failovers_total += 1
                     self.sched_affinity_hits += 1
+                    self.sched_qid_affinity_hits += 1
                     self._qid_server.move_to_end(qid)
                     return {"url": addr, "version": self.version}
                 del self._qid_server[qid]  # dead-server affinity eviction
@@ -396,6 +404,8 @@ class RouterState:
                 "servers": len(self.addresses),
                 "sched_total": self.sched_total,
                 "sched_affinity_hits": self.sched_affinity_hits,
+                "sched_rid_affinity_hits": self.sched_rid_affinity_hits,
+                "sched_qid_affinity_hits": self.sched_qid_affinity_hits,
                 "affinity_hit_rate": (
                     self.sched_affinity_hits / self.sched_total
                     if self.sched_total
@@ -414,6 +424,8 @@ class RouterState:
                 types={
                     "sched_total": "counter",
                     "sched_affinity_hits": "counter",
+                    "sched_rid_affinity_hits": "counter",
+                    "sched_qid_affinity_hits": "counter",
                     "failovers_total": "counter",
                     "requests_migrated_total": "counter",
                     "fleet_probes_total": "counter",

@@ -114,6 +114,21 @@ _METRIC_HELP = {
     "spec_draft_tokens_total": "draft tokens proposed to verify dispatches",
     "spec_accepted_tokens_total": "draft tokens accepted by the model",
     "spec_chunks_total": "multi-token verify dispatches run",
+    # prefix cache (radix tree over the paged pool, r9)
+    "prefix_cache_hit_rate": (
+        "fraction of prompt tokens served from cached KV (sibling dedup "
+        "+ radix claims)"
+    ),
+    "prefix_cached_tokens_total": "prompt tokens served from cached KV",
+    "prefix_claim_hit_rate": "fraction of prefix-cache claims that matched",
+    "prefix_cache_nodes": "radix-tree nodes (flat mode: parked entries)",
+    "prefix_cache_pages": "pool pages the prefix cache holds references on",
+    "prefix_cow_copies_total": (
+        "copy-on-write page copies for mid-page prefix claims"
+    ),
+    "prefix_evicted_pages_total": (
+        "prefix-cache pages evicted under allocation pressure"
+    ),
     "trace_spans": "spans currently buffered (drained by GET /trace)",
     "tracing_dropped_spans_total": (
         "spans lost to ring-buffer overflow (the trace is truncated)"
@@ -382,6 +397,17 @@ def main(argv: Optional[list] = None):
         "decode bucket-ladder warmup)",
     )
     p.add_argument(
+        "--prefix-cache-mode", default="radix",
+        choices=("radix", "flat"),
+        help="prefix-cache implementation: radix (publish-at-commit "
+        "tree, the default) or flat (the legacy free-time registry)",
+    )
+    p.add_argument(
+        "--prefix-reuse-min", type=int, default=16,
+        help="minimum matched prompt tokens for a prefix-cache claim "
+        "(0 disables prefix reuse entirely)",
+    )
+    p.add_argument(
         "--spec", action="store_true",
         help="enable draft-free speculative decoding (n-gram proposals "
         "+ multi-token verify; greedy streams stay bit-identical)",
@@ -421,6 +447,8 @@ def main(argv: Optional[list] = None):
         host=args.host,
         port=args.port,
         compilation_cache_dir=args.compilation_cache_dir,
+        prefix_cache_mode=args.prefix_cache_mode,
+        prefix_reuse_min=args.prefix_reuse_min,
     )
     cfg.tracing.enabled = args.trace
     cfg.spec.enabled = args.spec

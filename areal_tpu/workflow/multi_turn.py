@@ -85,11 +85,16 @@ class MultiTurnWorkflow(RolloutWorkflow):
         versions: List[int] = [-1] * len(prompt_ids)
         discount = 1.0
         reward = 0.0
+        # one episode id across all turns: qid affinity lands turn N on
+        # the server whose radix cache holds turn N-1's pages, so each
+        # turn re-prefills only its new feedback/output suffix
+        episode_id = unique_rid("ep")
         for turn in range(self.max_turns):
             req = ModelRequest(
                 rid=unique_rid(),
                 input_ids=tokens,
                 gconfig=self.gconfig.new(n_samples=1),
+                metadata={"qid": episode_id},
             )
             resp = await engine.agenerate(req)
             tokens.extend(resp.output_tokens)

@@ -66,8 +66,14 @@ class RLVRWorkflow(RolloutWorkflow):
     ) -> Optional[Dict[str, np.ndarray]]:
         prompt_ids = self._tokenize_prompt(data)
         n = self.gconfig.n_samples
+        # one group id for all n siblings: the router/client qid
+        # affinity steers the whole group to one server, where the radix
+        # prefix cache serves n-1 of the prompt prefills from the pages
+        # the first sibling published at prefill commit
+        group_id = unique_rid("grp")
         req_template = ModelRequest(
-            input_ids=prompt_ids, gconfig=self.gconfig.new(n_samples=1)
+            input_ids=prompt_ids, gconfig=self.gconfig.new(n_samples=1),
+            metadata={"qid": group_id, "group_size": n},
         )
         resps = await asyncio.gather(
             *[

@@ -149,6 +149,10 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             gconfig=self.gconfig.new(n_samples=1),
             image_data=byte_images,
             mm=mm_payload,
+            # group key: siblings steer to one server (qid affinity) —
+            # pixel-conditioned KV itself is never token-prefix-cached,
+            # but same-wave sibling dedup still shares the mm prefill
+            metadata={"qid": unique_rid("grp"), "group_size": n},
         )
         resps = await asyncio.gather(
             *[

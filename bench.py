@@ -640,6 +640,150 @@ def main():
     spec_ab_compiles = _sp_c1["count"] - _sp_c0["count"]
     spec_ab_compile_s = round(_sp_c1["secs"] - _sp_c0["secs"], 1)
 
+    # --- prefix-cache A/B sub-phase (r9): radix × group size. The
+    # workload is the shape the radix cache exists for: each GRPO
+    # group's FIRST sibling is submitted alone, and the remaining
+    # n_samples-1 siblings arrive while it is still decoding — so the
+    # flat registry (free-time-only parking) serves ~0 cached prompt
+    # tokens, while publish-at-prefill-commit serves the siblings'
+    # whole shared prefix from the owner's live pages. Reports the
+    # cached-prompt-token fraction and prefill tok/s per cell, with
+    # per-cell graceful degradation like the other A/B phases. ---
+    def prefix_ab_phase():
+        import gc
+        import itertools
+
+        results = {}
+        for mode, gs in itertools.product(("radix", "flat"), (2, 8)):
+            ab_rng = np.random.default_rng(44)
+            name = f"{mode}__group_{gs}"
+            g = None
+            try:
+                g = GenerationEngine(
+                    JaxGenConfig(
+                        dtype="bfloat16", max_num_seqs=64,
+                        max_model_len=4096, page_size=256, num_pages=320,
+                        prefill_chunk=128, decode_chunk=32,
+                        decode_pipeline=2, admit_wave=16, kv_bucket=1024,
+                        prefix_cache_mode=mode, prefix_reuse_min=64,
+                    ),
+                    model_config=model_cfg,
+                    params=params,
+                ).start()
+                n_groups, plen = 8, 512
+                prompts = [
+                    ab_rng.integers(
+                        1, model_cfg.vocab_size, size=plen
+                    ).tolist()
+                    for _ in range(n_groups)
+                ]
+
+                def submit(prompt, mnew):
+                    return g.submit(
+                        {
+                            "input_ids": prompt,
+                            "sampling_params": {
+                                "max_new_tokens": mnew,
+                                "temperature": 1.0,
+                            },
+                        }
+                    )
+
+                # warm the shape ladder with DISTINCT prompts (kept out
+                # of the measurement — warming with the measured prompts
+                # would park them free-time and let even the flat
+                # baseline serve the groups from cache)
+                warm = [
+                    ab_rng.integers(
+                        1, model_cfg.vocab_size, size=plen
+                    ).tolist()
+                    for _ in range(n_groups)
+                ]
+                [f.result(timeout=600) for f in
+                 [submit(p, 16) for p in warm]]
+                m0 = g.metrics()
+                t0 = time.perf_counter()
+                # group owners first — long budgets keep them decoding
+                owners = [submit(p, 384) for p in prompts]
+                # staggered-group regime: siblings arrive round-robin
+                # (one per group per wave, each wave after the previous
+                # round's prefills COMMIT) — the async-fleet arrival
+                # pattern, where a wave almost never carries a whole
+                # group, so same-wave dedup can't serve the siblings
+                # and any cached prefill must come from CROSS-WAVE
+                # reuse (the mechanism under test)
+                stagger_ok = True
+
+                def wait_prefilled(tokens):
+                    # a timed-out wait means the next round's siblings
+                    # may merge into a pending wave (same-wave dedup
+                    # would then pollute even the flat baseline) — the
+                    # cell must SAY its premise broke, not record a
+                    # corrupted number as valid
+                    nonlocal stagger_ok
+                    deadline = time.monotonic() + 120
+                    while (
+                        g.metrics()["total_prompt_tokens"]
+                        - m0["total_prompt_tokens"] < tokens
+                        and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.05)
+                    if (
+                        g.metrics()["total_prompt_tokens"]
+                        - m0["total_prompt_tokens"] < tokens
+                    ):
+                        stagger_ok = False
+
+                wait_prefilled(n_groups * plen)
+                sibs = []
+                for r in range(gs - 1):
+                    sibs += [submit(p, 128) for p in prompts]
+                    wait_prefilled((r + 2) * n_groups * plen)
+                rs = [f.result(timeout=3600) for f in owners + sibs]
+                dt = time.perf_counter() - t0
+                m1 = g.metrics()
+                pt = (
+                    m1["total_prompt_tokens"] - m0["total_prompt_tokens"]
+                )
+                ct = (
+                    m1["total_cached_prompt_tokens"]
+                    - m0["total_cached_prompt_tokens"]
+                )
+                toks = sum(len(r["output_ids"]) for r in rs)
+                results[name] = {
+                    "prompt_tokens": int(pt),
+                    "cached_prompt_tokens": int(ct),
+                    "cached_token_fraction": round(ct / max(1, pt), 4),
+                    "prefill_tok_s": m1["prefill_tokens_per_sec"],
+                    "wall_tok_s": round(toks / dt, 1),
+                    "cow_copies": int(m1["prefix_cow_copies_total"]),
+                    "cache_pages": int(m1["prefix_cache_pages"]),
+                    # False = the staggered-arrival premise broke (a
+                    # wait timed out; same-wave dedup may pollute this
+                    # cell) — comparisons must skip such cells
+                    "stagger_ok": stagger_ok,
+                }
+            except Exception as e:  # degrade per-cell, keep the rest
+                results[name] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+            finally:
+                if g is not None:
+                    try:
+                        g.stop()
+                    except Exception:
+                        pass
+                    del g
+                gc.collect()
+            emit_phase("prefix_ab", {"configs": results})
+        return results
+
+    _px_c0 = compile_snap()
+    prefix_ab = prefix_ab_phase()
+    _px_c1 = compile_snap()
+    prefix_ab_compiles = _px_c1["count"] - _px_c0["count"]
+    prefix_ab_compile_s = round(_px_c1["secs"] - _px_c0["secs"], 1)
+
     gen_cfg = JaxGenConfig(
         dtype="bfloat16",
         max_num_seqs=n_samples,
@@ -821,9 +965,10 @@ def main():
         # keep the A/B phases' compile bills out of the warmup counter
         # (comparable to the r5 baseline: main-loop warmup only)
         "count": warm_compiles["count"] - decode_ab_compiles
-        - spec_ab_compiles,
+        - spec_ab_compiles - prefix_ab_compiles,
         "secs": warm_compiles["secs"] - (_ab_c1["secs"] - _ab_c0["secs"])
-        - (_sp_c1["secs"] - _sp_c0["secs"]),
+        - (_sp_c1["secs"] - _sp_c0["secs"])
+        - (_px_c1["secs"] - _px_c0["secs"]),
     }
 
     # --- serial measurement (rollout -> train, no overlap) ---
@@ -1008,6 +1153,12 @@ def main():
         "spec_ab": spec_ab,
         "spec_ab_compiles": spec_ab_compiles,
         "spec_ab_compile_s": spec_ab_compile_s,
+        # r9: radix × group-size prefix-cache A/B (full per-cell record
+        # in BENCH_<round>_prefix_ab.json): cached-prompt-token fraction
+        # under staggered GRPO groups, radix vs the flat baseline
+        "prefix_ab": prefix_ab,
+        "prefix_ab_compiles": prefix_ab_compiles,
+        "prefix_ab_compile_s": prefix_ab_compile_s,
         "compile_cache_dir": cache_dir,
         "compile_cache_hits": cache_events["hits"],
     }

@@ -244,6 +244,71 @@ def format_spec(sp: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def cache_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Prefix-cache report from ``prefill`` spans (attrs prompt_tokens /
+    cached_tokens — a sibling's whole prompt rode the representative's
+    prefill, a claimant's cached_tokens is its radix-claim offset):
+    token-level hit rate, request-level reuse counts, and a reuse-DEPTH
+    histogram (how many tokens each cache-served request reused) — the
+    first-look answer to "are GRPO siblings and agentic turns actually
+    sharing prefill"."""
+    requests = 0
+    served = 0
+    prompt_tokens = 0
+    cached_tokens = 0
+    depth_hist: Dict[str, int] = {}
+    for s in spans:
+        if s.get("name") != "prefill":
+            continue
+        attrs = s.get("attrs") or {}
+        pt = int(attrs.get("prompt_tokens", 0))
+        ct = int(attrs.get("cached_tokens", attrs.get("cached_offset", 0)))
+        requests += 1
+        prompt_tokens += pt
+        cached_tokens += ct
+        if ct > 0:
+            served += 1
+            # pow2 token buckets: reuse depth spans 1-token partial-page
+            # claims to multi-thousand-token shared histories
+            b = 1 << max(0, ct - 1).bit_length()
+            key = f"<={b}"
+            depth_hist[key] = depth_hist.get(key, 0) + 1
+    return {
+        "prefill_requests": requests,
+        "requests_served_from_cache": served,
+        "request_hit_rate": round(served / requests, 4) if requests else 0.0,
+        "prompt_tokens": prompt_tokens,
+        "cached_tokens": cached_tokens,
+        "token_hit_rate": (
+            round(cached_tokens / prompt_tokens, 4) if prompt_tokens else 0.0
+        ),
+        "mean_reuse_depth": (
+            round(cached_tokens / served, 1) if served else 0.0
+        ),
+        "reuse_depth_hist": {
+            k: depth_hist[k]
+            for k in sorted(depth_hist, key=lambda x: int(x[2:]))
+        },
+    }
+
+
+def format_cache(ca: Dict[str, Any]) -> str:
+    rows = [
+        f"prefill requests     {ca['prefill_requests']}",
+        f"served from cache    {ca['requests_served_from_cache']}"
+        f" ({ca['request_hit_rate'] * 100:.1f}%)",
+        f"prompt tokens        {ca['prompt_tokens']}",
+        f"cached tokens        {ca['cached_tokens']}"
+        f" ({ca['token_hit_rate'] * 100:.1f}%)",
+        f"mean reuse depth     {ca['mean_reuse_depth']} tokens",
+        "",
+        f"{'reuse depth':<14}{'requests':>10}",
+    ]
+    for bucket, count in ca["reuse_depth_hist"].items():
+        rows.append(f"{bucket:<14}{count:>10}")
+    return "\n".join(rows)
+
+
 def failover_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Resilience-event report from ``failover``/``migration`` spans
     (engine/remote.py records one instant per server hop; migrations are
@@ -638,6 +703,12 @@ def main(argv=None) -> int:
         "1 when the trace carries no verify rounds",
     )
     p.add_argument(
+        "--cache", action="store_true",
+        help="summarize prefix-cache reuse (prefill spans' "
+        "cached_tokens: hit rates + reuse-depth histogram) instead of "
+        "the latency table; exit 1 when the trace carries no prefills",
+    )
+    p.add_argument(
         "--env", action="store_true",
         help="summarize the environment service plane (env_reset/"
         "env_step/verify span latencies + env_replay/env_failover "
@@ -715,6 +786,20 @@ def main(argv=None) -> int:
             print(
                 "no spec_verify spans in trace (tracing off, or "
                 "speculation never engaged)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    if args.cache:
+        ca = cache_summary(spans)
+        if args.json:
+            print(json.dumps(ca, indent=2))
+        else:
+            print(format_cache(ca))
+        if ca["prefill_requests"] == 0:
+            print(
+                "no prefill spans in trace (tracing off, or the engine "
+                "never admitted a request)",
                 file=sys.stderr,
             )
             return 1
