@@ -251,6 +251,13 @@ class InferenceEngineConfig:
     # policy, forwarding the trace context so the router lands on the
     # same stitched timeline; empty = client-local choose_server
     router_addr: str = ""
+    # SLO-aware traffic plane: class weights, tenant caps, router
+    # shedding thresholds, and the fleet autoscaler envelope. The client
+    # reads `tenant` (default stamp) here; the router and launcher read
+    # the admission/autoscale knobs
+    traffic: "TrafficConfig" = dataclasses.field(
+        default_factory=lambda: TrafficConfig()
+    )
     # trajectory lineage ledger (utils/telemetry.LineageLedger): consumed
     # records are appended here as JSONL when set (the in-memory ledger
     # is always on; recover checkpoints snapshot it either way)
@@ -342,6 +349,22 @@ class JaxGenConfig:
     # tests/test_paged_kernel_parity.py); TP serving stays token_packed
     # (the pool's kv-head dim is the TP shard axis).
     pool_layout: str = "auto"
+    # --- SLO traffic plane (server side) ---
+    # bounded admission queue: with more than this many requests queued
+    # (admit queue + pending), new BULK submissions are shed with a
+    # typed 429 + Retry-After instead of queueing unboundedly behind
+    # max_num_seqs; interactive submissions are shed only past twice
+    # the bound (protected, not unbounded). 0 = unbounded (legacy).
+    max_queued_requests: int = 0
+    # Retry-After seconds attached to shed responses
+    shed_retry_after_s: float = 1.0
+    # deadline-aware preemption: a queued INTERACTIVE request carrying a
+    # soft deadline that is about to miss it (inside this margin, or
+    # having already waited half its deadline budget with no free slot)
+    # preempts the youngest BULK request; the victim resumes via the
+    # prefix-cache re-queue path (zero lost rollouts). False disables.
+    deadline_preemption: bool = True
+    deadline_margin_s: float = 0.25
     # persistent XLA compilation cache directory ("" = disabled). The
     # decode bucket ladder compiles O(100) programs on a cold engine
     # (378 s of warmup in the r5 bench capture); a warm cache replays
@@ -403,6 +426,14 @@ class JaxGenConfig:
             f"--prefix-cache-mode={config.prefix_cache_mode}",
             f"--prefix-reuse-min={config.prefix_reuse_min}",
         ]
+        if config.max_queued_requests > 0:
+            args += [
+                f"--max-queued-requests={config.max_queued_requests}",
+                f"--shed-retry-after={config.shed_retry_after_s}",
+            ]
+        args.append(f"--deadline-margin={config.deadline_margin_s}")
+        if not config.deadline_preemption:
+            args.append("--no-deadline-preemption")
         if config.spec.enabled:
             args += [
                 "--spec",
@@ -502,6 +533,69 @@ class TelemetryConfig:
     # consolidated hub endpoint (serve() binds here; port 0 = auto)
     host: str = "127.0.0.1"
     port: int = 0
+
+
+@dataclasses.dataclass
+class TrafficConfig:
+    """SLO-aware traffic plane (router admission + server shedding +
+    fleet autoscaling). Two request classes exist: ``interactive``
+    (latency-sensitive — eval sweeps, agentic sessions driven by a live
+    caller) and ``bulk`` (throughput work — GRPO training rollouts).
+    Workflows stamp the class into ``ModelRequest.metadata["priority"]``;
+    anything unstamped is bulk. Under contention the plane sheds or
+    preempts BULK first, never interactive: the router answers
+    ``429 + Retry-After`` (which utils/http honors as backoff, not
+    failure), the server's bounded admission queue sheds overflow, and
+    the engine preempts a bulk request when an interactive one would
+    miss its soft deadline (the preempted rollout resumes via the prefix
+    cache — zero lost work). The autoscaler grows/drains the fleet from
+    observed queue backlog and KV utilization inside
+    ``[min_servers, max_servers]`` with hysteresis."""
+
+    # default tenant label stamped on requests from this client when the
+    # workflow doesn't carry one (per-tenant fairness needs SOME key)
+    tenant: str = "default"
+    # weighted fairness between classes while the fleet is contended:
+    # bulk may hold at most bulk_weight/(bulk_weight+interactive_weight)
+    # of contended in-flight capacity when interactive traffic is
+    # present (work-conserving: with no interactive in flight, bulk
+    # takes everything; bulk is also never starved below ONE in-flight
+    # request, since small counts round the share to zero)
+    interactive_weight: int = 4
+    bulk_weight: int = 1
+    # per-tenant in-flight cap at the router (0 = uncapped): one tenant
+    # flooding the fleet cannot starve the rest regardless of class
+    max_inflight_per_tenant: int = 0
+    # router-side overload shed: when the fleet's summed queued_requests
+    # (from /health probes) reaches this depth, new BULK schedules are
+    # shed with 429 + Retry-After until the backlog drains (0 disables)
+    shed_queue_depth: int = 0
+    # Retry-After seconds attached to router 429s
+    retry_after_s: float = 1.0
+    # router-side in-flight ledger entries expire after this long
+    # without a /finish_request (crashed clients must not leak tenant
+    # capacity forever)
+    inflight_ttl_s: float = 600.0
+    # --- FleetMonitor-driven autoscaler (inference/fleet.FleetAutoscaler) ---
+    autoscale: bool = False
+    min_servers: int = 1
+    max_servers: int = 4
+    # evaluation period of the control loop
+    autoscale_interval_s: float = 5.0
+    # scale up when queued-per-server exceeds this, or KV utilization
+    # exceeds up_kv_util, or queue-wait p95 (when a telemetry rollup is
+    # wired) exceeds up_queue_wait_s
+    up_queued_per_server: float = 4.0
+    up_kv_util: float = 0.9
+    up_queue_wait_s: float = 10.0
+    # scale down only when the fleet is quiet: zero queued and KV
+    # utilization below this on every server
+    down_kv_util: float = 0.3
+    # hysteresis: consecutive evaluations the condition must hold
+    up_consecutive: int = 2
+    down_consecutive: int = 6
+    # minimum seconds between scaling actions (either direction)
+    cooldown_s: float = 30.0
 
 
 @dataclasses.dataclass

@@ -265,7 +265,7 @@ class _ChatCompletions:
             input_ids=list(input_ids),
             gconfig=gconfig,
             rid=f"chatcmpl-{uuid.uuid4().hex}",
-            metadata={"qid": c.session_id},
+            metadata={"qid": c.session_id, "priority": c.priority},
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
@@ -323,11 +323,17 @@ class ArealOpenAI:
         gconfig: Optional[GenerationHyperparameters] = None,
         tool_parser: Callable[[str], List[ToolCall]] = hermes_tool_parser,
         session_id: Optional[str] = None,
+        priority: str = "interactive",
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.gconfig = gconfig or GenerationHyperparameters()
         self.tool_parser = tool_parser
+        # traffic-plane class: a live OpenAI-shaped session is
+        # INTERACTIVE by default (agentic TRAINING loops driving this
+        # client should pass priority="bulk" so their rollouts stay
+        # shed-able under load)
+        self.priority = priority
         # session/affinity key stamped into every request's metadata
         # ("qid"): all of an agentic episode's turns steer to one
         # server, where each turn's growing history rides the previous

@@ -459,13 +459,19 @@ class RemoteInferenceEngine(InferenceEngine):
     async def _schedule_via_router(
         self, session, req: ModelRequest, failed: set, headers,
         qid: Optional[str] = None,
+        priority: str = "bulk", tenant: str = "", resumed: bool = False,
     ) -> Optional[str]:
         """Router-scheduled mode (config.router_addr): ask the fronting
         router for a server, forwarding the trace context so the
         router's `route` span lands on the same stitched timeline.
         Returns None (→ local choose_server fallback) when no router is
         configured, the router is unreachable, or it answered with a
-        server this request already failed on."""
+        server this request already failed on. A router SHED (429) is
+        NOT a fallback case — re-raised, because routing around
+        admission control would defeat it; the 429's Retry-After was
+        already honored by the retry loop, so what escapes here is
+        sustained backpressure that belongs to the episode-retry
+        budget."""
         router = getattr(self.config, "router_addr", "")
         if not router:
             return None
@@ -482,6 +488,11 @@ class RemoteInferenceEngine(InferenceEngine):
             "prompt_len": len(req.input_ids),
             "new_token_budget": req.gconfig.max_new_tokens,
             "exclude": sorted(failed),
+            "priority": priority,
+            "tenant": tenant,
+            # continuations must pass the router's admission gates: a
+            # shed here would strand the accumulated suffix
+            "resumed": resumed,
         }
         if req.metadata.get("group_size"):
             meta["group_size"] = int(req.metadata["group_size"])
@@ -493,10 +504,24 @@ class RemoteInferenceEngine(InferenceEngine):
                 session,
                 f"http://{router}/schedule_request",
                 meta,
-                max_retries=2,
+                max_retries=max(3, self.config.request_retries),
                 timeout=30.0,
                 headers=headers,
             )
+        except HttpRequestError as e:
+            if e.status == 429:
+                stats_tracker.scalar(**{"rollout/requests_shed": 1.0})
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "shed", req.rid, sched_class=priority,
+                        tenant=tenant, source="router",
+                    )
+                raise
+            logger.warning(
+                f"router schedule for {req.rid} failed ({e}); "
+                f"falling back to the client-local policy"
+            )
+            return None
         except Exception as e:
             logger.warning(
                 f"router schedule for {req.rid} failed ({e}); "
@@ -574,12 +599,32 @@ class RemoteInferenceEngine(InferenceEngine):
         if ep_uid == "?":
             ep_uid = ""  # uid-less episodes must not all glue together
         qid = str(req.metadata.get("qid") or ep_uid or "") or None
+        # traffic-plane stamps (api/cli_args.TrafficConfig): scheduling
+        # class + tenant ride every /generate and router schedule;
+        # workflows stamp metadata["priority"]/"tenant", the engine
+        # config's default tenant covers the rest, and anything
+        # unlabeled is bulk (shed-able — never silently promoted)
+        traffic_cfg = getattr(self.config, "traffic", None)
+        priority = str(req.metadata.get("priority") or "bulk")
+        if priority not in ("interactive", "bulk"):
+            priority = "bulk"
+        tenant = str(
+            req.metadata.get("tenant")
+            or (traffic_cfg.tenant if traffic_cfg is not None else "")
+        )
+        deadline_s = req.metadata.get("deadline_s")
+        deadline_at = (
+            start + float(deadline_s)
+            if deadline_s is not None and float(deadline_s) > 0
+            else None
+        )
         hdrs = trace_headers(trace_id, req.rid)
         self.tracer.bind_trace(req.rid, trace_id)
         lineage = telemetry.RequestLineage(
             rid=req.rid,
             attempt=episode.attempt if episode is not None else 0,
         )
+        routed = False  # this rid ever held a router schedule (ledger)
         try:
             while (
                 stop_reason not in ("stop", "length")
@@ -590,18 +635,42 @@ class RemoteInferenceEngine(InferenceEngine):
                     # the exclusions (one may have recovered) rather than
                     # fail closed; max_failovers still bounds total hops
                     failed.clear()
-                server = await self._schedule_via_router(
-                    session, req, failed, hdrs, qid=qid
-                ) or self.choose_server(req.rid, exclude=failed, qid=qid)
+                router_server = await self._schedule_via_router(
+                    session, req, failed, hdrs, qid=qid,
+                    priority=priority, tenant=tenant,
+                    resumed=len(accumulated) > 0,
+                )
+                routed = routed or router_server is not None
+                server = router_server or self.choose_server(
+                    req.rid, exclude=failed, qid=qid
+                )
                 remaining = gconfig.max_new_tokens - len(accumulated)
                 ask = min(remaining, chunk) if chunk > 0 else remaining
                 payload = {
                     "rid": req.rid,
                     "input_ids": list(req.input_ids) + accumulated,
+                    "priority": priority,
+                    "tenant": tenant,
+                    # suffix-resume continuations carry client progress:
+                    # the server's admission bound never sheds them
+                    "resumed": len(accumulated) > 0,
                     "sampling_params": {
                         "max_new_tokens": ask,
                     },
                 }
+                deadline_left = (
+                    deadline_at - time.monotonic()
+                    if deadline_at is not None
+                    else 0.0
+                )
+                if deadline_left > 0:
+                    # per-chunk remaining deadline budget (the engine
+                    # tracks an absolute deadline from chunk submit).
+                    # An EXPIRED deadline is not restamped: the miss
+                    # already happened, and a near-zero deadline on
+                    # every remaining chunk would preempt one bulk
+                    # victim per chunk and count one miss per chunk
+                    payload["deadline_s"] = deadline_left
                 if req.image_data:
                     payload["image_data"] = list(req.image_data)
                 if req.mm is not None:
@@ -656,6 +725,19 @@ class RemoteInferenceEngine(InferenceEngine):
                     # not restart (the suffix-resume loop makes the moved
                     # request token-exact).
                     status = getattr(e, "status", None)
+                    if status == 429:
+                        # sustained load shed (Retry-After already
+                        # honored per attempt inside the retry loop):
+                        # surface to the episode-retry budget, visibly
+                        stats_tracker.scalar(
+                            **{"rollout/requests_shed": 1.0}
+                        )
+                        if self.tracer.enabled:
+                            self.tracer.instant(
+                                "shed", req.rid, sched_class=priority,
+                                tenant=tenant, source="server",
+                            )
+                        raise
                     if status is not None and 400 <= status < 500:
                         raise
                     if self.fleet is not None:
@@ -745,9 +827,36 @@ class RemoteInferenceEngine(InferenceEngine):
             self.tracer.unbind_trace(req.rid)
             # hand the request's path to the episode's lineage record
             # even on failure — a half-generated, exception-killed
-            # request is exactly what the ledger must explain
+            # request is exactly what the ledger must explain. Runs
+            # BEFORE the best-effort router notify below: a cancelled
+            # await there must not cost the ledger its record.
             if episode is not None:
                 episode.add_request(lineage)
+            # release the router's in-flight ledger entry (tenant/class
+            # capacity) — on failure paths too, but ONLY for rids the
+            # router actually scheduled (local-fallback requests never
+            # entered its ledger, and pinging a wedged router from
+            # every completion would stall the fallback path the outage
+            # is relying on). Best-effort: the router's TTL sweep
+            # covers a lost release; a fresh CancelledError here (loop
+            # teardown) is suppressed without masking one already
+            # propagating through this finally.
+            router = getattr(self.config, "router_addr", "")
+            if router and routed:
+                try:
+                    await arequest_with_retry(
+                        session,
+                        f"http://{router}/finish_request",
+                        {"rid": req.rid},
+                        max_retries=1,
+                        timeout=5.0,
+                    )
+                except asyncio.CancelledError:
+                    pass
+                except Exception as e:
+                    logger.debug(
+                        f"finish_request for {req.rid} failed: {e}"
+                    )
         now = time.monotonic()
         if self.tracer.enabled:
             # recorded after the finally-block unbind: carry the trace

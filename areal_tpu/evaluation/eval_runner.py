@@ -108,7 +108,13 @@ def evaluate_dataset(
     """
     from areal_tpu.workflow.rlvr import RLVRWorkflow
 
-    wf = RLVRWorkflow(reward_fn, gconfig, tokenizer=tokenizer)
+    # eval sweeps are the INTERACTIVE traffic class: the SLO plane
+    # (router admission + server shed/preemption) protects their
+    # latency against concurrent bulk rollout pressure
+    wf = RLVRWorkflow(
+        reward_fn, gconfig, tokenizer=tokenizer,
+        priority="interactive",
+    )
     t0 = time.perf_counter()
 
     async def run_all():

@@ -64,6 +64,30 @@ logger = logging_util.getLogger("GenerationEngine")
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
+# the traffic plane's two scheduling classes (api/cli_args.TrafficConfig):
+# interactive = latency-sensitive (eval, live agentic sessions), bulk =
+# throughput rollouts. Unknown labels degrade to bulk — the shed/preempt
+# machinery must never promote unlabeled traffic.
+SCHED_CLASSES = ("interactive", "bulk")
+
+
+class AdmissionRejectedError(RuntimeError):
+    """The bounded admission queue is full and this request's class is
+    being shed (load shedding, not failure). ``retry_after`` is the
+    backpressure hint an HTTP shell forwards as ``429 + Retry-After`` —
+    utils/http treats that as "back off and retry", so a shed never
+    burns the client's episode-retry budget."""
+
+    def __init__(
+        self,
+        message: str,
+        retry_after: float = 1.0,
+        sched_class: str = "bulk",
+    ):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.sched_class = sched_class
+
 
 @dataclasses.dataclass
 class _Request:
@@ -84,6 +108,17 @@ class _Request:
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     preemptions: int = 0
+    # --- SLO traffic plane (r10) ---
+    # scheduling class ("interactive" | "bulk") + tenant label, stamped
+    # by workflows through engine/remote.py; unknown/absent = bulk
+    priority: str = "bulk"
+    tenant: str = ""
+    # absolute soft deadline (monotonic clock); a queued interactive
+    # request about to miss it may preempt a bulk request
+    deadline_at: Optional[float] = None
+    # a suffix-resume continuation of an in-flight episode request: it
+    # already holds client-side progress, so admission never sheds it
+    resumed: bool = False
     # multimodal payload (VLM serving): pixel_values [P, Dp],
     # vis_seg/vis_pos_h/vis_pos_w [P], mm_index [plen] (-1 = text),
     # mrope_pos [plen, 3]; rope_delta shifts decode rope positions
@@ -162,8 +197,22 @@ def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
             rope_delta = int(mm["mrope_pos"].max()) + 1 - len(
                 mm["mrope_pos"]
             )
+    priority = str(payload.get("priority") or "bulk")
+    if priority not in SCHED_CLASSES:
+        priority = "bulk"
+    deadline_s = payload.get("deadline_s")
+    submit_time = time.monotonic()
     return _Request(
         rid=payload.get("rid", f"req-{time.time_ns()}"),
+        priority=priority,
+        tenant=str(payload.get("tenant") or ""),
+        deadline_at=(
+            submit_time + float(deadline_s)
+            if deadline_s is not None and float(deadline_s) > 0
+            else None
+        ),
+        resumed=bool(payload.get("resumed")),
+        submit_time=submit_time,
         input_ids=list(payload["input_ids"]),
         max_new_tokens=int(sp.get("max_new_tokens", 128)),
         min_new_tokens=int(sp.get("min_new_tokens", 0)),
@@ -518,6 +567,18 @@ class GenerationEngine:
         self.total_requests = 0
         self.total_aborted = 0
         self.total_preemptions = 0
+        # --- SLO traffic plane (r10) ---
+        # admission-queue composition: per-class count of requests
+        # sitting in _admit_queue (submit increments on handler threads,
+        # _admit decrements on the loop thread); _pending composition is
+        # scanned directly at metrics time
+        self._aq_lock = threading.Lock()
+        self._aq_class = {c: 0 for c in SCHED_CLASSES}
+        self._aq_resumed = 0  # resumed (bound-exempt) entries in-queue
+        self._class_submitted = {c: 0 for c in SCHED_CLASSES}
+        self.requests_shed_total = 0
+        self.deadline_preemptions_total = 0
+        self.deadline_misses_total = 0
         # request-lifecycle spans (strict no-op unless config.tracing is
         # enabled — the scheduler loop only ever pays an attribute read)
         self.tracer = SpanTracer(getattr(config, "tracing", None))
@@ -611,6 +672,57 @@ class GenerationEngine:
                 )
             )
             return fut
+        # bounded admission queue (traffic plane): overflow sheds BULK at
+        # the bound and interactive only past twice the bound — queueing
+        # unboundedly behind max_num_seqs turns saturation into silent
+        # multi-minute tail latency for everyone. Suffix-resume
+        # continuations are never shed (they carry client-side progress
+        # a 429 would strand). The queue-depth read is racy vs the loop
+        # thread, which only makes the bound soft by one or two entries.
+        bound = int(getattr(self.config, "max_queued_requests", 0) or 0)
+        if bound > 0 and not req.resumed:
+            pending_snapshot = list(self._pending)
+            queued = self._admit_queue.qsize() + len(pending_snapshot)
+            if req.priority != "bulk":
+                # the interactive limit must not count RESUMED entries:
+                # they bypass the bound themselves (a post-pause resume
+                # storm would otherwise shed the protected class while
+                # admitting unlimited exempt bulk — priority inversion)
+                with self._aq_lock:
+                    resumed_q = self._aq_resumed
+                resumed_q += sum(
+                    r.resumed for r in pending_snapshot
+                )
+                queued = max(0, queued - resumed_q)
+            limit = bound if req.priority == "bulk" else 2 * bound
+            if queued >= limit:
+                retry_after = float(
+                    getattr(self.config, "shed_retry_after_s", 1.0)
+                )
+                with self._aq_lock:  # handler threads race here too
+                    self.requests_shed_total += 1
+                self.tracer.instant(
+                    "shed", req.rid, sched_class=req.priority,
+                    tenant=req.tenant, queued=queued,
+                )
+                self.tracer.unbind_trace(req.rid)
+                fut.set_exception(
+                    AdmissionRejectedError(
+                        f"admission queue full ({queued} >= {limit} for "
+                        f"class {req.priority}); retry after "
+                        f"{retry_after}s",
+                        retry_after=retry_after,
+                        sched_class=req.priority,
+                    )
+                )
+                return fut
+        with self._aq_lock:
+            # both counters under the lock: concurrent handler threads
+            # must not lose submitted_total increments
+            self._class_submitted[req.priority] += 1
+            self._aq_class[req.priority] += 1
+            if req.resumed:
+                self._aq_resumed += 1
         self._admit_queue.put(req)
         return fut
 
@@ -709,6 +821,9 @@ class GenerationEngine:
             total_requests=self.total_requests,
             total_aborted=self.total_aborted,
             total_preemptions=self.total_preemptions,
+            requests_shed_total=self.requests_shed_total,
+            deadline_preemptions_total=self.deadline_preemptions_total,
+            deadline_misses_total=self.deadline_misses_total,
             model_version=self.model_version,
             paused=float(self._paused.is_set()),
             trace_spans=len(self.tracer) if self.tracer.enabled else 0,
@@ -716,6 +831,24 @@ class GenerationEngine:
             # VISIBLY truncated, not silently missing its oldest spans
             tracing_dropped_spans_total=float(self.tracer.dropped),
         )
+        # per-class composition (traffic plane): running from an active
+        # snapshot, queued = admit-queue class counters + a pending-list
+        # scan (both metrics-grade racy reads — the loop thread owns the
+        # structures)
+        active_reqs = list(self._active.values())
+        pending_reqs = list(self._pending)
+        with self._aq_lock:
+            aq = dict(self._aq_class)
+        for cls in SCHED_CLASSES:
+            m[f"sched_class_{cls}_running"] = sum(
+                r.priority == cls for r in active_reqs
+            )
+            m[f"sched_class_{cls}_queued"] = max(0, aq[cls]) + sum(
+                r.priority == cls for r in pending_reqs
+            )
+            m[f"sched_class_{cls}_submitted_total"] = (
+                self._class_submitted[cls]
+            )
         if self._spec_configured:
             # spec gauges exist ONLY when speculation is configured —
             # spec off is a strict no-op, metric surface included
@@ -924,14 +1057,25 @@ class GenerationEngine:
             pages = self.pm.alloc(n)
         return pages
 
-    def _preempt_youngest(self) -> bool:
+    def _preempt_youngest(
+        self,
+        victims: Optional[tuple] = None,
+        reason: str = "pool pressure",
+    ) -> bool:
         """Preempt the most recently submitted active request: its pages
         go to the registry (the transparent re-queue usually re-claims
-        them) and the request returns to the FRONT of the pending list."""
-        if not self._active:
+        them) and the request returns to the FRONT of the pending list.
+        ``victims`` restricts candidates to those scheduling classes
+        (deadline preemption may only evict bulk; pool pressure prefers
+        bulk but may fall back to anyone)."""
+        candidates = [
+            sl for sl, r in self._active.items()
+            if victims is None or r.priority in victims
+        ]
+        if not candidates:
             return False
         slot = max(
-            self._active, key=lambda sl: self._active[sl].submit_time
+            candidates, key=lambda sl: self._active[sl].submit_time
         )
         req = self._active.pop(slot)
         self._release_slot(slot, park_tokens=req.all_tokens)
@@ -940,11 +1084,57 @@ class GenerationEngine:
         self.total_preemptions += 1
         self.tracer.instant(
             "preempt", req.rid, tokens_in=len(req.output_ids),
+            sched_class=req.priority, reason=reason,
         )
         self._pending.insert(0, req)
         logger.info(
             f"preempted {req.rid} ({len(req.output_ids)} tokens in) — "
-            f"pool pressure"
+            f"{reason}"
+        )
+        return True
+
+    def _maybe_deadline_preempt(self) -> bool:
+        """Deadline-aware preemption: a queued INTERACTIVE request that
+        would miss its soft deadline — already inside the margin, or
+        having burned half its deadline budget waiting with no free slot
+        — evicts the youngest BULK request. The victim re-queues through
+        the existing preemption path (its KV parks in the prefix cache,
+        so resuming costs at most one partial-page re-prefill): bulk
+        loses latency, never work."""
+        margin = float(getattr(self.config, "deadline_margin_s", 0.25))
+        now = time.monotonic()
+        waiter = None
+        for r in self._pending:
+            if r.priority != "interactive" or r.deadline_at is None:
+                continue
+            budget = r.deadline_at - r.submit_time
+            if (
+                now >= r.deadline_at - margin
+                or now - r.submit_time >= 0.5 * budget
+            ):
+                waiter = r
+                break
+        if waiter is None:
+            return False
+        if not any(
+            r.priority == "bulk" for r in self._active.values()
+        ):
+            return False  # nothing shed-able holds a slot
+        # preemption needs a quiesced pipeline (in-flight chunks may
+        # still write the victim's pages) — and draining may itself
+        # finish a request, making the eviction unnecessary
+        self._drain_pipeline()
+        if self._free_slots:
+            return False
+        if not self._preempt_youngest(
+            victims=("bulk",), reason="deadline"
+        ):
+            return False
+        self.deadline_preemptions_total += 1
+        self.tracer.instant(
+            "deadline_preempt", waiter.rid,
+            deadline_in_s=round(waiter.deadline_at - now, 4),
+            waited_s=round(now - waiter.submit_time, 4),
         )
         return True
 
@@ -1019,10 +1209,29 @@ class GenerationEngine:
         got_new = 0
         while True:
             try:
-                self._pending.append(self._admit_queue.get_nowait())
-                got_new += 1
+                req = self._admit_queue.get_nowait()
             except queue.Empty:
                 break
+            with self._aq_lock:
+                self._aq_class[req.priority] -= 1
+                if req.resumed:
+                    self._aq_resumed -= 1
+            self._pending.append(req)
+            got_new += 1
+        if (
+            self._pending
+            and not self._free_slots
+            and getattr(self.config, "deadline_preemption", True)
+        ):
+            self._maybe_deadline_preempt()
+        if any(r.priority == "interactive" for r in self._pending):
+            # priority admission: interactive requests jump every queued
+            # bulk request — including a just-preempted victim re-queued
+            # at the front, so the slot a deadline preemption freed goes
+            # to the interactive waiter THIS wave, not back to its
+            # victim (stable within each class, so bulk FIFO is
+            # preserved)
+            self._pending.sort(key=lambda r: r.priority != "interactive")
         if not self._pending or not self._free_slots:
             return False
         if self._pending_since is None:
@@ -1415,6 +1624,10 @@ class GenerationEngine:
                 self.tracer.record(
                     "queue_wait", req.rid, req.submit_time, t_pf_start,
                     preemptions=req.preemptions,
+                    # per-class queue-wait is THE priority-isolation SLO
+                    # signal (trace_report --slo aggregates it)
+                    sched_class=req.priority,
+                    **({"tenant": req.tenant} if req.tenant else {}),
                 )
                 self.tracer.record(
                     "prefill", req.rid, t_pf_start, t_pf_end,
@@ -1501,7 +1714,12 @@ class GenerationEngine:
                 )
                 self._finish(slot, "length")
                 return False
-            if not self._preempt_youngest():
+            # pool pressure prefers BULK victims (priority isolation);
+            # an all-interactive batch still preempts its youngest
+            if not (
+                self._preempt_youngest(victims=("bulk",))
+                or self._preempt_youngest()
+            ):
                 return False
         return False
 
@@ -2071,6 +2289,18 @@ class GenerationEngine:
         req = self._active.pop(slot)
         if reason == "abort":
             self.total_aborted += 1
+        elif req.deadline_at is not None:
+            # soft-deadline outcome, counted only on real completions
+            # (an abort is a pause-window resume, not a final answer)
+            if time.monotonic() > req.deadline_at:
+                self.deadline_misses_total += 1
+                self.tracer.instant(
+                    "deadline_miss", req.rid,
+                    sched_class=req.priority,
+                    late_s=round(
+                        time.monotonic() - req.deadline_at, 4
+                    ),
+                )
         # the slot's pages hold the prompt plus all generated tokens
         # except the last sampled one (it was never fed back)
         self._release_slot(

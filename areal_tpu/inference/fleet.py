@@ -66,6 +66,7 @@ class ServerHealth:
     __slots__ = (
         "addr", "state", "fails", "successes", "probe_latency_s",
         "last_probe", "last_transition", "source",
+        "running_requests", "queued_requests", "max_num_seqs",
     )
 
     def __init__(self, addr: str, source: str = "seed",
@@ -78,10 +79,19 @@ class ServerHealth:
         self.last_probe = -float("inf")
         self.last_transition = t
         self.source = source  # seed | registered | discovered
+        # load view from the last /health probe (r10): running vs queued
+        # SEPARATELY — the router's overload shed and the autoscaler
+        # must tell a queue backlog (add capacity) apart from busy
+        # decode (don't). -1 = not reported yet / pre-r10 server.
+        self.running_requests = -1.0
+        self.queued_requests = -1.0
+        self.max_num_seqs = -1.0
 
 
-def default_probe(addr: str, timeout: float) -> Tuple[str, float]:
-    """GET /health → ("ok" | "draining" | "fail", latency_s)."""
+def default_probe(addr: str, timeout: float) -> Tuple[str, float, Dict]:
+    """GET /health → ("ok" | "draining" | "fail", latency_s, load_info).
+    ``load_info`` carries the body's running_requests / queued_requests /
+    max_num_seqs when the server reports them (empty otherwise)."""
     t0 = time.monotonic()
     try:
         with urllib.request.urlopen(
@@ -89,14 +99,25 @@ def default_probe(addr: str, timeout: float) -> Tuple[str, float]:
         ) as r:
             latency = time.monotonic() - t0
             if r.status != 200:
-                return "fail", latency
+                return "fail", latency, {}
+            info: Dict = {}
             try:
-                status = json.loads(r.read()).get("status", "ok")
+                body = json.loads(r.read())
+                status = body.get("status", "ok")
+                for k in (
+                    "running_requests", "queued_requests", "max_num_seqs"
+                ):
+                    if k in body:
+                        info[k] = float(body[k])
             except Exception:
                 status = "ok"
-            return ("draining" if status == "draining" else "ok"), latency
+            return (
+                ("draining" if status == "draining" else "ok"),
+                latency,
+                info,
+            )
     except Exception:
-        return "fail", time.monotonic() - t0
+        return "fail", time.monotonic() - t0, {}
 
 
 class FleetMonitor:
@@ -333,7 +354,11 @@ class FleetMonitor:
                 )
             ]
         for addr in due:
-            status, latency = self._probe_fn(addr)
+            # injected probe_fns may return the legacy (status, latency)
+            # pair; the default adds a load-info dict
+            out = self._probe_fn(addr)
+            status, latency = out[0], out[1]
+            load = out[2] if len(out) > 2 else {}
             dead: Optional[str] = None
             recovered: Optional[str] = None
             with self._lock:
@@ -342,6 +367,16 @@ class FleetMonitor:
                     continue
                 h.last_probe = self._time()
                 h.probe_latency_s = latency
+                if load:
+                    h.running_requests = load.get(
+                        "running_requests", h.running_requests
+                    )
+                    h.queued_requests = load.get(
+                        "queued_requests", h.queued_requests
+                    )
+                    h.max_num_seqs = load.get(
+                        "max_num_seqs", h.max_num_seqs
+                    )
                 self.probes_total += 1
                 if status == "ok":
                     recovered = self._apply_success(h, from_probe=True)
@@ -446,6 +481,249 @@ class FleetMonitor:
                     "state": h.state.value,
                     "probe_latency_s": h.probe_latency_s,
                     "consecutive_failures": float(h.fails),
+                    "running_requests": h.running_requests,
+                    "queued_requests": h.queued_requests,
                 }
                 for a, h in self._servers.items()
             }
+
+    def load_map(self) -> Dict[str, Tuple[float, float]]:
+        """addr → (running, queued) from the latest /health probes —
+        the router load map the overload shed and autoscaler read.
+        Servers that have not reported load yet are absent."""
+        with self._lock:
+            return {
+                a: (h.running_requests, h.queued_requests)
+                for a, h in self._servers.items()
+                if h.queued_requests >= 0
+            }
+
+
+# ==========================================================================
+# Fleet autoscaler (r10): size the serving fleet from observed load
+# ==========================================================================
+def scrape_server_load(addr: str, timeout: float = 5.0) -> Dict[str, float]:
+    """One server's load observation: running/queued from ``/health``
+    plus ``kv_page_utilization`` from ``/metrics`` (the PR 2/3 gauges).
+    Raises on an unreachable server — the caller decides whether a
+    missing observation blocks a decision."""
+    status, _, info = default_probe(addr, timeout)
+    if status == "fail":
+        raise ConnectionError(f"{addr} failed its load probe")
+    obs = {
+        "running": info.get("running_requests", 0.0),
+        "queued": info.get("queued_requests", 0.0),
+        "slots": info.get("max_num_seqs", 0.0),
+        "draining": 1.0 if status == "draining" else 0.0,
+        "kv_util": 0.0,
+    }
+    try:
+        from areal_tpu.utils.tracing import parse_prometheus
+
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=timeout
+        ) as r:
+            parsed = parse_prometheus(r.read().decode(), prefix="areal_tpu_gen_")
+        obs["kv_util"] = parsed.get("kv_page_utilization", 0.0)
+    except Exception:
+        pass  # /health sufficed; KV utilization is a soft signal
+    return obs
+
+
+class FleetAutoscaler:
+    """FleetMonitor-driven autoscaler: a control loop that watches the
+    fleet's queue backlog, KV-page utilization, and (when a telemetry
+    rollup is wired — utils/telemetry.TelemetryCollector.rollup) the
+    queue-wait p95, and grows or drains the serving fleet inside
+    ``[min_servers, max_servers]``.
+
+    Control discipline: every signal must hold for ``up_consecutive`` /
+    ``down_consecutive`` evaluations (hysteresis — one bursty scrape
+    must not flap the fleet), any action starts a ``cooldown_s`` window
+    during which no further action fires (a just-launched server needs
+    time to warm up and absorb load before the backlog is re-judged),
+    and scale-down only ever uses the graceful path: ``drain_fn`` →
+    the server finishes in-flight work → deregisters (the PR 4
+    ``POST /drain`` contract), so shrinking the fleet loses zero
+    rollouts by construction.
+
+    Everything is injectable (``observe_fn``, ``rollup_fn``,
+    ``time_fn``, ``launch_fn``, ``drain_fn``) so the control law is
+    unit-testable without processes or sleeps; ``evaluate_once`` is the
+    public single-step entry the tests (and the background loop) drive.
+    """
+
+    def __init__(
+        self,
+        traffic,
+        launch_fn: Callable[[], None],
+        drain_fn: Callable[[str], None],
+        addresses_fn: Callable[[], List[str]],
+        observe_fn: Optional[Callable[[str], Dict[str, float]]] = None,
+        rollup_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.traffic = traffic
+        self._launch = launch_fn
+        self._drain = drain_fn
+        self._addresses = addresses_fn
+        self._observe = observe_fn or scrape_server_load
+        self._rollup = rollup_fn
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action = -float("inf")
+        # the size the controller is steering toward (fleet_target_size
+        # gauge); initialized lazily from the first observation
+        self.target_size: Optional[int] = None
+        self.ups_total = 0
+        self.downs_total = 0
+        self.last_decision = "init"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def evaluate_once(self) -> Optional[str]:
+        """One control-loop step. Returns the action taken ("up",
+        "down:<addr>") or None."""
+        cfg = self.traffic
+        now = self._time()
+        obs: Dict[str, Dict[str, float]] = {}
+        for addr in list(self._addresses()):
+            try:
+                obs[addr] = self._observe(addr)
+            except Exception as e:
+                logger.warning(f"autoscaler observe {addr}: {e}")
+        # draining servers are capacity already leaving — they must not
+        # count toward the active fleet or be drained twice
+        active = {
+            a: o for a, o in obs.items() if not o.get("draining")
+        }
+        n = len(active)
+        with self._lock:
+            if self.target_size is None:
+                self.target_size = max(n, cfg.min_servers)
+        if n == 0:
+            self.last_decision = "no_observations"
+            return None
+        queued_total = sum(o.get("queued", 0.0) for o in active.values())
+        kv_utils = [o.get("kv_util", 0.0) for o in active.values()]
+        kv_mean = sum(kv_utils) / n
+        kv_max = max(kv_utils)
+        qw_p95 = 0.0
+        if self._rollup is not None:
+            try:
+                qw_p95 = float(
+                    self._rollup().get("queue_wait_p95_s", 0.0)
+                )
+            except Exception as e:
+                logger.warning(f"autoscaler rollup failed: {e}")
+        up = (
+            queued_total / n > cfg.up_queued_per_server
+            or kv_mean > cfg.up_kv_util
+            or qw_p95 > cfg.up_queue_wait_s
+        )
+        down = (
+            queued_total == 0
+            and kv_max < cfg.down_kv_util
+            and qw_p95 <= cfg.up_queue_wait_s
+        )
+        with self._lock:
+            if now - self._last_action < cfg.cooldown_s:
+                # cooldown also RESETS the hysteresis streaks: a scaling
+                # action invalidates the evidence that justified it, so
+                # the next decision must re-accumulate from scratch once
+                # the fleet has settled
+                self._up_streak = 0
+                self._down_streak = 0
+                self.last_decision = "cooldown"
+                return None
+            self._up_streak = self._up_streak + 1 if up else 0
+            self._down_streak = self._down_streak + 1 if down else 0
+            if (
+                up
+                and self._up_streak >= max(1, cfg.up_consecutive)
+                and n < cfg.max_servers
+            ):
+                self.target_size = n + 1
+                self.ups_total += 1
+                self._last_action = now
+                self._up_streak = 0
+                self.last_decision = "up"
+            elif (
+                down
+                and self._down_streak >= max(1, cfg.down_consecutive)
+                and n > cfg.min_servers
+            ):
+                # graceful victim choice: least-loaded active server
+                victim = min(
+                    active,
+                    key=lambda a: (
+                        active[a].get("running", 0.0)
+                        + active[a].get("queued", 0.0)
+                    ),
+                )
+                self.target_size = n - 1
+                self.downs_total += 1
+                self._last_action = now
+                self._down_streak = 0
+                self.last_decision = f"down:{victim}"
+            else:
+                self.last_decision = "hold"
+                return None
+            decision = self.last_decision
+        # actions run OUTSIDE the lock (launching/draining does I/O)
+        if decision == "up":
+            logger.info(
+                f"autoscaler: scale up {n} -> {n + 1} "
+                f"(queued={queued_total:.0f}, kv_mean={kv_mean:.2f}, "
+                f"queue_wait_p95={qw_p95:.2f}s)"
+            )
+            self._launch()
+            return "up"
+        victim = decision.split(":", 1)[1]
+        logger.info(
+            f"autoscaler: scale down {n} -> {n - 1}, draining {victim} "
+            f"(fleet quiet: queued=0, kv_max={kv_max:.2f})"
+        )
+        self._drain(victim)
+        return decision
+
+    # ------------------------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "fleet_target_size": float(
+                    self.target_size
+                    if self.target_size is not None
+                    else 0
+                ),
+                "autoscale_up_total": float(self.ups_total),
+                "autoscale_down_total": float(self.downs_total),
+            }
+
+    # ------------------------------------------------------------------
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-autoscaler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+
+    def _loop(self) -> None:
+        interval = max(0.05, self.traffic.autoscale_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # the controller must never die
+                logger.error(f"autoscaler evaluation failed: {e}")

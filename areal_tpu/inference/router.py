@@ -42,7 +42,11 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from areal_tpu.api.cli_args import FleetConfig, TracingConfig
+from areal_tpu.api.cli_args import (
+    FleetConfig,
+    TracingConfig,
+    TrafficConfig,
+)
 from areal_tpu.inference.fleet import FleetMonitor, ServerState
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils import name_resolve, names, network
@@ -66,6 +70,7 @@ class RouterState:
         schedule_policy: str = "least_token_usage",
         qid_cache_size: int = 8192,
         tracing: Optional[TracingConfig] = None,
+        traffic: Optional[TrafficConfig] = None,
     ):
         self.lock = threading.Lock()
         self.addresses = list(addresses)
@@ -106,10 +111,123 @@ class RouterState:
         # unhealthy server (sticky/affinity target no longer schedulable)
         self.requests_migrated_total = 0  # affinity entries evicted from
         # a DEAD server — in-flight work forced to move
+        # --- SLO traffic plane (r10) ---
+        # per-request in-flight ledger: rid → (tenant, class, admit
+        # time). A rid's FIRST schedule charges its tenant/class; later
+        # chunk schedules of the same rid only refresh the entry, and
+        # POST /finish_request releases it. Entries expire after
+        # traffic.inflight_ttl_s so a crashed client cannot leak tenant
+        # capacity forever.
+        self.traffic = traffic or TrafficConfig()
+        self._inflight_reqs: "OrderedDict[str, tuple]" = OrderedDict()
+        self._tenant_inflight: Dict[str, int] = {}
+        self._class_inflight = {"interactive": 0, "bulk": 0}
+        self.sched_class_totals = {"interactive": 0, "bulk": 0}
+        self.requests_shed_total = 0
+        self.tenant_rejections_total = 0
+        self.overload = False  # gauge: fleet backlog past shed depth
+        # attached by serve_router when autoscaling is wired; its
+        # fleet_target_size gauge rides this /metrics
+        self.autoscaler = None
         # router-side request spans: one `route` span per schedule
         # decision, carrying the forwarded trace context so the router
         # lands on the same stitched timeline as client and servers
         self.tracer = SpanTracer(tracing, service="router")
+
+    # -- traffic-plane admission (lock held) ---------------------------
+    def _sweep_inflight_locked(self, now: float) -> None:
+        ttl = self.traffic.inflight_ttl_s
+        while self._inflight_reqs:
+            rid, (tenant, cls, t0) = next(iter(self._inflight_reqs.items()))
+            if now - t0 < ttl:
+                break
+            self._release_inflight_locked(rid)
+            logger.warning(
+                f"in-flight ledger entry {rid} (tenant={tenant}) "
+                f"expired after {ttl}s without /finish_request"
+            )
+
+    def _release_inflight_locked(self, rid: str) -> bool:
+        ent = self._inflight_reqs.pop(rid, None)
+        if ent is None:
+            return False
+        tenant, cls, _ = ent
+        if tenant:
+            left = self._tenant_inflight.get(tenant, 0) - 1
+            if left > 0:
+                self._tenant_inflight[tenant] = left
+            else:
+                self._tenant_inflight.pop(tenant, None)
+        self._class_inflight[cls] = max(
+            0, self._class_inflight[cls] - 1
+        )
+        return True
+
+    def _queued_backlog_locked(self) -> float:
+        """Fleet-wide queued_requests from the latest /health probes
+        (the load map the overload shed and weighted fairness read);
+        0 when no server reports load yet."""
+        if self.fleet is None:
+            return 0.0
+        return sum(
+            max(0.0, q) for _, q in self.fleet.load_map().values()
+        )
+
+    def _admission_check_locked(
+        self, rid: str, cls: str, tenant: str, now: float
+    ) -> Optional[Dict]:
+        """Traffic-plane gates for a FIRST-time rid (chunk resubmits of
+        an admitted rid always pass). Returns a shed response dict or
+        None (= admitted; the caller records the ledger entry)."""
+        cfg = self.traffic
+        shed = {
+            "success": False,
+            "shed": True,
+            "retry_after": cfg.retry_after_s,
+        }
+        # per-tenant in-flight cap: one tenant cannot starve the rest
+        cap = cfg.max_inflight_per_tenant
+        if (
+            cap > 0
+            and tenant
+            and self._tenant_inflight.get(tenant, 0) >= cap
+        ):
+            self.tenant_rejections_total += 1
+            self.requests_shed_total += 1
+            return {**shed, "reason": "tenant_cap"}
+        backlog = self._queued_backlog_locked()
+        self.overload = bool(
+            cfg.shed_queue_depth > 0 and backlog >= cfg.shed_queue_depth
+        )
+        if cls == "interactive":
+            return None  # interactive is never router-shed
+        # fleet-wide overload: lowest class sheds first, visibly
+        if self.overload:
+            self.requests_shed_total += 1
+            return {**shed, "reason": "overload"}
+        # weighted fairness while contended (some server has a queue):
+        # bulk may hold at most bulk_weight/(bulk+interactive) of the
+        # contended in-flight mix WHEN interactive traffic is present —
+        # work-conserving otherwise, and never below ONE bulk request
+        # in flight (at small in-flight counts the proportional gate
+        # would otherwise round bulk's share down to zero and starve
+        # training entirely behind a single live session)
+        if (
+            backlog > 0
+            and self._class_inflight["interactive"] > 0
+            and self._class_inflight["bulk"] > 0
+        ):
+            total = (
+                self._class_inflight["interactive"]
+                + self._class_inflight["bulk"]
+            )
+            share = cfg.bulk_weight / max(
+                1, cfg.bulk_weight + cfg.interactive_weight
+            )
+            if self._class_inflight["bulk"] + 1 > share * (total + 1):
+                self.requests_shed_total += 1
+                return {**shed, "reason": "fair_share"}
+        return None
 
     # -- scheduling ----------------------------------------------------
     def _schedulable(self, addr: str) -> bool:
@@ -136,8 +254,47 @@ class RouterState:
         # per-request exclusions: servers the CLIENT already failed this
         # request on — never schedulable for it, even failing open
         excl = set(meta.get("exclude") or ())
+        cls = (
+            "interactive"
+            if meta.get("priority") == "interactive"
+            else "bulk"
+        )
+        tenant = str(meta.get("tenant") or "")
+        rid = str(meta.get("rid") or "")
+        # a suffix-resume continuation carries accumulated progress a
+        # 429 would strand — never shed it, even when its ledger entry
+        # TTL-expired or its first chunk was scheduled via the client's
+        # local fallback (mirrors the server-side `resumed` exemption)
+        resumed = bool(meta.get("resumed"))
         with self.lock:
+            now = time.monotonic()
+            self._sweep_inflight_locked(now)
+            first_time = not (rid and rid in self._inflight_reqs)
+            if first_time and not resumed:
+                out = self._admission_check_locked(rid, cls, tenant, now)
+                if out is not None:
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "shed", rid, sched_class=cls, tenant=tenant,
+                            reason=out.get("reason", ""),
+                        )
+                    return out
             self.sched_total += 1
+            self.sched_class_totals[cls] += 1
+            charged = False
+            if rid:
+                if first_time:
+                    if tenant:
+                        self._tenant_inflight[tenant] = (
+                            self._tenant_inflight.get(tenant, 0) + 1
+                        )
+                    self._class_inflight[cls] += 1
+                    self._inflight_reqs[rid] = (tenant, cls, now)
+                    charged = True
+                else:
+                    # chunk resubmit: refresh the entry's TTL clock
+                    tenant0, cls0, _ = self._inflight_reqs.pop(rid)
+                    self._inflight_reqs[rid] = (tenant0, cls0, now)
             qid = str(meta.get("qid") or meta.get("rid") or "")
             candidates = [
                 a for a in self.addresses
@@ -151,7 +308,14 @@ class RouterState:
                 candidates = [a for a in self.addresses if a not in excl]
             if not candidates:
                 # every server deregistered/drained away — an explicit
-                # error beats a 500 from an empty min()/modulo
+                # error beats a 500 from an empty min()/modulo. The
+                # charge made above must not outlive this failed
+                # schedule: the client falls back to its local policy
+                # and will never post /finish_request for this rid, so
+                # leaving the entry would shed legitimate traffic for a
+                # full TTL after a transient fleet blip.
+                if charged:
+                    self._release_inflight_locked(rid)
                 return {"success": False, "reason": "no_servers"}
             cset = set(candidates)
             redirected = False
@@ -285,6 +449,15 @@ class RouterState:
             )
         return len(stale)
 
+    def finish_request(self, rid: str) -> Dict:
+        """Release a rid's in-flight ledger entry (tenant + class
+        capacity). Fired by the client when the request completes or
+        dies; idempotent — a double release or an expired entry is a
+        no-op, not an error."""
+        with self.lock:
+            released = self._release_inflight_locked(rid)
+        return {"success": True, "released": released}
+
     # -- capacity + staleness gate ------------------------------------
     def allocate(self) -> Dict:
         with self.lock:
@@ -396,6 +569,16 @@ class RouterState:
         from areal_tpu.utils.tracing import render_prometheus
 
         with self.lock:
+            # refresh the overload gauge at scrape time: it must track
+            # the LIVE backlog, not latch at whatever the last
+            # first-time schedule computed (clients backing off on
+            # 429s stop producing exactly the events that would
+            # otherwise clear it)
+            backlog = self._queued_backlog_locked()
+            self.overload = bool(
+                self.traffic.shed_queue_depth > 0
+                and backlog >= self.traffic.shed_queue_depth
+            )
             own = {
                 "version": self.version,
                 "running": self.running,
@@ -415,7 +598,25 @@ class RouterState:
                 "failovers_total": self.failovers_total,
                 "requests_migrated_total": self.requests_migrated_total,
                 "tracing_dropped_spans_total": float(self.tracer.dropped),
+                # traffic plane (r10)
+                "sched_class_interactive_total": (
+                    self.sched_class_totals["interactive"]
+                ),
+                "sched_class_bulk_total": self.sched_class_totals["bulk"],
+                "sched_class_interactive_inflight": (
+                    self._class_inflight["interactive"]
+                ),
+                "sched_class_bulk_inflight": self._class_inflight["bulk"],
+                "requests_shed_total": self.requests_shed_total,
+                "tenant_rejections_total": self.tenant_rejections_total,
+                "tenants_inflight": len(self._tenant_inflight),
+                "traffic_overload": float(self.overload),
+                # the size the control loop steers toward (= the live
+                # fleet when no autoscaler is attached)
+                "fleet_target_size": float(len(self.addresses)),
             }
+            if self.autoscaler is not None:
+                own.update(self.autoscaler.metrics())
         if self.fleet is not None:
             own.update(self.fleet.state_metrics())
         lines = [
@@ -426,6 +627,10 @@ class RouterState:
                     "sched_affinity_hits": "counter",
                     "sched_rid_affinity_hits": "counter",
                     "sched_qid_affinity_hits": "counter",
+                    "sched_class_interactive_total": "counter",
+                    "sched_class_bulk_total": "counter",
+                    "requests_shed_total": "counter",
+                    "tenant_rejections_total": "counter",
                     "failovers_total": "counter",
                     "requests_migrated_total": "counter",
                     "fleet_probes_total": "counter",
@@ -482,10 +687,12 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def _send_json(self, obj, code: int = 200):
+    def _send_json(self, obj, code: int = 200, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -541,7 +748,23 @@ class _Handler(BaseHTTPRequestHandler):
                 header_rid = self.headers.get(RID_HEADER)
                 if header_rid and "rid" not in payload:
                     payload["rid"] = header_rid
-                self._send_json(self.state.schedule(payload))
+                out = self.state.schedule(payload)
+                if out.get("shed"):
+                    # load shed: HTTP 429 + Retry-After so utils/http
+                    # backs off instead of failing the episode
+                    self._send_json(
+                        out, 429,
+                        headers={
+                            "Retry-After":
+                                f"{out.get('retry_after', 1.0):g}",
+                        },
+                    )
+                    return
+                self._send_json(out)
+            elif self.path == "/finish_request":
+                self._send_json(
+                    self.state.finish_request(str(payload.get("rid", "")))
+                )
             elif self.path == "/allocate_rollout":
                 self._send_json(self.state.allocate())
             elif self.path == "/finish_rollout":
@@ -576,6 +799,8 @@ def serve_router(
     fleet_config: Optional[FleetConfig] = None,
     probe_interval_s: float = 0.0,
     tracing: Optional[TracingConfig] = None,
+    traffic: Optional[TrafficConfig] = None,
+    autoscale_launch_fn=None,
     **state_kwargs,
 ) -> ThreadingHTTPServer:
     """Start the router; discovers servers from name_resolve when
@@ -593,7 +818,9 @@ def serve_router(
         addresses = sorted(name_resolve.get_subtree(key))
     if not addresses:
         raise ValueError("router needs at least one generation server")
-    state = RouterState(addresses, tracing=tracing, **state_kwargs)
+    state = RouterState(
+        addresses, tracing=tracing, traffic=traffic, **state_kwargs
+    )
     cfg = fleet_config
     if cfg is None:
         cfg = FleetConfig(enabled=probe_interval_s > 0)
@@ -619,12 +846,44 @@ def serve_router(
     state.fleet = monitor
     if cfg.enabled:
         monitor.start()
+    if traffic is not None and traffic.autoscale:
+        # router-hosted autoscaler: drains through the router's own
+        # graceful path; scale-UP needs an embedder-provided launch_fn
+        # (the router cannot spawn server processes — launcher/local.py
+        # owns that) and degrades to drain-only without one
+        from areal_tpu.inference.fleet import FleetAutoscaler
+
+        if autoscale_launch_fn is None:
+            def autoscale_launch_fn():  # noqa: F811
+                logger.warning(
+                    "autoscaler wants to scale up but the router has "
+                    "no launch_fn (run the autoscaler in the launcher "
+                    "for real scale-up)"
+                )
+
+        state.autoscaler = FleetAutoscaler(
+            traffic,
+            launch_fn=autoscale_launch_fn,
+            drain_fn=lambda a: state.drain(a),
+            addresses_fn=lambda: list(state.addresses),
+        ).start()
     handler = type("Handler", (_Handler,), {"state": state})
     if port == 0:
         port = network.find_free_ports(1)[0]
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
     httpd.router_state = state  # for tests/introspection
+    if state.autoscaler is not None:
+        # tie the control loop's lifetime to the server's: shutdown()
+        # must not leave a thread probing (and draining!) a fleet this
+        # router no longer fronts
+        _orig_shutdown = httpd.shutdown
+
+        def _shutdown_with_autoscaler():
+            state.autoscaler.stop()
+            _orig_shutdown()
+
+        httpd.shutdown = _shutdown_with_autoscaler
     logger.info(
         f"router on {host}:{port} fronting {len(addresses)} server(s)"
     )
@@ -653,6 +912,19 @@ def main(argv=None):
     )
     p.add_argument("--qid-cache-size", type=int, default=8192)
     p.add_argument(
+        "--max-inflight-per-tenant", type=int, default=0,
+        help="per-tenant in-flight request cap (0 = uncapped)",
+    )
+    p.add_argument(
+        "--shed-queue-depth", type=int, default=0,
+        help="fleet queued-request depth past which new bulk schedules "
+        "are shed with 429 + Retry-After (0 disables)",
+    )
+    p.add_argument(
+        "--retry-after", type=float, default=1.0,
+        help="Retry-After seconds attached to shed (429) responses",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="record per-schedule route spans (drain via GET /trace)",
     )
@@ -673,6 +945,11 @@ def main(argv=None):
         probe_interval_s=args.probe_interval,
         qid_cache_size=args.qid_cache_size,
         tracing=TracingConfig(enabled=True) if args.trace else None,
+        traffic=TrafficConfig(
+            max_inflight_per_tenant=args.max_inflight_per_tenant,
+            shed_queue_depth=args.shed_queue_depth,
+            retry_after_s=args.retry_after,
+        ),
     )
 
 

@@ -42,7 +42,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from areal_tpu.api.cli_args import JaxGenConfig
-from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.engine import (
+    AdmissionRejectedError,
+    GenerationEngine,
+)
 from areal_tpu.utils import chaos
 from areal_tpu.utils import logging as logging_util, names, network
 from areal_tpu.utils import name_resolve
@@ -129,6 +132,34 @@ _METRIC_HELP = {
     "prefix_evicted_pages_total": (
         "prefix-cache pages evicted under allocation pressure"
     ),
+    # SLO traffic plane (r10)
+    "requests_shed_total": (
+        "submissions rejected by the bounded admission queue "
+        "(429 + Retry-After)"
+    ),
+    "deadline_preemptions_total": (
+        "bulk requests preempted so a deadline-carrying interactive "
+        "request could run"
+    ),
+    "deadline_misses_total": (
+        "requests that completed after their soft deadline"
+    ),
+    "sched_class_interactive_running": (
+        "interactive requests holding a decode slot"
+    ),
+    "sched_class_bulk_running": "bulk requests holding a decode slot",
+    "sched_class_interactive_queued": (
+        "interactive requests admitted but not yet running"
+    ),
+    "sched_class_bulk_queued": (
+        "bulk requests admitted but not yet running"
+    ),
+    "sched_class_interactive_submitted_total": (
+        "interactive submissions accepted by admission"
+    ),
+    "sched_class_bulk_submitted_total": (
+        "bulk submissions accepted by admission"
+    ),
     "trace_spans": "spans currently buffered (drained by GET /trace)",
     "tracing_dropped_spans_total": (
         "spans lost to ring-buffer overflow (the trace is truncated)"
@@ -160,10 +191,12 @@ class _Handler(BaseHTTPRequestHandler):
         True when a response was already produced (caller must return)."""
         return chaos.apply_server_chaos(self, self._send_json)
 
-    def _send_json(self, obj, code: int = 200):
+    def _send_json(self, obj, code: int = 200, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -191,9 +224,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self.control is not None
                 and self.control.draining.is_set()
             )
-            self._send_json(
-                {"status": "draining" if draining else "ok"}
-            )
+            body = {"status": "draining" if draining else "ok"}
+            try:
+                # load view for the router map and the autoscaler:
+                # running vs queued SEPARATELY — a busy decode and a
+                # queue backlog demand different reactions (more
+                # servers fixes a backlog; it does nothing for one
+                # long decode). Stub engines without metrics() still
+                # answer a bare status.
+                m = eng.metrics()
+                body["running_requests"] = int(m["running_requests"])
+                body["queued_requests"] = int(m["queued_requests"])
+                body["max_num_seqs"] = int(eng.config.max_num_seqs)
+            except Exception:
+                pass
+            self._send_json(body)
         elif url.path == "/get_model_info":
             self._send_json(
                 {
@@ -222,6 +267,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if self.path == "/generate":
+                # the body must be consumed BEFORE any early response:
+                # on an HTTP/1.1 keep-alive connection an unread body
+                # desyncs the stream — the peer's next request line is
+                # parsed out of the leftover JSON (a 400 the client
+                # treats as non-retryable). The drain-path 503 below
+                # was exactly that bug until the autoscaler drain test
+                # ran mid-wave over pooled aiohttp connections.
+                payload = self._read_json()
                 if (
                     self.control is not None
                     and self.control.draining.is_set()
@@ -230,7 +283,6 @@ class _Handler(BaseHTTPRequestHandler):
                     # (already inside eng.generate) run to completion
                     self._send_json({"error": "draining"}, 503)
                     return
-                payload = self._read_json()
                 # incoming trace context: bind the originating episode's
                 # trace id (and rid, when the body doesn't carry one)
                 # onto this server's spans so the fleet timeline stitches
@@ -240,7 +292,21 @@ class _Handler(BaseHTTPRequestHandler):
                 trace_id = self.headers.get(TRACE_HEADER)
                 if trace_id and "trace_ctx" not in payload:
                     payload["trace_ctx"] = trace_id
-                result = eng.generate(payload)
+                try:
+                    result = eng.generate(payload)
+                except AdmissionRejectedError as e:
+                    # load shed: typed 429 + Retry-After so utils/http
+                    # treats it as backpressure, not failure
+                    self._send_json(
+                        {
+                            "error": "shed",
+                            "sched_class": e.sched_class,
+                            "retry_after": e.retry_after,
+                        },
+                        429,
+                        headers={"Retry-After": f"{e.retry_after:g}"},
+                    )
+                    return
                 self._send_json(result)
             elif self.path.startswith("/profile"):
                 if not self.profile_endpoint:
@@ -418,6 +484,25 @@ def main(argv: Optional[list] = None):
     p.add_argument("--spec-accept-floor", type=float, default=0.1)
     p.add_argument("--spec-disable-patience", type=int, default=32)
     p.add_argument(
+        "--max-queued-requests", type=int, default=0,
+        help="bounded admission queue: past this depth new bulk "
+        "requests are shed with 429 + Retry-After (interactive past "
+        "twice the bound; 0 = unbounded)",
+    )
+    p.add_argument(
+        "--shed-retry-after", type=float, default=1.0,
+        help="Retry-After seconds attached to shed (429) responses",
+    )
+    p.add_argument(
+        "--no-deadline-preemption", action="store_true",
+        help="disable deadline-aware preemption of bulk requests",
+    )
+    p.add_argument(
+        "--deadline-margin", type=float, default=0.25,
+        help="preempt a bulk request when a queued interactive request "
+        "is within this many seconds of its soft deadline",
+    )
+    p.add_argument(
         "--router-addr", default="",
         help="router host:port to POST /register to at startup "
         "(dynamic fleet membership without shared name_resolve)",
@@ -449,6 +534,10 @@ def main(argv: Optional[list] = None):
         compilation_cache_dir=args.compilation_cache_dir,
         prefix_cache_mode=args.prefix_cache_mode,
         prefix_reuse_min=args.prefix_reuse_min,
+        max_queued_requests=args.max_queued_requests,
+        shed_retry_after_s=args.shed_retry_after,
+        deadline_preemption=not args.no_deadline_preemption,
+        deadline_margin_s=args.deadline_margin,
     )
     cfg.tracing.enabled = args.trace
     cfg.spec.enabled = args.spec

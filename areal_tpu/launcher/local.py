@@ -14,11 +14,14 @@ owns the chip) is the default and this launcher simply execs the trainer.
 """
 
 import dataclasses
+import json
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+import urllib.request
 from typing import Dict, List, Optional
 
 from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
@@ -75,7 +78,10 @@ class LocalLauncher:
         return proc
 
     def poll(self) -> Optional[JobException]:
-        for name, proc in self._procs.items():
+        # snapshot: the autoscaler launch/reap threads insert and pop
+        # jobs concurrently with this 1 Hz sweep — iterating the live
+        # dict would raise "changed size during iteration"
+        for name, proc in list(self._procs.items()):
             code = proc.poll()
             if code is not None and code != 0:
                 return JobException(name, code)
@@ -110,14 +116,15 @@ class LocalLauncher:
                     pass
 
     def stop_all(self):
-        for name, proc in self._procs.items():
+        procs = list(self._procs.values())  # concurrent-mutation safe
+        for proc in procs:
             if proc.poll() is None:
                 try:
                     os.killpg(proc.pid, signal.SIGTERM)
                 except ProcessLookupError:
                     pass
         deadline = time.monotonic() + 10
-        for proc in self._procs.values():
+        for proc in procs:
             while proc.poll() is None and time.monotonic() < deadline:
                 time.sleep(0.1)
             if proc.poll() is None:
@@ -133,8 +140,11 @@ def launch_servers(
     gen_config: JaxGenConfig,
     n_servers: int,
     base_env: Optional[Dict[str, str]] = None,
+    name_offset: int = 0,
 ) -> List[str]:
-    """Start n generation-server subprocesses; returns host:port addrs."""
+    """Start n generation-server subprocesses; returns host:port addrs.
+    ``name_offset`` keeps job names unique when the autoscaler adds
+    servers after launch."""
     ports = network.find_free_ports(n_servers)
     addrs = []
     if gen_config.compilation_cache_dir:
@@ -154,8 +164,8 @@ def launch_servers(
             experiment_name=launcher.experiment_name,
             trial_name=launcher.trial_name,
         )
-        cmd.append(f"--server-index={i}")
-        launcher.submit(f"gen_server_{i}", cmd, env=base_env)
+        cmd.append(f"--server-index={name_offset + i}")
+        launcher.submit(f"gen_server_{name_offset + i}", cmd, env=base_env)
         addrs.append(f"{host}:{ports[i]}")
     return addrs
 
@@ -327,15 +337,107 @@ def local_main(
             env_worker_addrs[name] = addr
         env_worker_seq["n"] += len(addrs)
 
-    def start_servers(env: Dict[str, str]) -> None:
+    server_seq = {"n": 0}
+    server_name_by_addr: Dict[str, str] = {}
+    # SLO traffic plane: the rollout config's TrafficConfig drives a
+    # launcher-hosted autoscaler (the launcher is the one process that
+    # can actually SPAWN a server)
+    traffic_cfg = getattr(
+        getattr(config, "rollout", None), "traffic", None
+    )
+    autoscaler = None
+
+    def _server_cfg() -> JaxGenConfig:
         server_cfg = getattr(config, "server", None) or JaxGenConfig()
-        n_servers = alloc.gen.data_parallel_size
         # per-server tensor parallelism comes from the allocation mode
         # (reference: SGLang tp wired at areal/launcher/local.py:277-306)
         if alloc.gen.tensor_parallel_size > 1:
             server_cfg.tensor_parallel_size = alloc.gen.tensor_parallel_size
-        server_addrs[:] = launch_servers(launcher, server_cfg, n_servers, env)
-        server_names[:] = [f"gen_server_{i}" for i in range(n_servers)]
+        return server_cfg
+
+    def start_servers(env: Dict[str, str]) -> None:
+        n_servers = alloc.gen.data_parallel_size
+        server_addrs[:] = launch_servers(
+            launcher, _server_cfg(), n_servers, env,
+            name_offset=server_seq["n"],
+        )
+        server_names[:] = [
+            f"gen_server_{server_seq['n'] + i}" for i in range(n_servers)
+        ]
+        server_name_by_addr.clear()
+        server_name_by_addr.update(
+            dict(zip(server_addrs, server_names))
+        )
+        server_seq["n"] += n_servers
+
+    def scale_up_one() -> None:
+        """Autoscaler launch_fn: one more generation server; it
+        self-registers under name_resolve so fleet membership (trainer
+        client + any router) discovers it without a restart."""
+        env = dict(base_env)
+        addr = launch_servers(
+            launcher, _server_cfg(), 1, env,
+            name_offset=server_seq["n"],
+        )[0]
+        name = f"gen_server_{server_seq['n']}"
+        server_seq["n"] += 1
+        server_addrs.append(addr)
+        server_names.append(name)
+        server_name_by_addr[addr] = name
+
+    def scale_down_drain(addr: str) -> None:
+        """Autoscaler drain_fn: POST /drain (graceful — the server
+        finishes in-flight work, then deregisters), then reap the empty
+        process in the background. Zero rollouts are lost: in-flight
+        requests complete, and clients suffix-resume anything that
+        would have landed here."""
+        try:
+            req = urllib.request.Request(
+                f"http://{addr}/drain", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                r.read()
+        except Exception as e:
+            logger.warning(f"autoscaler drain of {addr} failed: {e}")
+            return
+
+        def _reap():
+            deadline = time.monotonic() + 600
+            probe_fails = 0
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{addr}/health", timeout=5
+                    ) as r:
+                        body = json.loads(r.read())
+                    probe_fails = 0
+                    if (
+                        body.get("running_requests", 0)
+                        + body.get("queued_requests", 0)
+                        <= 0
+                    ):
+                        break
+                except Exception:
+                    # one transient probe timeout must not kill a
+                    # server that still holds in-flight work — only a
+                    # SUSTAINED unreachable drainee counts as gone
+                    probe_fails += 1
+                    if probe_fails >= 3:
+                        break
+                time.sleep(0.5)
+            name = server_name_by_addr.pop(addr, None)
+            if addr in server_addrs:
+                server_addrs.remove(addr)
+            if name:
+                if name in server_names:
+                    server_names.remove(name)
+                launcher.stop(name)
+                logger.info(
+                    f"autoscaler: drained + stopped {name} ({addr})"
+                )
+
+        threading.Thread(target=_reap, daemon=True).start()
 
     def start_trainers(env: Dict[str, str]) -> None:
         if n_trainers == 1:
@@ -374,6 +476,26 @@ def local_main(
             if wants_servers and not servers_up:
                 start_servers(env)
                 servers_up = True
+            if (
+                autoscaler is None
+                and wants_servers
+                and traffic_cfg is not None
+                and traffic_cfg.autoscale
+            ):
+                from areal_tpu.inference.fleet import FleetAutoscaler
+
+                autoscaler = FleetAutoscaler(
+                    traffic_cfg,
+                    launch_fn=scale_up_one,
+                    drain_fn=scale_down_drain,
+                    addresses_fn=lambda: list(server_addrs),
+                ).start()
+                logger.info(
+                    f"fleet autoscaler on: "
+                    f"[{traffic_cfg.min_servers}, "
+                    f"{traffic_cfg.max_servers}] servers, "
+                    f"eval every {traffic_cfg.autoscale_interval_s}s"
+                )
             if wants_env_workers and not env_worker_names:
                 start_env_workers(env)
             if server_addrs:
@@ -461,4 +583,6 @@ def local_main(
                 env_worker_addrs.clear()
             time.sleep(delay)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         launcher.stop_all()

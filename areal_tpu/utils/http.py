@@ -1,9 +1,13 @@
 """Async HTTP helpers (role of reference areal/utils/http.py).
 
-Retry policy: connection errors, timeouts, and 5xx responses are
-retryable (the server may be mid-crash, mid-restart, or behind a weight
-update); 4xx responses are NOT — they mean the request itself is wrong,
-and re-POSTing it N times just multiplies the error. Backoff is
+Retry policy: connection errors, timeouts, 5xx responses, and 429
+(load shed) are retryable; other 4xx responses are NOT — they mean the
+request itself is wrong, and re-POSTing it N times just multiplies the
+error. 429 is the traffic plane's backpressure signal (router/server
+admission control, inference/router.py + inference/server.py): the
+response's ``Retry-After`` is HONORED as the retry delay — treating a
+shed as a hard failure would burn the caller's episode-retry budget on
+what is merely "come back in a second". Backoff for everything else is
 exponential with bounded random jitter so N clients whose server died
 under them don't re-converge on the survivor in lockstep.
 
@@ -29,15 +33,36 @@ from areal_tpu.utils import chaos
 class HttpRequestError(Exception):
     """Request failed. ``status`` carries the last HTTP status when the
     failure was a response (None for connection errors / timeouts), so
-    callers can distinguish "server is gone" from "request is wrong"."""
+    callers can distinguish "server is gone" from "request is wrong";
+    ``retry_after`` carries a shed response's honored Retry-After
+    seconds (None otherwise)."""
 
-    def __init__(self, message: str, status: Optional[int] = None):
+    def __init__(
+        self,
+        message: str,
+        status: Optional[int] = None,
+        retry_after: Optional[float] = None,
+    ):
         super().__init__(message)
         self.status = status
+        self.retry_after = retry_after
 
 
 def retryable_status(status: int) -> bool:
-    return status >= 500
+    # 429 = admission control shed us, explicitly temporary
+    return status >= 500 or status == 429
+
+
+def _parse_retry_after(value) -> Optional[float]:
+    """Seconds from a Retry-After header (delta-seconds form only — the
+    traffic plane always sends numbers; an HTTP-date falls back to the
+    normal backoff)."""
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(str(value).strip()))
+    except (TypeError, ValueError):
+        return None
 
 
 def backoff_delay(
@@ -95,6 +120,9 @@ async def arequest_with_retry(
                         raise HttpRequestError(
                             f"POST {url} -> {resp.status}: {body[:500]}",
                             status=resp.status,
+                            retry_after=_parse_retry_after(
+                                resp.headers.get("Retry-After")
+                            ),
                         )
                     return await resp.json()
             else:
@@ -106,6 +134,9 @@ async def arequest_with_retry(
                         raise HttpRequestError(
                             f"GET {url} -> {resp.status}: {body[:500]}",
                             status=resp.status,
+                            retry_after=_parse_retry_after(
+                                resp.headers.get("Retry-After")
+                            ),
                         )
                     return await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, HttpRequestError) as e:
@@ -116,12 +147,21 @@ async def arequest_with_retry(
                 raise
             last_exc = e
             if attempt + 1 < max_retries:
+                # a shed's Retry-After IS the backoff (admission control
+                # told us exactly when to come back) — clamped to the
+                # caller's delay cap so a bogus header can't wedge us
+                ra = getattr(e, "retry_after", None)
                 await asyncio.sleep(
-                    backoff_delay(attempt, retry_delay, max_retry_delay, jitter)
+                    min(ra, max_retry_delay)
+                    if ra is not None
+                    else backoff_delay(
+                        attempt, retry_delay, max_retry_delay, jitter
+                    )
                 )
     raise HttpRequestError(
         f"request to {url} failed after {max_retries} tries",
         status=getattr(last_exc, "status", None),
+        retry_after=getattr(last_exc, "retry_after", None),
     ) from last_exc
 
 
@@ -181,7 +221,11 @@ def request_with_retry(
             except Exception:
                 body = ""
             err = HttpRequestError(
-                f"{method.upper()} {url} -> {e.code}: {body}", status=e.code
+                f"{method.upper()} {url} -> {e.code}: {body}",
+                status=e.code,
+                retry_after=_parse_retry_after(
+                    e.headers.get("Retry-After") if e.headers else None
+                ),
             )
             if not retryable_status(e.code):
                 raise err from None
@@ -194,10 +238,16 @@ def request_with_retry(
                 raise
             last_exc = e
         if attempt + 1 < max_retries:
+            ra = getattr(last_exc, "retry_after", None)
             time.sleep(
-                backoff_delay(attempt, retry_delay, max_retry_delay, jitter)
+                min(ra, max_retry_delay)
+                if ra is not None
+                else backoff_delay(
+                    attempt, retry_delay, max_retry_delay, jitter
+                )
             )
     raise HttpRequestError(
         f"request to {url} failed after {max_retries} tries",
         status=getattr(last_exc, "status", None),
+        retry_after=getattr(last_exc, "retry_after", None),
     ) from last_exc

@@ -159,6 +159,10 @@ class AgenticToolWorkflow(RolloutWorkflow):
             self.tokenizer,
             gconfig=self.gconfig,
             tool_parser=self.tool_parser,
+            # training rollouts are bulk-class traffic even over the
+            # OpenAI-shaped client (live sessions keep its interactive
+            # default)
+            priority="bulk",
         )
         messages: List[Dict[str, str]] = []
         if self.system_prompt:

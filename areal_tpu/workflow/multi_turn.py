@@ -94,7 +94,7 @@ class MultiTurnWorkflow(RolloutWorkflow):
                 rid=unique_rid(),
                 input_ids=tokens,
                 gconfig=self.gconfig.new(n_samples=1),
-                metadata={"qid": episode_id},
+                metadata={"qid": episode_id, "priority": "bulk"},
             )
             resp = await engine.agenerate(req)
             tokens.extend(resp.output_tokens)

@@ -37,12 +37,18 @@ class RLVRWorkflow(RolloutWorkflow):
         tokenizer=None,
         enable_thinking: bool = False,
         dump_dir: Optional[str] = None,
+        priority: str = "bulk",
     ):
         self.reward_fn = AsyncRewardWrapper(reward_fn)
         self.gconfig = gconfig
         self.tokenizer = tokenizer
         self.enable_thinking = enable_thinking
         self.dump_dir = dump_dir
+        # traffic-plane scheduling class: training rollouts are BULK
+        # (shed-able under load); eval sweeps construct the same
+        # workflow with priority="interactive" so admission control
+        # protects their latency against bulk rollout pressure
+        self.priority = priority
 
     def _tokenize_prompt(self, data: Dict[str, Any]) -> List[int]:
         if "input_ids" in data:
@@ -73,7 +79,11 @@ class RLVRWorkflow(RolloutWorkflow):
         group_id = unique_rid("grp")
         req_template = ModelRequest(
             input_ids=prompt_ids, gconfig=self.gconfig.new(n_samples=1),
-            metadata={"qid": group_id, "group_size": n},
+            metadata={
+                "qid": group_id,
+                "group_size": n,
+                "priority": self.priority,
+            },
         )
         resps = await asyncio.gather(
             *[

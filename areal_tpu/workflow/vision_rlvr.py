@@ -48,6 +48,7 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         dump_dir: Optional[str] = None,
         image_token_id: Optional[int] = None,
         spatial_merge_size: int = 2,
+        priority: str = "bulk",
     ):
         super().__init__(
             reward_fn,
@@ -55,6 +56,7 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             tokenizer=tokenizer,
             enable_thinking=enable_thinking,
             dump_dir=dump_dir,
+            priority=priority,
         )
         self.processor = processor
         self.image_token_id = image_token_id
@@ -152,7 +154,11 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             # group key: siblings steer to one server (qid affinity) —
             # pixel-conditioned KV itself is never token-prefix-cached,
             # but same-wave sibling dedup still shares the mm prefill
-            metadata={"qid": unique_rid("grp"), "group_size": n},
+            metadata={
+                "qid": unique_rid("grp"),
+                "group_size": n,
+                "priority": self.priority,
+            },
         )
         resps = await asyncio.gather(
             *[

@@ -32,6 +32,32 @@ def pytest_configure(config):
     )
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Tier-1 wall-time budget tracking: the suite runs against a hard
+    cap, so every run prints its slowest tests (setup+call+teardown per
+    nodeid) — a PR that regresses the budget is visible in its own CI
+    output, not discovered at the next cap overrun."""
+    durations = {}
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            d = getattr(rep, "duration", None)
+            nodeid = getattr(rep, "nodeid", None)
+            if d is None or not nodeid:
+                continue
+            durations[nodeid] = durations.get(nodeid, 0.0) + d
+    if not durations:
+        return
+    top = sorted(durations.items(), key=lambda kv: -kv[1])[:10]
+    total = sum(durations.values())
+    tr = terminalreporter
+    tr.write_sep("=", "slowest tests (tier-1 budget report)")
+    for nodeid, d in top:
+        tr.write_line(f"{d:8.2f}s  {nodeid}")
+    tr.write_line(
+        f"{total:8.2f}s  total across {len(durations)} tests"
+    )
+
+
 @pytest.fixture
 def memory_name_resolve():
     from areal_tpu.utils import name_resolve

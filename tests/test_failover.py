@@ -8,6 +8,7 @@ router fronting the pair must both reflect the event
 (failovers_total / requests_migrated_total / fleet_healthy_servers)."""
 
 import asyncio
+import json
 import os
 import queue
 import subprocess
@@ -532,3 +533,218 @@ def test_lineage_ledger_and_stitched_trace_across_kill(
     finally:
         client.destroy()
         router.shutdown()
+
+
+# ==========================================================================
+# Autoscaler scale-down under live traffic (r10 traffic plane)
+# ==========================================================================
+@pytest.fixture()
+def drainee_server():
+    """(drainee_addr, survivor_addr): TWO real generation engines with
+    identical seed-0 weights, each behind its own real HTTP shell
+    (drain mode, /health, /metrics are all per-shell ServerControl
+    state). In-process rather than subprocess — drain needs no process
+    death, and the wall-time budget note from r7 applies (the live-hub
+    test set this precedent); the /drain → finish-in-flight → 503 →
+    suffix-resume path is byte-for-byte the production one."""
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import serve
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+
+    cfg = tiny_config("qwen2")
+    engines, shells, addrs = [], [], []
+    for _ in range(2):
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+            ),
+            model_config=cfg, params=params,
+        ).start()
+        httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+        engines.append(eng)
+        shells.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    yield addrs[0], addrs[1]
+    for httpd in shells:
+        httpd.shutdown()
+    for eng in engines:
+        eng.stop()
+
+
+@pytest.mark.chaos
+def test_autoscaler_drain_live_server_zero_loss_token_exact(
+    drainee_server,
+):
+    """Scale-down composes with the chaos-harness invariants: the
+    autoscaler decides the fleet is oversized mid-generation and drains
+    one of two REAL servers. Zero rollouts are lost, greedy streams are
+    bit-identical to an undrained run (in-flight chunks finish on the
+    drainee; later chunks suffix-resume on the survivor), and the drain
+    is visible on the client's fleet metrics + the autoscaler gauges."""
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        TrafficConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+    from areal_tpu.inference.fleet import FleetAutoscaler
+
+    drainee_addr, survivor_addr = drainee_server
+    MAX_NEW_DRAIN = 16
+
+    client = RemoteInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="autoscale", trial_name="t0",
+            consumer_batch_size=4, max_concurrent_rollouts=8,
+            request_timeout=60, request_retries=2, setup_timeout=120,
+            schedule_policy="round_robin",
+            # small chunks: the drain lands between chunks, and the
+            # post-drain 503s suffix-resume onto the survivor
+            new_tokens_per_chunk=4,
+            fleet=FleetConfig(
+                probe_interval_s=0.3, probe_timeout_s=2.0,
+                dead_threshold=2, halfopen_interval_s=60.0,
+            ),
+        )
+    ).initialize(addrs=[drainee_addr, survivor_addr])
+
+    # the control law is driven manually mid-wave (deterministic);
+    # the DRAIN ACTION is the real POST /drain against a live server
+    def real_drain(addr):
+        import urllib.request as _rq
+
+        req = _rq.Request(
+            f"http://{addr}/drain", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "draining"
+
+    quiet = {"running": 0.0, "queued": 0.0, "kv_util": 0.0}
+    scaler = FleetAutoscaler(
+        TrafficConfig(
+            autoscale=True, min_servers=1, max_servers=2,
+            down_consecutive=1, cooldown_s=0.0, down_kv_util=0.9,
+        ),
+        launch_fn=lambda: None,
+        drain_fn=real_drain,
+        addresses_fn=lambda: [drainee_addr, survivor_addr],
+        # steer the victim choice: the drainee reports idle, the
+        # survivor busy — least-loaded selection must drain the drainee
+        observe_fn=lambda a: dict(
+            quiet if a == drainee_addr
+            else {"running": 2.0, "queued": 0.0, "kv_util": 0.0}
+        ),
+    )
+
+    try:
+        results_holder = {}
+
+        async def wave():
+            reqs = [
+                ModelRequest(
+                    rid=f"dr{i}",
+                    input_ids=p,
+                    gconfig=GenerationHyperparameters(
+                        n_samples=1, max_new_tokens=MAX_NEW_DRAIN,
+                        greedy=True,
+                    ),
+                )
+                for i, p in enumerate(PROMPTS)
+            ]
+            tasks = [
+                asyncio.ensure_future(client.agenerate(r)) for r in reqs
+            ]
+            # drain mid-wave: once BOTH servers have produced tokens
+            # for this wave, the fleet is live-traffic by construction
+            def tokens(addr):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{addr}/metrics", timeout=5
+                    ) as r:
+                        text = r.read().decode()
+                    for line in text.splitlines():
+                        if line.startswith(
+                            "areal_tpu_gen_total_generated_tokens "
+                        ):
+                            return float(line.rsplit(" ", 1)[1])
+                except Exception:
+                    return 0.0
+                return 0.0
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (
+                    tokens(drainee_addr) > 0
+                    and tokens(survivor_addr) > 0
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert tokens(drainee_addr) > 0, "drainee never took traffic"
+            assert scaler.evaluate_once() == f"down:{drainee_addr}"
+            results_holder["out"] = await asyncio.gather(*tasks)
+
+        asyncio.run(wave())
+        results = results_holder["out"]
+
+        # zero lost rollouts
+        assert len(results) == len(PROMPTS)
+        for out in results:
+            assert len(out.output_tokens) == MAX_NEW_DRAIN
+
+        # token-exact: greedy streams equal an UNDRAINED single-server
+        # run over the same seed-0 weights — the live survivor serves
+        # the reference (one uninterrupted /generate per prompt)
+        for prompt, out in zip(PROMPTS, results):
+            req = urllib.request.Request(
+                f"http://{survivor_addr}/generate",
+                data=json.dumps(
+                    {
+                        "input_ids": prompt,
+                        "sampling_params": {
+                            "max_new_tokens": MAX_NEW_DRAIN,
+                            "greedy": True,
+                        },
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                expect = json.loads(r.read())
+            assert out.output_tokens == expect["output_ids"], (
+                f"prompt {prompt}: drained stream diverged"
+            )
+
+        # autoscaler gauges reflect the action
+        sm = scaler.metrics()
+        assert sm["autoscale_down_total"] == 1.0
+        assert sm["fleet_target_size"] == 1.0
+
+        # the drain is visible on /metrics planes: the drainee's own
+        # /health says draining, and the client fleet monitor moves it
+        # out of rotation without opening a circuit
+        with urllib.request.urlopen(
+            f"http://{drainee_addr}/health", timeout=5
+        ) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "draining"
+        deadline = time.monotonic() + 20
+        fm = {}
+        while time.monotonic() < deadline:
+            fm = client.fleet.metrics()
+            if fm["fleet_draining_servers"] == 1.0:
+                break
+            time.sleep(0.2)
+        assert fm["fleet_draining_servers"] == 1.0, fm
+        assert fm["fleet_circuit_open"] == 0.0, fm
+        assert fm["fleet_healthy_servers"] == 1.0, fm
+    finally:
+        client.destroy()

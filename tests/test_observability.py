@@ -437,6 +437,77 @@ class TestProfileEndpoint:
             httpd.shutdown()
 
 
+class TestTrafficPlaneObservability:
+    """r10 SLO traffic plane: the new metric families land on /metrics
+    with HELP text, /health reports running vs queued separately, and
+    trace_report --slo reads the class-tagged span stream."""
+
+    def test_traffic_metrics_and_help_on_endpoint(self, traced_engine):
+        eng, addr, _, _ = traced_engine
+        _generate(eng, "rid-slo-metrics", max_new=2)
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        for required in (
+            "areal_tpu_gen_requests_shed_total",
+            "areal_tpu_gen_deadline_preemptions_total",
+            "areal_tpu_gen_deadline_misses_total",
+            "areal_tpu_gen_sched_class_interactive_running",
+            "areal_tpu_gen_sched_class_bulk_running",
+            "areal_tpu_gen_sched_class_interactive_queued",
+            "areal_tpu_gen_sched_class_bulk_queued",
+            "areal_tpu_gen_sched_class_bulk_submitted_total",
+        ):
+            assert any(
+                line.startswith(required + " ")
+                for line in text.splitlines()
+            ), f"missing sample line for {required}"
+        assert "# HELP areal_tpu_gen_requests_shed_total" in text
+        assert "# HELP areal_tpu_gen_deadline_preemptions_total" in text
+        assert (
+            "# TYPE areal_tpu_gen_requests_shed_total counter" in text
+        )
+
+    def test_health_reports_running_and_queued_separately(
+        self, traced_engine
+    ):
+        _, addr, _, _ = traced_engine
+        with urllib.request.urlopen(
+            f"http://{addr}/health", timeout=30
+        ) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "ok"
+        # separate fields, NOT one summed in_flight integer — the
+        # autoscaler distinguishes backlog from busy decode
+        assert body["running_requests"] == 0
+        assert body["queued_requests"] == 0
+        assert body["max_num_seqs"] == 4
+
+    def test_trace_report_slo_reads_class_tagged_spans(
+        self, traced_engine, tmp_path
+    ):
+        eng, _, _, _ = traced_engine
+        eng.tracer.drain()
+        _generate(eng, "rid-slo-report", max_new=2)
+        path = str(tmp_path / "slo.jsonl")
+        eng.tracer.export_jsonl(path)
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import trace_report
+
+        assert trace_report.main([path, "--slo"]) == 0
+        sl = trace_report.slo_summary(trace_report.load_spans(path))
+        # a default-stamped request is bulk class with a measured wait
+        assert "bulk" in sl["queue_wait_by_class"]
+        assert sl["queue_wait_by_class"]["bulk"]["n"] >= 1
+        # an eventless trace exits 1 (CI smoke contract)
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        assert trace_report.main([empty, "--slo"]) == 1
+
+
 class TestDisabledNoOp:
     @pytest.fixture(scope="class")
     def plain_engine(self):

@@ -1,0 +1,650 @@
+"""SLO-aware traffic plane: priority classes, bounded admission +
+load shedding (typed 429 + Retry-After), per-tenant caps, weighted
+fairness, deadline-aware preemption, and the fleet autoscaler control
+law.
+
+The acceptance test is `test_priority_isolation_under_saturating_bulk`:
+on a REAL engine behind the HTTP shell, saturating bulk load never
+delays an interactive request unboundedly — overflow bulk is shed with
+429, a deadline-carrying interactive request preempts a bulk slot, and
+every bulk rollout still completes (shed ≠ lost; preempted ≠ lost).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.cli_args import (
+    FleetConfig,
+    JaxGenConfig,
+    TracingConfig,
+    TrafficConfig,
+)
+from areal_tpu.inference.engine import (
+    AdmissionRejectedError,
+    GenerationEngine,
+)
+from areal_tpu.inference.fleet import FleetAutoscaler, FleetMonitor
+from areal_tpu.inference.router import RouterState
+from areal_tpu.inference.server import serve
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+from areal_tpu.utils.http import (
+    HttpRequestError,
+    arequest_with_retry,
+    request_with_retry,
+)
+
+
+# ==========================================================================
+# utils/http: 429 is retryable and Retry-After is honored
+# ==========================================================================
+class _FlakyHandler(BaseHTTPRequestHandler):
+    sheds_left = 0
+    lock = threading.Lock()
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        with _FlakyHandler.lock:
+            shed = _FlakyHandler.sheds_left > 0
+            if shed:
+                _FlakyHandler.sheds_left -= 1
+        if self.path == "/notfound":
+            body = b'{"error": "nope"}'
+            self.send_response(404)
+        elif shed:
+            body = b'{"error": "shed"}'
+            self.send_response(429)
+            self.send_header("Retry-After", "0.01")
+        else:
+            body = b'{"ok": 1}'
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def flaky_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_sync_429_retries_with_retry_after(flaky_server):
+    _FlakyHandler.sheds_left = 2
+    t0 = time.monotonic()
+    out = request_with_retry(
+        f"http://{flaky_server}/x", {}, max_retries=3, retry_delay=30.0
+    )
+    # the two retry waits honored Retry-After (0.01s), NOT the 30s
+    # exponential backoff a 5xx would have used
+    assert out == {"ok": 1}
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_sync_429_exhausted_carries_status_and_retry_after(flaky_server):
+    _FlakyHandler.sheds_left = 99
+    with pytest.raises(HttpRequestError) as exc:
+        request_with_retry(
+            f"http://{flaky_server}/x", {}, max_retries=2,
+            retry_delay=30.0,
+        )
+    assert exc.value.status == 429
+    assert exc.value.retry_after == 0.01
+
+
+def test_sync_404_still_raises_immediately(flaky_server):
+    _FlakyHandler.sheds_left = 0
+    with pytest.raises(HttpRequestError) as exc:
+        request_with_retry(
+            f"http://{flaky_server}/notfound", {}, max_retries=3
+        )
+    assert exc.value.status == 404
+
+
+def test_async_429_retries_with_retry_after(flaky_server):
+    import aiohttp
+
+    _FlakyHandler.sheds_left = 2
+
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            return await arequest_with_retry(
+                s, f"http://{flaky_server}/x", {}, max_retries=3,
+                retry_delay=30.0,
+            )
+
+    t0 = time.monotonic()
+    assert asyncio.run(run()) == {"ok": 1}
+    assert time.monotonic() - t0 < 5.0
+
+
+# ==========================================================================
+# Router: tenant caps, overload shed, weighted fairness, ledger
+# ==========================================================================
+def _sched(state, rid, cls="bulk", tenant="t", **extra):
+    return state.schedule(
+        {"rid": rid, "priority": cls, "tenant": tenant, **extra}
+    )
+
+
+def test_router_tenant_cap_and_finish_request():
+    state = RouterState(
+        ["a:1", "b:2"],
+        traffic=TrafficConfig(max_inflight_per_tenant=2),
+    )
+    assert _sched(state, "r1", tenant="alpha").get("url")
+    assert _sched(state, "r2", tenant="alpha").get("url")
+    out = _sched(state, "r3", tenant="alpha")
+    assert out == {
+        "success": False, "shed": True, "reason": "tenant_cap",
+        "retry_after": state.traffic.retry_after_s,
+    }
+    # another tenant is unaffected
+    assert _sched(state, "o1", tenant="beta").get("url")
+    # chunk resubmits of an ADMITTED rid always pass and don't
+    # double-charge the tenant
+    assert _sched(state, "r2", tenant="alpha").get("url")
+    assert state._tenant_inflight["alpha"] == 2
+    # releasing one admits the blocked request
+    assert state.finish_request("r1")["released"]
+    assert _sched(state, "r3", tenant="alpha").get("url")
+    # idempotent release
+    assert not state.finish_request("r1")["released"]
+    assert state.requests_shed_total == 1
+    assert state.tenant_rejections_total == 1
+
+
+def _loaded_fleet(state, queued: float):
+    """Attach a FleetMonitor whose probes report a queue backlog."""
+    monitor = FleetMonitor(
+        list(state.addresses),
+        FleetConfig(enabled=False),
+        probe_fn=lambda a: (
+            "ok", 0.001,
+            {"running_requests": 2.0, "queued_requests": queued,
+             "max_num_seqs": 2.0},
+        ),
+    )
+    state.fleet = monitor
+    monitor.probe_once()
+    return monitor
+
+
+def test_router_overload_sheds_bulk_never_interactive():
+    state = RouterState(
+        ["a:1", "b:2"],
+        traffic=TrafficConfig(shed_queue_depth=4, retry_after_s=0.5),
+    )
+    _loaded_fleet(state, queued=3.0)  # 2 servers x 3 queued = 6 >= 4
+    out = _sched(state, "b1", cls="bulk")
+    assert out["shed"] and out["reason"] == "overload"
+    assert out["retry_after"] == 0.5
+    assert state.overload
+    # the interactive class rides through the same overload
+    assert _sched(state, "i1", cls="interactive").get("url")
+    # backlog drains -> overload clears, bulk admits again
+    state.fleet = None
+    _loaded_fleet(state, queued=0.0)
+    assert _sched(state, "b2", cls="bulk").get("url")
+    assert not state.overload
+
+
+def test_router_weighted_fair_share_under_contention():
+    # weights 4:1 -> bulk may hold 1/5 of contended in-flight capacity
+    state = RouterState(
+        ["a:1"],
+        traffic=TrafficConfig(
+            interactive_weight=4, bulk_weight=1, shed_queue_depth=0
+        ),
+    )
+    _loaded_fleet(state, queued=1.0)  # contended, but not overloaded
+    for i in range(4):
+        assert _sched(state, f"i{i}", cls="interactive").get("url")
+    # bulk 1 of 5 in flight: 1 <= 0.2*(4+0+1) -> admitted
+    assert _sched(state, "b0", cls="bulk").get("url")
+    # bulk 2 of 6 would exceed the share -> shed
+    out = _sched(state, "b1", cls="bulk")
+    assert out["shed"] and out["reason"] == "fair_share"
+    # work-conserving: with no interactive in flight, bulk takes all
+    for i in range(4):
+        state.finish_request(f"i{i}")
+    assert _sched(state, "b1", cls="bulk").get("url")
+
+
+def test_router_fair_share_never_fully_starves_bulk():
+    """At small in-flight counts the proportional share rounds to zero
+    — the gate still guarantees ONE bulk request in flight, so a lone
+    live session cannot halt training entirely."""
+    state = RouterState(
+        ["a:1"],
+        traffic=TrafficConfig(interactive_weight=4, bulk_weight=1),
+    )
+    _loaded_fleet(state, queued=1.0)
+    assert _sched(state, "i0", cls="interactive").get("url")
+    # first bulk admits despite 1 interactive in flight (share*2 < 1)
+    assert _sched(state, "b0", cls="bulk").get("url")
+    # the second is over the share -> shed
+    assert _sched(state, "b1", cls="bulk")["shed"]
+
+
+def test_router_never_sheds_resumed_continuations():
+    """A suffix-resume continuation passes every router gate even when
+    its ledger entry is gone (TTL expiry / first chunk scheduled via
+    local fallback) — shedding it would strand accumulated progress."""
+    state = RouterState(
+        ["a:1"],
+        traffic=TrafficConfig(
+            max_inflight_per_tenant=1, shed_queue_depth=1
+        ),
+    )
+    _loaded_fleet(state, queued=5.0)  # overloaded: fresh bulk sheds
+    assert _sched(state, "r1", tenant="alpha")["shed"]
+    out = _sched(state, "r2", tenant="alpha", resumed=True)
+    assert out.get("url")
+    # and the tenant cap does not block further resumed chunks either
+    assert _sched(state, "r3", tenant="alpha", resumed=True).get("url")
+
+
+def test_router_no_servers_releases_fresh_charge():
+    """A schedule that fails with no_servers must not leave its ledger
+    charge behind — the client falls back to local policy and never
+    posts /finish_request for it."""
+    state = RouterState(
+        ["a:1"], traffic=TrafficConfig(max_inflight_per_tenant=1)
+    )
+    out = _sched(state, "r1", tenant="alpha", exclude=["a:1"])
+    assert out == {"success": False, "reason": "no_servers"}
+    assert state._tenant_inflight == {}
+    # the tenant's capacity is intact for the next request
+    assert _sched(state, "r2", tenant="alpha").get("url")
+
+
+def test_router_inflight_ledger_ttl_expiry():
+    state = RouterState(
+        ["a:1"],
+        traffic=TrafficConfig(
+            max_inflight_per_tenant=1, inflight_ttl_s=0.05
+        ),
+    )
+    assert _sched(state, "r1", tenant="alpha").get("url")
+    assert _sched(state, "r2", tenant="alpha")["shed"]
+    time.sleep(0.06)  # r1's entry expires -> capacity returns
+    assert _sched(state, "r2", tenant="alpha").get("url")
+
+
+def test_router_metrics_expose_traffic_plane():
+    state = RouterState(
+        ["a:1"], traffic=TrafficConfig(max_inflight_per_tenant=1)
+    )
+    _sched(state, "r1", cls="interactive", tenant="alpha")
+    _sched(state, "r2", cls="bulk", tenant="alpha")  # shed: tenant cap
+    text = state.metrics()
+    assert "areal_tpu_router_sched_class_interactive_total 1" in text
+    assert "areal_tpu_router_requests_shed_total 1" in text
+    assert "areal_tpu_router_tenant_rejections_total 1" in text
+    assert "areal_tpu_router_traffic_overload 0" in text
+    # target size gauge exists even without an autoscaler attached
+    assert "areal_tpu_router_fleet_target_size 1" in text
+
+
+# ==========================================================================
+# Fleet: /health load parsing + autoscaler control law
+# ==========================================================================
+def test_fleet_probe_records_load_and_tolerates_legacy_tuples():
+    m = FleetMonitor(
+        ["a:1"], FleetConfig(enabled=False),
+        probe_fn=lambda a: (
+            "ok", 0.001,
+            {"running_requests": 2.0, "queued_requests": 5.0,
+             "max_num_seqs": 4.0},
+        ),
+    )
+    m.probe_once()
+    assert m.load_map() == {"a:1": (2.0, 5.0)}
+    assert m.per_server()["a:1"]["queued_requests"] == 5.0
+    # legacy 2-tuple probe_fn (pre-r10 injections) still works
+    legacy = FleetMonitor(
+        ["a:1"], FleetConfig(enabled=False),
+        probe_fn=lambda a: ("ok", 0.001),
+    )
+    legacy.probe_once()
+    assert legacy.load_map() == {}
+    assert legacy.is_schedulable("a:1")
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _autoscaler_rig(traffic, obs):
+    """obs: mutable {addr: observation}; launch appends addr-N, drain
+    marks the victim draining (the real /drain path does the same from
+    the autoscaler's point of view)."""
+    clock = _Clock()
+    launched = []
+    drained = []
+
+    def launch():
+        addr = f"new:{len(launched)}"
+        launched.append(addr)
+        obs[addr] = {"running": 0.0, "queued": 0.0, "kv_util": 0.0}
+
+    def drain(addr):
+        drained.append(addr)
+        obs[addr]["draining"] = 1.0
+
+    scaler = FleetAutoscaler(
+        traffic,
+        launch_fn=launch,
+        drain_fn=drain,
+        addresses_fn=lambda: list(obs),
+        observe_fn=lambda a: dict(obs[a]),
+        time_fn=clock,
+    )
+    return scaler, clock, launched, drained
+
+
+def test_autoscaler_scale_up_hysteresis_and_cooldown():
+    traffic = TrafficConfig(
+        autoscale=True, min_servers=1, max_servers=3,
+        up_consecutive=2, down_consecutive=2, cooldown_s=100.0,
+        up_queued_per_server=2.0,
+    )
+    obs = {"a:1": {"running": 2.0, "queued": 6.0, "kv_util": 0.5}}
+    scaler, clock, launched, drained = _autoscaler_rig(traffic, obs)
+    # hysteresis: one busy observation is not enough
+    assert scaler.evaluate_once() is None
+    assert scaler.evaluate_once() == "up"
+    assert launched == ["new:0"]
+    assert scaler.metrics()["fleet_target_size"] == 2.0
+    assert scaler.metrics()["autoscale_up_total"] == 1.0
+    # cooldown: still busy, but the new server needs time to absorb
+    clock.t += 10
+    assert scaler.evaluate_once() is None
+    assert scaler.last_decision == "cooldown"
+    assert launched == ["new:0"]
+    # past cooldown the streak rebuilds, then fires again up to max
+    clock.t += 100
+    assert scaler.evaluate_once() is None
+    assert scaler.evaluate_once() == "up"
+    clock.t += 200
+    assert len(obs) == 3
+    # at max_servers, busy holds, never exceeds
+    assert scaler.evaluate_once() is None
+    assert scaler.evaluate_once() is None
+    assert len(launched) == 2
+
+
+def test_autoscaler_scale_down_quiet_fleet_drains_least_loaded():
+    traffic = TrafficConfig(
+        autoscale=True, min_servers=1, max_servers=3,
+        up_consecutive=2, down_consecutive=2, cooldown_s=0.0,
+        down_kv_util=0.3,
+    )
+    obs = {
+        "a:1": {"running": 3.0, "queued": 0.0, "kv_util": 0.2},
+        "b:2": {"running": 0.0, "queued": 0.0, "kv_util": 0.1},
+    }
+    scaler, clock, launched, drained = _autoscaler_rig(traffic, obs)
+    assert scaler.evaluate_once() is None  # hysteresis tick 1
+    assert scaler.evaluate_once() == "down:b:2"  # least loaded
+    assert drained == ["b:2"]
+    assert scaler.metrics()["fleet_target_size"] == 1.0
+    # the draining server no longer counts; fleet is at min -> hold
+    assert scaler.evaluate_once() is None
+    assert scaler.evaluate_once() is None
+    assert drained == ["b:2"]
+
+
+def test_autoscaler_busy_fleet_never_scales_down():
+    traffic = TrafficConfig(
+        autoscale=True, min_servers=1, max_servers=2,
+        down_consecutive=1, cooldown_s=0.0, up_queued_per_server=99.0,
+    )
+    obs = {
+        "a:1": {"running": 1.0, "queued": 1.0, "kv_util": 0.1},
+        "b:2": {"running": 0.0, "queued": 0.0, "kv_util": 0.1},
+    }
+    scaler, *_ = _autoscaler_rig(traffic, obs)
+    for _ in range(4):
+        assert scaler.evaluate_once() is None  # queued>0 blocks down
+
+
+# ==========================================================================
+# Engine + HTTP shell: the acceptance test
+# ==========================================================================
+@pytest.fixture(scope="module")
+def traffic_engine():
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    gcfg = JaxGenConfig(
+        dtype="float32", max_num_seqs=2, max_model_len=64,
+        prefill_chunk=16, decode_chunk=4,
+        max_queued_requests=2, shed_retry_after_s=0.2,
+        tracing=TracingConfig(enabled=True, max_spans=10_000),
+    )
+    eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+    httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    yield eng, addr
+    httpd.shutdown()
+    eng.stop()
+
+
+def _post_generate(addr, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://{addr}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _bulk_payload(rid, prompt, max_new=24):
+    return {
+        "rid": rid,
+        "input_ids": prompt,
+        "priority": "bulk",
+        "tenant": "trainer",
+        "sampling_params": {"max_new_tokens": max_new, "greedy": True},
+    }
+
+
+def test_priority_isolation_under_saturating_bulk(traffic_engine):
+    """Acceptance: saturating bulk load on a real server — overflow
+    bulk is SHED (429 + Retry-After), a deadline-carrying interactive
+    request's queue-wait stays bounded (a bulk slot is preempted for
+    it), the interactive class is never shed or preempted, and every
+    admitted bulk rollout still completes its full budget."""
+    eng, addr = traffic_engine
+    eng.tracer.drain()  # isolate this test's spans
+    shed_before = eng.requests_shed_total
+    preempt_before = eng.deadline_preemptions_total
+
+    # saturate in two stages (the bound counts the admit queue, so the
+    # first pair must reach their slots before the second pair fills
+    # the queue): 2 running + 2 queued, all bulk
+    prompts = [[7, 6, 5, 4], [1, 2, 3], [9, 8, 7], [2, 4, 6, 8]]
+    futs = [
+        eng.submit(_bulk_payload(f"bulk-{i}", p))
+        for i, p in enumerate(prompts[:2])
+    ]
+    deadline = time.monotonic() + 60
+    while len(eng._active) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    futs += [
+        eng.submit(_bulk_payload(f"bulk-{2 + i}", p))
+        for i, p in enumerate(prompts[2:])
+    ]
+    m = eng.metrics()
+    assert m["running_requests"] == 2
+    assert m["queued_requests"] >= 2
+
+    # overflow bulk is shed with a typed 429 + honored Retry-After
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post_generate(addr, _bulk_payload("bulk-over", [5, 5, 5]))
+    assert exc.value.code == 429
+    assert float(exc.value.headers["Retry-After"]) == 0.2
+    body = json.loads(exc.value.read())
+    assert body["error"] == "shed" and body["sched_class"] == "bulk"
+
+    # a resumed continuation is NEVER shed, even with the queue full
+    resumed = eng.submit(
+        {**_bulk_payload("bulk-resume", [3, 1, 4], max_new=2),
+         "resumed": True}
+    )
+
+    # the interactive request: soft deadline -> preempts a bulk slot
+    t0 = time.monotonic()
+    out = eng.submit(
+        {
+            "rid": "inter-0",
+            "input_ids": [8, 8, 8],
+            "priority": "interactive",
+            "tenant": "eval",
+            "deadline_s": 0.2,
+            "sampling_params": {"max_new_tokens": 4, "greedy": True},
+        }
+    ).result(timeout=60)
+    interactive_latency = time.monotonic() - t0
+    assert len(out["output_ids"]) == 4
+    # bounded: it ran ahead of ~96 queued bulk decode tokens
+    assert interactive_latency < 20.0
+    assert eng.deadline_preemptions_total >= preempt_before + 1
+
+    # zero lost rollouts: every admitted bulk request (including the
+    # preempted victim and the resumed continuation) completes in full
+    for f in futs:
+        res = f.result(timeout=120)
+        assert len(res["output_ids"]) == 24
+    assert len(resumed.result(timeout=120)["output_ids"]) == 2
+
+    # only the overflow bulk was shed; the interactive class never was
+    assert eng.requests_shed_total == shed_before + 1
+    m = eng.metrics()
+    assert m["sched_class_interactive_submitted_total"] >= 1
+    assert m["sched_class_bulk_submitted_total"] >= 5
+    assert m["deadline_misses_total"] >= 0  # gauge exists
+
+    # span-level proof of isolation: the interactive queue_wait is
+    # far below the worst bulk queue_wait (bulk absorbed the pressure)
+    spans = eng.tracer.drain()
+    qw = {}
+    for s in spans:
+        if s.name != "queue_wait":
+            continue
+        qw.setdefault(s.attrs["sched_class"], []).append(s.duration)
+    assert "interactive" in qw and "bulk" in qw
+    assert max(qw["interactive"]) < max(qw["bulk"])
+    names = {s.name for s in spans}
+    assert "shed" in names and "deadline_preempt" in names
+    shed_spans = [s for s in spans if s.name == "shed"]
+    assert all(s.attrs["sched_class"] == "bulk" for s in shed_spans)
+
+
+def test_interactive_shed_only_past_double_bound(traffic_engine):
+    """The interactive bound is 2x the bulk bound: protected under
+    pressure, but not an unbounded queue."""
+    eng, _ = traffic_engine
+    # block admission entirely so queue depth is fully controlled
+    eng.pause()
+    try:
+        futs = [
+            eng.submit(_bulk_payload(f"db-{i}", [i + 1, 2, 3], max_new=1))
+            for i in range(2)  # fills the bound (2)
+        ]
+        with pytest.raises(AdmissionRejectedError):
+            eng.submit(
+                _bulk_payload("db-bulk", [9, 9], max_new=1)
+            ).result(timeout=5)
+        # interactive still admitted between bound and 2x bound
+        ifuts = [
+            eng.submit(
+                {
+                    "rid": f"db-i{i}",
+                    "input_ids": [4, 4, i + 1],
+                    "priority": "interactive",
+                    "sampling_params": {
+                        "max_new_tokens": 1, "greedy": True
+                    },
+                }
+            )
+            for i in range(2)
+        ]
+        with pytest.raises(AdmissionRejectedError):
+            eng.submit(
+                {
+                    "rid": "db-i-over",
+                    "input_ids": [4, 4, 9],
+                    "priority": "interactive",
+                    "sampling_params": {
+                        "max_new_tokens": 1, "greedy": True
+                    },
+                }
+            ).result(timeout=5)
+    finally:
+        eng.continue_generation()
+    for f in futs + ifuts:
+        assert f.result(timeout=120)["output_ids"]
+
+
+def test_resume_storm_does_not_shed_interactive(traffic_engine):
+    """Post-pause resume storms are bound-exempt bulk traffic; they
+    must not inflate the queue count that sheds the INTERACTIVE class
+    (that would invert priority isolation exactly during weight-update
+    churn)."""
+    eng, _ = traffic_engine
+    eng.pause()
+    try:
+        rfuts = [
+            eng.submit(
+                {**_bulk_payload(f"rs-{i}", [i + 1, 7], max_new=1),
+                 "resumed": True}
+            )
+            for i in range(4)  # 2x the bound, all exempt
+        ]
+        # fresh bulk sheds against the full queue...
+        with pytest.raises(AdmissionRejectedError):
+            eng.submit(
+                _bulk_payload("rs-bulk", [9, 9], max_new=1)
+            ).result(timeout=5)
+        # ...but interactive still admits: resumed entries are excluded
+        # from its 2x-bound count
+        ifut = eng.submit(
+            {
+                "rid": "rs-i",
+                "input_ids": [4, 2],
+                "priority": "interactive",
+                "sampling_params": {"max_new_tokens": 1, "greedy": True},
+            }
+        )
+    finally:
+        eng.continue_generation()
+    for f in rfuts + [ifut]:
+        assert f.result(timeout=120)["output_ids"]

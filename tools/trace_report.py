@@ -366,6 +366,92 @@ def format_failover(fo: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def slo_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """SLO / traffic-plane report: per-scheduling-class queue-wait
+    percentiles (from ``queue_wait`` spans' ``sched_class`` attr — the
+    priority-isolation signal), the shed table (``shed`` instants by
+    class / reason / tenant), and deadline outcomes (``deadline_miss``
+    lateness + ``deadline_preempt`` events). This is the table that
+    answers "did bulk pressure ever reach the interactive class"."""
+    spans = list(spans)
+    per_class: Dict[str, List[float]] = {}
+    for s in spans:
+        if s.get("name") != "queue_wait":
+            continue
+        attrs = s.get("attrs") or {}
+        cls = str(attrs.get("sched_class", "?"))
+        per_class.setdefault(cls, []).append(float(s.get("dur", 0.0)))
+    queue_wait = {}
+    for cls, vals in sorted(per_class.items()):
+        vals.sort()
+        queue_wait[cls] = {
+            "n": len(vals),
+            "p50_s": _percentile(vals, 0.50),
+            "p95_s": _percentile(vals, 0.95),
+            "max_s": round(vals[-1], 4) if vals else 0.0,
+        }
+    sheds = [s for s in spans if s.get("name") == "shed"]
+    shed_by_class: Dict[str, int] = {}
+    shed_by_reason: Dict[str, int] = {}
+    shed_by_tenant: Dict[str, int] = {}
+    for s in sheds:
+        attrs = s.get("attrs") or {}
+        cls = str(attrs.get("sched_class", "?"))
+        shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+        # engine sheds carry no reason (queue-full is the only one);
+        # router sheds name tenant_cap/overload/fair_share
+        reason = str(attrs.get("reason") or "queue_full")
+        shed_by_reason[reason] = shed_by_reason.get(reason, 0) + 1
+        tenant = str(attrs.get("tenant") or "?")
+        shed_by_tenant[tenant] = shed_by_tenant.get(tenant, 0) + 1
+    misses = [s for s in spans if s.get("name") == "deadline_miss"]
+    late = sorted(
+        float((s.get("attrs") or {}).get("late_s", 0.0)) for s in misses
+    )
+    return {
+        "queue_wait_by_class": queue_wait,
+        "shed_total": len(sheds),
+        "shed_by_class": dict(sorted(shed_by_class.items())),
+        "shed_by_reason": dict(sorted(shed_by_reason.items())),
+        "shed_by_tenant": dict(sorted(shed_by_tenant.items())),
+        "deadline_misses": len(misses),
+        "deadline_late_p50_s": _percentile(late, 0.50),
+        "deadline_late_max_s": round(late[-1], 4) if late else 0.0,
+        "deadline_preemptions": sum(
+            1 for s in spans if s.get("name") == "deadline_preempt"
+        ),
+    }
+
+
+def format_slo(sl: Dict[str, Any]) -> str:
+    rows = [f"{'class':<14}{'n':>7}{'p50':>10}{'p95':>10}{'max':>10}"]
+    for cls, st in sl["queue_wait_by_class"].items():
+        rows.append(
+            f"{cls:<14}{st['n']:>7}{st['p50_s']:>10.4f}"
+            f"{st['p95_s']:>10.4f}{st['max_s']:>10.4f}"
+        )
+    if not sl["queue_wait_by_class"]:
+        rows.append("(no queue_wait spans)")
+    rows += [
+        "",
+        f"requests shed        {sl['shed_total']}",
+        f"deadline preemptions {sl['deadline_preemptions']}",
+        f"deadline misses      {sl['deadline_misses']} "
+        f"(late p50 {sl['deadline_late_p50_s']}s, "
+        f"max {sl['deadline_late_max_s']}s)",
+    ]
+    for title, table in (
+        ("shed by class", sl["shed_by_class"]),
+        ("shed by reason", sl["shed_by_reason"]),
+        ("shed by tenant", sl["shed_by_tenant"]),
+    ):
+        if table:
+            rows += ["", f"{title:<20}{'count':>7}"]
+            for k, v in table.items():
+                rows.append(f"{k:<20}{v:>7}")
+    return "\n".join(rows)
+
+
 def env_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Env-service-plane report: per-operation latency percentiles from
     ``env_reset``/``env_step``/``verify`` spans (client- or worker-side)
@@ -716,6 +802,13 @@ def main(argv=None) -> int:
         "carries no env spans",
     )
     p.add_argument(
+        "--slo", action="store_true",
+        help="summarize the SLO traffic plane (per-class queue-wait "
+        "percentiles from queue_wait spans, shed/deadline tables) "
+        "instead of the latency table; exit 1 when the trace carries "
+        "no class-tagged queue_wait spans and no traffic events",
+    )
+    p.add_argument(
         "--failover", action="store_true",
         help="summarize resilience events (failover/migration spans "
         "from engine/remote.py) instead of the latency table; exit 1 "
@@ -814,6 +907,25 @@ def main(argv=None) -> int:
             print(
                 "no env spans in trace (tracing off, or no remote "
                 "environments ran)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    if args.slo:
+        sl = slo_summary(spans)
+        if args.json:
+            print(json.dumps(sl, indent=2))
+        else:
+            print(format_slo(sl))
+        if (
+            not sl["queue_wait_by_class"]
+            and sl["shed_total"] == 0
+            and sl["deadline_preemptions"] == 0
+            and sl["deadline_misses"] == 0
+        ):
+            print(
+                "no traffic-plane spans in trace (tracing off, or a "
+                "pre-r10 engine)",
                 file=sys.stderr,
             )
             return 1
