@@ -386,6 +386,12 @@ class JaxGenConfig:
     tracing: "TracingConfig" = dataclasses.field(
         default_factory=lambda: TracingConfig()
     )
+    # goodput attribution (utils/goodput.py): engine wall-clock ledger
+    # (prefill/decode/spec_verify/weight_pause/compile/idle), compile
+    # event stream, and the warming→ready /health readiness rule
+    goodput: "GoodputConfig" = dataclasses.field(
+        default_factory=lambda: GoodputConfig()
+    )
     log_level: str = "info"
     host: str = "127.0.0.1"
     port: int = 0  # 0 = auto
@@ -425,7 +431,15 @@ class JaxGenConfig:
         args += [
             f"--prefix-cache-mode={config.prefix_cache_mode}",
             f"--prefix-reuse-min={config.prefix_reuse_min}",
+            f"--ready-quiet={config.goodput.ready_quiet_s}",
+            f"--ready-min-requests={config.goodput.ready_min_requests}",
         ]
+        if config.goodput.compile_events_path:
+            args.append(
+                f"--compile-events={config.goodput.compile_events_path}"
+            )
+        if config.goodput.jsonl_path:
+            args.append(f"--goodput-jsonl={config.goodput.jsonl_path}")
         if config.max_queued_requests > 0:
             args += [
                 f"--max-queued-requests={config.max_queued_requests}",
@@ -482,6 +496,31 @@ class SpecConfig:
 
 
 @dataclasses.dataclass
+class GoodputConfig:
+    """Goodput attribution plane (utils/goodput.py): wall-clock bucket
+    ledger + recompile attribution for one owning loop. Always on — the
+    ledger costs a few monotonic reads per loop iteration — but the
+    JSONL streams only flow when paths are set."""
+
+    # goodput ledger snapshots appended here (one JSON line per export)
+    jsonl_path: str = ""
+    # one line per XLA backend compile with its triggering phase + shape
+    # signature — the input the shape-ladder AOT precompiler consumes
+    compile_events_path: str = ""
+    # readiness: a server reports /health "warming" from its first XLA
+    # compile until its shape ladder is covered, it goes ready_quiet_s
+    # without compiling, or it has COMPLETED ready_min_requests
+    # requests end-to-end (a server successfully serving is
+    # serving-ready even while incremental shapes still compile —
+    # without this, sustained traffic would hold a healthy server out
+    # of rotation indefinitely; <= 0 disables the completion path).
+    # Keeps cold servers out of fleet rotation through the compile
+    # storm without deadlocking an idle fresh one.
+    ready_quiet_s: float = 3.0
+    ready_min_requests: int = 1
+
+
+@dataclasses.dataclass
 class TracingConfig:
     """Request-lifecycle span tracing (utils/tracing.py): per-rid spans
     recorded by the inference engine / remote rollout controller, exported
@@ -530,6 +569,14 @@ class TelemetryConfig:
     # staleness runaway: max staleness-at-consumption in the lineage
     # ledger exceeds this many versions
     staleness_max: int = 8
+    # goodput collapse (r11): the fleet-mean pause+idle wall fraction
+    # (from the engines' goodput ledgers) runs away from the run's own
+    # baseline — the first `goodput_baseline_sweeps` observations set
+    # the manifest baseline; the anomaly fires when the current value
+    # exceeds baseline + margin AND the absolute floor
+    goodput_baseline_sweeps: int = 3
+    goodput_collapse_margin: float = 0.25
+    goodput_collapse_floor: float = 0.5
     # consolidated hub endpoint (serve() binds here; port 0 = auto)
     host: str = "127.0.0.1"
     port: int = 0

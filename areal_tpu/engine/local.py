@@ -25,6 +25,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
 from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.utils import goodput
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils import stats_tracker
 
@@ -217,20 +218,26 @@ class LocalSyncInferenceEngine(InferenceEngine):
 
     def wait(self, count: int, timeout: Optional[float] = None,
              group_filter=None):
-        return self.workflow_executor.wait(
-            count, timeout=timeout, group_filter=group_filter
-        )
+        # rollout_wait bucket mirrors engine/remote.py: trainer wall
+        # time blocked on generation (reentrant no-op under an outer
+        # bucket)
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.wait(
+                count, timeout=timeout, group_filter=group_filter
+            )
 
     def rollout_batch(self, data: List[Dict[str, Any]], workflow,
                       group_filter=None):
-        return self.workflow_executor.rollout_batch(
-            data, workflow, group_filter=group_filter
-        )
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.rollout_batch(
+                data, workflow, group_filter=group_filter
+            )
 
     def prepare_batch(self, dataloader, workflow, group_filter=None):
-        return self.workflow_executor.prepare_batch(
-            dataloader, workflow, group_filter=group_filter
-        )
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.prepare_batch(
+                dataloader, workflow, group_filter=group_filter
+            )
 
     def pause(self):
         self.workflow_executor.pause()

@@ -69,6 +69,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
 from areal_tpu.inference.fleet import FleetMonitor
+from areal_tpu.utils import goodput
 from areal_tpu.utils import logging as logging_util, name_resolve, names
 from areal_tpu.utils import stats_tracker, telemetry
 from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
@@ -401,9 +402,23 @@ class RemoteInferenceEngine(InferenceEngine):
                     return False
                 return fleet is None or fleet.is_schedulable(a)
 
+            def usable_continuation(a: str) -> bool:
+                # rid affinity = an in-flight request's next chunk: a
+                # WARMING server still serves it (it holds the KV; r11
+                # warming only gates NEW work)
+                if exclude and a in exclude:
+                    return False
+                if fleet is None:
+                    return True
+                cont = getattr(fleet, "is_continuation_target", None)
+                return (
+                    cont(a) if cont is not None
+                    else fleet.is_schedulable(a)
+                )
+
             if rid is not None and rid in self._rid_to_address:
                 addr = self._rid_to_address[rid]
-                if usable(addr):
+                if usable_continuation(addr):
                     # LRU touch: a hot resumed rid must not be the next
                     # eviction victim just because it was inserted early
                     self._rid_to_address.move_to_end(rid)
@@ -911,12 +926,16 @@ class RemoteInferenceEngine(InferenceEngine):
         def _alive_addresses():
             """Fan-out target set: skip servers the fleet already knows
             are DEAD/DRAINING — posting at them would stall or fail the
-            whole update for capacity that isn't serving anyway."""
+            whole update for capacity that isn't serving anyway.
+            WARMING servers ARE included (is_update_target): a cold
+            server skipped here would finish compiling straight into
+            rotation with stale weights."""
             if self.fleet is None:
                 return list(self.addresses)
-            alive = [
-                a for a in self.addresses if self.fleet.is_schedulable(a)
-            ]
+            in_target = getattr(
+                self.fleet, "is_update_target", self.fleet.is_schedulable
+            )
+            alive = [a for a in self.addresses if in_target(a)]
             return alive or list(self.addresses)
 
         def _pause_all():
@@ -1092,20 +1111,26 @@ class RemoteInferenceEngine(InferenceEngine):
 
     def wait(self, count: int, timeout: Optional[float] = None,
              group_filter=None):
-        return self.workflow_executor.wait(
-            count, timeout=timeout, group_filter=group_filter
-        )
+        # rollout_wait: the async gap the goodput ledger measures —
+        # trainer wall time spent blocked on generation (reentrant
+        # no-op when the step loop already opened the bucket)
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.wait(
+                count, timeout=timeout, group_filter=group_filter
+            )
 
     def rollout_batch(self, data: List[Dict[str, Any]], workflow,
                       group_filter=None):
-        return self.workflow_executor.rollout_batch(
-            data, workflow, group_filter=group_filter
-        )
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.rollout_batch(
+                data, workflow, group_filter=group_filter
+            )
 
     def prepare_batch(self, dataloader, workflow, group_filter=None):
-        return self.workflow_executor.prepare_batch(
-            dataloader, workflow, group_filter=group_filter
-        )
+        with goodput.trainer_bucket("rollout_wait"):
+            return self.workflow_executor.prepare_batch(
+                dataloader, workflow, group_filter=group_filter
+            )
 
     def pause(self):
         self.workflow_executor.pause()

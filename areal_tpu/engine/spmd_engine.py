@@ -44,6 +44,7 @@ from areal_tpu.parallel import distributed as distributed_lib
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.parallel import sharding as sharding_lib
 from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import goodput
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils.data import Batch
 
@@ -484,12 +485,23 @@ class SPMDTrainEngine(TrainEngine):
         pad_to = self._mb_pad_to(mbs.mbs)
         losses, weights, all_stats = [], [], []
         pack_s, grad_call_s = 0.0, []
+        # goodput attribution: host packing books data_h2d, the grad
+        # dispatches book fwd_bwd (minus any compile, which the trainer
+        # CompileTracker carves into the compile bucket with this step's
+        # shape signature), the apply + scalar fetch books optim
+        gp_sig = f"mbs{len(mbs.mbs)}|pad{pad_to}|window{window}"
         for mb in mbs.mbs:
             t0 = time.perf_counter()
-            _, arrays = self._pack_for_device(mb, pad_to=pad_to)
+            with goodput.trainer_bucket("data_h2d"):
+                _, arrays = self._pack_for_device(mb, pad_to=pad_to)
             t1 = time.perf_counter()
             pack_s += t1 - t0
-            grad_accum, loss, stats, w = grad_fn(self.params, grad_accum, arrays)
+            with goodput.trainer_bucket("fwd_bwd"), goodput.dispatch_scope(
+                goodput.trainer_tracker(), "fwd_bwd", gp_sig
+            ):
+                grad_accum, loss, stats, w = grad_fn(
+                    self.params, grad_accum, arrays
+                )
             # wall time of the (async) dispatch: a multi-second outlier on
             # one call = that call traced/compiled a fresh program
             grad_call_s.append(round(time.perf_counter() - t1, 3))
@@ -499,21 +511,33 @@ class SPMDTrainEngine(TrainEngine):
         total_w = functools.reduce(lambda a, b: a + b, weights)
         apply_fn = self._get_apply_fn()
         t_apply = time.perf_counter()
-        self.params, self.opt_state, grad_norm, ok = apply_fn(
-            self.params, self.opt_state, grad_accum, total_w
-        )
-        lr = float(self.lr_schedule(self.step_count))  # lr applied this step
-        self.step_count += 1
-        t_fetch = time.perf_counter()
-        # ONE packed host fetch for every scalar this step produced — each
-        # separate float() is a full device round-trip
-        stat_keys = sorted(all_stats[0])
-        scalars = [ok, grad_norm, total_w] + losses + weights + [
-            s[k] for s in all_stats for k in stat_keys
-        ]
-        blob = np.asarray(
-            jnp.stack([jnp.asarray(x, jnp.float32).reshape(()) for x in scalars])
-        )
+        # the optim bucket spans apply THROUGH the blocking scalar
+        # fetch: the fetch is where every async dispatch's device
+        # compute actually lands on the wall clock
+        with goodput.trainer_bucket("optim"):
+            with goodput.dispatch_scope(
+                goodput.trainer_tracker(), "optim", gp_sig
+            ):
+                self.params, self.opt_state, grad_norm, ok = apply_fn(
+                    self.params, self.opt_state, grad_accum, total_w
+                )
+            lr = float(self.lr_schedule(self.step_count))
+            self.step_count += 1
+            t_fetch = time.perf_counter()
+            # ONE packed host fetch for every scalar this step produced —
+            # each separate float() is a full device round-trip
+            stat_keys = sorted(all_stats[0])
+            scalars = [ok, grad_norm, total_w] + losses + weights + [
+                s[k] for s in all_stats for k in stat_keys
+            ]
+            blob = np.asarray(
+                jnp.stack(
+                    [
+                        jnp.asarray(x, jnp.float32).reshape(())
+                        for x in scalars
+                    ]
+                )
+            )
         n_mb = len(mbs.mbs)
         h_ok, h_gnorm, h_total_w = blob[0], blob[1], blob[2]
         h_losses = blob[3 : 3 + n_mb]
@@ -815,9 +839,10 @@ class SPMDTrainEngine(TrainEngine):
         t_upload = time.perf_counter()
 
         if meta.type == WeightUpdateMethod.DISK:
-            host = self._host_tree(self.params)  # collective: all ranks
-            if jax.process_index() == 0:
-                hf_io.save_params(host, self.model_config, meta.path)
+            with goodput.trainer_bucket("weight_push"):
+                host = self._host_tree(self.params)  # collective
+                if jax.process_index() == 0:
+                    hf_io.save_params(host, self.model_config, meta.path)
             stats_tracker.scalar(**{
                 "spmd/upload_weights_s": time.perf_counter() - t_upload
             })
@@ -855,7 +880,9 @@ class SPMDTrainEngine(TrainEngine):
         # broadcast reaches every server at once; servers sit paused for
         # the whole transfer, so wall time matters). The generator is
         # collective: non-zero ranks drain it without posting.
-        with ThreadPoolExecutor(max_workers=max(1, len(addrs))) as pool:
+        with goodput.trainer_bucket("weight_push"), ThreadPoolExecutor(
+            max_workers=max(1, len(addrs))
+        ) as pool:
             for i, n_chunks, chunk in self.iter_weight_chunks(
                 meta.chunk_bytes, dtype=self.compute_dtype
             ):

@@ -75,6 +75,7 @@ from areal_tpu.utils.tracing import (
     TRACE_HEADER,
     SpanTracer,
     TracingConfig,
+    register_metric_types,
     render_prometheus,
     trace_headers,
     trace_response,
@@ -371,6 +372,7 @@ _METRIC_HELP = {
     "errors_total": "env calls that raised (answered 500)",
     "rejected_draining_total": "resets refused while draining (503)",
     "rejected_capacity_total": "resets refused at max_sessions (429)",
+    "sessions_expired_total": "idle sessions reaped by the TTL sweeper",
     "draining": "1 while this worker is draining",
     "step_latency_ewma_s": "EWMA of env step execution latency",
     "trace_spans": "spans currently buffered (drained by GET /trace)",
@@ -378,6 +380,12 @@ _METRIC_HELP = {
         "spans lost to ring-buffer overflow (the trace is truncated)"
     ),
 }
+register_metric_types(
+    {
+        n: ("counter" if n.endswith("_total") else "gauge")
+        for n in _METRIC_HELP
+    }
+)
 
 
 class _EnvHandler(BaseHTTPRequestHandler):

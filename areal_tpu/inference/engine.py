@@ -57,8 +57,9 @@ from areal_tpu.models import hf_io
 from areal_tpu.models.config import ModelConfig, load_hf_config
 from areal_tpu.models.transformer import Params
 from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import goodput
 from areal_tpu.utils import logging as logging_util
-from areal_tpu.utils.tracing import SpanTracer
+from areal_tpu.utils.tracing import Histogram, SpanTracer
 
 logger = logging_util.getLogger("GenerationEngine")
 
@@ -582,6 +583,41 @@ class GenerationEngine:
         # request-lifecycle spans (strict no-op unless config.tracing is
         # enabled — the scheduler loop only ever pays an attribute read)
         self.tracer = SpanTracer(getattr(config, "tracing", None))
+        # --- goodput attribution plane (r11) ---
+        # every XLA compile is attributed to the dispatch that triggered
+        # it (phase + shape signature → compile_events.jsonl + the
+        # shape_ladder_coverage gauge readiness consumes), and the loop
+        # below books its wall time into exclusive buckets whose
+        # fractions sum to 1.0 of observed wall
+        gp = getattr(config, "goodput", None)
+        self.compiles = goodput.CompileTracker(
+            events_path=getattr(gp, "compile_events_path", "") or "",
+            ladder_size=self._ladder_estimate(),
+        )
+        self.ledger = goodput.GoodputLedger(
+            "engine", goodput.ENGINE_BUCKETS, remainder="idle",
+            productive=goodput.ENGINE_PRODUCTIVE,
+            jsonl_path=getattr(gp, "jsonl_path", "") or "",
+            compile_tracker=self.compiles,
+        )
+        self._ready_quiet_s = float(getattr(gp, "ready_quiet_s", 3.0))
+        self._ready_min_requests = int(
+            getattr(gp, "ready_min_requests", 1)
+        )
+        self._started_at = time.monotonic()
+        self._ready_latched = False
+        self._completed_requests = 0  # non-abort finishes (readiness)
+        # native latency histograms per scheduling class (always on —
+        # span-derived percentiles only exist while tracing is enabled
+        # AND the spans haven't been drained; these are the durable
+        # latency source the fleet rollup consumes)
+        self._hists = {
+            name: {cls: Histogram() for cls in SCHED_CLASSES}
+            for name in (
+                "queue_wait_seconds", "ttft_seconds",
+                "request_latency_seconds",
+            )
+        }
         # EWMA throughput gauges (tokens/s), updated by the loop thread
         self._decode_tps = 0.0
         self._prefill_tps = 0.0
@@ -642,6 +678,9 @@ class GenerationEngine:
         # non-HTTP deployments: drain remaining spans to the configured
         # JSONL sink (the server path drains via GET /trace instead)
         self.tracer.flush()
+        # final goodput snapshot to the configured stream (no-op
+        # without a path; live deployments scrape /metrics instead)
+        self.ledger.export_jsonl()
 
     # ------------------------------------------------------------------
     # Public API (thread-safe)
@@ -769,6 +808,84 @@ class GenerationEngine:
         self._command_queue.put(("update_weights_chunk", (header, arrays), done))
         return done.result(timeout=600)
 
+    def _ladder_estimate(self) -> int:
+        """Expected distinct compiled programs for a fully-warm engine —
+        the shape_ladder_coverage denominator. An ESTIMATE (the true
+        ladder depends on traffic: wave shapes, kv buckets, sampling
+        modes), deliberately on the low side so coverage saturates
+        rather than never reaching 1.0; the compile_events stream is the
+        exact record an AOT precompiler replays."""
+        s = max(1, self.config.max_num_seqs)
+        if getattr(self.config, "decode_compact", True):
+            floor = max(1, self.config.decode_compact_min_rows)
+            lo = 1 << (floor - 1).bit_length()
+            row_buckets = max(1, s.bit_length() - lo.bit_length() + 1)
+        else:
+            row_buckets = 1
+        decode_programs = row_buckets
+        sc = getattr(self.config, "spec", None)
+        if sc is not None and sc.enabled:
+            decode_programs *= 2  # verify + regular per row bucket
+        wave = max(1, self.config.admit_wave)
+        prefill_programs = wave.bit_length()  # pow2 wave rows
+        # sampling, pack_host, copy_pages, merge helpers
+        misc = 4
+        return decode_programs + prefill_programs + misc
+
+    def readiness(self) -> Dict[str, Any]:
+        """Server readiness for /health: ``warming`` while the initial
+        compile storm runs, ``ready`` after.
+
+        Warming begins at the FIRST observed XLA compile (an idle fresh
+        server is ready — it has nothing to warm yet, and reporting
+        warming before any traffic would deadlock it out of rotation
+        forever) and ends when the shape ladder is covered, the engine
+        goes ``ready_quiet_s`` without compiling, or it has COMPLETED
+        ``ready_min_requests`` requests end-to-end (under sustained
+        traffic a serving engine may never see a compile-quiet window —
+        successfully finishing requests is the stronger proof). Ready
+        LATCHES: a long-serving engine compiling one incremental shape
+        must not drop out of fleet rotation mid-run — readiness answers
+        "is the cold-start storm over", not "did anything ever compile
+        again". An AOT-precompiled or warmup-driven engine therefore
+        reports warming from its first startup compile until its
+        ladder lands or its first real completions prove it serves."""
+        now = time.monotonic()
+        cov = self.compiles.coverage()
+        quiet = self.compiles.quiet_s(now)  # inf before the 1st compile
+        served = (
+            self._ready_min_requests > 0
+            and self._completed_requests >= self._ready_min_requests
+        )
+        ready = (
+            self._ready_latched
+            or cov >= 1.0
+            or served
+            or quiet >= self._ready_quiet_s
+        )
+        if ready and (cov >= 1.0 or served or quiet != float("inf")):
+            # latch only once a real warmup ran its course — an idle
+            # fresh server is *servable* but still cold, and its first
+            # compile storm must still read as warming
+            self._ready_latched = True
+        return {
+            "state": "ready" if ready else "warming",
+            "ladder_coverage": round(cov, 4),
+            "compiled_shapes": self.compiles.compiled_shapes(),
+            "shape_ladder_size": self.compiles.ladder_size,
+            "warmup_eta_s": self.compiles.warmup_eta_s(),
+            "quiet_s": round(min(quiet, now - self._started_at), 3),
+        }
+
+    def latency_histograms(self) -> Dict[str, Histogram]:
+        """Per-class native Prometheus histograms keyed the way
+        ``render_prometheus(histograms=...)`` wants them."""
+        return {
+            f'{name}{{sched_class="{cls}"}}': h
+            for name, per_cls in self._hists.items()
+            for cls, h in per_cls.items()
+        }
+
     def metrics(self) -> Dict[str, float]:
         num_pages = max(1, self.cache_config.num_pages)
         m = dict(
@@ -831,6 +948,12 @@ class GenerationEngine:
             # VISIBLY truncated, not silently missing its oldest spans
             tracing_dropped_spans_total=float(self.tracer.dropped),
         )
+        # goodput attribution (r11): exclusive wall-time bucket
+        # fractions + duty cycle + effective tok/s, recompile bill, and
+        # the readiness gauge the fleet plane mirrors from /health
+        m.update(self.ledger.metrics())
+        m.update(self.compiles.metrics())
+        m["server_ready"] = float(self.readiness()["state"] == "ready")
         # per-class composition (traffic plane): running from an active
         # snapshot, queued = admit-queue class counters + a pending-list
         # scan (both metrics-grade racy reads — the loop thread owns the
@@ -870,20 +993,44 @@ class GenerationEngine:
     # Engine loop (single owner of device state)
     # ------------------------------------------------------------------
     def _loop(self):
+        # compiles fired outside an explicit dispatch_scope (helper jits
+        # like pack_host) still attribute to this engine's tracker
+        goodput.set_thread_tracker(self.compiles, phase="engine")
+        led = self.ledger
         while self._running:
             self._maybe_start_profile()
-            did_work = self._drain_commands()
+            if self._paused.is_set() or not self._command_queue.empty():
+                # command work (weight swaps, aborts) and every paused
+                # moment book to weight_pause — the capacity a weight
+                # update takes from serving, measured from the server's
+                # own clock
+                with led.bucket("weight_pause"):
+                    did_work = self._drain_commands()
+            else:
+                did_work = self._drain_commands()
             if not self._paused.is_set():
-                did_work |= self._admit()
-                did_work |= self._decode()
+                if (
+                    self._pending
+                    or self._active
+                    or not self._admit_queue.empty()
+                ):
+                    with led.bucket("prefill"):
+                        did_work |= self._admit()
+                else:
+                    did_work |= self._admit()
+                did_work |= self._decode()  # buckets decode/spec inside
             self._maybe_stop_profile(did_work)
             if not did_work:
                 # idle/pause gap: the decode-rate EWMA must not absorb it
                 # (the next chunk's dt would span the whole quiet period
                 # and crater the gauge)
                 self._last_decode_mark = None
-                time.sleep(0.001)
+                with led.bucket(
+                    "weight_pause" if self._paused.is_set() else "idle"
+                ):
+                    time.sleep(0.001)
         self._maybe_stop_profile(did_work=True, force=True)
+        goodput.set_thread_tracker(None)
 
     # ------------------------------------------------------------------
     # On-demand profiler capture (POST /profile)
@@ -1453,16 +1600,21 @@ class GenerationEngine:
             )
             pf_pos3 = jnp.asarray(pos3)
         t_pf_start = time.monotonic()
-        self.cache, wave_logits, pf_last = model_runner.prefill_batch(
-            self.params, self.model_config, self.cache,
-            jnp.asarray(tokens), jnp.asarray(row_offsets),
-            jnp.asarray(true_lens), jnp.asarray(row_tables),
-            prefix_bound=pf_prefix_bound,
-            last_rows=self._last_rows,
-            slot_ids=jnp.asarray(row_slots),
-            embeds=pf_embeds,
-            pos3=pf_pos3,
-        )
+        with goodput.dispatch_scope(
+            self.compiles, "prefill",
+            f"rows{n_rows}|tp{tp}|pps{pps_pf}|pfb{pf_prefix_bound}"
+            f"|mm{int(pf_embeds is not None)}",
+        ):
+            self.cache, wave_logits, pf_last = model_runner.prefill_batch(
+                self.params, self.model_config, self.cache,
+                jnp.asarray(tokens), jnp.asarray(row_offsets),
+                jnp.asarray(true_lens), jnp.asarray(row_tables),
+                prefix_bound=pf_prefix_bound,
+                last_rows=self._last_rows,
+                slot_ids=jnp.asarray(row_slots),
+                embeds=pf_embeds,
+                pos3=pf_pos3,
+            )
         if self._radix:
             # publish-at-prefill-commit: the wave's prompt pages enter
             # the radix tree NOW (the merge dispatch is already ordered
@@ -1618,6 +1770,12 @@ class GenerationEngine:
             self._prefill_tps = (
                 inst if self._prefill_tps == 0.0
                 else 0.8 * self._prefill_tps + 0.2 * inst
+            )
+        for (req, _, _) in admitted:
+            # native queue-wait histogram per class: the durable latency
+            # source (span percentiles vanish with every /trace drain)
+            self._hists["queue_wait_seconds"][req.priority].observe(
+                t_pf_start - req.submit_time
             )
         if self.tracer.enabled:
             for (req, slot, row), ctok in zip(admitted, adm_cached):
@@ -1820,6 +1978,7 @@ class GenerationEngine:
         plain single-token step for them); when NO slot has a candidate
         the regular pipelined path runs untouched."""
         depth = max(0, self.config.decode_pipeline)
+        led = self.ledger
         did = False
         dispatched = False
         drafts: Optional[Dict[int, List[int]]] = None
@@ -1827,9 +1986,16 @@ class GenerationEngine:
             if not self._inflight:
                 drafts = self._propose_drafts() or None
             elif self._spec_has_candidates():
-                # drain-for-drafts (see docstring)
-                self._process_chunk(self._inflight.pop(0))
-                self._flush_deferred()
+                # drain-for-drafts (see docstring); the drained chunk
+                # may itself be a verify chunk — attribute its wall
+                # time to the bucket that dispatched it
+                chunk = self._inflight.pop(0)
+                spec_chunk = chunk.get("spec_draft_lens") is not None
+                with led.bucket(
+                    "spec_verify" if spec_chunk else "decode"
+                ):
+                    self._process_chunk(chunk)
+                    self._flush_deferred()
                 return True
         if self._active and len(self._inflight) <= depth:
             if drafts:
@@ -1842,20 +2008,25 @@ class GenerationEngine:
                     max(1, self.config.decode_chunk) - 1,
                 ) + 1
                 margin = self._margin(k)
-                if self._ensure_decode_pages(margin):
-                    self._dispatch_chunk(k, margin, drafts=drafts)
-                    dispatched = did = True
+                with led.bucket("spec_verify"):
+                    if self._ensure_decode_pages(margin):
+                        self._dispatch_chunk(k, margin, drafts=drafts)
+                        dispatched = did = True
             else:
                 steps = max(1, self.config.decode_chunk)
                 margin = self._margin(steps)
-                if self._ensure_decode_pages(margin):
-                    self._dispatch_chunk(steps, margin)
-                    dispatched = did = True
+                with led.bucket("decode"):
+                    if self._ensure_decode_pages(margin):
+                        self._dispatch_chunk(steps, margin)
+                        dispatched = did = True
         if self._inflight and (
             len(self._inflight) > depth or not dispatched
         ):
-            self._process_chunk(self._inflight.pop(0))
-            self._flush_deferred()
+            chunk = self._inflight.pop(0)
+            spec_chunk = chunk.get("spec_draft_lens") is not None
+            with led.bucket("spec_verify" if spec_chunk else "decode"):
+                self._process_chunk(chunk)
+                self._flush_deferred()
             did = True
         return did
 
@@ -2005,45 +2176,53 @@ class GenerationEngine:
                     m_ = min(len(toks_d), kd)
                     draft_np[r_, :m_] = toks_d[:m_]
                     spec_draft_lens[r_] = m_
-            (
-                self.cache, toks, logps, emitted, active_after,
-                remaining_a, no_stop_a, lens_a, new_last, cur_next,
-            ) = model_runner.spec_verify(
-                self.params, self.model_config, self.cache,
-                tables_dev, lens,
-                st["_cur_tokens"], jnp.asarray(draft_np),
-                jnp.asarray(spec_draft_lens), active, st["_remaining"],
-                st["_no_stop"], stops, key,
-                st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
-                st["_greedy_dev"], k=steps,
-                topk_bound=self._sampling_mode(),
-                attn_impl=self._attn_impl,
-                ppcb=self.config.pages_per_compute_block,
-                spb=self.config.slots_per_block,
-                last_rows=self._last_rows,
-                rope_delta=rope,
-                slot_ids=slot_ids_dev,
-                align_base=align_dev,
-                replay=replay,
-            )
+            with goodput.dispatch_scope(
+                self.compiles, "spec_verify",
+                f"rows{rows}|k{steps}|pps{pps}|replay{replay}",
+            ):
+                (
+                    self.cache, toks, logps, emitted, active_after,
+                    remaining_a, no_stop_a, lens_a, new_last, cur_next,
+                ) = model_runner.spec_verify(
+                    self.params, self.model_config, self.cache,
+                    tables_dev, lens,
+                    st["_cur_tokens"], jnp.asarray(draft_np),
+                    jnp.asarray(spec_draft_lens), active, st["_remaining"],
+                    st["_no_stop"], stops, key,
+                    st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
+                    st["_greedy_dev"], k=steps,
+                    topk_bound=self._sampling_mode(),
+                    attn_impl=self._attn_impl,
+                    ppcb=self.config.pages_per_compute_block,
+                    spb=self.config.slots_per_block,
+                    last_rows=self._last_rows,
+                    rope_delta=rope,
+                    slot_ids=slot_ids_dev,
+                    align_base=align_dev,
+                    replay=replay,
+                )
         else:
-            out = model_runner.decode_multi(
-                self.params, self.model_config, self.cache,
-                tables_dev, lens,
-                st["_cur_tokens"], active, st["_remaining"],
-                st["_no_stop"], stops, key,
-                st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
-                st["_greedy_dev"], steps=steps,
-                topk_bound=self._sampling_mode(),
-                attn_impl=self._attn_impl,
-                ppcb=self.config.pages_per_compute_block,
-                spb=self.config.slots_per_block,
-                last_rows=self._last_rows,
-                rope_delta=rope,
-                slot_ids=slot_ids_dev,
-                align_base=align_dev,
-                replay=replay,
-            )
+            with goodput.dispatch_scope(
+                self.compiles, "decode",
+                f"rows{rows}|steps{steps}|pps{pps}|replay{replay}",
+            ):
+                out = model_runner.decode_multi(
+                    self.params, self.model_config, self.cache,
+                    tables_dev, lens,
+                    st["_cur_tokens"], active, st["_remaining"],
+                    st["_no_stop"], stops, key,
+                    st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
+                    st["_greedy_dev"], steps=steps,
+                    topk_bound=self._sampling_mode(),
+                    attn_impl=self._attn_impl,
+                    ppcb=self.config.pages_per_compute_block,
+                    spb=self.config.slots_per_block,
+                    last_rows=self._last_rows,
+                    rope_delta=rope,
+                    slot_ids=slot_ids_dev,
+                    align_base=align_dev,
+                    replay=replay,
+                )
             (
                 self.cache, toks, logps, emitted, active_after,
                 remaining_a, no_stop_a, lens_a, new_last,
@@ -2196,6 +2375,7 @@ class GenerationEngine:
                 # each emitted step cached the slot's previous input token
                 self._cached_len[slot] += k
                 self.total_generated_tokens += k
+                self.ledger.note_tokens(k)
             if stopped_host:
                 self._finish(slot, "stop")
             elif not h_active[row]:
@@ -2244,10 +2424,14 @@ class GenerationEngine:
         `only_slots`."""
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
-        toks, logps = model_runner.sample_tokens(
-            logits, key, self._temp_dev, self._top_p_dev, self._top_k_dev,
-            self._greedy_dev, topk_bound=self._sampling_mode(),
-        )
+        with goodput.dispatch_scope(
+            self.compiles, "sample", f"topk{self._sampling_mode()}"
+        ):
+            toks, logps = model_runner.sample_tokens(
+                logits, key, self._temp_dev, self._top_p_dev,
+                self._top_k_dev, self._greedy_dev,
+                topk_bound=self._sampling_mode(),
+            )
         # record sampled tokens as the next decode inputs for these slots
         sl = jnp.asarray(np.asarray(only_slots, np.int32))
         self._cur_tokens = self._cur_tokens.at[sl].set(toks[sl])
@@ -2271,6 +2455,7 @@ class GenerationEngine:
             if self._proposer is not None:
                 self._proposer.extend(slot, [int(toks[i])])
             self.total_generated_tokens += 1
+            self.ledger.note_tokens(1)
             out_len = len(req.output_ids)
             total_len = len(req.input_ids) + out_len
             stop_hit = (
@@ -2310,6 +2495,17 @@ class GenerationEngine:
             ),
         )
         now = time.monotonic()
+        if reason != "abort":
+            # aborts are pause-window resumes, not client-visible
+            # completions — they'd poison the latency distributions
+            # (and must not count toward serving-readiness either)
+            self._completed_requests += 1
+            self._hists["ttft_seconds"][req.priority].observe(
+                (req.first_token_time or now) - req.submit_time
+            )
+            self._hists["request_latency_seconds"][req.priority].observe(
+                now - req.submit_time
+            )
         if self.tracer.enabled:
             # decode covers first-token → finish; request is the full
             # submit → finish lifecycle (what a client timeline wants)

@@ -56,10 +56,19 @@ class ServerState(str, enum.Enum):
     DEAD = "dead"
     RECOVERING = "recovering"
     DRAINING = "draining"
+    # r11: a cold server still compiling its shape ladder (/health
+    # reports "warming"). Out of rotation — routing traffic at it buys
+    # multi-second first-token stalls — but unlike DEAD it is alive and
+    # MUST receive weight updates, or it would re-enter rotation stale.
+    WARMING = "warming"
 
 
 # states that may receive new work
 _SCHEDULABLE = (ServerState.HEALTHY, ServerState.SUSPECT)
+# states that must be included in weight-update fan-outs: schedulable
+# servers plus warming ones (skipping a warming server would make it
+# serve stale weights the moment it finishes compiling)
+_UPDATE_TARGETS = _SCHEDULABLE + (ServerState.WARMING,)
 
 
 class ServerHealth:
@@ -67,6 +76,7 @@ class ServerHealth:
         "addr", "state", "fails", "successes", "probe_latency_s",
         "last_probe", "last_transition", "source",
         "running_requests", "queued_requests", "max_num_seqs",
+        "warming_since", "ladder_coverage", "ready_lead_s",
     )
 
     def __init__(self, addr: str, source: str = "seed",
@@ -86,12 +96,19 @@ class ServerHealth:
         self.running_requests = -1.0
         self.queued_requests = -1.0
         self.max_num_seqs = -1.0
+        # cold-start accounting (r11): when this server was first seen
+        # warming, its last reported shape-ladder coverage, and the
+        # measured warming→serving lead once it crossed over
+        self.warming_since: Optional[float] = None
+        self.ladder_coverage = -1.0
+        self.ready_lead_s = -1.0
 
 
 def default_probe(addr: str, timeout: float) -> Tuple[str, float, Dict]:
-    """GET /health → ("ok" | "draining" | "fail", latency_s, load_info).
-    ``load_info`` carries the body's running_requests / queued_requests /
-    max_num_seqs when the server reports them (empty otherwise)."""
+    """GET /health → ("ok" | "warming" | "draining" | "fail",
+    latency_s, load_info). ``load_info`` carries the body's
+    running_requests / queued_requests / max_num_seqs /
+    ladder_coverage when the server reports them (empty otherwise)."""
     t0 = time.monotonic()
     try:
         with urllib.request.urlopen(
@@ -105,17 +122,16 @@ def default_probe(addr: str, timeout: float) -> Tuple[str, float, Dict]:
                 body = json.loads(r.read())
                 status = body.get("status", "ok")
                 for k in (
-                    "running_requests", "queued_requests", "max_num_seqs"
+                    "running_requests", "queued_requests",
+                    "max_num_seqs", "ladder_coverage",
                 ):
                     if k in body:
                         info[k] = float(body[k])
             except Exception:
                 status = "ok"
-            return (
-                ("draining" if status == "draining" else "ok"),
-                latency,
-                info,
-            )
+            if status not in ("draining", "warming"):
+                status = "ok"
+            return status, latency, info
     except Exception:
         return "fail", time.monotonic() - t0, {}
 
@@ -167,6 +183,9 @@ class FleetMonitor:
         self.requests_migrated_total = 0
         self.probes_total = 0
         self.probe_failures_total = 0
+        # cold-start accounting (r11): warming→serving transitions seen
+        self.cold_to_serving_total = 0
+        self.last_cold_to_serving_s = 0.0
         self._last_membership_poll = -float("inf")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -233,6 +252,24 @@ class FleetMonitor:
             h = self._servers.get(addr)
             return h is not None and h.state in _SCHEDULABLE
 
+    def is_update_target(self, addr: str) -> bool:
+        """Whether weight-update fan-outs must include this server:
+        schedulable OR warming (a cold server skipped by an update
+        would re-enter rotation serving stale weights)."""
+        with self._lock:
+            h = self._servers.get(addr)
+            return h is not None and h.state in _UPDATE_TARGETS
+
+    def is_continuation_target(self, addr: str) -> bool:
+        """Whether an IN-FLIGHT request (rid affinity / suffix-resume)
+        may stay on this server. Warming only gates NEW work: the
+        server is alive and holds the continuation's cached KV, and
+        rerouting it would burn a needless migration. Same alive set
+        as update targets (DRAINING refuses /generate, DEAD is gone)."""
+        with self._lock:
+            h = self._servers.get(addr)
+            return h is not None and h.state in _UPDATE_TARGETS
+
     def schedulable_addresses(self) -> List[str]:
         with self._lock:
             return [
@@ -262,6 +299,13 @@ class FleetMonitor:
             # a half-open failure re-opens the circuit immediately
             return self._transition(h, ServerState.DEAD)
         if (
+            h.state is ServerState.WARMING
+            and h.fails >= cfg.dead_threshold
+        ):
+            # a warming server that stops answering died mid-warmup;
+            # it was never in rotation, so no SUSPECT intermediate
+            return self._transition(h, ServerState.DEAD)
+        if (
             h.state is ServerState.HEALTHY
             and h.fails >= cfg.suspect_threshold
         ):
@@ -289,6 +333,25 @@ class FleetMonitor:
             # admission); a passive success is just in-flight work from
             # before the drain finishing, not a rejoin signal
             if from_probe:
+                self._transition(h, ServerState.HEALTHY)
+                return h.addr
+        elif h.state is ServerState.WARMING:
+            # only the server's own /health saying "ok" ends a warmup
+            # (a passive request success is pre-warming in-flight work);
+            # record the cold→serving lead and fire on_recover so the
+            # owner verifies it didn't miss weight updates while cold
+            if from_probe:
+                now = self._time()
+                if h.warming_since is not None:
+                    h.ready_lead_s = now - h.warming_since
+                    h.warming_since = None  # a later re-warm re-stamps
+                    self.cold_to_serving_total += 1
+                    self.last_cold_to_serving_s = h.ready_lead_s
+                    logger.info(
+                        f"{self.service} fleet: {h.addr} warm after "
+                        f"{h.ready_lead_s:.1f}s (coverage "
+                        f"{h.ladder_coverage:.2f})"
+                    )
                 self._transition(h, ServerState.HEALTHY)
                 return h.addr
         elif h.state is ServerState.SUSPECT:
@@ -377,12 +440,26 @@ class FleetMonitor:
                     h.max_num_seqs = load.get(
                         "max_num_seqs", h.max_num_seqs
                     )
+                if "ladder_coverage" in load:
+                    h.ladder_coverage = load["ladder_coverage"]
                 self.probes_total += 1
                 if status == "ok":
                     recovered = self._apply_success(h, from_probe=True)
                 elif status == "draining":
                     # server-initiated drain: out of rotation, no circuit
                     self._transition(h, ServerState.DRAINING)
+                elif status == "warming":
+                    # cold server mid-compile-storm: out of rotation
+                    # (but a weight-update target) until its own
+                    # /health says ok. A DEAD server that answers
+                    # "warming" is alive again — half-close through
+                    # WARMING rather than RECOVERING; draining wins
+                    # (the server is leaving regardless of warmth)
+                    if h.state is not ServerState.DRAINING:
+                        if h.warming_since is None:
+                            h.warming_since = self._time()
+                        h.fails = 0
+                        self._transition(h, ServerState.WARMING)
                 else:
                     self.probe_failures_total += 1
                     dead = self._apply_failure(h)
@@ -451,6 +528,18 @@ class FleetMonitor:
                 "fleet_draining_servers": float(
                     sum(s is ServerState.DRAINING for s in states)
                 ),
+                # cold-start plane (r11): servers still compiling their
+                # shape ladder, and the last measured warming→serving
+                # lead (the autoscaler's reaction-time truth)
+                "fleet_warming_servers": float(
+                    sum(s is ServerState.WARMING for s in states)
+                ),
+                "fleet_cold_to_serving_last_s": float(
+                    self.last_cold_to_serving_s
+                ),
+                "fleet_cold_to_serving_total": float(
+                    self.cold_to_serving_total
+                ),
                 # open circuits = DEAD; half-open = RECOVERING
                 "fleet_circuit_open": float(
                     sum(s is ServerState.DEAD for s in states)
@@ -483,6 +572,8 @@ class FleetMonitor:
                     "consecutive_failures": float(h.fails),
                     "running_requests": h.running_requests,
                     "queued_requests": h.queued_requests,
+                    "ladder_coverage": h.ladder_coverage,
+                    "ready_lead_s": h.ready_lead_s,
                 }
                 for a, h in self._servers.items()
             }
@@ -515,6 +606,8 @@ def scrape_server_load(addr: str, timeout: float = 5.0) -> Dict[str, float]:
         "queued": info.get("queued_requests", 0.0),
         "slots": info.get("max_num_seqs", 0.0),
         "draining": 1.0 if status == "draining" else 0.0,
+        "warming": 1.0 if status == "warming" else 0.0,
+        "ladder_coverage": info.get("ladder_coverage", -1.0),
         "kv_util": 0.0,
     }
     try:
@@ -580,6 +673,15 @@ class FleetAutoscaler:
         self.ups_total = 0
         self.downs_total = 0
         self.last_decision = "init"
+        # cold→serving lead accounting (r11): when a scale-up launched,
+        # which addresses are observed warming, and the measured lead
+        # from launch (or first-warming sight) to first ready
+        # observation — THE number that says whether elasticity reacts
+        # within a spike or after it
+        self._pending_launch_t: Optional[float] = None
+        self._warming_first: Dict[str, float] = {}
+        self.last_cold_to_serving_s = 0.0
+        self.cold_to_serving_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -595,10 +697,34 @@ class FleetAutoscaler:
                 obs[addr] = self._observe(addr)
             except Exception as e:
                 logger.warning(f"autoscaler observe {addr}: {e}")
+        # cold→serving lead: stamp addresses first seen warming (a
+        # fresh scale-up spawn inherits the launch time, so the lead
+        # covers process start + compile storm), record the lead when
+        # they cross to serving
+        for a, o in obs.items():
+            if o.get("warming"):
+                if a not in self._warming_first:
+                    t0, self._pending_launch_t = (
+                        self._pending_launch_t or now, None
+                    )
+                    self._warming_first[a] = t0
+            elif a in self._warming_first:
+                lead = now - self._warming_first.pop(a)
+                with self._lock:
+                    self.last_cold_to_serving_s = lead
+                    self.cold_to_serving_total += 1
+                logger.info(
+                    f"autoscaler: {a} cold→serving in {lead:.1f}s"
+                )
         # draining servers are capacity already leaving — they must not
-        # count toward the active fleet or be drained twice
+        # count toward the active fleet or be drained twice; warming
+        # servers are capacity still ARRIVING — they don't serve yet
+        # (don't dilute queued-per-server) but a pending warmup also
+        # must not trigger another launch
+        warming_n = sum(1 for o in obs.values() if o.get("warming"))
         active = {
-            a: o for a, o in obs.items() if not o.get("draining")
+            a: o for a, o in obs.items()
+            if not o.get("draining") and not o.get("warming")
         }
         n = len(active)
         with self._lock:
@@ -641,9 +767,16 @@ class FleetAutoscaler:
                 return None
             self._up_streak = self._up_streak + 1 if up else 0
             self._down_streak = self._down_streak + 1 if down else 0
+            if up and warming_n > 0:
+                # capacity is already on its way — judging the backlog
+                # again before the warmup lands would double-launch
+                self.last_decision = "warming_pending"
+                return None
             if (
                 up
                 and self._up_streak >= max(1, cfg.up_consecutive)
+                # warming_n is 0 here — the warming_pending guard above
+                # already returned while capacity was arriving
                 and n < cfg.max_servers
             ):
                 self.target_size = n + 1
@@ -651,6 +784,7 @@ class FleetAutoscaler:
                 self._last_action = now
                 self._up_streak = 0
                 self.last_decision = "up"
+                self._pending_launch_t = now
             elif (
                 down
                 and self._down_streak >= max(1, cfg.down_consecutive)
@@ -701,6 +835,14 @@ class FleetAutoscaler:
                 ),
                 "autoscale_up_total": float(self.ups_total),
                 "autoscale_down_total": float(self.downs_total),
+                # scale-up reaction time (r11): launch → first ready
+                # observation of the spawned server
+                "autoscale_cold_to_serving_s": float(
+                    self.last_cold_to_serving_s
+                ),
+                "autoscale_cold_to_serving_total": float(
+                    self.cold_to_serving_total
+                ),
             }
 
     # ------------------------------------------------------------------

@@ -54,10 +54,79 @@ from areal_tpu.utils.tracing import (
     RID_HEADER,
     TRACE_HEADER,
     SpanTracer,
+    register_metric_types,
     trace_response,
 )
 
 logger = logging_util.getLogger("Router")
+
+# router /metrics surface: HELP + explicit TYPE for every own-name the
+# router renders (fleet-shape gauges included — the FleetMonitor is
+# embedded here); the metrics-hygiene lint keeps this complete
+_METRIC_HELP = {
+    "version": "weight version the staleness gate admits against",
+    "running": "rollouts currently holding router capacity",
+    "accepted": "rollouts admitted by /allocate_rollout",
+    "finished": "rollouts returned via /finish_rollout",
+    "servers": "servers in the routing set",
+    "sched_total": "schedule decisions made",
+    "sched_affinity_hits": "schedules honoring any affinity",
+    "sched_rid_affinity_hits": "schedules honoring rid (resume) affinity",
+    "sched_qid_affinity_hits": "schedules honoring qid (group) affinity",
+    "affinity_hit_rate": "affinity hits / schedule decisions",
+    "qid_affinity_entries": "live qid→server affinity entries",
+    "failovers_total": "requests that hopped servers",
+    "requests_migrated_total": "failovers carrying accumulated tokens",
+    "tracing_dropped_spans_total": "router spans lost to ring overflow",
+    "sched_class_interactive_total": "interactive schedule decisions",
+    "sched_class_bulk_total": "bulk schedule decisions",
+    "sched_class_interactive_inflight": "interactive requests in flight",
+    "sched_class_bulk_inflight": "bulk requests in flight",
+    "requests_shed_total": "schedules shed with 429 + Retry-After",
+    "tenant_rejections_total": "schedules rejected by per-tenant caps",
+    "tenants_inflight": "tenants with live in-flight ledger entries",
+    "traffic_overload": "1 while the fleet backlog forces bulk shedding",
+    "fleet_target_size": "fleet size the control loop steers toward",
+    "autoscale_up_total": "autoscaler scale-up actions",
+    "autoscale_down_total": "autoscaler scale-down (drain) actions",
+    "autoscale_cold_to_serving_s": (
+        "last measured launch→serving lead of a scaled-up server"
+    ),
+    "autoscale_cold_to_serving_total": "cold→serving transitions timed",
+    "fleet_servers": "servers the fleet monitor tracks",
+    "fleet_healthy_servers": "servers in HEALTHY",
+    "fleet_suspect_servers": "servers in SUSPECT (still schedulable)",
+    "fleet_dead_servers": "servers with an open circuit (DEAD)",
+    "fleet_recovering_servers": "servers half-open (RECOVERING)",
+    "fleet_draining_servers": "servers draining out of rotation",
+    "fleet_warming_servers": "cold servers still compiling (WARMING)",
+    "fleet_cold_to_serving_last_s": (
+        "last measured warming→serving lead time"
+    ),
+    "fleet_cold_to_serving_total": "warming→serving transitions seen",
+    "fleet_circuit_open": "open circuits (= DEAD servers)",
+    "fleet_circuit_half_open": "half-open circuits (= RECOVERING)",
+    "fleet_probes_total": "health probes sent",
+    "fleet_probe_failures_total": "health probes that failed",
+    "fleet_probe_latency_s": "per-server /health probe latency",
+    "fleet_server_up": "1 while the labeled server is schedulable",
+}
+_ROUTER_COUNTERS = (
+    "accepted", "finished", "sched_total", "sched_affinity_hits",
+    "sched_rid_affinity_hits", "sched_qid_affinity_hits",
+    "failovers_total", "requests_migrated_total",
+    "tracing_dropped_spans_total", "sched_class_interactive_total",
+    "sched_class_bulk_total", "requests_shed_total",
+    "tenant_rejections_total", "autoscale_up_total",
+    "autoscale_down_total", "autoscale_cold_to_serving_total",
+    "fleet_cold_to_serving_total", "fleet_probes_total",
+    "fleet_probe_failures_total",
+)
+_METRIC_TYPES = {
+    n: ("counter" if n in _ROUTER_COUNTERS else "gauge")
+    for n in _METRIC_HELP
+}
+register_metric_types(_METRIC_TYPES)
 
 
 class RouterState:
@@ -233,6 +302,17 @@ class RouterState:
     def _schedulable(self, addr: str) -> bool:
         return self.fleet is None or self.fleet.is_schedulable(addr)
 
+    def _continuation_ok(self, addr: str) -> bool:
+        """Sticky/affinity targets for IN-FLIGHT requests: a WARMING
+        server still serves the chunks it already holds KV for —
+        rerouting a continuation off it would force a migration for a
+        server that is merely compiling (r11)."""
+        if addr not in self._requests:
+            return False
+        if self.fleet is None:
+            return True
+        return self.fleet.is_continuation_target(addr)
+
     def schedule(self, meta: Dict) -> Dict:
         t0 = time.monotonic()
         out = self._schedule(meta)
@@ -325,8 +405,11 @@ class RouterState:
                 and int(meta.get("previous_version", -1)) == self.version
             ):
                 # sticky while the version is unchanged (interruptible
-                # resubmits reuse the server's cached prefix)
-                if prev in cset:
+                # resubmits reuse the server's cached prefix); a WARMING
+                # target still honors the continuation (it holds the KV)
+                if prev in cset or (
+                    prev not in excl and self._continuation_ok(prev)
+                ):
                     self.sched_affinity_hits += 1
                     self.sched_rid_affinity_hits += 1
                     return {"url": prev, "version": self.version}
@@ -620,22 +703,11 @@ class RouterState:
         if self.fleet is not None:
             own.update(self.fleet.state_metrics())
         lines = [
+            # TYPEs come from the explicit process registry (the module
+            # header registers every router/fleet name)
             render_prometheus(
                 own, prefix="areal_tpu_router_",
-                types={
-                    "sched_total": "counter",
-                    "sched_affinity_hits": "counter",
-                    "sched_rid_affinity_hits": "counter",
-                    "sched_qid_affinity_hits": "counter",
-                    "sched_class_interactive_total": "counter",
-                    "sched_class_bulk_total": "counter",
-                    "requests_shed_total": "counter",
-                    "tenant_rejections_total": "counter",
-                    "failovers_total": "counter",
-                    "requests_migrated_total": "counter",
-                    "fleet_probes_total": "counter",
-                    "fleet_probe_failures_total": "counter",
-                },
+                help_text=_METRIC_HELP,
             ).rstrip("\n")
         ]
         if self.fleet is not None:
@@ -665,7 +737,14 @@ class RouterState:
                     if not line or line.startswith("#"):
                         continue  # per-server HELP/TYPE preambles
                     k, v = line.rsplit(" ", 1)
-                    lines.append(f'{k}{{server="{tag}"}} {v}')
+                    if k.endswith("}"):
+                        # native-histogram samples already carry labels
+                        # (le=, sched_class=): merge the server label in
+                        # rather than appending a second label set
+                        k = f'{k[:-1]},server="{tag}"}}'
+                        lines.append(f"{k} {v}")
+                    else:
+                        lines.append(f'{k}{{server="{tag}"}} {v}')
             except Exception as e:
                 logger.warning(f"metrics scrape {addr}: {e}")
         return "\n".join(lines) + "\n"

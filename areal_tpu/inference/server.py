@@ -52,6 +52,7 @@ from areal_tpu.utils import name_resolve
 from areal_tpu.utils.tracing import (
     RID_HEADER,
     TRACE_HEADER,
+    register_metric_types,
     render_prometheus,
     trace_response,
 )
@@ -104,12 +105,60 @@ class ServerControl:
 _METRIC_HELP = {
     "running_requests": "requests currently holding a decode slot",
     "queued_requests": "requests admitted but not yet running",
+    "free_slots": "decode slots currently unoccupied",
+    "free_pages": "KV pool pages currently unallocated",
     "kv_page_utilization": "fraction of the paged KV pool in use",
+    "registry_entries": "prefix-cache entries currently parked",
     "decode_tokens_per_sec": "EWMA decode throughput",
     "prefill_tokens_per_sec": "EWMA prefill throughput",
+    "decode_rows_dispatched": "rows the last decode chunk dispatched",
+    "decode_rows_active": "rows carrying live requests in the last chunk",
+    "decode_occupancy": "lifetime active/dispatched decode-row ratio",
+    "total_decode_chunks": "decode chunks dispatched",
+    "total_rows_dispatched": "decode rows dispatched (lifetime)",
+    "total_rows_active": "decode rows that carried live requests",
+    "total_generated_tokens": "completion tokens emitted",
+    "total_prompt_tokens": "prompt tokens admitted",
+    "total_cached_prompt_tokens": "prompt tokens served from cached KV",
+    "total_requests": "requests admitted to a decode slot",
+    "total_aborted": "requests aborted (pause windows)",
     "total_preemptions": "requests preempted under pool pressure",
     "model_version": "weight version currently being served",
     "paused": "1 while generation is paused for a weight update",
+    # goodput attribution plane (r11): exclusive wall-time buckets —
+    # fractions sum to 1.0 of observed wall so nothing hides
+    "goodput_prefill_frac": "fraction of wall time in prefill dispatches",
+    "goodput_decode_frac": "fraction of wall time in decode dispatches",
+    "goodput_spec_verify_frac": (
+        "fraction of wall time in speculative verify dispatches"
+    ),
+    "goodput_weight_pause_frac": (
+        "fraction of wall time paused for weight updates"
+    ),
+    "goodput_compile_frac": "fraction of wall time in XLA compilation",
+    "goodput_idle_frac": "fraction of wall time with no work",
+    "goodput_duty_cycle": (
+        "productive fraction of wall time (prefill + decode + verify)"
+    ),
+    "goodput_effective_tokens_per_sec": (
+        "delivered tokens over total observed wall time"
+    ),
+    "goodput_wall_s": "observed wall seconds since the ledger started",
+    # recompile attribution (r11)
+    "compile_events_total": "XLA backend compilations observed",
+    "compile_seconds_total": "wall seconds spent in XLA compilation",
+    "compiled_shapes": "distinct (phase, shape signature) programs compiled",
+    "shape_ladder_size": "estimated programs for a fully-warm engine",
+    "shape_ladder_coverage": "compiled shapes / ladder size (0..1)",
+    "server_ready": "1 once warm (ladder covered or compile-quiet)",
+    # native latency histograms (per sched class)
+    "queue_wait_seconds": (
+        "submit-to-prefill wait per scheduling class (histogram)"
+    ),
+    "ttft_seconds": "submit-to-first-token latency per class (histogram)",
+    "request_latency_seconds": (
+        "submit-to-finish latency per class (histogram)"
+    ),
     # speculative decoding (present only when spec is configured)
     "spec_enabled": "1 while speculation is active (0 = auto-disabled)",
     "spec_accept_rate": "lifetime accepted/drafted speculative tokens",
@@ -165,6 +214,51 @@ _METRIC_HELP = {
         "spans lost to ring-buffer overflow (the trace is truncated)"
     ),
 }
+
+# explicit metric-type registry for the engine surface: every name the
+# engine emits declares its Prometheus TYPE here (registered globally so
+# render_prometheus never falls back to the name-suffix heuristic — the
+# metrics-hygiene lint enforces full coverage)
+_ENGINE_COUNTERS = (
+    "total_decode_chunks", "total_rows_dispatched", "total_rows_active",
+    "total_generated_tokens", "total_prompt_tokens",
+    "total_cached_prompt_tokens", "total_requests", "total_aborted",
+    "total_preemptions", "prefix_cached_tokens_total",
+    "prefix_cow_copies_total", "prefix_evicted_pages_total",
+    "requests_shed_total", "deadline_preemptions_total",
+    "deadline_misses_total", "tracing_dropped_spans_total",
+    "sched_class_interactive_submitted_total",
+    "sched_class_bulk_submitted_total",
+    "spec_chunks_total", "spec_draft_tokens_total",
+    "spec_accepted_tokens_total",
+    "compile_events_total", "compile_seconds_total",
+)
+_ENGINE_HISTOGRAMS = (
+    "queue_wait_seconds", "ttft_seconds", "request_latency_seconds",
+)
+_ENGINE_GAUGES = (
+    "running_requests", "queued_requests", "free_slots", "free_pages",
+    "kv_page_utilization", "registry_entries", "decode_tokens_per_sec",
+    "prefill_tokens_per_sec", "decode_rows_dispatched",
+    "decode_rows_active", "decode_occupancy", "prefix_cache_hit_rate",
+    "prefix_claim_hit_rate", "prefix_cache_nodes", "prefix_cache_pages",
+    "model_version", "paused", "trace_spans",
+    "sched_class_interactive_running", "sched_class_bulk_running",
+    "sched_class_interactive_queued", "sched_class_bulk_queued",
+    "spec_enabled", "spec_accept_rate", "spec_accept_rate_ewma",
+    "goodput_prefill_frac", "goodput_decode_frac",
+    "goodput_spec_verify_frac", "goodput_weight_pause_frac",
+    "goodput_compile_frac", "goodput_idle_frac", "goodput_duty_cycle",
+    "goodput_effective_tokens_per_sec", "goodput_wall_s",
+    "compiled_shapes", "shape_ladder_size", "shape_ladder_coverage",
+    "server_ready",
+)
+_METRIC_TYPES = {
+    **{n: "counter" for n in _ENGINE_COUNTERS},
+    **{n: "gauge" for n in _ENGINE_GAUGES},
+    **{n: "histogram" for n in _ENGINE_HISTOGRAMS},
+}
+register_metric_types(_METRIC_TYPES)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -225,6 +319,21 @@ class _Handler(BaseHTTPRequestHandler):
                 and self.control.draining.is_set()
             )
             body = {"status": "draining" if draining else "ok"}
+            # readiness (r11): a cold engine reports "warming" with its
+            # shape-ladder coverage + warmup ETA until the compile storm
+            # quiets — FleetMonitor classifies WARMING out of rotation,
+            # the autoscaler times cold→serving from it (drain wins:
+            # a draining server is leaving regardless of warmth)
+            if hasattr(eng, "readiness"):
+                try:
+                    rd = eng.readiness()
+                    body["ladder_coverage"] = rd["ladder_coverage"]
+                    if rd["state"] == "warming":
+                        body["warmup_eta_s"] = rd["warmup_eta_s"]
+                        if not draining:
+                            body["status"] = "warming"
+                except Exception:
+                    pass
             try:
                 # load view for the router map and the autoscaler:
                 # running vs queued SEPARATELY — a busy decode and a
@@ -248,9 +357,14 @@ class _Handler(BaseHTTPRequestHandler):
                 }
             )
         elif url.path == "/metrics":
+            hists = (
+                eng.latency_histograms()
+                if hasattr(eng, "latency_histograms")
+                else None
+            )
             body = render_prometheus(
                 eng.metrics(), prefix="areal_tpu_gen_",
-                help_text=_METRIC_HELP,
+                help_text=_METRIC_HELP, histograms=hists,
             ).encode()
             self._send_text(body, "text/plain; version=0.0.4")
         elif url.path == "/trace":
@@ -503,6 +617,26 @@ def main(argv: Optional[list] = None):
         "is within this many seconds of its soft deadline",
     )
     p.add_argument(
+        "--ready-quiet", type=float, default=3.0,
+        help="report /health warming until this many seconds pass "
+        "without an XLA compile (or the shape ladder is covered)",
+    )
+    p.add_argument(
+        "--ready-min-requests", type=int, default=1,
+        help="completed requests that latch the server ready even "
+        "while incremental shapes still compile (<= 0 disables)",
+    )
+    p.add_argument(
+        "--compile-events", default="",
+        help="append one JSONL line per XLA compile (phase + shape "
+        "signature + duration) — the AOT precompiler's input",
+    )
+    p.add_argument(
+        "--goodput-jsonl", default="",
+        help="append goodput ledger snapshots (bucket fractions, duty "
+        "cycle, effective tok/s) to this JSONL stream",
+    )
+    p.add_argument(
         "--router-addr", default="",
         help="router host:port to POST /register to at startup "
         "(dynamic fleet membership without shared name_resolve)",
@@ -540,6 +674,10 @@ def main(argv: Optional[list] = None):
         deadline_margin_s=args.deadline_margin,
     )
     cfg.tracing.enabled = args.trace
+    cfg.goodput.ready_quiet_s = args.ready_quiet
+    cfg.goodput.ready_min_requests = args.ready_min_requests
+    cfg.goodput.compile_events_path = args.compile_events
+    cfg.goodput.jsonl_path = args.goodput_jsonl
     cfg.spec.enabled = args.spec
     if args.spec:
         cfg.spec.max_draft = args.spec_max_draft

@@ -45,7 +45,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from areal_tpu.utils import chaos, name_resolve, names, telemetry
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils.http import HttpRequestError, request_with_retry
-from areal_tpu.utils.tracing import trace_headers
+from areal_tpu.utils.tracing import register_metric_types, trace_headers
 
 logger = logging_util.getLogger("verifier_service")
 
@@ -110,6 +110,12 @@ _METRIC_HELP = {
     "busy_workers": "sandbox slots currently occupied",
     "draining": "1 while this worker is draining",
 }
+register_metric_types(
+    {
+        n: ("counter" if n.endswith("_total") else "gauge")
+        for n in _METRIC_HELP
+    }
+)
 
 
 def serve_verifier(

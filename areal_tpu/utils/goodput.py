@@ -1,0 +1,515 @@
+"""Goodput attribution plane: wall-clock ledgers + recompile attribution.
+
+The two biggest perf items on the roadmap (zero-pause weight updates,
+cold-start elimination) are blocked on *measurement*, not mechanism —
+before a cost can be eliminated it must be a first-class, continuously
+exported signal. This module accounts for every second of wall time on
+both sides of the system:
+
+1. :class:`GoodputLedger` — segments an owning loop's wall time into
+   named EXCLUSIVE buckets. The trainer step loop uses
+   ``TRAINER_BUCKETS`` (``rollout_wait`` / ``weight_push`` / ``compile``
+   / ``data_h2d`` / ``fwd_bwd`` / ``optim`` / ``checkpoint`` /
+   ``other``); the inference engine loop uses ``ENGINE_BUCKETS``
+   (``prefill`` / ``decode`` / ``spec_verify`` / ``weight_pause`` /
+   ``compile`` / ``idle``). Whatever no bucket claims lands in the
+   remainder bucket (``other`` / ``idle``), so per-bucket fractions sum
+   to 1.0 of observed wall time BY CONSTRUCTION — nothing hides.
+   ``bucket()`` contexts are reentrancy-safe per thread (the outermost
+   wins; nested entries are no-ops), which lets every layer self-wrap
+   without double counting when a caller already opened a bucket.
+
+2. :class:`CompileTracker` — every XLA compilation is recorded with the
+   dispatch that triggered it. A ``jax.monitoring`` listener (installed
+   once per process) attributes ``/jax/core/compile/*`` event durations
+   to the thread's current :func:`dispatch_scope` (phase + shape
+   signature, e.g. ``rows8|steps8|pps16``), appends one line per
+   backend compile to a ``compile_events.jsonl`` stream — the exact
+   input a shape-ladder AOT precompiler consumes — and feeds the
+   ``shape_ladder_coverage`` gauge (compiled shapes / ladder size) that
+   drives server readiness (``warming`` vs ``ready`` on ``/health``).
+
+   A ledger constructed with a ``compile_tracker`` CARVES compile time
+   out of whatever bucket it occurred in and credits it to the
+   ``compile`` bucket: a prefill dispatch that spent 4 s compiling and
+   40 ms running books 4 s of ``compile`` and 40 ms of ``prefill``.
+
+The trainer side is wired through a process singleton
+(:func:`trainer_ledger` / :func:`trainer_bucket`) because the step loop
+spans many layers (workflow executor, SPMD engine, recover handler)
+that should not all thread a ledger handle through their APIs.
+"""
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("goodput")
+
+# trainer step loop: what the wall clock of one training process buys
+TRAINER_BUCKETS = (
+    "rollout_wait", "weight_push", "compile", "data_h2d", "fwd_bwd",
+    "optim", "checkpoint", "other",
+)
+# inference engine loop: what a generation server's wall clock buys
+ENGINE_BUCKETS = (
+    "prefill", "decode", "spec_verify", "weight_pause", "compile", "idle",
+)
+# buckets counted as productive for the duty-cycle gauge
+TRAINER_PRODUCTIVE = ("data_h2d", "fwd_bwd", "optim")
+ENGINE_PRODUCTIVE = ("prefill", "decode", "spec_verify")
+
+# jax.monitoring event prefix for XLA compilation phases; the
+# backend-compile event is the one counted as "a compile happened"
+_COMPILE_EVENT_PREFIX = "/jax/core/compile"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+# --------------------------------------------------------------------------
+# Compile attribution
+# --------------------------------------------------------------------------
+class _ScopeState(threading.local):
+    """Per-thread dispatch context consumed by the monitoring listener."""
+
+    def __init__(self):
+        self.stack: List[Tuple["CompileTracker", str, str]] = []
+        self.default: Optional[Tuple["CompileTracker", str]] = None
+
+
+_TLS = _ScopeState()
+_LISTENER_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def _on_monitoring_event(event: str, duration: float, **kw) -> None:
+    if not event.startswith(_COMPILE_EVENT_PREFIX):
+        return
+    if _TLS.stack:
+        tracker, phase, signature = _TLS.stack[-1]
+    elif _TLS.default is not None:
+        tracker, phase = _TLS.default
+        signature = ""
+    else:
+        return
+    tracker._observe(phase, signature, float(duration), event)
+
+
+def _install_listener() -> bool:
+    """Register the process-wide jax.monitoring listener (idempotent).
+    Returns False when jax is unavailable — the tracker then only sees
+    durations fed to it directly (unit tests, stub environments)."""
+    global _LISTENER_INSTALLED
+    with _LISTENER_LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover - jax is a baked-in dep
+            return False
+        monitoring.register_event_duration_secs_listener(
+            _on_monitoring_event
+        )
+        _LISTENER_INSTALLED = True
+        return True
+
+
+class _DispatchScope:
+    __slots__ = ("_entry",)
+
+    def __init__(self, entry):
+        self._entry = entry
+
+    def __enter__(self):
+        _TLS.stack.append(self._entry)
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.stack.pop()
+        return False
+
+
+def dispatch_scope(
+    tracker: "CompileTracker", phase: str, signature: str = ""
+) -> _DispatchScope:
+    """Tag the current thread's dispatches: any XLA compile fired while
+    the scope is open is attributed to ``(phase, signature)``."""
+    return _DispatchScope((tracker, phase, signature))
+
+
+def set_thread_tracker(
+    tracker: Optional["CompileTracker"], phase: str = "untagged"
+) -> None:
+    """Fallback attribution for this thread: compiles fired OUTSIDE any
+    dispatch_scope still land on ``tracker`` (signature empty) instead
+    of vanishing. The engine loop thread sets this once at start."""
+    _TLS.default = None if tracker is None else (tracker, phase)
+
+
+class CompileTracker:
+    """Per-owner recompile ledger fed by the jax.monitoring listener.
+
+    Tracks total compiles / compile seconds, a per-``(phase, signature)``
+    breakdown (the shape ladder actually paid for), per-thread compile
+    seconds (the ledger carve-out input), and optionally appends one
+    JSONL line per backend compile to ``events_path``."""
+
+    def __init__(
+        self,
+        events_path: str = "",
+        ladder_size: int = 0,
+        time_fn=time.monotonic,
+    ):
+        self.events_path = events_path
+        # expected distinct (phase, signature) programs for a fully-warm
+        # owner; 0 = unknown (coverage reports 0 and readiness falls
+        # back to the compile-quiet rule alone)
+        self.ladder_size = int(ladder_size)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self.compiles_total = 0
+        self.compile_seconds_total = 0.0
+        # (phase, signature) -> {"count", "seconds"}
+        self.signatures: Dict[Tuple[str, str], Dict[str, float]] = {}
+        self.last_compile_t: Optional[float] = None
+        self._thread_seconds: Dict[int, float] = {}
+        self._epoch_unix = time.time()
+        self._epoch_mono = time.monotonic()
+        _install_listener()
+
+    # -- ingestion -----------------------------------------------------
+    def _observe(
+        self, phase: str, signature: str, duration: float, event: str
+    ) -> None:
+        tid = threading.get_ident()
+        is_backend = event == _BACKEND_COMPILE_EVENT
+        with self._lock:
+            self.compile_seconds_total += duration
+            self.last_compile_t = self._time()
+            self._thread_seconds[tid] = (
+                self._thread_seconds.get(tid, 0.0) + duration
+            )
+            if is_backend:
+                self.compiles_total += 1
+                sig = self.signatures.setdefault(
+                    (phase, signature), {"count": 0, "seconds": 0.0}
+                )
+                sig["count"] += 1
+            else:
+                sig = self.signatures.get((phase, signature))
+            if sig is not None:
+                sig["seconds"] += duration
+        if is_backend and self.events_path:
+            rec = {
+                "kind": "compile",
+                "ts_unix": self._epoch_unix
+                + (time.monotonic() - self._epoch_mono),
+                "phase": phase,
+                "signature": signature,
+                "duration_s": round(duration, 6),
+                "event": event,
+            }
+            try:
+                with open(self.events_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError as e:  # attribution must never kill the owner
+                logger.warning(
+                    f"compile event append to {self.events_path} "
+                    f"failed: {e}"
+                )
+
+    # -- carve-out support ---------------------------------------------
+    def thread_seconds(self) -> float:
+        """Cumulative compile seconds observed on THIS thread (the
+        ledger bucket carve-out reads the delta across its window)."""
+        with self._lock:
+            return self._thread_seconds.get(threading.get_ident(), 0.0)
+
+    # -- derived gauges ------------------------------------------------
+    def compiled_shapes(self) -> int:
+        with self._lock:
+            return len(self.signatures)
+
+    def coverage(self) -> float:
+        """Compiled distinct shapes / ladder size, clamped to [0, 1].
+        0 when the ladder size is unknown."""
+        if self.ladder_size <= 0:
+            return 0.0
+        return min(1.0, self.compiled_shapes() / self.ladder_size)
+
+    def mean_compile_s(self) -> float:
+        with self._lock:
+            if not self.compiles_total:
+                return 0.0
+            return self.compile_seconds_total / self.compiles_total
+
+    def warmup_eta_s(self) -> float:
+        """Estimated seconds of compilation left to full ladder
+        coverage (remaining shapes x mean observed compile time)."""
+        if self.ladder_size <= 0:
+            return 0.0
+        remaining = max(0, self.ladder_size - self.compiled_shapes())
+        return round(remaining * self.mean_compile_s(), 3)
+
+    def quiet_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last observed compile (inf if none yet)."""
+        with self._lock:
+            last = self.last_compile_t
+        if last is None:
+            return float("inf")
+        return (now if now is not None else self._time()) - last
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "compile_events_total": float(self.compiles_total),
+                "compile_seconds_total": round(
+                    self.compile_seconds_total, 4
+                ),
+                "compiled_shapes": float(len(self.signatures)),
+                "shape_ladder_size": float(self.ladder_size),
+            }
+        out["shape_ladder_coverage"] = round(self.coverage(), 4)
+        return out
+
+    def signature_table(self, top: int = 0) -> List[Dict[str, Any]]:
+        """Per-shape compile bill, most expensive first — what the AOT
+        precompiler (and ``trace_report --goodput``) consume."""
+        with self._lock:
+            rows = [
+                {
+                    "phase": ph,
+                    "signature": sig,
+                    "count": int(v["count"]),
+                    "seconds": round(v["seconds"], 4),
+                }
+                for (ph, sig), v in self.signatures.items()
+            ]
+        rows.sort(key=lambda r: -r["seconds"])
+        return rows[:top] if top else rows
+
+
+# --------------------------------------------------------------------------
+# Wall-clock ledger
+# --------------------------------------------------------------------------
+class _LedgerTLS(threading.local):
+    depth = 0
+
+
+class _BucketCtx:
+    __slots__ = ("_ledger", "_name", "_t0", "_c0", "_outer")
+
+    def __init__(self, ledger: "GoodputLedger", name: str):
+        self._ledger = ledger
+        self._name = name
+
+    def __enter__(self):
+        led = self._ledger
+        self._outer = led._tls.depth == 0
+        led._tls.depth += 1
+        if self._outer:
+            self._t0 = led._time()
+            tr = led.compile_tracker
+            self._c0 = tr.thread_seconds() if tr is not None else 0.0
+        return self
+
+    def __exit__(self, *exc):
+        led = self._ledger
+        led._tls.depth -= 1
+        if self._outer:
+            dt = led._time() - self._t0
+            dc = 0.0
+            tr = led.compile_tracker
+            if tr is not None and "compile" in led._acc:
+                dc = max(0.0, min(dt, tr.thread_seconds() - self._c0))
+            with led._lock:
+                if dc:
+                    led._acc["compile"] += dc
+                led._acc[self._name] += dt - dc
+        return False
+
+
+class GoodputLedger:
+    """Exclusive wall-time bucket accounting for one owning loop.
+
+    ``bucket(name)`` measures its body into ``name`` (compile time
+    observed on the same thread is carved out into ``compile`` when a
+    tracker is attached). Reentrant entries on the same thread are
+    no-ops — the outermost bucket wins — so layered code can self-wrap
+    freely. ``fractions()`` divides by observed wall time since the
+    ledger started, with the remainder bucket absorbing unclaimed time:
+    the fractions sum to 1.0 by construction."""
+
+    def __init__(
+        self,
+        role: str,
+        buckets: Tuple[str, ...],
+        remainder: str = "other",
+        productive: Tuple[str, ...] = (),
+        jsonl_path: str = "",
+        compile_tracker: Optional[CompileTracker] = None,
+        time_fn=time.monotonic,
+    ):
+        if remainder not in buckets:
+            raise ValueError(
+                f"remainder bucket {remainder!r} must be one of {buckets}"
+            )
+        self.role = role
+        self.buckets = tuple(buckets)
+        self.remainder = remainder
+        self.productive = tuple(productive)
+        self.jsonl_path = jsonl_path
+        self.compile_tracker = compile_tracker
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._tls = _LedgerTLS()
+        self._t_start = time_fn()
+        self._acc: Dict[str, float] = {b: 0.0 for b in buckets}
+        self._tokens = 0
+        self._epoch_unix = time.time()
+
+    # -- recording -----------------------------------------------------
+    def bucket(self, name: str) -> _BucketCtx:
+        if name not in self._acc:
+            raise KeyError(f"unknown goodput bucket {name!r}")
+        return _BucketCtx(self, name)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Direct credit (for windows measured elsewhere)."""
+        with self._lock:
+            self._acc[name] += max(0.0, float(seconds))
+
+    def note_tokens(self, n: int) -> None:
+        """Count delivered tokens toward effective tok/s."""
+        with self._lock:
+            self._tokens += int(n)
+
+    # -- derived views -------------------------------------------------
+    def observed_wall_s(self) -> float:
+        return max(1e-9, self._time() - self._t_start)
+
+    def seconds(self) -> Dict[str, float]:
+        """Per-bucket seconds INCLUDING the remainder: unclaimed wall
+        time goes to the remainder bucket (clamped at 0 if concurrent
+        threads over-account a window)."""
+        wall = self.observed_wall_s()
+        with self._lock:
+            acc = dict(self._acc)
+        claimed = sum(v for b, v in acc.items() if b != self.remainder)
+        acc[self.remainder] += max(0.0, wall - claimed - acc[self.remainder])
+        return acc
+
+    def fractions(self) -> Dict[str, float]:
+        wall = self.observed_wall_s()
+        return {b: v / wall for b, v in self.seconds().items()}
+
+    def duty_cycle(self) -> float:
+        fr = self.fractions()
+        return sum(fr.get(b, 0.0) for b in self.productive)
+
+    def effective_tokens_per_sec(self) -> float:
+        with self._lock:
+            tokens = self._tokens
+        return tokens / self.observed_wall_s()
+
+    def metrics(self, prefix: str = "goodput_") -> Dict[str, float]:
+        out = {
+            f"{prefix}{b}_frac": round(v, 4)
+            for b, v in self.fractions().items()
+        }
+        out[f"{prefix}duty_cycle"] = round(self.duty_cycle(), 4)
+        out[f"{prefix}effective_tokens_per_sec"] = round(
+            self.effective_tokens_per_sec(), 2
+        )
+        out[f"{prefix}wall_s"] = round(self.observed_wall_s(), 3)
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One self-describing record (the JSONL stream line format)."""
+        secs = self.seconds()
+        wall = self.observed_wall_s()
+        with self._lock:
+            tokens = self._tokens
+        return {
+            "kind": "goodput",
+            "role": self.role,
+            "ts_unix": round(
+                self._epoch_unix + (self._time() - self._t_start), 3
+            ),
+            "wall_s": round(wall, 3),
+            "seconds": {b: round(v, 4) for b, v in secs.items()},
+            "fractions": {b: round(v / wall, 4) for b, v in secs.items()},
+            "duty_cycle": round(self.duty_cycle(), 4),
+            "tokens": tokens,
+            "effective_tokens_per_sec": round(tokens / wall, 2),
+        }
+
+    def export_jsonl(self, path: Optional[str] = None) -> None:
+        path = path or self.jsonl_path
+        if not path:
+            return
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(self.snapshot()) + "\n")
+        except OSError as e:  # the ledger must never kill its owner
+            logger.warning(f"goodput append to {path} failed: {e}")
+
+
+# --------------------------------------------------------------------------
+# Trainer-side process singleton
+# --------------------------------------------------------------------------
+# RLock: trainer_ledger() constructs with trainer_tracker() under the
+# same guard
+_TRAINER_LOCK = threading.RLock()
+_TRAINER: Optional[GoodputLedger] = None
+_TRAINER_TRACKER: Optional[CompileTracker] = None
+
+
+def trainer_tracker() -> CompileTracker:
+    global _TRAINER_TRACKER
+    with _TRAINER_LOCK:
+        if _TRAINER_TRACKER is None:
+            _TRAINER_TRACKER = CompileTracker()
+        return _TRAINER_TRACKER
+
+
+def trainer_ledger() -> GoodputLedger:
+    """The process's trainer-side ledger (created on first use; the
+    observation window starts then). Layers wrap their own work in
+    :func:`trainer_bucket` — reentrancy makes nesting safe — and the
+    step-loop owner exports per-step snapshots."""
+    global _TRAINER
+    with _TRAINER_LOCK:
+        if _TRAINER is None:
+            _TRAINER = GoodputLedger(
+                "trainer", TRAINER_BUCKETS, remainder="other",
+                productive=TRAINER_PRODUCTIVE,
+                compile_tracker=trainer_tracker(),
+            )
+        return _TRAINER
+
+
+def trainer_bucket(name: str) -> _BucketCtx:
+    return trainer_ledger().bucket(name)
+
+
+def configure_trainer(
+    jsonl_path: str = "", compile_events_path: str = ""
+) -> GoodputLedger:
+    """Attach export paths to the trainer singleton (idempotent)."""
+    led = trainer_ledger()
+    if jsonl_path:
+        led.jsonl_path = jsonl_path
+    if compile_events_path:
+        trainer_tracker().events_path = compile_events_path
+    return led
+
+
+def reset_trainer_ledger() -> None:
+    """Drop the singleton (tests; a fresh window starts on next use)."""
+    global _TRAINER, _TRAINER_TRACKER
+    with _TRAINER_LOCK:
+        _TRAINER = None
+        _TRAINER_TRACKER = None

@@ -219,6 +219,25 @@ class RecoverHandler:
             return False
         if not force and not self.freq_ctl.check(epochs=0, steps=1):
             return False
+        from areal_tpu.utils import goodput
+
+        with goodput.trainer_bucket("checkpoint"):
+            return self._dump(
+                engine, step_info, saver=saver, evaluator=evaluator,
+                dataloader=dataloader, inference_engine=inference_engine,
+                extra=extra,
+            )
+
+    def _dump(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        inference_engine=None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> bool:
         import jax
 
         t_start = time.monotonic()

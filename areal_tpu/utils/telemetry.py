@@ -49,9 +49,12 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from areal_tpu.api.cli_args import TelemetryConfig
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils.tracing import (
+    Histogram,
     SpanTracer,
     new_trace_id,
     parse_prometheus,
+    parse_prometheus_histograms,
+    register_metric_types,
     render_prometheus,
 )
 
@@ -461,11 +464,17 @@ def stitch_chrome_traces(
 # --------------------------------------------------------------------------
 # Telemetry hub
 # --------------------------------------------------------------------------
-def _default_fetch_metrics(addr: str, timeout: float) -> Dict[str, float]:
+def _default_fetch_metrics(addr: str, timeout: float):
+    """One scrape: (flat metrics, native histograms). Injected fetchers
+    may return just the flat dict — scrape_once tolerates both."""
     with urllib.request.urlopen(
         f"http://{addr}/metrics", timeout=timeout
     ) as r:
-        return parse_prometheus(r.read().decode(), prefix="areal_tpu_gen_")
+        text = r.read().decode()
+    return (
+        parse_prometheus(text, prefix="areal_tpu_gen_"),
+        parse_prometheus_histograms(text, prefix="areal_tpu_gen_"),
+    )
 
 
 def _default_fetch_trace(
@@ -483,12 +492,15 @@ def _default_fetch_trace(
 
 class _ServerScrape:
     __slots__ = (
-        "metrics", "ok", "stall_scrapes", "scrape_failures", "spans",
-        "epoch", "dropped_spans",
+        "metrics", "hists", "ok", "stall_scrapes", "scrape_failures",
+        "spans", "epoch", "dropped_spans",
     )
 
     def __init__(self, span_window: int):
         self.metrics: Dict[str, float] = {}
+        # native latency histograms from the last sweep (series key →
+        # Histogram) — the durable latency source the rollup merges
+        self.hists: Dict[str, Histogram] = {}
         self.ok = False  # last sweep reached the server
         self.stall_scrapes = 0  # consecutive decode-stall observations
         self.scrape_failures = 0
@@ -497,6 +509,91 @@ class _ServerScrape:
         self.dropped_spans = 0
 
 
+# hub /metrics surface: HELP text + explicit TYPE for every rollup name
+# (the metrics-hygiene lint keeps this complete)
+_FLEET_METRIC_HELP = {
+    "servers_total": "servers in the scrape set",
+    "servers_scraped": "servers reached on the last sweep",
+    "scrapes_total": "scrape sweeps completed",
+    "scrape_failures_total": "per-server scrape failures",
+    "running_requests": "fleet-summed requests holding decode slots",
+    "queued_requests": "fleet-summed admitted-but-not-running requests",
+    "decode_tokens_per_sec": "fleet-summed EWMA decode throughput",
+    "prefill_tokens_per_sec": "fleet-summed EWMA prefill throughput",
+    "generated_tokens_total": "fleet-summed completion tokens",
+    "preemptions_total": "fleet-summed pool-pressure preemptions",
+    "kv_page_utilization_mean": "mean KV pool utilization across servers",
+    "kv_page_utilization_max": "max KV pool utilization across servers",
+    "queue_wait_p50_s": "fleet queue-wait p50 (histograms when present)",
+    "queue_wait_p95_s": "fleet queue-wait p95 (histograms when present)",
+    "queue_wait_samples": "queue-wait observations behind the percentiles",
+    "tracing_dropped_spans_total": "spans lost to ring overflow fleetwide",
+    "spec_enabled_servers": "servers with speculation currently active",
+    "spec_draft_tokens_total": "fleet-summed speculative draft tokens",
+    "spec_accepted_tokens_total": "fleet-summed accepted draft tokens",
+    "spec_accept_rate": "fleet accepted/drafted ratio",
+    "staleness_p50": "median staleness-at-consumption (versions)",
+    "staleness_max": "max staleness-at-consumption (versions)",
+    "staleness_samples": "consumed lineage records in the window",
+    "anomaly_decode_stall": "1 while a decode-stall anomaly is active",
+    "anomaly_queue_wait": "1 while the queue-wait p95 breach is active",
+    "anomaly_accept_collapse": "1 while spec accept rate has collapsed",
+    "anomaly_staleness": "1 while staleness runaway is active",
+    "anomaly_goodput_collapse": (
+        "1 while fleet pause+idle fraction runs away from the manifest "
+        "baseline"
+    ),
+    "goodput_pause_idle_frac": (
+        "fleet-mean weight_pause + idle wall fraction"
+    ),
+    "goodput_duty_cycle_mean": "fleet-mean productive wall fraction",
+    "goodput_effective_tokens_per_sec": (
+        "fleet-summed delivered tokens over wall time"
+    ),
+    "goodput_baseline_pause_idle_frac": (
+        "run-manifest baseline pause+idle fraction (-1 until set)"
+    ),
+    "fleet_warming_servers": "scraped servers not yet reporting ready",
+    "queue_wait_seconds": "merged per-class queue-wait (histogram)",
+    "ttft_seconds": "merged per-class TTFT (histogram)",
+    "request_latency_seconds": "merged per-class request latency (histogram)",
+}
+_FLEET_PER_CLASS = {}
+for _cls in ("interactive", "bulk"):
+    for _stem, _what in (
+        (f"queue_wait_{_cls}", "queue-wait"),
+        (f"ttft_{_cls}", "TTFT"),
+    ):
+        _FLEET_PER_CLASS[f"{_stem}_p50_s"] = (
+            f"{_cls} {_what} p50 from merged native histograms"
+        )
+        _FLEET_PER_CLASS[f"{_stem}_p95_s"] = (
+            f"{_cls} {_what} p95 from merged native histograms"
+        )
+        _FLEET_PER_CLASS[f"{_stem}_count"] = (
+            f"{_cls} {_what} observations behind the percentiles"
+        )
+_FLEET_METRIC_HELP.update(_FLEET_PER_CLASS)
+_FLEET_COUNTERS = (
+    "scrapes_total", "scrape_failures_total", "generated_tokens_total",
+    "preemptions_total", "tracing_dropped_spans_total",
+    "spec_draft_tokens_total", "spec_accepted_tokens_total",
+)
+_FLEET_HISTOGRAMS = (
+    "queue_wait_seconds", "ttft_seconds", "request_latency_seconds",
+)
+register_metric_types(
+    {
+        **{n: "counter" for n in _FLEET_COUNTERS},
+        **{n: "histogram" for n in _FLEET_HISTOGRAMS},
+        **{
+            n: "gauge"
+            for n in _FLEET_METRIC_HELP
+            if n not in _FLEET_COUNTERS and n not in _FLEET_HISTOGRAMS
+        },
+    }
+)
+
 # which anomaly gauge each rule drives (all exported even when 0, so a
 # dashboard alert can key on the name before the first incident)
 ANOMALIES = (
@@ -504,6 +601,7 @@ ANOMALIES = (
     "anomaly_queue_wait",
     "anomaly_accept_collapse",
     "anomaly_staleness",
+    "anomaly_goodput_collapse",
 )
 
 
@@ -537,6 +635,11 @@ class TelemetryCollector:
         self._lock = threading.Lock()
         self._servers: Dict[str, _ServerScrape] = {}
         self._anomalies: Dict[str, bool] = {a: False for a in ANOMALIES}
+        # goodput-collapse baseline: fleet-mean pause+idle fraction over
+        # the first `goodput_baseline_sweeps` observations (the run
+        # manifest records it; the anomaly measures runaway FROM it)
+        self._goodput_obs: List[float] = []
+        self._goodput_baseline: Optional[float] = None
         self.scrapes_total = 0
         self.scrape_failures_total = 0
         self._stop = threading.Event()
@@ -570,10 +673,16 @@ class TelemetryCollector:
                     )
         for addr in addrs:
             try:
-                m = self._fetch_metrics(addr)
+                fetched = self._fetch_metrics(addr)
+                # tuple = (flat, histograms); injected legacy fetchers
+                # may return the flat dict alone
+                if isinstance(fetched, tuple):
+                    m, hists = fetched
+                else:
+                    m, hists = fetched, {}
                 ok = True
             except Exception:
-                m, ok = {}, False
+                m, hists, ok = {}, {}, False
             spans: List[Dict] = []
             epoch = None
             dropped = None
@@ -589,6 +698,7 @@ class TelemetryCollector:
                 st.ok = ok
                 if ok:
                     st.metrics = m
+                    st.hists = hists
                     stalled = (
                         m.get("running_requests", 0) > 0
                         and m.get("decode_tokens_per_sec", 0) <= 0
@@ -615,9 +725,34 @@ class TelemetryCollector:
         idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
         return vals[idx]
 
-    def rollup(self) -> Dict[str, float]:
+    def merged_histograms(self) -> Dict[str, Histogram]:
+        """Per-series native histograms merged across the scraped fleet
+        (same series key on every server — per-class queue-wait / TTFT /
+        request latency)."""
+        with self._lock:
+            per_server = [
+                dict(s.hists) for s in self._servers.values() if s.ok
+            ]
+        merged: Dict[str, Histogram] = {}
+        for hists in per_server:
+            for key, h in hists.items():
+                if key in merged:
+                    try:
+                        merged[key].merge(h)
+                    except ValueError:
+                        pass  # mismatched ladders: keep the first
+                else:
+                    merged[key] = Histogram(h.bounds)
+                    merged[key].merge(h)
+        return merged
+
+    def rollup(
+        self, merged_hists: Optional[Dict[str, Histogram]] = None
+    ) -> Dict[str, float]:
         """Fleet-wide gauges from the last sweep's per-server scrapes
-        (plus the bounded span window for latency percentiles)."""
+        (plus the bounded span window for latency percentiles).
+        ``merged_hists`` lets a caller that already merged the fleet's
+        histograms (render_metrics) avoid doing the work twice."""
         with self._lock:
             servers = dict(self._servers)
             scraped = [s for s in servers.values() if s.ok]
@@ -661,6 +796,75 @@ class TelemetryCollector:
             # truncated traces must not read as complete)
             tracing_dropped_spans_total=float(
                 sum(s.dropped_spans for s in servers.values())
+            ),
+        )
+        # native per-class latency rollups (r11): merged across servers
+        # from the engines' always-on histograms — unlike the span-based
+        # percentiles above these survive /trace drains and tracing-off
+        # deployments. When present, the histogram p95 REPLACES the
+        # span-derived queue_wait_p95_s as the fleet number.
+        merged = (
+            merged_hists if merged_hists is not None
+            else self.merged_histograms()
+        )
+        hist_qw_all: Optional[Histogram] = None
+        for cls in ("interactive", "bulk"):
+            for base, out_stem in (
+                ("queue_wait_seconds", f"queue_wait_{cls}"),
+                ("ttft_seconds", f"ttft_{cls}"),
+            ):
+                h = merged.get(f'{base}{{sched_class="{cls}"}}')
+                if h is None or h.count == 0:
+                    continue
+                out[f"{out_stem}_p50_s"] = round(h.quantile(0.50), 6)
+                out[f"{out_stem}_p95_s"] = round(h.quantile(0.95), 6)
+                out[f"{out_stem}_count"] = float(h.count)
+                if base == "queue_wait_seconds":
+                    if hist_qw_all is None:
+                        hist_qw_all = Histogram(h.bounds)
+                    try:
+                        hist_qw_all.merge(h)
+                    except ValueError:
+                        pass
+        if hist_qw_all is not None and hist_qw_all.count > 0:
+            out["queue_wait_p50_s"] = round(hist_qw_all.quantile(0.50), 6)
+            out["queue_wait_p95_s"] = round(hist_qw_all.quantile(0.95), 6)
+            out["queue_wait_samples"] = float(hist_qw_all.count)
+        # goodput rollup (r11): fleet-mean bucket pressure + summed
+        # effective throughput from the engines' ledgers
+        gp_pause = [
+            s.metrics["goodput_weight_pause_frac"]
+            + s.metrics["goodput_idle_frac"]
+            for s in scraped
+            if "goodput_weight_pause_frac" in s.metrics
+            and "goodput_idle_frac" in s.metrics
+        ]
+        duty = [
+            s.metrics["goodput_duty_cycle"]
+            for s in scraped
+            if "goodput_duty_cycle" in s.metrics
+        ]
+        out.update(
+            goodput_pause_idle_frac=(
+                round(sum(gp_pause) / len(gp_pause), 4) if gp_pause
+                else 0.0
+            ),
+            goodput_duty_cycle_mean=(
+                round(sum(duty) / len(duty), 4) if duty else 0.0
+            ),
+            goodput_effective_tokens_per_sec=ssum(
+                "goodput_effective_tokens_per_sec"
+            ),
+            goodput_baseline_pause_idle_frac=float(
+                self._goodput_baseline
+                if self._goodput_baseline is not None else -1.0
+            ),
+            fleet_warming_servers=float(
+                sum(
+                    1
+                    for s in scraped
+                    if s.metrics.get("server_ready", 1.0) < 1.0
+                )
             ),
         )
         drafted = ssum("spec_draft_tokens_total")
@@ -742,6 +946,38 @@ class TelemetryCollector:
             f"staleness at consumption reached {st_max} versions "
             f"(> {cfg.staleness_max})",
         )
+        # goodput collapse (r11): the fleet-mean pause+idle fraction ran
+        # away from the run's own baseline — weight pauses or starvation
+        # are eating the wall clock that used to be decode
+        gp_vals = [
+            s.metrics["goodput_weight_pause_frac"]
+            + s.metrics["goodput_idle_frac"]
+            for s in scraped.values()
+            if "goodput_weight_pause_frac" in s.metrics
+            and "goodput_idle_frac" in s.metrics
+        ]
+        cur = sum(gp_vals) / len(gp_vals) if gp_vals else None
+        baseline_n = max(1, cfg.goodput_baseline_sweeps)
+        if cur is not None and self._goodput_baseline is None:
+            self._goodput_obs.append(cur)
+            if len(self._goodput_obs) >= baseline_n:
+                self._goodput_baseline = sum(self._goodput_obs) / len(
+                    self._goodput_obs
+                )
+        baseline = self._goodput_baseline
+        self._set_anomaly(
+            "anomaly_goodput_collapse",
+            cur is not None
+            and baseline is not None
+            and cur - baseline > cfg.goodput_collapse_margin
+            and cur > cfg.goodput_collapse_floor,
+            f"fleet pause+idle wall fraction "
+            f"{cur if cur is not None else 0:.2f} ran away from the "
+            f"manifest baseline "
+            f"{baseline if baseline is not None else 0:.2f} "
+            f"(margin {cfg.goodput_collapse_margin}, floor "
+            f"{cfg.goodput_collapse_floor})",
+        )
 
     def _set_anomaly(self, name: str, active: bool, detail: str) -> None:
         with self._lock:
@@ -763,7 +999,16 @@ class TelemetryCollector:
         return self.rollup()
 
     def render_metrics(self) -> str:
-        return render_prometheus(self.rollup(), prefix="areal_tpu_fleet_")
+        # the hub re-exports the merged per-class histograms so one
+        # Prometheus scrape of the hub carries fleet-true latency
+        # distributions, not just the derived percentile gauges
+        # (merged once, shared with the rollup math)
+        merged = self.merged_histograms()
+        return render_prometheus(
+            self.rollup(merged_hists=merged), prefix="areal_tpu_fleet_",
+            help_text=_FLEET_METRIC_HELP,
+            histograms=merged,
+        )
 
     def manifest(self) -> Dict[str, Any]:
         """Run manifest: the consolidated fleet view as one JSON doc
@@ -790,6 +1035,9 @@ class TelemetryCollector:
             "rollup": self.rollup(),
             "anomalies": self.anomalies(),
             "lineage_records": len(self.ledger) if self.ledger else 0,
+            # the goodput-collapse rule's frame of reference: what this
+            # run considered normal pause+idle pressure when it started
+            "goodput_baseline_pause_idle_frac": self._goodput_baseline,
         }
 
     def stitched_trace(

@@ -40,7 +40,7 @@ import threading
 import time
 import uuid
 from collections import OrderedDict, deque
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from areal_tpu.api.cli_args import TracingConfig
 
@@ -341,6 +341,139 @@ def trace_response(tracer: "SpanTracer", query: str):
 # --------------------------------------------------------------------------
 # Prometheus text exposition
 # --------------------------------------------------------------------------
+# Default latency bucket ladder (seconds) for the native histograms —
+# wide enough for queue waits under load shedding and TTFT under cold
+# compiles; +Inf is implicit.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+class Histogram:
+    """A native Prometheus histogram: fixed cumulative ``le`` buckets
+    plus ``_sum``/``_count``. Thread-safe observe; mergeable across
+    servers (same ladder) for fleet rollups; quantile estimates by
+    linear interpolation within the winning bucket."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+
+    def __init__(self, bounds: tuple = LATENCY_BUCKETS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 = +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        if other.bounds != self.bounds:
+            raise ValueError(
+                "cannot merge histograms with different bucket ladders"
+            )
+        with self._lock:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+            self.sum += other.sum
+            self.count += other.count
+        return self
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile from the cumulative buckets (0 when
+        empty; the +Inf bucket answers its lower bound)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total <= 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.bounds):
+                    return self.bounds[-1] if self.bounds else 0.0
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (target - prev_cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        return self.bounds[-1] if self.bounds else 0.0
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """``[(le_bound, cumulative_count), ...]`` ending at +Inf."""
+        with self._lock:
+            counts = list(self.counts)
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
+
+    @classmethod
+    def from_cumulative(
+        cls, pairs: List[Tuple[float, float]], total_sum: float,
+        total_count: float,
+    ) -> "Histogram":
+        """Reconstruct from parsed ``_bucket``/``_sum``/``_count``
+        samples (the scrape-side inverse of rendering)."""
+        finite = sorted(
+            (le, c) for le, c in pairs if le != float("inf")
+        )
+        h = cls(tuple(le for le, _ in finite) or LATENCY_BUCKETS)
+        if not finite:
+            h.counts = [0] * (len(h.bounds) + 1)
+        prev = 0.0
+        counts = []
+        for _, c in finite:
+            counts.append(int(c - prev))
+            prev = c
+        inf_cum = next(
+            (c for le, c in pairs if le == float("inf")), total_count
+        )
+        counts.append(int(inf_cum - prev))
+        if len(counts) == len(h.bounds) + 1:
+            h.counts = counts
+        h.sum = float(total_sum)
+        h.count = int(total_count)
+        return h
+
+
+# Explicit metric-type registry: surfaces register every name they emit
+# (gauge | counter | histogram) so a new metric can't silently export as
+# the wrong TYPE on the strength of a name suffix. The legacy suffix
+# heuristic survives only as the fallback for unregistered names; the
+# metrics-hygiene lint (tests/test_metrics_hygiene.py) enforces that no
+# real surface relies on it.
+METRIC_TYPES: Dict[str, str] = {}
+
+
+def register_metric_types(types: Dict[str, str]) -> None:
+    for name, t in types.items():
+        if t not in ("gauge", "counter", "histogram"):
+            raise ValueError(f"metric {name!r}: unknown type {t!r}")
+        prev = METRIC_TYPES.get(name)
+        if prev is not None and prev != t:
+            raise ValueError(
+                f"metric {name!r} re-registered as {t!r} (was {prev!r})"
+            )
+        METRIC_TYPES[name] = t
+
+
 def parse_prometheus(text: str, prefix: str = "") -> Dict[str, float]:
     """Inverse of ``render_prometheus`` for scrape aggregation: flat
     ``{name: value}`` from text exposition. HELP/TYPE preambles are
@@ -368,14 +501,37 @@ def parse_prometheus(text: str, prefix: str = "") -> Dict[str, float]:
 
 
 def _prom_type(name: str, types: Optional[Dict[str, str]]) -> str:
+    # precedence: caller-local types > the explicit process registry >
+    # the legacy suffix heuristic (unregistered names only — the
+    # metrics-hygiene lint keeps real surfaces off this fallback)
     if types and name in types:
         return types[name]
-    # monotonically increasing engine totals are counters (legacy
-    # "total_" prefix or the Prometheus-conventional "_total" suffix);
-    # everything else is a point-in-time gauge
+    if name in METRIC_TYPES:
+        return METRIC_TYPES[name]
     if name.startswith("total_") or name.endswith("_total"):
         return "counter"
     return "gauge"
+
+
+def _prom_value(v: float) -> str:
+    # prometheus value spellings: NaN/+Inf/-Inf, integers without the
+    # trailing .0 noise
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+def _split_labels(key: str) -> Tuple[str, str]:
+    """``'name{a="b"}'`` → ``("name", 'a="b"')``; bare names pass."""
+    if "{" in key and key.endswith("}"):
+        base, rest = key.split("{", 1)
+        return base, rest[:-1]
+    return key, ""
 
 
 def render_prometheus(
@@ -383,25 +539,94 @@ def render_prometheus(
     prefix: str = "",
     types: Optional[Dict[str, str]] = None,
     help_text: Optional[Dict[str, str]] = None,
+    histograms: Optional[Dict[str, "Histogram"]] = None,
 ) -> str:
     """Render a flat metric dict in Prometheus text-exposition format
-    (# HELP / # TYPE preamble per metric, sorted by name)."""
+    (# HELP / # TYPE preamble per metric, sorted by name).
+
+    ``histograms`` maps series keys to :class:`Histogram` instances; a
+    key may carry a label set (``'queue_wait_seconds{sched_class="bulk"}'``)
+    — the HELP/TYPE preamble is emitted once per base name and each
+    series renders cumulative ``_bucket{...,le="..."}`` samples plus
+    ``_sum``/``_count``."""
     lines: List[str] = []
     for name in sorted(metrics):
         full = f"{prefix}{name}"
         if help_text and name in help_text:
             lines.append(f"# HELP {full} {help_text[name]}")
         lines.append(f"# TYPE {full} {_prom_type(name, types)}")
-        v = float(metrics[name])
-        # prometheus value spellings: NaN/+Inf/-Inf, integers without the
-        # trailing .0 noise
-        if v != v:
-            sv = "NaN"
-        elif v in (float("inf"), float("-inf")):
-            sv = "+Inf" if v > 0 else "-Inf"
-        elif v == int(v):
-            sv = str(int(v))
-        else:
-            sv = str(v)
-        lines.append(f"{full} {sv}")
+        lines.append(f"{full} {_prom_value(metrics[name])}")
+    if histograms:
+        by_base: Dict[str, List[Tuple[str, Histogram]]] = {}
+        for key in sorted(histograms):
+            base, labels = _split_labels(key)
+            by_base.setdefault(base, []).append((labels, histograms[key]))
+        for base, series in by_base.items():
+            full = f"{prefix}{base}"
+            if help_text and base in help_text:
+                lines.append(f"# HELP {full} {help_text[base]}")
+            lines.append(f"# TYPE {full} histogram")
+            for labels, hist in series:
+                sep = f"{labels}," if labels else ""
+                for le, cum in hist.cumulative():
+                    le_s = "+Inf" if le == float("inf") else _prom_value(le)
+                    lines.append(
+                        f'{full}_bucket{{{sep}le="{le_s}"}} {cum}'
+                    )
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(
+                    f"{full}_sum{suffix} {_prom_value(hist.sum)}"
+                )
+                lines.append(f"{full}_count{suffix} {hist.count}")
     return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_histograms(
+    text: str, prefix: str = ""
+) -> Dict[str, "Histogram"]:
+    """Scrape-side inverse of the histogram rendering: reconstructs
+    ``{series_key: Histogram}`` from ``_bucket``/``_sum``/``_count``
+    samples. Series keys mirror the render input (base name plus any
+    non-``le`` labels), with ``prefix`` stripped."""
+    buckets: Dict[str, List[Tuple[float, float]]] = {}
+    sums: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        base, labels = _split_labels(key)
+        if prefix and base.startswith(prefix):
+            base = base[len(prefix):]
+        if base.endswith("_bucket"):
+            le = None
+            rest = []
+            for part in labels.split(","):
+                if part.startswith("le="):
+                    raw = part[3:].strip('"')
+                    le = float("inf") if raw == "+Inf" else float(raw)
+                elif part:
+                    rest.append(part)
+            if le is None:
+                continue
+            series = base[: -len("_bucket")]
+            if rest:
+                series = f"{series}{{{','.join(rest)}}}"
+            buckets.setdefault(series, []).append((le, val))
+        elif base.endswith("_sum") or base.endswith("_count"):
+            stem = base.rsplit("_", 1)[0]
+            series = f"{stem}{{{labels}}}" if labels else stem
+            (sums if base.endswith("_sum") else counts)[series] = val
+    out: Dict[str, Histogram] = {}
+    for series, pairs in buckets.items():
+        out[series] = Histogram.from_cumulative(
+            pairs, sums.get(series, 0.0), counts.get(series, 0.0)
+        )
+    return out

@@ -231,6 +231,103 @@ def _resilience_phase() -> dict:
                     proc.kill()
 
 
+def _scaleup_phase() -> dict:
+    """Autoscaler cold→serving lead time, measured (ROADMAP item 3's
+    'scale-up lead time as a first-class bench metric'). One tiny-model
+    CPU server subprocess (never contends for the bench chip) is
+    launched cold; the phase stamps process launch → first /health
+    answer → first WARMING report (warmup traffic triggers the compile
+    storm) → first READY report, with the ladder coverage the server
+    claimed along the way."""
+    import queue as _q
+    import subprocess
+    import threading
+    import urllib.request as _rq
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "genserver_worker.py",
+    )
+    env = dict(os.environ)
+    env["AREAL_WORKER_READY_QUIET"] = "2.0"
+    # quiet-driven readiness for the measurement: the first completed
+    # request must not latch ready while the compile storm still runs
+    env["AREAL_WORKER_READY_MIN"] = "1000000"
+    t_launch = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, worker, "0"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    lines: "_q.Queue[str]" = _q.Queue()
+    threading.Thread(
+        target=lambda: [lines.put(ln) for ln in proc.stdout],
+        daemon=True,
+    ).start()
+    try:
+        deadline = time.monotonic() + 240
+        port = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("scale-up worker died at startup")
+            try:
+                line = lines.get(timeout=1.0)
+            except _q.Empty:
+                continue
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+                break
+        if port is None:
+            raise RuntimeError("scale-up worker never reported a port")
+        addr = f"127.0.0.1:{port}"
+        t_port = time.monotonic()
+        # warmup traffic starts the compile storm the readiness rule
+        # watches (a real spawn gets this from the router/auto-warmer)
+        body = json.dumps(
+            {
+                "input_ids": [1, 2, 3, 4, 5],
+                "sampling_params": {"max_new_tokens": 8},
+            }
+        ).encode()
+        req = _rq.Request(
+            f"http://{addr}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=120) as r:
+            r.read()
+        t_warming = t_ready = None
+        coverage = -1.0
+        while time.monotonic() < deadline:
+            with _rq.urlopen(f"http://{addr}/health", timeout=10) as r:
+                h = json.loads(r.read())
+            coverage = float(h.get("ladder_coverage", coverage))
+            if h.get("status") == "warming" and t_warming is None:
+                t_warming = time.monotonic()
+            if h.get("status") == "ok":
+                # ready — with or without an observed warming window (a
+                # fast warmup can latch before the first poll; spinning
+                # out the deadline would just lose the measurement)
+                if t_warming is not None:
+                    t_ready = time.monotonic()
+                break
+            time.sleep(0.1)
+        return {
+            "scaleup_port_s": round(t_port - t_launch, 3),
+            "scaleup_warming_observed": t_warming is not None,
+            "scaleup_cold_to_serving_s": (
+                round(t_ready - t_launch, 3) if t_ready else None
+            ),
+            "scaleup_ladder_coverage": round(coverage, 4),
+        }
+    finally:
+        if proc.poll() is None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+
+
 def _env_resilience_phase() -> dict:
     """Kill-one-of-two ENV WORKERS under the chaos harness, measured.
     Two env-service subprocesses host the countdown tool env; a wave of
@@ -414,6 +511,7 @@ def main():
     from areal_tpu.models.transformer import init_params
     from areal_tpu.utils import data as data_utils
     from areal_tpu.utils import flops as flops_util
+    from areal_tpu.utils import goodput as goodput_util
 
     model_cfg = ModelConfig(
         vocab_size=32768,
@@ -923,10 +1021,17 @@ def main():
         # bf16 serving copy of the f32 master weights, swapped into the
         # server mid-generation (interruptible decoding keeps going; token
         # versions record the swap point)
-        serving = jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.bfloat16), trainer.params
-        )
-        gen.update_weights_from_tensors(serving, version=version)
+        with goodput_util.trainer_bucket("weight_push"):
+            serving = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16), trainer.params
+            )
+            gen.update_weights_from_tensors(serving, version=version)
+
+    def collect(futs):
+        # the bench's trainer-side ledger books blocking generation
+        # waits as rollout_wait (the async gap, measured)
+        with goodput_util.trainer_bucket("rollout_wait"):
+            return [f.result(timeout=3600) for f in futs]
 
     # round-2-comparable SHORT workload (256-token gens) for cross-round
     # trend tracking — measured before the main workload warms longer
@@ -979,7 +1084,7 @@ def main():
         c0 = compile_snap()
         t0 = time.perf_counter()
         prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
-        results = [f.result(timeout=3600) for f in futs]
+        results = collect(futs)
         t_roll = time.perf_counter()
         tokens, lens = train_on(prompts, results)
         t_end = time.perf_counter()
@@ -1059,7 +1164,7 @@ def main():
     overlap_steps = []
     staleness_counts = {}
     prompts, futs = submit_batch(n_prompts, group_size, prompt_len, max_new)
-    results = [f.result(timeout=3600) for f in futs]
+    results = collect(futs)
     for i in range(n_overlap):
         c0 = compile_snap()
         t0 = time.perf_counter()
@@ -1071,7 +1176,7 @@ def main():
         t_train = time.perf_counter()
         push_weights(version=i + 1)
         t_push = time.perf_counter()
-        nxt_results = [f.result(timeout=3600) for f in nxt_futs]
+        nxt_results = collect(nxt_futs)
         t_end = time.perf_counter()
         c1 = compile_snap()
         # offpolicyness: trainer version at consumption minus the version
@@ -1105,6 +1210,24 @@ def main():
             "staleness_token_counts": staleness_counts,
         },
     )
+
+    # --- goodput attribution (r11): where every second of the bench's
+    # wall time went, on both sides. The trainer ledger accumulated
+    # rollout_wait/weight_push/fwd_bwd/optim/data_h2d/compile through
+    # the phases above; the engine ledger ran inside the serving loop.
+    # Bucket fractions sum to 1.0 of each side's observed wall by
+    # construction, and the per-shape compile table is the warmup bill
+    # the AOT precompiler (ROADMAP item 3) will have to eliminate. ---
+    trainer_goodput = goodput_util.trainer_ledger().snapshot()
+    engine_goodput = gen.ledger.snapshot()
+    warmup_compiles_per_shape = gen.compiles.signature_table(top=16)
+    goodput_payload = {
+        "trainer": trainer_goodput,
+        "engine": engine_goodput,
+        "engine_readiness": gen.readiness(),
+        "warmup_compiles_per_shape": warmup_compiles_per_shape,
+    }
+    emit_phase("goodput", goodput_payload)
 
     from areal_tpu.ops import flash as flash_ops
 
@@ -1161,6 +1284,10 @@ def main():
         "prefix_ab_compile_s": prefix_ab_compile_s,
         "compile_cache_dir": cache_dir,
         "compile_cache_hits": cache_events["hits"],
+        # r11: goodput attribution — trainer + engine wall-time bucket
+        # breakdowns (fractions sum to 1.0 per side) and the per-shape
+        # warmup compile bill (full record in BENCH_<round>_goodput.json)
+        "goodput": goodput_payload,
     }
     extra.update(cap_stats)
     # checkpoint partial results (stderr) — a failure in a later phase must
@@ -1402,6 +1529,25 @@ def main():
                 "resilience_completion_rate": None,
                 "resilience_added_latency_s": None,
                 "error": extra["resilience_error"],
+            },
+        )
+
+    # --- scale-up lead-time cell (r11): launch one cold CPU server
+    # subprocess and time launch → port → WARMING (warmup traffic
+    # starts the compile storm) → READY from its own /health — the
+    # autoscaler's true reaction time, graceful-degradation like the
+    # other auxiliary phases ---
+    try:
+        scaleup = _scaleup_phase()
+        extra.update(scaleup)
+        emit_phase("scaleup", scaleup)
+    except Exception as e:
+        extra["scaleup_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "scaleup",
+            {
+                "scaleup_cold_to_serving_s": None,
+                "error": extra["scaleup_error"],
             },
         )
 

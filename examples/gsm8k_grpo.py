@@ -33,6 +33,7 @@ from areal_tpu.engine.ppo.actor import PPOActor
 from areal_tpu.engine.remote import SERVER_ADDRS_ENV, RemoteInferenceEngine
 from areal_tpu.engine.spmd_engine import SPMDTrainEngine
 from areal_tpu.reward.math_parser import gsm8k_reward_fn
+from areal_tpu.utils import goodput
 from areal_tpu.utils import logging as logging_util, stats_tracker
 from areal_tpu.utils.evaluator import Evaluator
 from areal_tpu.utils.recover import RecoverHandler, check_if_recover
@@ -165,6 +166,27 @@ def main(argv):
     stats_logger = StatsLogger(
         config.experiment_name, config.trial_name, config.cluster.fileroot
     )
+    # goodput attribution (r11): the trainer-side wall-clock ledger.
+    # rollout_wait/fwd_bwd/optim/data_h2d/checkpoint book themselves in
+    # the layers below; this loop wraps weight_push and exports one
+    # snapshot per step (JSONL stream + goodput/* stats keys)
+    goodput_dir = os.path.join(
+        config.cluster.fileroot, config.experiment_name, config.trial_name
+    )
+    os.makedirs(goodput_dir, exist_ok=True)
+    # JSONL sinks are main-rank-only (like every other per-step
+    # artifact): N ranks appending role="trainer" lines to one shared
+    # file would make "last snapshot per role" meaningless. Non-main
+    # ranks still ledger locally (their stats stay inspectable).
+    gp_ledger = goodput.configure_trainer(
+        jsonl_path=(
+            os.path.join(goodput_dir, "goodput.jsonl") if is_main else ""
+        ),
+        compile_events_path=(
+            os.path.join(goodput_dir, "compile_events.jsonl")
+            if is_main else ""
+        ),
+    )
     from areal_tpu.utils.profiling import PhaseProfiler
 
     profiler = PhaseProfiler(
@@ -251,7 +273,9 @@ def main(argv):
             with stats_tracker.record_timing("ppo_update"):
                 train_stats = actor.ppo_update(batch)
 
-            with stats_tracker.record_timing("weight_update"):
+            with stats_tracker.record_timing(
+                "weight_update"
+            ), goodput.trainer_bucket("weight_push"):
                 if is_main:
                     rollout.pause()
                 new_version = engine.get_version() + 1
@@ -284,7 +308,8 @@ def main(argv):
             with stats_tracker.record_timing("save_eval_recover"):
                 # engine.save is a collective (all ranks gather, rank 0
                 # writes) — every process must enter it
-                saver.save(engine, step, tokenizer=tokenizer)
+                with goodput.trainer_bucket("checkpoint"):
+                    saver.save(engine, step, tokenizer=tokenizer)
                 eval_stats = (
                     evaluator.evaluate(run_eval, step) if is_main else None
                 )
@@ -294,6 +319,14 @@ def main(argv):
                 )
 
         stats = stats_tracker.export_all()
+        # per-step goodput snapshot: bucket fractions sum to 1.0 of the
+        # run's observed wall — the async gap (rollout_wait), the weight
+        # push, and compile time are first-class numbers every step
+        stats.update(
+            {f"goodput/{k}": v for k, v in gp_ledger.metrics().items()}
+        )
+        if is_main:
+            gp_ledger.export_jsonl()
         for s in train_stats:
             for k, v in s.items():
                 stats[f"ppo_actor/{k}"] = v

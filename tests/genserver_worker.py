@@ -32,6 +32,17 @@ def main():
     if os.environ.get("AREAL_WORKER_TRACE"):
         # request-lifecycle spans for stitched cross-process trace tests
         gcfg.tracing.enabled = True
+    if os.environ.get("AREAL_WORKER_READY_QUIET"):
+        # readiness tests/bench shrink the warming→ready quiet window
+        gcfg.goodput.ready_quiet_s = float(
+            os.environ["AREAL_WORKER_READY_QUIET"]
+        )
+    if os.environ.get("AREAL_WORKER_READY_MIN"):
+        # raise the completions-based ready latch so the warming state
+        # stays observable past the first served request
+        gcfg.goodput.ready_min_requests = int(
+            os.environ["AREAL_WORKER_READY_MIN"]
+        )
     eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
     # lineage tests label servers with distinct weight VERSIONS while
     # keeping identical seed-0 weights (version is an accounting label;

@@ -87,7 +87,11 @@ def _randomized_payloads(seed, n):
     return payloads
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "seed",
+    # tier-1 cap shave (r11): seed 0 stays in budget, seed 1 slow
+    [0, pytest.param(1, marks=pytest.mark.slow)],
+)
 def test_compact_on_off_streams_identical_under_races(model, seed):
     """The acceptance invariant, under the hard regime: oversubscribed
     pool (preempt + re-admit), decode_pipeline=2 (in-flight chunks when

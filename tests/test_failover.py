@@ -31,6 +31,11 @@ VICTIM_CHAOS = "kill:side=server,match=/generate,start=2"
 def _spawn_worker(env_extra=None):
     worker = os.path.join(os.path.dirname(__file__), "genserver_worker.py")
     env = dict(os.environ)
+    # near-zero warming window (r11 readiness): these chaos tests pin
+    # exact /generate call schedules, and a WARMING classification
+    # diverting a wave's round-robin placement would break them — the
+    # warming plane has its own tests (test_goodput.py)
+    env["AREAL_WORKER_READY_QUIET"] = "0.01"
     if env_extra:
         env.update(env_extra)
     proc = subprocess.Popen(
@@ -386,6 +391,21 @@ def test_lineage_ledger_and_stitched_trace_across_kill(
         assert rollup_a["servers_scraped"] == 2.0
         assert rollup_a["generated_tokens_total"] >= 2 * MAX_NEW
         assert rollup_a["queue_wait_samples"] >= 2
+
+        # wave B's deterministic placement (one rid per server, round
+        # robin) needs BOTH servers in rotation at submit time: wait
+        # out any residual WARMING classification from wave A's compile
+        # storm (the victim latched ready on its first completion; the
+        # router's next probe picks that up)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {
+                info["state"]
+                for info in router.router_state.fleet.per_server().values()
+            }
+            if states <= {"healthy", "suspect"}:
+                break
+            time.sleep(0.1)
 
         # -- wave B: the victim dies on its 3rd wave-B call, mid-wave --
         for i, prompt in enumerate(PROMPTS[2:4]):

@@ -179,6 +179,9 @@ def test_on_recover_fires_only_for_rotation_reentry():
     assert recovered2 == []
 
 
+@pytest.mark.slow  # ~30 s of real HTTP timeouts/backoff — tier-1 cap
+# shave (r11); the version-checked re-admission contract stays pinned
+# by the router-side resync test and the failover chaos suite
 def test_recovered_stale_server_is_resynced_or_drained():
     """engine/remote._on_server_recovered: a server re-entering rotation
     at an old weight version gets the last disk checkpoint re-pushed;

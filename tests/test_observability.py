@@ -477,7 +477,11 @@ class TestTrafficPlaneObservability:
             f"http://{addr}/health", timeout=30
         ) as r:
             body = json.loads(r.read())
-        assert body["status"] == "ok"
+        # r11 readiness: this module's engine may still be inside its
+        # compile-quiet window (warming) or already latched (ok); either
+        # way coverage rides along and the load view stays intact
+        assert body["status"] in ("ok", "warming")
+        assert "ladder_coverage" in body
         # separate fields, NOT one summed in_flight integer — the
         # autoscaler distinguishes backlog from busy decode
         assert body["running_requests"] == 0

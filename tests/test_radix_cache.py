@@ -473,7 +473,16 @@ def _run_cohort(model, payloads, **cfg_kw):
     return outs, metrics
 
 
-@pytest.mark.parametrize("seed", [3, 4, 5])
+@pytest.mark.parametrize(
+    "seed",
+    # tier-1 cap shave (r11): one randomized cohort in budget, the
+    # other two on the slow lane
+    [
+        3,
+        pytest.param(4, marks=pytest.mark.slow),
+        pytest.param(5, marks=pytest.mark.slow),
+    ],
+)
 def test_radix_stream_parity_randomized(model, seed):
     """Greedy streams are identical radix on vs off under preemption
     (oversubscribed pool) + decode_pipeline=2 + compaction + spec races.

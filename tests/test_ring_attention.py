@@ -141,6 +141,9 @@ def test_sp_correctness_at_24k(impl):
     )
 
 
+@pytest.mark.slow  # ~136 s of compile on the tier-1 CPU budget — the
+# heaviest single test in the suite (r11 cap-overrun shave); the
+# blockwise kernel stays covered by test_blockwise_attention.py
 def test_block_attend_matches_blockwise():
     """Pin ring's unnormalized inner kernel to the blockwise kernel: one
     self-attention block normalized by its own (m, l) must equal the

@@ -130,7 +130,12 @@ def _mixed_payloads(seed):
     return out
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "seed",
+    # tier-1 cap shave (r11): one randomized seed stays in the budget,
+    # the second rides the slow lane (same program, -25s of compiles)
+    [0, pytest.param(1, marks=pytest.mark.slow)],
+)
 def test_spec_on_off_greedy_streams_identical_under_races(model, seed):
     """The acceptance invariant under the hard regime: oversubscribed
     pool (preempt + re-admit), decode_pipeline=2, compaction races, and

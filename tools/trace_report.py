@@ -45,6 +45,12 @@ the ledger alone.
 ``--fleet`` reads a telemetry-hub run-manifest JSON (r9:
 ``TelemetryCollector.manifest`` / ``GET /manifest``) and prints the
 fleet rollup, the anomaly table, and a per-server line.
+
+``--goodput`` reads a goodput JSONL stream (r11: ``utils/goodput.py``
+ledger snapshots and/or ``compile_events.jsonl``) and prints each
+role's wall-time bucket breakdown (fractions sum to 1.0 — the direct
+answer to "what did every second of trainer/server wall time buy") plus
+the per-shape XLA compile bill, most expensive shape first.
 """
 
 import argparse
@@ -749,6 +755,98 @@ def format_fleet(fl: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def load_goodput(path: str) -> Dict[str, List[Dict[str, Any]]]:
+    """Read a goodput JSONL stream: ledger snapshots (``kind: goodput``,
+    one per export — latest per role wins) and compile events
+    (``kind: compile``, one per XLA backend compile). The two kinds may
+    share one file or arrive in separate files."""
+    snapshots: List[Dict[str, Any]] = []
+    compiles: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            if kind == "goodput":
+                snapshots.append(rec)
+            elif kind == "compile":
+                compiles.append(rec)
+    return {"snapshots": snapshots, "compiles": compiles}
+
+
+def goodput_summary(
+    records: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Latest ledger snapshot per role + the per-shape compile bill."""
+    latest: Dict[str, Dict[str, Any]] = {}
+    for rec in records["snapshots"]:
+        latest[rec.get("role", "?")] = rec  # stream order: last wins
+    shapes: Dict[tuple, Dict[str, float]] = {}
+    for ev in records["compiles"]:
+        key = (ev.get("phase", "?"), ev.get("signature", ""))
+        agg = shapes.setdefault(key, {"count": 0, "seconds": 0.0})
+        agg["count"] += 1
+        agg["seconds"] += float(ev.get("duration_s", 0.0))
+    shape_rows = [
+        {
+            "phase": ph, "signature": sig,
+            "count": int(v["count"]), "seconds": round(v["seconds"], 3),
+        }
+        for (ph, sig), v in shapes.items()
+    ]
+    shape_rows.sort(key=lambda r: -r["seconds"])
+    return {
+        "roles": latest,
+        "compile_events": len(records["compiles"]),
+        "compile_seconds": round(
+            sum(r["seconds"] for r in shape_rows), 3
+        ),
+        "shapes": shape_rows,
+    }
+
+
+def format_goodput(gp: Dict[str, Any]) -> str:
+    rows: List[str] = []
+    for role, snap in sorted(gp["roles"].items()):
+        rows.append(
+            f"goodput [{role}]  wall={snap.get('wall_s', 0):.1f}s  "
+            f"duty={snap.get('duty_cycle', 0):.3f}  "
+            f"eff_tok/s={snap.get('effective_tokens_per_sec', 0):.1f}"
+        )
+        header = f"  {'bucket':<16}{'seconds':>10}{'frac':>8}"
+        rows.append(header)
+        rows.append("  " + "-" * (len(header) - 2))
+        fracs = snap.get("fractions", {})
+        for b, secs in sorted(
+            snap.get("seconds", {}).items(), key=lambda kv: -kv[1]
+        ):
+            rows.append(
+                f"  {b:<16}{secs:>10.3f}{fracs.get(b, 0.0):>8.4f}"
+            )
+        total = sum(fracs.values())
+        rows.append(f"  {'SUM':<16}{'':>10}{total:>8.4f}")
+    if gp["shapes"]:
+        rows.append(
+            f"compile bill: {gp['compile_events']} compiles, "
+            f"{gp['compile_seconds']:.1f}s across {len(gp['shapes'])} "
+            f"shapes (most expensive first)"
+        )
+        header = f"  {'phase':<12}{'signature':<34}{'count':>6}{'sec':>9}"
+        rows.append(header)
+        rows.append("  " + "-" * (len(header) - 2))
+        for r in gp["shapes"][:15]:
+            rows.append(
+                f"  {r['phase']:<12}{r['signature']:<34}"
+                f"{r['count']:>6d}{r['seconds']:>9.3f}"
+            )
+    return "\n".join(rows)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -827,12 +925,32 @@ def main(argv=None) -> int:
         "attempt/migration/staleness table; exit 1 when it is empty",
     )
     p.add_argument(
+        "--goodput", action="store_true",
+        help="treat the input as a goodput JSONL stream (ledger "
+        "snapshots + compile events — utils/goodput.py) and print the "
+        "per-role wall-time bucket breakdown + the per-shape compile "
+        "bill; exit 1 when the file carries neither",
+    )
+    p.add_argument(
         "--fleet", action="store_true",
         help="treat the input as a telemetry-hub run-manifest JSON "
         "(GET /manifest) and print the fleet rollup + anomaly table; "
         "exit 1 when no server was ever scraped",
     )
     args = p.parse_args(argv)
+    if args.goodput:
+        gp = goodput_summary(load_goodput(args.trace))
+        if args.json:
+            print(json.dumps(gp, indent=2))
+        else:
+            print(format_goodput(gp))
+        if not gp["roles"] and not gp["shapes"]:
+            print(
+                "no goodput snapshots or compile events in file",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     if args.lineage:
         ln = lineage_summary(load_lineage(args.trace))
         if args.json:
