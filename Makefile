@@ -1,0 +1,24 @@
+# Convenience targets. `lint` is the arealint gate tier-1 also runs
+# via tests/test_arealint.py::TestFrameworkAndGate::test_tree_is_clean;
+# run it directly for instant feedback (pure AST, no jax, < 10 s).
+
+PY ?= python
+
+.PHONY: lint lint-diff test tier1
+
+lint:
+	$(PY) -m tools.arealint
+
+# incremental: only files changed vs BASE (default: main) plus any
+# cross-module rule whose anchor files changed
+BASE ?= main
+lint-diff:
+	$(PY) -m tools.arealint --diff $(BASE)
+
+# the tier-1 suite (ROADMAP.md's verify line, minus the harness pipefail
+# wrapper); JAX_PLATFORMS=cpu matches CI
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+test: lint tier1

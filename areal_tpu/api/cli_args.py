@@ -428,12 +428,45 @@ class JaxGenConfig:
             args.append(
                 f"--compilation-cache-dir={config.compilation_cache_dir}"
             )
+        # engine shape/batching knobs: forwarded unconditionally so a
+        # launched server always serves exactly this config — a flag
+        # missing here means subprocess servers silently run defaults
+        # (the deadline_margin_s bug class; arealint ARL002 pins the
+        # field ↔ flag ↔ build_cmd parity)
+        args += [
+            f"--prefill-chunk={config.prefill_chunk}",
+            f"--decode-chunk={config.decode_chunk}",
+            f"--decode-pipeline={config.decode_pipeline}",
+            f"--decode-compact-min-rows={config.decode_compact_min_rows}",
+            (
+                "--decode-compact-hysteresis="
+                f"{config.decode_compact_hysteresis}"
+            ),
+            f"--admit-wave={config.admit_wave}",
+            f"--admit-hold={config.admit_hold_s}",
+            f"--kv-bucket={config.kv_bucket}",
+            f"--sample-topk-bound={config.sample_topk_bound}",
+            f"--page-size={config.page_size}",
+            f"--num-pages={config.num_pages}",
+            f"--attn-impl={config.attn_impl}",
+            f"--pages-per-compute-block={config.pages_per_compute_block}",
+            f"--slots-per-block={config.slots_per_block}",
+            f"--pool-layout={config.pool_layout}",
+            f"--mem-fraction={config.mem_fraction}",
+            f"--log-level={config.log_level}",
+        ]
+        if not config.decode_compact:
+            args.append("--no-decode-compact")
+        if not config.enable_metrics:
+            args.append("--disable-metrics")
         args += [
             f"--prefix-cache-mode={config.prefix_cache_mode}",
             f"--prefix-reuse-min={config.prefix_reuse_min}",
             f"--ready-quiet={config.goodput.ready_quiet_s}",
             f"--ready-min-requests={config.goodput.ready_min_requests}",
         ]
+        if config.tracing.enabled:
+            args.append(f"--trace-max-spans={config.tracing.max_spans}")
         if config.goodput.compile_events_path:
             args.append(
                 f"--compile-events={config.goodput.compile_events_path}"

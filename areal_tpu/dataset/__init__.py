@@ -149,7 +149,8 @@ class StatefulDataLoader:
         drop_last: bool = True,
         collate_fn: Optional[Callable] = None,
     ):
-        assert batch_size >= 1
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle

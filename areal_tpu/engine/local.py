@@ -83,7 +83,10 @@ class LocalSyncInferenceEngine(InferenceEngine):
         import asyncio
 
         gconfig = req.gconfig
-        assert gconfig.n_samples == 1
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "agenerate expects n_samples=1; workflows fan out samples"
+            )
         start = time.monotonic()
         accumulated: List[int] = []
         logprobs: List[float] = []
@@ -191,9 +194,11 @@ class LocalSyncInferenceEngine(InferenceEngine):
         def _do():
             try:
                 if meta.type == WeightUpdateMethod.DEVICE:
-                    assert self._train_engine is not None, (
-                        "device weight path needs initialize(train_engine=...)"
-                    )
+                    if self._train_engine is None:
+                        raise RuntimeError(
+                            "device weight path needs "
+                            "initialize(train_engine=...)"
+                        )
                     self.engine.update_weights_from_tensors(
                         self._train_engine.params, version=meta.model_version
                     )

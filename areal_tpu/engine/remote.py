@@ -27,6 +27,26 @@ from typing import Any, Dict, List, Optional
 import aiohttp
 import requests as _requests
 
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.api.io_struct import (
+    ModelRequest,
+    ModelResponse,
+    WeightUpdateMeta,
+    WeightUpdateMethod,
+)
+from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
+from areal_tpu.inference.fleet import FleetMonitor
+from areal_tpu.utils import goodput
+from areal_tpu.utils import logging as logging_util, name_resolve, names
+from areal_tpu.utils import stats_tracker, telemetry
+from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
+from areal_tpu.utils.tracing import (
+    SpanTracer,
+    new_trace_id,
+    trace_headers,
+)
+
 
 def _abandon_session(s: "aiohttp.ClientSession") -> None:
     """Close a session whose owning loop is gone: ``detach`` marks the
@@ -58,26 +78,6 @@ def _abandon_session(s: "aiohttp.ClientSession") -> None:
             "could not tear down abandoned http session: %s", e
         )
 
-
-from areal_tpu.api.cli_args import InferenceEngineConfig
-from areal_tpu.api.engine_api import InferenceEngine
-from areal_tpu.api.io_struct import (
-    ModelRequest,
-    ModelResponse,
-    WeightUpdateMeta,
-    WeightUpdateMethod,
-)
-from areal_tpu.api.workflow_api import RolloutWorkflow, WorkflowExecutor
-from areal_tpu.inference.fleet import FleetMonitor
-from areal_tpu.utils import goodput
-from areal_tpu.utils import logging as logging_util, name_resolve, names
-from areal_tpu.utils import stats_tracker, telemetry
-from areal_tpu.utils.http import HttpRequestError, arequest_with_retry
-from areal_tpu.utils.tracing import (
-    SpanTracer,
-    new_trace_id,
-    trace_headers,
-)
 
 logger = logging_util.getLogger("RemoteInferenceEngine")
 
@@ -278,7 +278,9 @@ class RemoteInferenceEngine(InferenceEngine):
                     timeout=600,
                 )
                 r.raise_for_status()
-                assert r.json().get("success"), r.json()
+                body = r.json()
+                if not body.get("success"):
+                    raise RuntimeError(f"re-sync push rejected: {body}")
                 logger.info(
                     f"re-synced recovered server {addr}: "
                     f"v{served} -> v{version}"
@@ -576,9 +578,10 @@ class RemoteInferenceEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Interruptible generation loop (reference sglang_remote.py:121-249)."""
         gconfig = req.gconfig
-        assert gconfig.n_samples == 1, (
-            "agenerate expects n_samples=1; workflows fan out samples"
-        )
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "agenerate expects n_samples=1; workflows fan out samples"
+            )
         session = await self._get_session()
         start = time.monotonic()
         accumulated: List[int] = []
@@ -1047,8 +1050,14 @@ class RemoteInferenceEngine(InferenceEngine):
                     try:
                         name_resolve.get(key)
                         break
-                    except Exception:
-                        pass
+                    except name_resolve.NameEntryNotFoundError:
+                        pass  # trainer hasn't posted the signal yet
+                    except Exception as e:
+                        # transient backend failure (kv server restart,
+                        # NFS blip): keep polling until the deadline —
+                        # the checkpoint-on-disk check above still
+                        # short-circuits the wait
+                        logger.debug(f"signal poll for {key} failed: {e}")
                     if time.monotonic() > deadline:
                         raise TimeoutError(
                             f"weight checkpoint never appeared at {meta.path}"
@@ -1066,7 +1075,11 @@ class RemoteInferenceEngine(InferenceEngine):
                             timeout=600,
                         )
                         r.raise_for_status()
-                        assert r.json().get("success"), r.json()
+                        body = r.json()
+                        if not body.get("success"):
+                            raise RuntimeError(
+                                f"weight update rejected: {body}"
+                            )
                         updated.append(addr)
                     except Exception as e:
                         # it missed this version: quarantine so it can

@@ -21,6 +21,7 @@ Contracts:
 import functools
 import os
 import time
+import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -473,7 +474,8 @@ class SPMDTrainEngine(TrainEngine):
         loss_fn: Callable,
         loss_weight_fn: Callable,
     ) -> Dict[str, float]:
-        assert self.optimizer is not None, "no optimizer configured"
+        if self.optimizer is None:
+            raise RuntimeError("no optimizer configured")
         t_start = time.perf_counter()
         mbs = data_utils.split_padded_batch_into_mb_list(
             input_, self.config.mb_spec.max_tokens_per_mb,
@@ -847,8 +849,6 @@ class SPMDTrainEngine(TrainEngine):
                 "spmd/upload_weights_s": time.perf_counter() - t_upload
             })
             return
-        import urllib.request
-
         from areal_tpu.utils import weight_transfer as wt
 
         addrs = list(meta.addrs or [])

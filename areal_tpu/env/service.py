@@ -50,6 +50,8 @@ countdown game runs remote end-to-end (``countdown_env``).
 
 import asyncio
 import contextlib
+
+import aiohttp
 import importlib
 import json
 import os
@@ -801,8 +803,6 @@ class RemoteEnv(Env):
 
     # -- plumbing -------------------------------------------------------
     async def _session(self):
-        import aiohttp
-
         if self._http is None or self._http.closed:
             self._http = aiohttp.ClientSession()
         return self._http

@@ -100,19 +100,24 @@ class PageManager:
             return None
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
-            assert self.refcount[p] == 0
+            if self.refcount[p] != 0:
+                raise RuntimeError(
+                    f"free page {p} has refcount {self.refcount[p]}"
+                )
             self.refcount[p] = 1
         return pages
 
     def share(self, pages: Sequence[int]) -> None:
         for p in pages:
-            assert self.refcount[p] > 0
+            if self.refcount[p] <= 0:
+                raise RuntimeError(f"sharing unreferenced page {p}")
             self.refcount[p] += 1
 
     def release(self, pages: Sequence[int]) -> None:
         for p in pages:
             self.refcount[p] -= 1
-            assert self.refcount[p] >= 0
+            if self.refcount[p] < 0:
+                raise RuntimeError(f"double release of page {p}")
             if self.refcount[p] == 0:
                 self._free.append(p)
 
@@ -303,7 +308,10 @@ class RadixPrefixCache:
         return child
 
     def _remove_leaf(self, pm: PageManager, node: _RadixNode) -> None:
-        assert not node.children and node.parent is not None
+        if node.children or node.parent is None:
+            raise RuntimeError(
+                "radix eviction targeted a non-leaf or the root"
+            )
         key = int(node.tokens[0])
         sibs = node.parent.children[key]
         sibs.remove(node)

@@ -664,7 +664,8 @@ class GenerationEngine:
     # Lifecycle
     # ------------------------------------------------------------------
     def start(self):
-        assert not self._running
+        if self._running:
+            raise RuntimeError("engine already started")
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -1480,7 +1481,10 @@ class GenerationEngine:
             # a COW claim with no admitted rep cannot happen (the claim
             # only survives when its rep allocates), but release holds
             # defensively if a future edit changes that
-            assert not cow_src
+            if cow_src:
+                raise RuntimeError(
+                    "COW source pages on a non-allocating claim path"
+                )
             return False
         if cow_src:
             # dispatch the COW copies BEFORE the wave prefill: the
@@ -1857,7 +1861,11 @@ class GenerationEngine:
             if shortfall <= self.pm.n_free:
                 for slot, n in grow:
                     pages = self.pm.alloc(n)
-                    assert pages is not None
+                    if pages is None:
+                        raise RuntimeError(
+                            "page allocation failed after preemption "
+                            "freed the pool"
+                        )
                     sp = self._slot_pages[slot]
                     self._tables[slot, len(sp) : len(sp) + n] = pages
                     sp.extend(pages)

@@ -631,7 +631,8 @@ class RouterState:
                     addr, "/update_weights_from_disk",
                     {"path": last[0], "version": last[1]}, timeout=600,
                 )
-                assert out.get("success"), out
+                if not out.get("success"):
+                    raise RuntimeError(f"re-sync push rejected: {out}")
                 logger.info(
                     f"re-synced recovered {addr}: v{served} -> v{last[1]}"
                 )
@@ -1004,6 +1005,19 @@ def main(argv=None):
         help="Retry-After seconds attached to shed (429) responses",
     )
     p.add_argument(
+        "--interactive-weight", type=int, default=4,
+        help="interactive share weight for contended fairness",
+    )
+    p.add_argument(
+        "--bulk-weight", type=int, default=1,
+        help="bulk share weight for contended fairness",
+    )
+    p.add_argument(
+        "--inflight-ttl", type=float, default=600.0,
+        help="seconds before an unfinished in-flight ledger entry "
+        "expires (crashed clients must not leak tenant capacity)",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="record per-schedule route spans (drain via GET /trace)",
     )
@@ -1028,6 +1042,9 @@ def main(argv=None):
             max_inflight_per_tenant=args.max_inflight_per_tenant,
             shed_queue_depth=args.shed_queue_depth,
             retry_after_s=args.retry_after,
+            interactive_weight=args.interactive_weight,
+            bulk_weight=args.bulk_weight,
+            inflight_ttl_s=args.inflight_ttl,
         ),
     )
 

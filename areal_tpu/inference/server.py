@@ -38,6 +38,7 @@ import os
 import threading
 import time
 import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -533,14 +534,12 @@ def serve(
         # directly to the fronting router (best-effort — the router's
         # prober also finds us through the membership watch)
         try:
-            import urllib.request as _rq
-
-            req = _rq.Request(
+            req = urllib.request.Request(
                 f"http://{router_addr}/register",
                 data=json.dumps({"addr": f"{host}:{port}"}).encode(),
                 headers={"Content-Type": "application/json"},
             )
-            with _rq.urlopen(req, timeout=10) as r:
+            with urllib.request.urlopen(req, timeout=10) as r:
                 r.read()
             logger.info(f"registered with router {router_addr}")
         except Exception as e:
@@ -564,6 +563,66 @@ def main(argv: Optional[list] = None):
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--seed", type=int, default=1)
+    # engine shape/batching knobs — every scalar JaxGenConfig field has
+    # a flag and build_cmd forwards it, so a LAUNCHED server serves the
+    # same config a colocated engine would (arealint ARL002 pins the
+    # field ↔ flag ↔ build_cmd parity; defaults are read from a default
+    # dataclass instance so a dataclass edit cannot leave a manually-
+    # launched server on a stale hand-copied default)
+    d = JaxGenConfig()
+    p.add_argument("--prefill-chunk", type=int, default=d.prefill_chunk)
+    p.add_argument("--decode-chunk", type=int, default=d.decode_chunk)
+    p.add_argument(
+        "--decode-pipeline", type=int, default=d.decode_pipeline
+    )
+    p.add_argument(
+        "--no-decode-compact", action="store_true",
+        help="disable decode tail compaction (full-slot dispatch)",
+    )
+    p.add_argument(
+        "--decode-compact-min-rows", type=int,
+        default=d.decode_compact_min_rows,
+    )
+    p.add_argument(
+        "--decode-compact-hysteresis", type=int,
+        default=d.decode_compact_hysteresis,
+    )
+    p.add_argument("--admit-wave", type=int, default=d.admit_wave)
+    p.add_argument("--admit-hold", type=float, default=d.admit_hold_s)
+    p.add_argument("--kv-bucket", type=int, default=d.kv_bucket)
+    p.add_argument(
+        "--sample-topk-bound", type=int, default=d.sample_topk_bound
+    )
+    p.add_argument("--page-size", type=int, default=d.page_size)
+    p.add_argument(
+        "--num-pages", type=int, default=d.num_pages,
+        help="KV pool pages (0 = auto full provisioning)",
+    )
+    p.add_argument(
+        "--attn-impl", default=d.attn_impl,
+        choices=("auto", "kernel", "jnp"),
+    )
+    p.add_argument(
+        "--pages-per-compute-block", type=int,
+        default=d.pages_per_compute_block,
+    )
+    p.add_argument(
+        "--slots-per-block", type=int, default=d.slots_per_block
+    )
+    p.add_argument(
+        "--pool-layout", default=d.pool_layout,
+        choices=("auto", "token_packed", "head_merged"),
+    )
+    p.add_argument("--mem-fraction", type=float, default=d.mem_fraction)
+    p.add_argument(
+        "--disable-metrics", action="store_true",
+        help="turn off the engine metrics counters",
+    )
+    p.add_argument("--log-level", default=d.log_level)
+    p.add_argument(
+        "--trace-max-spans", type=int, default=d.tracing.max_spans,
+        help="span ring-buffer bound when --trace is on",
+    )
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
     p.add_argument("--server-index", type=int, default=0)
@@ -665,6 +724,25 @@ def main(argv: Optional[list] = None):
         tensor_parallel_size=args.tensor_parallel_size,
         host=args.host,
         port=args.port,
+        prefill_chunk=args.prefill_chunk,
+        decode_chunk=args.decode_chunk,
+        decode_pipeline=args.decode_pipeline,
+        decode_compact=not args.no_decode_compact,
+        decode_compact_min_rows=args.decode_compact_min_rows,
+        decode_compact_hysteresis=args.decode_compact_hysteresis,
+        admit_wave=args.admit_wave,
+        admit_hold_s=args.admit_hold,
+        kv_bucket=args.kv_bucket,
+        sample_topk_bound=args.sample_topk_bound,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        attn_impl=args.attn_impl,
+        pages_per_compute_block=args.pages_per_compute_block,
+        slots_per_block=args.slots_per_block,
+        pool_layout=args.pool_layout,
+        mem_fraction=args.mem_fraction,
+        enable_metrics=not args.disable_metrics,
+        log_level=args.log_level,
         compilation_cache_dir=args.compilation_cache_dir,
         prefix_cache_mode=args.prefix_cache_mode,
         prefix_reuse_min=args.prefix_reuse_min,
@@ -674,6 +752,7 @@ def main(argv: Optional[list] = None):
         deadline_margin_s=args.deadline_margin,
     )
     cfg.tracing.enabled = args.trace
+    cfg.tracing.max_spans = args.trace_max_spans
     cfg.goodput.ready_quiet_s = args.ready_quiet
     cfg.goodput.ready_min_requests = args.ready_min_requests
     cfg.goodput.compile_events_path = args.compile_events

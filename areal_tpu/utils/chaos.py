@@ -50,6 +50,7 @@ own, so each call site stays in control of its error semantics.
 
 import dataclasses
 import os
+import socket
 import threading
 import time
 from typing import Any, Dict, List, Optional, Union
@@ -199,8 +200,6 @@ def apply_server_chaos(handler, send_json) -> bool:
         send_json({"error": "chaos injected"}, 500)
         return True
     if mode == "connect_drop":
-        import socket
-
         try:
             handler.connection.shutdown(socket.SHUT_RDWR)
         except Exception:

@@ -15,7 +15,9 @@ repository configured by `reconfigure()` (reference name_resolve.py:1239).
 """
 
 import dataclasses
+import json
 import os
+import urllib.request
 import random
 import shutil
 import threading
@@ -211,16 +213,13 @@ class KvNameRecordRepository(NameRecordRepository):
         self._owned: set = set()
 
     def _call(self, payload: Dict):
-        import json as _json
-        import urllib.request as _rq
-
-        req = _rq.Request(
+        req = urllib.request.Request(
             f"http://{self.address}/",
-            data=_json.dumps(payload).encode(),
+            data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"},
         )
-        with _rq.urlopen(req, timeout=30) as r:
-            out = _json.loads(r.read())
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
         if not out.get("ok"):
             if out.get("error") == "not_found":
                 raise NameEntryNotFoundError(

@@ -67,10 +67,11 @@ class AgenticToolWorkflow(RolloutWorkflow):
         system_prompt: Optional[str] = None,
         tool_timeout_s: Optional[float] = 30.0,
     ):
-        assert gconfig.n_samples == 1, (
-            "agentic episodes are single-trajectory; group sampling happens "
-            "at the prompt level"
-        )
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "agentic episodes are single-trajectory; group sampling "
+                "happens at the prompt level"
+            )
         self.env_factory = env_factory
         self.gconfig = gconfig
         self.tokenizer = tokenizer

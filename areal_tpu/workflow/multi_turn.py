@@ -44,10 +44,11 @@ class MultiTurnWorkflow(RolloutWorkflow):
         # leaves bounding to the backend's internal timeouts.
         reward_timeout_s: Optional[float] = None,
     ):
-        assert gconfig.n_samples == 1, (
-            "multi-turn episodes are single-trajectory; group sampling "
-            "happens at the prompt level"
-        )
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "multi-turn episodes are single-trajectory; group sampling "
+                "happens at the prompt level"
+            )
         self.reward_fn = AsyncRewardWrapper(
             reward_fn, timeout_s=reward_timeout_s
         )

@@ -1,0 +1,533 @@
+"""arealint: per-rule fixture tests + the tree-wide tier-1 gate.
+
+Everything here is pure AST (no jax import) and must stay fast — the
+tree-wide run is the lint gate that keeps the repo clean, so its cost
+is budgeted like any other tier-1 test (≲ 5 s total).
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.arealint import core, run, summarize
+from tools.arealint.rules import (
+    async_blocking,
+    config_parity,
+    error_handling,
+    import_hygiene,
+    lock_discipline,
+    metrics_static,
+)
+
+REPO_ROOT = core.REPO_ROOT
+
+
+def _project(tmp_path, **files):
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+    return core.Project(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# ARL001 async-no-blocking
+# ---------------------------------------------------------------------------
+class TestAsyncBlocking:
+    def test_flags_blocking_calls_in_async_def(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import time
+                import requests
+                import urllib.request
+                from areal_tpu.utils.http import request_with_retry
+
+                async def bad():
+                    time.sleep(1)
+                    requests.post("http://x")
+                    urllib.request.urlopen("http://x")
+                    request_with_retry("http://x")
+                    with open("/tmp/f") as f:
+                        pass
+                """
+            },
+        )
+        got = async_blocking.check(p, ["m.py"])
+        msgs = "\n".join(v.message for v in got)
+        assert len(got) == 5
+        for frag in (
+            "time.sleep", "requests.post", "urllib.request.urlopen",
+            "request_with_retry", "open",
+        ):
+            assert frag in msgs
+        assert all(v.rule == "ARL001" for v in got)
+        assert all(v.symbol == "bad" for v in got)
+
+    def test_alias_resolution(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import time as t
+                from time import sleep
+
+                async def bad():
+                    t.sleep(1)
+                    sleep(2)
+                """
+            },
+        )
+        assert len(async_blocking.check(p, ["m.py"])) == 2
+
+    def test_sync_code_and_closures_not_flagged(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import asyncio
+                import time
+
+                def sync_ok():
+                    time.sleep(1)
+
+                async def good():
+                    await asyncio.sleep(1)
+                    def closure():  # runs in an executor
+                        time.sleep(1)
+                    blocked = lambda: time.sleep(2)
+                    return closure, blocked
+
+                async def atwin_ok():
+                    from areal_tpu.utils.http import arequest_with_retry
+                    await arequest_with_retry(None, "http://x")
+                """
+            },
+        )
+        assert async_blocking.check(p, ["m.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# ARL002 config-plumbing-parity (runs on the real tree: the anchors are
+# the production files themselves)
+# ---------------------------------------------------------------------------
+class TestConfigParity:
+    def test_real_tree_has_no_parity_gaps(self):
+        got = config_parity.check(core.Project(REPO_ROOT), [])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_detects_unplumbed_field(self, monkeypatch, tmp_path):
+        """Drop one flag from a copy of the real server main() and the
+        rule must notice both directions of the break."""
+        import re
+
+        with open(os.path.join(REPO_ROOT, config_parity.SERVER)) as f:
+            server_src = f.read()
+        broken = server_src.replace(
+            'p.add_argument("--kv-bucket", type=int, default=d.kv_bucket)',
+            "",
+        )
+        assert broken != server_src
+        for rel in (config_parity.CLI_ARGS, config_parity.ROUTER) + tuple(
+            config_parity.LAUNCHERS
+        ):
+            full = tmp_path / rel
+            full.parent.mkdir(parents=True, exist_ok=True)
+            with open(os.path.join(REPO_ROOT, rel)) as f:
+                full.write_text(f.read())
+        sfull = tmp_path / config_parity.SERVER
+        sfull.parent.mkdir(parents=True, exist_ok=True)
+        sfull.write_text(broken)
+        got = config_parity.check(core.Project(str(tmp_path)), [])
+        msgs = "\n".join(v.message for v in got)
+        # field → flag gap AND build_cmd emits a now-undeclared flag
+        assert "kv_bucket has no server CLI flag" in msgs
+        assert re.search(r"--kv-bucket but the\s+server parser", msgs)
+
+
+# ---------------------------------------------------------------------------
+# ARL003 metrics-hygiene-static
+# ---------------------------------------------------------------------------
+class TestMetricsStatic:
+    def test_real_tree_is_clean(self):
+        got = metrics_static.check(core.Project(REPO_ROOT), [])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_inventory_resolves_fstring_loops(self):
+        inv = metrics_static.static_metric_inventory(REPO_ROOT)
+        engine = inv["engine server"]
+        # f"sched_class_{cls}_running" over SCHED_CLASSES resolved
+        assert "sched_class_interactive_running" in engine
+        assert "sched_class_bulk_queued" in engine
+        # spec-only branch discovered without running a spec engine
+        assert "spec_accept_rate_ewma" in engine
+        hub = inv["telemetry hub"]
+        # nested literal-tuple loops in the hub rollup resolved
+        assert "queue_wait_interactive_p95_s" in hub
+        assert "ttft_bulk_count" in hub
+        # anomaly gauges via the ANOMALIES module constant
+        assert "anomaly_goodput_collapse" in hub
+
+    def test_detects_missing_help(self, tmp_path):
+        surface = metrics_static.Surface(
+            name="toy",
+            help_module="toy.py",
+            help_dict="_METRIC_HELP",
+            emitters=[("toy.py", ["metrics"])],
+        )
+        _project(
+            tmp_path,
+            **{
+                "toy.py": """
+                _METRIC_HELP = {"a": "doc"}
+
+                def metrics():
+                    return {"a": 1.0, "b_mystery": 2.0}
+                """
+            },
+        )
+        old = metrics_static.SURFACES
+        metrics_static.SURFACES = [surface]
+        try:
+            got = metrics_static.check(core.Project(str(tmp_path)), [])
+        finally:
+            metrics_static.SURFACES = old
+        assert len(got) == 1
+        assert "b_mystery" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# ARL004 lock-discipline
+# ---------------------------------------------------------------------------
+class TestLockDiscipline:
+    def test_flags_nested_and_call_through_acquisition(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def direct(self):
+                        with self._lock:
+                            with self._lock:
+                                pass
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                """
+            },
+        )
+        got = lock_discipline.check(p, ["m.py"])
+        msgs = "\n".join(v.message for v in got)
+        assert "nested `with` on non-reentrant" in msgs
+        assert "calls C.inner() while holding" in msgs
+
+    def test_rlock_and_module_function_cases(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import threading
+
+                _GUARD = threading.Lock()
+                _RE = threading.RLock()
+
+                def tracker():
+                    with _GUARD:
+                        return 1
+
+                def ledger():
+                    with _GUARD:
+                        return tracker()  # the goodput PR 11 deadlock
+
+                def reentrant_ok():
+                    with _RE:
+                        with _RE:
+                            return 2
+                """
+            },
+        )
+        got = lock_discipline.check(p, ["m.py"])
+        assert len(got) == 1
+        assert "tracker" in got[0].message
+        assert got[0].symbol == "ledger"
+
+    def test_lock_order_cycle(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def ab():
+                    with A:
+                        with B:
+                            pass
+
+                def ba():
+                    with B:
+                        with A:
+                            pass
+                """
+            },
+        )
+        got = lock_discipline.check(p, ["m.py"])
+        assert any("lock-order cycle" in v.message for v in got)
+
+    def test_consistent_order_no_cycle(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def one():
+                    with A:
+                        with B:
+                            pass
+
+                def two():
+                    with A:
+                        with B:
+                            pass
+                """
+            },
+        )
+        assert lock_discipline.check(p, ["m.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# ARL005 no-bare-assert-or-swallow
+# ---------------------------------------------------------------------------
+class TestErrorHandling:
+    def test_flags_assert_in_scope_only(self, tmp_path):
+        src = """
+        def f(x):
+            assert x > 0
+            return x
+        """
+        p = _project(
+            tmp_path,
+            **{
+                "areal_tpu/inference/mod.py": src,
+                "areal_tpu/ops/kernel.py": src,  # exempt package
+            },
+        )
+        got = error_handling.check(
+            p, ["areal_tpu/inference/mod.py", "areal_tpu/ops/kernel.py"]
+        )
+        assert len(got) == 1
+        assert got[0].path == "areal_tpu/inference/mod.py"
+        assert "bare assert" in got[0].message
+
+    def test_silent_swallow_vs_visible_handlers(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "areal_tpu/inference/mod.py": """
+                import logging
+
+                logger = logging.getLogger(__name__)
+
+                def silent():
+                    try:
+                        work()
+                    except Exception:
+                        pass  # flagged
+
+                def logs():
+                    try:
+                        work()
+                    except Exception as e:
+                        logger.warning(f"failed: {e}")
+
+                def reraises():
+                    try:
+                        work()
+                    except Exception:
+                        raise RuntimeError("typed")
+
+                def carries():
+                    try:
+                        work()
+                    except Exception as e:
+                        out = {"error": str(e)}
+                        return out
+
+                def returns_result():
+                    try:
+                        return work()
+                    except Exception:
+                        return 0.0
+
+                def narrow_ok():
+                    try:
+                        work()
+                    except KeyError:
+                        pass
+                """
+            },
+        )
+        got = error_handling.check(p, ["areal_tpu/inference/mod.py"])
+        assert len(got) == 1
+        assert got[0].symbol == "silent"
+
+
+# ---------------------------------------------------------------------------
+# ARL006 import-hygiene
+# ---------------------------------------------------------------------------
+class TestImportHygiene:
+    def test_midfile_and_network_imports(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                \"\"\"doc\"\"\"
+                import os
+
+                try:  # header fallback guard: fine
+                    import fast_json as json
+                except ImportError:
+                    import json
+
+
+                def f():
+                    import requests  # flagged: network in function body
+                    import jax  # allowed: heavyweight lazy import
+                    return requests, jax
+
+
+                import threading  # flagged: mid-file
+                """
+            },
+        )
+        got = import_hygiene.check(p, ["m.py"])
+        assert len(got) == 2
+        msgs = "\n".join(v.message for v in got)
+        assert "requests" in msgs and "threading" in msgs
+        assert "jax" not in msgs
+
+    def test_nested_def_reported_once(self, tmp_path):
+        p = _project(
+            tmp_path,
+            **{
+                "m.py": """
+                def outer():
+                    def inner():
+                        import socket
+                        return socket
+                    return inner
+                """
+            },
+        )
+        got = import_hygiene.check(p, ["m.py"])
+        assert len(got) == 1
+        assert got[0].symbol == "outer.inner"
+
+
+# ---------------------------------------------------------------------------
+# Waivers + framework
+# ---------------------------------------------------------------------------
+class TestWaivers:
+    def test_waiver_covers_and_stale_reporting(self):
+        v = core.Violation(
+            rule="ARL005", path="a.py", line=3, message="swallow",
+            symbol="C.m",
+        )
+        other = core.Violation(
+            rule="ARL005", path="a.py", line=9, message="swallow",
+            symbol="C.other",
+        )
+        waivers = [
+            core.Waiver(
+                rule="ARL005", path="a.py", symbol="C.m", reason="ok",
+            ),
+            core.Waiver(
+                rule="ARL001", path="gone.py", reason="stale", line=40,
+            ),
+        ]
+        out = core.apply_waivers([v, other], waivers)
+        assert v.waived and v.waiver_reason == "ok"
+        assert not other.waived
+        stale = [x for x in out if x.rule == core.STALE_WAIVER_RULE]
+        assert len(stale) == 1 and "gone.py" in stale[0].message
+
+    def test_parse_waivers_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            core.parse_waivers("[[waiver]]\nrule = \"ARL001\"\n")  # no path
+        with pytest.raises(ValueError):
+            core.parse_waivers("[[waiver]]\nbad line\n")
+        with pytest.raises(ValueError):
+            core.parse_waivers("[[waiver]]\nrule = unquoted\n")
+
+    def test_repo_waivers_parse_and_all_used(self):
+        waivers = core.load_waivers(REPO_ROOT)
+        assert waivers, "waivers.toml should carry the justified entries"
+        for w in waivers:
+            assert len(w.reason) > 10, f"reason too thin: {w}"
+
+
+class TestFrameworkAndGate:
+    def test_cli_list_rules_has_six(self):
+        from tools.arealint import all_rules
+
+        rules = all_rules()
+        assert len(rules) >= 6
+        assert {r.id for r in rules} >= {
+            "ARL001", "ARL002", "ARL003", "ARL004", "ARL005", "ARL006",
+        }
+
+    def test_rule_filter_unknown_id_raises(self):
+        with pytest.raises(ValueError):
+            run(root=REPO_ROOT, rule_ids=["ARL999"])
+
+    def test_tree_is_clean(self):
+        """THE tier-1 lint gate: zero unwaived violations on the tree
+        (stale waivers count as violations too, so the waiver file can
+        only shrink)."""
+        violations = run(root=REPO_ROOT)
+        unwaived = [v for v in violations if not v.waived]
+        assert unwaived == [], (
+            "arealint violations (fix them or add a justified "
+            "waivers.toml entry):\n"
+            + "\n".join(v.format() for v in unwaived)
+        )
+
+    def test_linter_never_imports_jax(self):
+        """The gate must stay pure-AST: a jax import would 10x its cost
+        and couple linting to the accelerator runtime."""
+        import subprocess
+
+        code = (
+            "import sys; import tools.arealint; "
+            "import tools.arealint.rules; "
+            "sys.exit(1 if any(m.startswith('jax') for m in sys.modules)"
+            " else 0)"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT},
+        )
+        assert proc.returncode == 0, proc.stderr.decode()

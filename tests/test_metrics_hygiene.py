@@ -4,7 +4,16 @@ telemetry hub) must carry a _METRIC_HELP entry AND an explicit type in
 the process-wide registry (tracing.METRIC_TYPES) — the *_total suffix
 heuristic is a fallback for unregistered names only, and no real
 surface may rely on it. Also pins render/parse round-tripping for all
-three metric types."""
+three metric types.
+
+Static cross-check (r12): every runtime-OBSERVED name must be a subset
+of the names arealint's ARL003 rule discovers statically
+(tools/arealint/rules/metrics_static.py). The static side covers emit
+branches these fixtures never take (spec-off engines, unfired anomaly
+gauges); this side proves the static extractor keeps up with the real
+emitters — a runtime name the AST scan cannot see means the rule's
+surface spec needs extending, caught HERE instead of silently losing
+lint coverage."""
 
 import os
 import sys
@@ -21,6 +30,9 @@ from areal_tpu.utils.tracing import (
     register_metric_types,
     render_prometheus,
 )
+from tools.arealint.rules.metrics_static import static_metric_inventory
+
+_STATIC_INVENTORY = static_metric_inventory()
 
 
 def _base_names(text: str) -> set:
@@ -64,6 +76,20 @@ def _assert_surface(text: str, prefix: str, surface: str):
         f"{surface}: names not in the explicit type registry "
         f"(tracing.METRIC_TYPES) — the suffix heuristic would guess "
         f"their TYPE: {unregistered}"
+    )
+    # runtime ⊆ static: everything this render produced must also be
+    # statically discoverable by arealint ARL003, or the lint rule has
+    # lost sight of an emitter and its branch coverage is fiction
+    static = _STATIC_INVENTORY.get(surface)
+    assert static is not None, (
+        f"{surface!r} missing from arealint's SURFACES map "
+        f"(tools/arealint/rules/metrics_static.py)"
+    )
+    unseen = sorted(names - static)
+    assert not unseen, (
+        f"{surface}: runtime emits names the static scan cannot see "
+        f"(extend the surface's emitters/extras in metrics_static.py): "
+        f"{unseen}"
     )
 
 

@@ -1,0 +1,79 @@
+"""arealint — project-native AST invariant checker for the async
+serving/training stack.
+
+Usage::
+
+    python -m tools.arealint                 # full tree, human output
+    python -m tools.arealint --diff main     # only files changed vs main
+    python -m tools.arealint --rule ARL001   # one rule
+    python -m tools.arealint --json          # machine-readable findings
+    python -m tools.arealint --list-rules
+
+Exit status 0 = clean (waived findings allowed), 1 = unwaived
+violations, 2 = usage/internal error. The run is pure AST: it never
+imports jax or any areal_tpu module, and a full-tree run stays under
+ten seconds. The tier-1 gate is ``tests/test_arealint.py`` — the rule
+catalog and waiver policy are documented in docs/ARCHITECTURE.md §16.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+from tools.arealint import core
+from tools.arealint.core import (  # noqa: F401  (public API)
+    Project,
+    Rule,
+    Violation,
+    Waiver,
+    all_rules,
+    apply_waivers,
+    load_waivers,
+)
+import tools.arealint.rules  # noqa: F401  (registers every rule)
+
+
+def run(
+    root: str = core.REPO_ROOT,
+    rule_ids: Optional[Sequence[str]] = None,
+    diff_base: Optional[str] = None,
+    waive: bool = True,
+) -> List[Violation]:
+    """Run the selected rules over ``root``; returns every finding with
+    waived ones marked (callers gate on the unwaived subset)."""
+    project = Project(root)
+    rules = all_rules()
+    if rule_ids:
+        unknown = set(rule_ids) - {r.id for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+        rules = [r for r in rules if r.id in set(rule_ids)]
+    diff_files: Optional[List[str]] = None
+    if diff_base is not None:
+        diff_files = core.changed_files(root, diff_base)
+    violations: List[Violation] = []
+    for rule in rules:
+        files = project.walk_python_files(rule.paths) if rule.paths else []
+        if diff_files is not None:
+            changed = set(diff_files)
+            files = [f for f in files if f in changed]
+            anchored = bool(set(rule.anchors) & changed)
+            if not files and not anchored:
+                continue  # nothing this rule covers changed
+        violations.extend(rule.check(project, files))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    if waive:
+        waivers = load_waivers(root)
+        # stale-waiver reporting needs the FULL picture: a diff run or a
+        # rule subset sees only part of the tree
+        report_stale = diff_base is None and not rule_ids
+        apply_waivers(violations, waivers, report_stale=report_stale)
+        violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+def summarize(violations: List[Violation]) -> Dict[str, int]:
+    out: Dict[str, int] = {"total": len(violations), "unwaived": 0}
+    for v in violations:
+        out[v.rule] = out.get(v.rule, 0) + 1
+        if not v.waived:
+            out["unwaived"] += 1
+    return out

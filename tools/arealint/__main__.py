@@ -1,0 +1,93 @@
+"""CLI for arealint (see package docstring for the contract)."""
+
+import argparse
+import json
+import sys
+import time
+
+from tools.arealint import all_rules, run, summarize
+from tools.arealint.core import REPO_ROOT
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.arealint",
+        description=(
+            "project-native AST invariant checker (pure AST — never "
+            "imports jax)"
+        ),
+    )
+    p.add_argument(
+        "--root", default=REPO_ROOT, help="lint root (default: repo root)"
+    )
+    p.add_argument(
+        "--diff",
+        metavar="BASE",
+        default=None,
+        help="lint only files changed vs this git ref (cross-module "
+        "rules still run when an anchor file changed)",
+    )
+    p.add_argument(
+        "--rule",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--show-waived",
+        action="store_true",
+        help="also print findings carried by waivers.toml",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    t0 = time.monotonic()
+    try:
+        violations = run(
+            root=args.root,
+            rule_ids=args.rule.split(",") if args.rule else None,
+            diff_base=args.diff,
+        )
+    except ValueError as e:
+        print(f"arealint: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+    unwaived = [v for v in violations if not v.waived]
+    waived = [v for v in violations if v.waived]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "violations": [v.to_dict() for v in violations],
+                    "summary": summarize(violations),
+                    "elapsed_s": round(elapsed, 3),
+                },
+                indent=2,
+            )
+        )
+        return 1 if unwaived else 0
+
+    for v in unwaived:
+        print(v.format())
+    if args.show_waived:
+        for v in waived:
+            print(f"[waived: {v.waiver_reason}] {v.format()}")
+    status = "clean" if not unwaived else f"{len(unwaived)} violation(s)"
+    print(
+        f"arealint: {status} "
+        f"({len(waived)} waived) in {elapsed:.2f}s",
+        file=sys.stderr,
+    )
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

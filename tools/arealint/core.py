@@ -1,0 +1,607 @@
+"""arealint core: parsed-module cache, violations, waivers, and the
+constant resolver the cross-module rules share.
+
+Design constraints (docs/ARCHITECTURE.md §16):
+
+- **Pure AST.** Nothing here may import ``jax``, ``numpy``, or any
+  ``areal_tpu`` module — the whole run must stay under ten seconds on a
+  cold interpreter, and linting must never depend on the runtime
+  environment the lint is protecting.
+- **Project-native.** Rules are allowed (encouraged) to hardcode this
+  repo's layout: the server's ``_METRIC_HELP`` dict, the launcher's
+  ``build_cmd``, the typed error families in ``api/env_api.py``. A rule
+  is a codified PR review, not a general-purpose checker.
+- **No silent drops.** A violation is either reported, fixed, or
+  carried by a justified entry in ``waivers.toml``; waivers that no
+  longer match anything are themselves reported (ARL000) so the file
+  can only shrink over time.
+"""
+
+import ast
+import dataclasses
+import os
+import subprocess
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")
+)
+
+# rule ids are stable contract names: waiver entries and --rule filters
+# key on them, so renaming one is a breaking change to waivers.toml
+STALE_WAIVER_RULE = "ARL000"
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding. ``symbol`` is the dotted qualname of the enclosing
+    class/function (waivers key on it — line numbers churn, symbols
+    don't); ``hint`` says how to fix, not just what is wrong."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+    symbol: str = ""
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        suffix = f" (fix: {self.hint})" if self.hint else ""
+        return f"{loc}: {self.rule}{sym}: {self.message}{suffix}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file plus the derived indexes every rule wants:
+    import aliases, enclosing-symbol lookup, module-level constants."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.tree = ast.parse(source, filename=rel_path)
+        self.import_aliases = _collect_import_aliases(self.tree)
+        self._symbol_spans: List[tuple] = []
+        self._index_symbols(self.tree.body, prefix="")
+
+    def _index_symbols(self, body, prefix: str) -> None:
+        for node in body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                qual = f"{prefix}{node.name}"
+                end = getattr(node, "end_lineno", node.lineno)
+                self._symbol_spans.append((node.lineno, end, qual))
+                self._index_symbols(node.body, prefix=f"{qual}.")
+
+    def symbol_at(self, lineno: int) -> str:
+        """Innermost enclosing def/class qualname for a line."""
+        best = ""
+        best_span = None
+        for start, end, qual in self._symbol_spans:
+            if start <= lineno <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def dotted_call_name(self, func: ast.AST) -> str:
+        """Resolve a call's func expression to a dotted name with import
+        aliases applied: ``t.sleep`` with ``import time as t`` resolves
+        to ``time.sleep``; ``sleep`` with ``from time import sleep``
+        resolves to ``time.sleep``; ``self.foo`` resolves to
+        ``self.foo`` (untouched — local attribute)."""
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        else:
+            return ""
+        parts.reverse()
+        head = parts[0]
+        if head in self.import_aliases:
+            parts[0] = self.import_aliases[head]
+        return ".".join(parts)
+
+
+def _collect_import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name → fully-dotted origin, from every import in the file
+    (function-level ones included: a lazy ``import requests`` inside a
+    coroutine must still make ``requests.post`` resolvable)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+class Project:
+    """Lazy parsed-module cache over the lint root. Rules address files
+    by repo-relative path, so cross-module joins (ARL002/ARL003) read
+    their anchors through the same cache as the per-file walks."""
+
+    def __init__(self, root: str = REPO_ROOT):
+        self.root = os.path.abspath(root)
+        self._cache: Dict[str, Optional[Module]] = {}
+
+    def module(self, rel_path: str) -> Optional[Module]:
+        rel_path = rel_path.replace(os.sep, "/")
+        if rel_path not in self._cache:
+            full = os.path.join(self.root, rel_path)
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    src = f.read()
+                self._cache[rel_path] = Module(full, rel_path, src)
+            except (OSError, SyntaxError):
+                self._cache[rel_path] = None
+        return self._cache[rel_path]
+
+    def walk_python_files(self, subdirs: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for sub in subdirs:
+            base = os.path.join(self.root, sub)
+            if os.path.isfile(base) and base.endswith(".py"):
+                out.append(os.path.relpath(base, self.root))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(
+                            os.path.relpath(
+                                os.path.join(dirpath, fn), self.root
+                            ).replace(os.sep, "/")
+                        )
+        return sorted(set(out))
+
+
+# --------------------------------------------------------------------------
+# Rule registry
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class Rule:
+    """One invariant. ``paths`` scopes the per-file walk; ``anchors``
+    are the files whose change triggers the rule in --diff mode even
+    when the rule is cross-module (a build_cmd edit must re-run parity
+    even if no other file moved)."""
+
+    id: str
+    name: str
+    description: str
+    check: Callable[[Project, List[str]], List[Violation]]
+    paths: Sequence[str] = ("areal_tpu",)
+    anchors: Sequence[str] = ()
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule: Rule) -> Rule:
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _RULES[rule.id] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# --------------------------------------------------------------------------
+# Waivers
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class Waiver:
+    rule: str
+    path: str
+    reason: str
+    symbol: str = ""
+    match: str = ""
+    line: int = 0  # waivers.toml line, for the stale-waiver report
+    used: bool = False
+
+    def covers(self, v: Violation) -> bool:
+        if self.rule != v.rule or self.path != v.path:
+            return False
+        if self.symbol and self.symbol != v.symbol:
+            return False
+        if self.match and self.match not in v.message:
+            return False
+        return True
+
+
+def parse_waivers(text: str) -> List[Waiver]:
+    """Parse the restricted TOML subset waivers.toml uses: ``[[waiver]]``
+    tables of ``key = "value"`` string pairs plus ``#`` comments. (The
+    interpreter this repo pins is 3.10 — no stdlib tomllib — and the
+    linter must not grow a dependency for one file.)"""
+    waivers: List[Waiver] = []
+    current: Optional[Dict[str, Any]] = None
+    current_line = 0
+
+    def flush():
+        nonlocal current
+        if current is None:
+            return
+        missing = {"rule", "path", "reason"} - set(current)
+        if missing:
+            raise ValueError(
+                f"waivers.toml line {current_line}: entry missing "
+                f"required keys {sorted(missing)}"
+            )
+        waivers.append(Waiver(line=current_line, **current))
+        current = None
+
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[waiver]]":
+            flush()
+            current = {}
+            current_line = lineno
+            continue
+        if "=" in line and current is not None:
+            key, _, value = line.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if not (
+                len(value) >= 2
+                and value[0] == value[-1]
+                and value[0] in "\"'"
+            ):
+                raise ValueError(
+                    f"waivers.toml line {lineno}: value for {key!r} must "
+                    f"be a quoted string"
+                )
+            if key not in ("rule", "path", "reason", "symbol", "match"):
+                raise ValueError(
+                    f"waivers.toml line {lineno}: unknown key {key!r}"
+                )
+            current[key] = value[1:-1]
+            continue
+        raise ValueError(
+            f"waivers.toml line {lineno}: unparseable line {line!r} "
+            f"(this file uses a restricted TOML subset: [[waiver]] "
+            f"tables of string pairs)"
+        )
+    flush()
+    return waivers
+
+
+def load_waivers(root: str) -> List[Waiver]:
+    path = os.path.join(root, "tools", "arealint", "waivers.toml")
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_waivers(f.read())
+
+
+def apply_waivers(
+    violations: List[Violation],
+    waivers: List[Waiver],
+    report_stale: bool = True,
+) -> List[Violation]:
+    """Mark waived violations in place; append an ARL000 stale-waiver
+    violation for every entry that matched nothing (full runs only —
+    a --diff run sees a partial tree, so staleness is unknowable)."""
+    for v in violations:
+        for w in waivers:
+            if w.covers(v):
+                v.waived = True
+                v.waiver_reason = w.reason
+                w.used = True
+                break
+    if report_stale:
+        for w in waivers:
+            if not w.used:
+                violations.append(
+                    Violation(
+                        rule=STALE_WAIVER_RULE,
+                        path="tools/arealint/waivers.toml",
+                        line=w.line,
+                        message=(
+                            f"stale waiver: {w.rule} on {w.path}"
+                            + (f" [{w.symbol}]" if w.symbol else "")
+                            + " matches no current violation"
+                        ),
+                        hint="delete the entry — the violation it "
+                        "carried no longer exists",
+                    )
+                )
+    return violations
+
+
+# --------------------------------------------------------------------------
+# Constant resolver (the cross-module rules' shared mini-evaluator)
+# --------------------------------------------------------------------------
+class ResolveError(Exception):
+    pass
+
+
+_MAX_LOOP_ITER = 128
+
+
+class ConstResolver:
+    """Best-effort evaluation of the constant-shaped Python this repo
+    writes its metric registries and flag tables in: string constants,
+    f-strings over resolved names, tuples/lists, dicts (keys tracked,
+    values kept when resolvable), ``{**a, **b}`` merges, comprehensions
+    over resolvable iterables, module-level ``for`` loops that fill a
+    dict by subscript, and ``d.update(...)`` statements.
+
+    Values are plain Python: ``str``, ``list`` (tuples too), ``dict``.
+    Anything else raises :class:`ResolveError` — callers treat failure
+    as "skip, don't guess": the rules must never fabricate a finding
+    from an unresolvable expression.
+    """
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.consts: Dict[str, Any] = {}
+
+    # -- statement pass (module body or a function body) ----------------
+    def exec_body(self, body: Iterable[ast.stmt], env: Dict[str, Any]):
+        for stmt in body:
+            try:
+                self._exec_stmt(stmt, env)
+            except ResolveError:
+                continue  # unresolvable statements don't poison the rest
+
+    def _exec_stmt(self, stmt: ast.stmt, env: Dict[str, Any]) -> None:
+        if isinstance(stmt, ast.Assign):
+            value = self.eval(stmt.value, env)
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    env[target.id] = value
+                elif isinstance(target, ast.Subscript) and isinstance(
+                    target.value, ast.Name
+                ):
+                    container = env.get(target.value.id)
+                    if isinstance(container, dict):
+                        key = self.eval(target.slice, env)
+                        if isinstance(key, str):
+                            container[key] = value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = self.eval(stmt.value, env)
+        elif isinstance(stmt, ast.For):
+            self._exec_for(stmt, env)
+        elif isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Call
+        ):
+            call = stmt.value
+            # d.update(other) / d.update(k=v, ...)
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "update"
+                and isinstance(call.func.value, ast.Name)
+            ):
+                container = env.get(call.func.value.id)
+                if isinstance(container, dict):
+                    for arg in call.args:
+                        val = self.eval(arg, env)
+                        if isinstance(val, dict):
+                            container.update(val)
+                    for kw in call.keywords:
+                        if kw.arg is not None:
+                            try:
+                                container[kw.arg] = self.eval(
+                                    kw.value, env
+                                )
+                            except ResolveError:
+                                container[kw.arg] = None
+
+    def _exec_for(self, stmt: ast.For, env: Dict[str, Any]) -> None:
+        iterable = self.eval(stmt.iter, env)
+        items = _iter_items(iterable)
+        if len(items) > _MAX_LOOP_ITER:
+            raise ResolveError("loop too large to unroll")
+        for item in items:
+            # loop vars bind in a copy; dict/list mutations flow back
+            # through the shared container references
+            bound = dict(env)
+            _bind_target(stmt.target, item, bound)
+            self.exec_body(stmt.body, bound)
+
+    # -- expression evaluation ------------------------------------------
+    def eval(self, node: ast.AST, env: Optional[Dict[str, Any]] = None):
+        env = env or {}
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (str, int, float, bool)):
+                return node.value
+            raise ResolveError(f"constant {node.value!r}")
+        if isinstance(node, ast.JoinedStr):
+            parts: List[str] = []
+            for piece in node.values:
+                if isinstance(piece, ast.Constant):
+                    parts.append(str(piece.value))
+                elif isinstance(piece, ast.FormattedValue):
+                    val = self.eval(piece.value, env)
+                    if not isinstance(val, (str, int, float)):
+                        raise ResolveError("unresolvable f-string part")
+                    parts.append(str(val))
+                else:
+                    raise ResolveError("unknown f-string piece")
+            return "".join(parts)
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            if node.id in self.consts:
+                return self.consts[node.id]
+            raise ResolveError(f"unknown name {node.id}")
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [self.eval(el, env) for el in node.elts]
+        if isinstance(node, ast.Dict):
+            out: Dict[str, Any] = {}
+            for k, v in zip(node.keys, node.values):
+                if k is None:  # {**other}
+                    merged = self.eval(v, env)
+                    if not isinstance(merged, dict):
+                        raise ResolveError("** of non-dict")
+                    out.update(merged)
+                    continue
+                key = self.eval(k, env)
+                if not isinstance(key, str):
+                    raise ResolveError("non-string dict key")
+                try:
+                    out[key] = self.eval(v, env)
+                except ResolveError:
+                    out[key] = None  # keys matter; values are optional
+            return out
+        if isinstance(node, ast.DictComp):
+            out = {}
+            for bound in self._comp_bindings(node.generators, env):
+                try:
+                    key = self.eval(node.key, bound)
+                except ResolveError:
+                    continue
+                if isinstance(key, str):
+                    try:
+                        out[key] = self.eval(node.value, bound)
+                    except ResolveError:
+                        out[key] = None
+            return out
+        if isinstance(node, (ast.ListComp, ast.SetComp)):
+            vals = []
+            for bound in self._comp_bindings(node.generators, env):
+                vals.append(self.eval(node.elt, bound))
+            return vals
+        if isinstance(node, ast.Compare):
+            return self._eval_compare(node, env)
+        if isinstance(node, ast.BoolOp):
+            vals = [self.eval(v, env) for v in node.values]
+            if isinstance(node.op, ast.And):
+                result = True
+                for v in vals:
+                    result = result and v
+                return result
+            result = False
+            for v in vals:
+                result = result or v
+            return result
+        raise ResolveError(f"unsupported node {type(node).__name__}")
+
+    def _eval_compare(self, node: ast.Compare, env: Dict[str, Any]):
+        left = self.eval(node.left, env)
+        for op, comparator in zip(node.ops, node.comparators):
+            right = self.eval(comparator, env)
+            container = (
+                list(right.keys()) if isinstance(right, dict) else right
+            )
+            if isinstance(op, ast.In):
+                ok = left in container
+            elif isinstance(op, ast.NotIn):
+                ok = left not in container
+            elif isinstance(op, ast.Eq):
+                ok = left == right
+            elif isinstance(op, ast.NotEq):
+                ok = left != right
+            else:
+                raise ResolveError("unsupported comparison")
+            if not ok:
+                return False
+            left = right
+        return True
+
+    def _comp_bindings(self, generators, env: Dict[str, Any]):
+        """All variable bindings a (possibly nested, filtered)
+        comprehension produces."""
+
+        def expand(gens, bound):
+            if not gens:
+                yield bound
+                return
+            gen = gens[0]
+            iterable = self.eval(gen.iter, bound)
+            items = _iter_items(iterable)
+            if len(items) > _MAX_LOOP_ITER:
+                raise ResolveError("comprehension too large")
+            for item in items:
+                nxt = dict(bound)
+                _bind_target(gen.target, item, nxt)
+                keep = True
+                for cond in gen.ifs:
+                    try:
+                        keep = keep and bool(self.eval(cond, nxt))
+                    except ResolveError:
+                        keep = True  # over-approximate: keep the item
+                if keep:
+                    yield from expand(gens[1:], nxt)
+
+        yield from expand(list(generators), dict(env))
+
+
+def _iter_items(value: Any) -> List[Any]:
+    if isinstance(value, dict):
+        return list(value.keys())
+    if isinstance(value, list):
+        return list(value)
+    if isinstance(value, str):
+        raise ResolveError("refusing to iterate a string")
+    raise ResolveError(f"non-iterable {type(value).__name__}")
+
+
+def _bind_target(target: ast.AST, item: Any, env: Dict[str, Any]) -> None:
+    if isinstance(target, ast.Name):
+        env[target.id] = item
+        return
+    if isinstance(target, (ast.Tuple, ast.List)):
+        if not isinstance(item, list) or len(item) != len(target.elts):
+            raise ResolveError("tuple-unpack arity mismatch")
+        for sub, val in zip(target.elts, item):
+            _bind_target(sub, val, env)
+        return
+    raise ResolveError("unsupported loop target")
+
+
+def module_constants(module: Module) -> Dict[str, Any]:
+    """Evaluate a module's top-level constant-shaped statements (the
+    registries ARL002/ARL003 join across files). Cached per resolver
+    call site — cheap enough not to bother caching globally."""
+    resolver = ConstResolver(module)
+    resolver.exec_body(module.tree.body, resolver.consts)
+    return resolver.consts
+
+
+# --------------------------------------------------------------------------
+# Git helpers (--diff mode)
+# --------------------------------------------------------------------------
+def changed_files(root: str, base: str) -> List[str]:
+    """Python files changed since ``base`` (committed, staged, and
+    unstaged alike — the linter gates what WOULD land)."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", base, "--", "*.py"],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    return [
+        line.strip()
+        for line in out.stdout.splitlines()
+        if line.strip().endswith(".py")
+        and os.path.exists(os.path.join(root, line.strip()))
+    ]

@@ -1,0 +1,16 @@
+"""Rule registry: importing this package registers every rule.
+
+To add a rule: create a module here that builds a ``core.Rule`` and
+passes it to ``core.register_rule``, then import it below (and document
+the contract in docs/ARCHITECTURE.md §16). Rule ids are stable —
+waivers.toml and --rule filters key on them.
+"""
+
+from tools.arealint.rules import (  # noqa: F401
+    async_blocking,
+    config_parity,
+    error_handling,
+    import_hygiene,
+    lock_discipline,
+    metrics_static,
+)

@@ -1,0 +1,144 @@
+"""ARL001 async-no-blocking: no blocking call lexically inside
+``async def``.
+
+The historical bugs this encodes: PR 4's executor re-sync (a blocking
+reward call serialized the whole rollout loop) and PR 8's
+``ToolEnvAdapter`` fix (a tool call ran on the asyncio loop thread and
+froze every in-flight episode). One blocking call on the loop stalls
+EVERY coroutine sharing it — in a fully-async serving stack that is a
+fleet-wide latency spike, not a local slowdown.
+
+Flagged inside ``async def`` bodies (nested sync ``def``/``lambda``
+bodies are excluded — closures handed to ``run_in_executor``/
+``to_thread`` run off-loop by construction):
+
+- ``time.sleep`` (use ``asyncio.sleep``)
+- any ``requests.*`` / ``urllib.request.urlopen`` / ``http.client``
+  call (use ``utils/http.arequest_with_retry`` on the shared session)
+- the sync ``request_with_retry`` twin (same: use the ``a``-prefixed
+  coroutine)
+- ``socket.create_connection`` / ``socket.socket(...).connect``
+- blocking file I/O via builtin ``open`` (wrap in
+  ``loop.run_in_executor`` / ``asyncio.to_thread``)
+- ``subprocess.run/call/check_output/check_call`` and ``os.system``
+"""
+
+import ast
+from typing import Dict, List
+
+from tools.arealint import core
+
+RULE_ID = "ARL001"
+
+# dotted call name (import-alias resolved) → fix hint
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "await asyncio.sleep(...) instead",
+    "urllib.request.urlopen": (
+        "use utils/http.arequest_with_retry (aiohttp) or run_in_executor"
+    ),
+    "socket.create_connection": "use asyncio streams or run_in_executor",
+    "os.system": "use asyncio.create_subprocess_shell",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "open": (
+        "blocking file I/O on the loop thread: wrap in "
+        "asyncio.to_thread / loop.run_in_executor"
+    ),
+}
+# any call under these roots is blocking network I/O
+_BLOCKING_PREFIXES: Dict[str, str] = {
+    "requests.": "use utils/http.arequest_with_retry (aiohttp)",
+    "http.client.": "use utils/http.arequest_with_retry (aiohttp)",
+}
+# the sync retry twin, however it was imported
+_SYNC_TWIN_SUFFIX = "request_with_retry"
+
+
+def _is_blocking(dotted: str) -> str:
+    """Return the fix hint when ``dotted`` names a blocking call."""
+    if dotted in _BLOCKING_CALLS:
+        return _BLOCKING_CALLS[dotted]
+    for prefix, hint in _BLOCKING_PREFIXES.items():
+        if dotted.startswith(prefix):
+            return hint
+    if (
+        dotted.split(".")[-1] == _SYNC_TWIN_SUFFIX
+        and not dotted.split(".")[-1].startswith("a")
+    ):
+        return "use the async twin arequest_with_retry"
+    return ""
+
+
+class _AsyncWalker(ast.NodeVisitor):
+    def __init__(self, module: core.Module):
+        self.module = module
+        self.violations: List[core.Violation] = []
+        self._async_depth = 0
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._async_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # a sync def nested in a coroutine is a closure that runs
+        # wherever it is CALLED (usually an executor thread) — its body
+        # is out of async scope
+        depth, self._async_depth = self._async_depth, 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self._async_depth = depth
+
+    def visit_Lambda(self, node: ast.Lambda):
+        depth, self._async_depth = self._async_depth, 0
+        self.visit(node.body)
+        self._async_depth = depth
+
+    def visit_Call(self, node: ast.Call):
+        if self._async_depth > 0:
+            dotted = self.module.dotted_call_name(node.func)
+            hint = _is_blocking(dotted) if dotted else ""
+            if hint:
+                self.violations.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=self.module.rel_path,
+                        line=node.lineno,
+                        message=(
+                            f"blocking call {dotted}() inside async def "
+                            f"— it stalls every coroutine on this loop"
+                        ),
+                        hint=hint,
+                        symbol=self.module.symbol_at(node.lineno),
+                    )
+                )
+        self.generic_visit(node)
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for rel in files:
+        module = project.module(rel)
+        if module is None:
+            continue
+        walker = _AsyncWalker(module)
+        walker.visit(module.tree)
+        out.extend(walker.violations)
+    return out
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="async-no-blocking",
+        description=(
+            "no blocking sleep/network/file/subprocess call lexically "
+            "inside async def"
+        ),
+        check=check,
+        paths=("areal_tpu",),
+    )
+)

@@ -1,0 +1,453 @@
+"""ARL002 config-plumbing-parity: a config field that cannot reach a
+subprocess is a silent default.
+
+The historical bug (PR 10): ``JaxGenConfig.deadline_margin_s`` existed
+on the dataclass and the engine read it — but the server CLI had no
+flag and ``build_cmd`` never passed it, so every LAUNCHED server ran
+the default while colocated tests ran the configured value. This rule
+makes the whole plumbing chain a machine-checked join:
+
+1. **field → flag**: every scalar field of ``JaxGenConfig`` (and its
+   ``SpecConfig``/``TracingConfig``/``GoodputConfig`` sub-configs) must
+   have a matching ``add_argument`` flag in ``inference/server.py``'s
+   ``main()``. Matching is kebab-case of the field name, the same minus
+   a trailing ``_s`` unit suffix, or an explicit alias below.
+2. **flag → build_cmd**: every such flag must appear in
+   ``JaxGenConfig.build_cmd`` (string-literal scan of the function, so
+   conditionally-emitted flags count).
+3. **build_cmd → flag**: every flag build_cmd (or a launcher append on
+   its result) emits must be declared by the server parser — a typo'd
+   flag kills the subprocess at spawn, in production, not in review.
+4. **router**: every ``TrafficConfig`` field the router implementation
+   reads (``*.traffic.<field>`` attribute accesses in
+   ``inference/router.py``) must have a flag in the router's ``main()``
+   — the subprocess router must be configurable to what the in-process
+   router already honors.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.arealint import core
+
+RULE_ID = "ARL002"
+
+CLI_ARGS = "areal_tpu/api/cli_args.py"
+SERVER = "areal_tpu/inference/server.py"
+ROUTER = "areal_tpu/inference/router.py"
+LAUNCHERS = (
+    "areal_tpu/launcher/local.py",
+    "areal_tpu/launcher/ray.py",
+    "areal_tpu/launcher/slurm.py",
+    "areal_tpu/launcher/pod.py",
+)
+
+# (config class, field) → server flag, where kebab-case doesn't match.
+# A None value means the field is deliberately NOT CLI-plumbed; every
+# exemption must say why.
+_SERVER_ALIASES: Dict[Tuple[str, str], Optional[str]] = {
+    ("JaxGenConfig", "shed_retry_after_s"): "shed-retry-after",
+    ("JaxGenConfig", "deadline_margin_s"): "deadline-margin",
+    # bool default True → negative flag
+    ("JaxGenConfig", "deadline_preemption"): "no-deadline-preemption",
+    ("JaxGenConfig", "decode_compact"): "no-decode-compact",
+    ("JaxGenConfig", "enable_metrics"): "disable-metrics",
+    # host/port are build_cmd positional inputs, not config plumbing:
+    # the launcher assigns them per server (ports are allocated, not
+    # configured), and build_cmd receives them as arguments
+    ("JaxGenConfig", "host"): "host",
+    ("JaxGenConfig", "port"): "port",
+    ("SpecConfig", "enabled"): "spec",
+    ("SpecConfig", "max_draft"): "spec-max-draft",
+    ("SpecConfig", "ngram_min"): "spec-ngram-min",
+    ("SpecConfig", "ngram_max"): "spec-ngram-max",
+    ("SpecConfig", "accept_floor"): "spec-accept-floor",
+    ("SpecConfig", "disable_patience"): "spec-disable-patience",
+    ("TracingConfig", "enabled"): "trace",
+    ("TracingConfig", "max_spans"): "trace-max-spans",
+    # TracingConfig.export_path: client-side JSONL sink only — the
+    # server drains over GET /trace, a server-local file would be
+    # unreachable from the trainer side
+    ("TracingConfig", "export_path"): None,
+    ("GoodputConfig", "ready_quiet_s"): "ready-quiet",
+    ("GoodputConfig", "compile_events_path"): "compile-events",
+    ("GoodputConfig", "jsonl_path"): "goodput-jsonl",
+}
+# sub-configs of JaxGenConfig whose fields ride the same server CLI
+_SUBCONFIGS = ("SpecConfig", "TracingConfig", "GoodputConfig")
+
+# flags the server declares that no config field maps to (launcher- or
+# operator-supplied identity/opt-in knobs, each with its reason)
+_SERVER_ONLY_FLAGS = {
+    "model-path",  # JaxGenConfig.model_path (kebab match) — listed for doc
+    "experiment-name",  # launcher identity, not JaxGenConfig state
+    "trial-name",  # launcher identity
+    "server-index",  # appended per-process by the launcher
+    "router-addr",  # deployment wiring, InferenceEngineConfig territory
+    "enable-chaos",  # operator opt-in; never launched on by default
+    "enable-profile",  # operator opt-in; never launched on by default
+}
+
+_ROUTER_ALIASES: Dict[str, Optional[str]] = {
+    "retry_after_s": "retry-after",
+    "inflight_ttl_s": "inflight-ttl",
+    # autoscale knobs are consumed by FleetAutoscaler, which only runs
+    # embedded in the trainer-side remote engine (it spawns servers via
+    # the launcher — a subprocess router cannot); not router-CLI state
+    "autoscale": None,
+    "min_servers": None,
+    "max_servers": None,
+    "autoscale_interval_s": None,
+    "up_queued_per_server": None,
+    "up_kv_util": None,
+    "up_queue_wait_s": None,
+    "down_kv_util": None,
+    "up_consecutive": None,
+    "down_consecutive": None,
+    "cooldown_s": None,
+}
+
+
+def _kebab(field: str) -> str:
+    return field.replace("_", "-")
+
+
+def _flag_candidates(cls: str, field: str) -> Optional[List[str]]:
+    alias = _SERVER_ALIASES.get((cls, field), "__unset__")
+    if alias is None:
+        return None  # exempt
+    if alias != "__unset__":
+        return [alias]
+    cands = [_kebab(field)]
+    if field.endswith("_s"):
+        cands.append(_kebab(field[:-2]))
+    return cands
+
+
+def _dataclass_scalar_fields(
+    module: core.Module, class_name: str
+) -> List[Tuple[str, int]]:
+    """(field, line) for each scalar (non-dataclass-typed, non-List)
+    field of a config dataclass, by AST annotation inspection."""
+    out: List[Tuple[str, int]] = []
+    for node in module.tree.body:
+        if not (
+            isinstance(node, ast.ClassDef) and node.name == class_name
+        ):
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+                stmt.target, ast.Name
+            ):
+                continue
+            ann = ast.unparse(stmt.annotation)
+            if any(
+                sub in ann
+                for sub in ("Config", "List", "Dict", "Hyperparameters")
+            ):
+                continue  # nested config / collection: not a scalar flag
+            out.append((stmt.target.id, stmt.lineno))
+    return out
+
+
+def _add_argument_flags(fn: ast.AST) -> Set[str]:
+    flags: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("--")
+        ):
+            flags.add(node.args[0].value[2:])
+    return flags
+
+
+def _string_flags(fn: ast.AST) -> Set[str]:
+    """Every ``--flag`` string literal (f-string literal prefixes
+    included) inside a function body."""
+    flags: Set[str] = set()
+
+    def _scan_text(text: str):
+        if text.startswith("--"):
+            flag = text[2:].split("=")[0].strip()
+            if flag:
+                flags.add(flag)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            _scan_text(node.value)
+        elif isinstance(node, ast.JoinedStr):
+            first = node.values[0] if node.values else None
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                _scan_text(first.value)
+    return flags
+
+
+def _find_function(
+    module: core.Module, qualname: str
+) -> Optional[ast.AST]:
+    parts = qualname.split(".")
+    body = module.tree.body
+    node = None
+    for i, part in enumerate(parts):
+        node = next(
+            (
+                n
+                for n in body
+                if isinstance(
+                    n,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                )
+                and n.name == part
+            ),
+            None,
+        )
+        if node is None:
+            return None
+        if i + 1 < len(parts):
+            body = node.body
+    return node
+
+
+def _launcher_appended_flags(project: core.Project) -> Set[str]:
+    """Flags a launcher appends onto a build_cmd result: find the
+    variables assigned from ``JaxGenConfig.build_cmd(...)`` and collect
+    ``--flag`` literals in ``<var>.append/extend`` calls."""
+    flags: Set[str] = set()
+    for rel in LAUNCHERS:
+        module = project.module(rel)
+        if module is None:
+            continue
+        cmd_vars: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "build_cmd"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        cmd_vars.add(t.id)
+        if not cmd_vars:
+            continue
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "extend")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in cmd_vars
+            ):
+                flags |= _string_flags(node)
+    return flags
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    cli = project.module(CLI_ARGS)
+    server = project.module(SERVER)
+    router = project.module(ROUTER)
+    if cli is None or server is None:
+        return out
+
+    server_main = _find_function(server, "main")
+    build_cmd = _find_function(cli, "JaxGenConfig.build_cmd")
+    if server_main is None or build_cmd is None:
+        out.append(
+            core.Violation(
+                rule=RULE_ID,
+                path=SERVER if server_main is None else CLI_ARGS,
+                line=1,
+                message=(
+                    "parity anchors missing: server main() or "
+                    "JaxGenConfig.build_cmd not found"
+                ),
+                hint="the rule's anchor map needs updating",
+            )
+        )
+        return out
+
+    server_flags = _add_argument_flags(server_main)
+    build_flags = _string_flags(build_cmd)
+    launcher_flags = _launcher_appended_flags(project)
+
+    # (1) + (2): field → server flag → build_cmd
+    for cls in ("JaxGenConfig",) + _SUBCONFIGS:
+        for field, line in _dataclass_scalar_fields(cli, cls):
+            cands = _flag_candidates(cls, field)
+            if cands is None:
+                continue  # documented exemption
+            matched = next((c for c in cands if c in server_flags), None)
+            where = f"{cls}.{field}"
+            if matched is None:
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=CLI_ARGS,
+                        line=line,
+                        message=(
+                            f"{where} has no server CLI flag "
+                            f"(tried --{', --'.join(cands)}): launched "
+                            f"servers silently run the default"
+                        ),
+                        hint=(
+                            f"add --{cands[0]} to inference/server.py "
+                            f"main() and forward it in build_cmd"
+                        ),
+                        symbol=cls,
+                    )
+                )
+                continue
+            if matched not in build_flags:
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=CLI_ARGS,
+                        line=line,
+                        message=(
+                            f"{where}: server flag --{matched} exists "
+                            f"but build_cmd never emits it — launched "
+                            f"servers silently run the default"
+                        ),
+                        hint=f"emit --{matched} in JaxGenConfig.build_cmd",
+                        symbol="JaxGenConfig.build_cmd",
+                    )
+                )
+
+    # (3): everything emitted must be parseable
+    for flag in sorted(build_flags | launcher_flags):
+        if flag not in server_flags:
+            out.append(
+                core.Violation(
+                    rule=RULE_ID,
+                    path=CLI_ARGS,
+                    line=build_cmd.lineno,
+                    message=(
+                        f"build_cmd/launcher emits --{flag} but the "
+                        f"server parser does not declare it — the "
+                        f"subprocess dies at argparse"
+                    ),
+                    hint=f"add --{flag} to inference/server.py main()",
+                    symbol="JaxGenConfig.build_cmd",
+                )
+            )
+
+    # unknown server flags: declared but neither config-mapped nor in
+    # the documented server-only set (dead plumbing rots — PR 10's bug
+    # in the other direction)
+    mapped: Set[str] = set(_SERVER_ONLY_FLAGS)
+    for cls in ("JaxGenConfig",) + _SUBCONFIGS:
+        for field, _ in _dataclass_scalar_fields(cli, cls):
+            cands = _flag_candidates(cls, field)
+            for c in cands or []:
+                mapped.add(c)
+    for flag in sorted(server_flags - mapped):
+        out.append(
+            core.Violation(
+                rule=RULE_ID,
+                path=SERVER,
+                line=server_main.lineno,
+                message=(
+                    f"server flag --{flag} maps to no JaxGenConfig "
+                    f"field and is not in the documented server-only "
+                    f"set — dead or untracked plumbing"
+                ),
+                hint=(
+                    "add the config field, or list the flag in "
+                    "config_parity._SERVER_ONLY_FLAGS with a reason"
+                ),
+                symbol="main",
+            )
+        )
+
+    # (4): router TrafficConfig parity
+    if router is not None:
+        router_main = _find_function(router, "main")
+        traffic_fields = {
+            f: ln
+            for f, ln in _dataclass_scalar_fields(cli, "TrafficConfig")
+        }
+        # local aliases of the traffic config (`cfg = self.traffic`)
+        # count as reads through them — the router aliases on purpose
+        aliases: Set[str] = set()
+        for node in ast.walk(router.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "traffic"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        reads: Set[str] = set()
+        for node in ast.walk(router.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in traffic_fields
+                and (
+                    (
+                        isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "traffic"
+                    )
+                    or (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id in aliases
+                    )
+                )
+            ):
+                reads.add(node.attr)
+        router_flags = (
+            _add_argument_flags(router_main) if router_main else set()
+        )
+        for field in sorted(reads):
+            alias = _ROUTER_ALIASES.get(field, "__unset__")
+            if alias is None:
+                continue  # documented exemption
+            cands = (
+                [alias]
+                if alias != "__unset__"
+                else [_kebab(field)]
+                + ([_kebab(field[:-2])] if field.endswith("_s") else [])
+            )
+            if not any(c in router_flags for c in cands):
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=ROUTER,
+                        line=traffic_fields.get(field, 1),
+                        message=(
+                            f"router reads TrafficConfig.{field} but "
+                            f"its main() has no --{cands[0]} flag: a "
+                            f"subprocess router silently runs the "
+                            f"default"
+                        ),
+                        hint=(
+                            f"add --{cands[0]} to router main() and "
+                            f"pass it into TrafficConfig(...)"
+                        ),
+                        symbol="main",
+                    )
+                )
+    return out
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="config-plumbing-parity",
+        description=(
+            "config dataclass fields, server/router CLI flags, and "
+            "launcher build_cmd stay in parity"
+        ),
+        check=check,
+        paths=(),  # pure cross-module join, no per-file walk
+        anchors=(CLI_ARGS, SERVER, ROUTER) + LAUNCHERS,
+    )
+)

@@ -1,0 +1,175 @@
+"""ARL005 no-bare-assert-or-swallow: production failures must be typed
+and visible.
+
+Two checks, one contract — *an invariant breach in the serving/training
+control plane must surface as a typed, catchable, logged event*:
+
+1. **bare assert** in production control-plane code. ``assert`` is
+   stripped under ``python -O`` and raises an untyped AssertionError
+   that the executor's retry/quarantine machinery cannot classify; PR 6
+   converted the checkpoint-commit asserts to typed ``ValueError``s for
+   exactly this reason. Scope: the control-plane packages (api,
+   inference, engine, launcher, env, reward, workflow, utils,
+   evaluation, dataset, platforms). The numeric/kernel packages (ops,
+   models, parallel) are exempt by path: their asserts run at JAX trace
+   time, where failing fast in the tracer with a shape message is the
+   established idiom.
+2. **silent swallow**: an ``except Exception:`` / bare ``except:``
+   handler that neither re-raises, nor logs, nor routes to the
+   quarantine/failure-reporting machinery. Such a handler eats the
+   typed error families in ``api/env_api.py`` / ``api/workflow_api.py``
+   (EnvServiceError, EnvSessionLostError, RolloutThreadError, ...)
+   along with everything else — the episode vanishes instead of
+   retrying, which is the exact bug class PR 6/PR 8 hunted by hand.
+
+Visibility calls that legitimize a broad handler: any ``logger.*`` /
+``logging.*`` / ``warnings.warn`` call, a ``raise``, or a call whose
+name contains ``quarantine`` / ``report_failure`` / ``record_failure``.
+Handlers that *assign the exception into a result* (``last_exc = e``
+retry loops) or ``return`` an explicit value (failure converted into a
+result the caller must handle — the grader's ``return False`` probes)
+are also fine: the error is carried, not dropped. The flagged shape is
+the pass-through — ``except Exception: pass`` and friends, where
+control continues as if nothing happened.
+"""
+
+import ast
+from typing import List
+
+from tools.arealint import core
+
+RULE_ID = "ARL005"
+
+# packages where a failed invariant must be a typed error, not an assert
+_ASSERT_SCOPE = (
+    "areal_tpu/api/",
+    "areal_tpu/inference/",
+    "areal_tpu/engine/",
+    "areal_tpu/launcher/",
+    "areal_tpu/env/",
+    "areal_tpu/reward/",
+    "areal_tpu/workflow/",
+    "areal_tpu/utils/",
+    "areal_tpu/evaluation/",
+    "areal_tpu/dataset/",
+    "areal_tpu/platforms/",
+)
+
+_VISIBILITY_ATTRS = {
+    "debug", "info", "warning", "error", "exception", "critical", "warn",
+    "log",
+}
+_VISIBILITY_SUBSTRINGS = ("quarantine", "report_failure", "record_failure")
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    names = []
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        names = [t]
+    elif isinstance(t, ast.Tuple):
+        names = list(t.elts)
+    for n in names:
+        base = n.id if isinstance(n, ast.Name) else n.attr
+        if base in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_is_visible(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True  # failure becomes an explicit result
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else ""
+            )
+            if attr in _VISIBILITY_ATTRS:
+                return True
+            if any(s in attr for s in _VISIBILITY_SUBSTRINGS):
+                return True
+        # any USE of the bound exception (`last_exc = e`,
+        # `done.set_exception(e)`, `{"error": str(e)}`): the error
+        # object is carried somewhere a caller can see, not dropped
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id == handler.name
+        ):
+            return True
+    return False
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for rel in files:
+        module = project.module(rel)
+        if module is None:
+            continue
+        in_assert_scope = any(rel.startswith(p) for p in _ASSERT_SCOPE)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assert) and in_assert_scope:
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=rel,
+                        line=node.lineno,
+                        message=(
+                            "bare assert in a production control-plane "
+                            "path: stripped under -O, and an untyped "
+                            "AssertionError defeats the retry/"
+                            "quarantine machinery"
+                        ),
+                        hint=(
+                            "raise a typed ValueError/RuntimeError "
+                            "with the same condition (PR 6 precedent)"
+                        ),
+                        symbol=module.symbol_at(node.lineno),
+                    )
+                )
+            elif isinstance(node, ast.ExceptHandler):
+                if _broad_handler(node) and not _handler_is_visible(node):
+                    out.append(
+                        core.Violation(
+                            rule=RULE_ID,
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                "except Exception swallows errors "
+                                "silently (no raise / log / quarantine "
+                                "call): typed env/workflow errors "
+                                "disappear here instead of routing to "
+                                "retry"
+                            ),
+                            hint=(
+                                "narrow the except, re-raise, or at "
+                                "minimum log at warning with context; "
+                                "waive with a reason if silence is the "
+                                "design"
+                            ),
+                            symbol=module.symbol_at(node.lineno),
+                        )
+                    )
+    return out
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="no-bare-assert-or-swallow",
+        description=(
+            "no bare assert in control-plane code; no silent "
+            "except-Exception swallows"
+        ),
+        check=check,
+        paths=("areal_tpu",),
+    )
+)

@@ -1,0 +1,149 @@
+"""ARL006 import-hygiene: imports live at the top of the file; ad-hoc
+networking never hides inside a function body.
+
+Two checks:
+
+1. **mid-file module-level imports** (the PR 5 class): a top-level
+   ``import``/``from-import`` that appears after the first class or
+   function definition. These load at an unpredictable point of module
+   import, defeat the import-order reading of the file header, and have
+   twice hidden a circular-import timebomb in this repo. Try-guarded
+   fallback imports and ``if TYPE_CHECKING:`` blocks in the header
+   remain fine — the rule only fires past the first def/class.
+2. **function-body imports of network modules** (``requests``,
+   ``aiohttp``, ``urllib.request``, ``http.client``, ``socket``): a
+   lazy network import inside a function is how one-off HTTP calls
+   bypass ``utils/http``'s retry/backoff/chaos policy and how a
+   blocking client sneaks into async code. Lazy imports of heavyweight
+   *compute* deps (jax, numpy, transformers) are deliberately allowed —
+   deferring those is an optimization this repo uses on purpose (the
+   linter itself must run without jax present).
+"""
+
+import ast
+from typing import List
+
+from tools.arealint import core
+
+RULE_ID = "ARL006"
+
+_NETWORK_MODULES = (
+    "requests",
+    "aiohttp",
+    "urllib.request",
+    "http.client",
+    "socket",
+)
+
+
+def _imported_module_names(node: ast.stmt) -> List[str]:
+    if isinstance(node, ast.Import):
+        return [a.name for a in node.names]
+    if isinstance(node, ast.ImportFrom):
+        return [node.module] if node.module else []
+    return []
+
+
+def _is_network(modname: str) -> bool:
+    return any(
+        modname == n or modname.startswith(n + ".")
+        for n in _NETWORK_MODULES
+    )
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for rel in files:
+        module = project.module(rel)
+        if module is None:
+            continue
+        # (1) mid-file top-level imports
+        first_def_line = None
+        for node in module.tree.body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                if first_def_line is None:
+                    first_def_line = node.lineno
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                if first_def_line is not None:
+                    mods = ", ".join(_imported_module_names(node))
+                    out.append(
+                        core.Violation(
+                            rule=RULE_ID,
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                f"mid-file module-level import of "
+                                f"{mods} (first def/class is at line "
+                                f"{first_def_line})"
+                            ),
+                            hint="move the import into the file header",
+                            symbol="",
+                        )
+                    )
+        # (2) function-body network imports — one depth-tracking pass,
+        # so nested defs cannot produce duplicate findings and
+        # module-level try-guarded imports stay out of scope
+        out.extend(_network_import_findings(module))
+    return out
+
+
+class _FnImportVisitor(ast.NodeVisitor):
+    def __init__(self, module: core.Module):
+        self.module = module
+        self.depth = 0
+        self.found: List[core.Violation] = []
+
+    def visit_FunctionDef(self, node):
+        self.depth += 1
+        self.generic_visit(node)
+        self.depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _imp(self, node):
+        if self.depth > 0:
+            for mod in _imported_module_names(node):
+                if _is_network(mod):
+                    self.found.append(
+                        core.Violation(
+                            rule=RULE_ID,
+                            path=self.module.rel_path,
+                            line=node.lineno,
+                            message=(
+                                f"function-body import of network "
+                                f"module {mod}: ad-hoc HTTP bypasses "
+                                f"utils/http retry/chaos policy"
+                            ),
+                            hint=(
+                                "import at the top of the file and "
+                                "route calls through utils/http "
+                                "helpers where applicable"
+                            ),
+                            symbol=self.module.symbol_at(node.lineno),
+                        )
+                    )
+
+    visit_Import = _imp
+    visit_ImportFrom = _imp
+
+
+def _network_import_findings(module: core.Module) -> List[core.Violation]:
+    visitor = _FnImportVisitor(module)
+    visitor.visit(module.tree)
+    return visitor.found
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="import-hygiene",
+        description=(
+            "no mid-file module-level imports; no function-body "
+            "imports of network modules"
+        ),
+        check=check,
+        paths=("areal_tpu",),
+    )
+)

@@ -1,0 +1,296 @@
+"""ARL004 lock-discipline: no nested acquisition of a non-reentrant
+lock, no lock-ordering cycles within a module.
+
+The historical bug (PR 11): ``utils/goodput.trainer_ledger()`` called
+``trainer_tracker()`` while holding the module guard, and both acquired
+the same ``threading.Lock`` — a deadlock that only fired on the first
+trainer-process metrics export. The fix made it an ``RLock`` with a
+comment; this rule makes the comment machine-checked everywhere.
+
+Per module the rule builds the with-``Lock`` acquisition graph:
+
+- **lock identities**: ``self._x = threading.Lock()/RLock()`` assigns
+  (scoped per class) and module-level ``X = threading.Lock()/RLock()``
+  assigns. Only locks whose constructor the module can see are judged —
+  a lock attribute of unknown type is never flagged.
+- **direct nesting**: a ``with <lock>:`` lexically inside another
+  ``with`` on the SAME non-reentrant lock.
+- **call-through nesting**: while lexically holding lock L, a call to a
+  same-class method (``self.m()``) or same-module function known to
+  acquire L, L non-reentrant.
+- **ordering cycles**: edges L1→L2 when L2 is acquired (directly or one
+  call level deep) while L1 is held; any cycle across the module's
+  graph is reported once per participating edge site.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.arealint import core
+
+RULE_ID = "ARL004"
+
+_LOCK_CTORS = {
+    "threading.Lock": False,  # reentrant? no
+    "threading.RLock": True,
+    "multiprocessing.Lock": False,
+}
+
+
+def _lock_expr_key(node: ast.AST, class_name: str) -> Optional[str]:
+    """Canonical key for a lock expression: ``Class.self._lock`` for
+    attributes, ``module.NAME`` for globals. None when not lock-shaped."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"{class_name}.self.{node.attr}"
+    if isinstance(node, ast.Name):
+        return f"module.{node.id}"
+    return None
+
+
+class _ModuleLocks:
+    """Lock identities + per-function acquisition facts for one file."""
+
+    def __init__(self, module: core.Module):
+        self.module = module
+        # lock key → reentrant?
+        self.locks: Dict[str, bool] = {}
+        # qualname → set of lock keys the function acquires via `with`
+        self.acquires: Dict[str, Set[str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in self.module.tree.body:
+            if isinstance(node, ast.Assign):
+                self._lock_assign(node, class_name="")
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        self._lock_assign(sub, class_name=node.name)
+        # per-function acquisition sets
+        for qual, fn in self._functions():
+            acq: Set[str] = set()
+            cls = qual.rsplit(".", 1)[0] if "." in qual else ""
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        key = _lock_expr_key(item.context_expr, cls)
+                        if key is not None and key in self.locks:
+                            acq.add(key)
+            self.acquires[qual] = acq
+
+    def _lock_assign(self, node: ast.Assign, class_name: str) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        dotted = self.module.dotted_call_name(node.value.func)
+        if dotted not in _LOCK_CTORS:
+            return
+        for t in node.targets:
+            key = _lock_expr_key(t, class_name)
+            if key is not None:
+                self.locks[key] = _LOCK_CTORS[dotted]
+
+    def _functions(self):
+        for node in self.module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node.name, node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        yield f"{node.name}.{sub.name}", sub
+
+
+class _HoldWalker(ast.NodeVisitor):
+    """Walk one function tracking the lexically-held lock stack."""
+
+    def __init__(
+        self,
+        info: _ModuleLocks,
+        qualname: str,
+        violations: List[core.Violation],
+        edges: Set[Tuple[str, str, int]],
+    ):
+        self.info = info
+        self.module = info.module
+        self.qual = qualname
+        self.cls = qualname.rsplit(".", 1)[0] if "." in qualname else ""
+        self.violations = violations
+        self.edges = edges
+        self.held: List[str] = []
+
+    def visit_With(self, node: ast.With):
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._with(node)
+
+    def _with(self, node):
+        keys = []
+        for item in node.items:
+            key = _lock_expr_key(item.context_expr, self.cls)
+            if key is not None and key in self.info.locks:
+                keys.append(key)
+        for key in keys:
+            if key in self.held and not self.info.locks[key]:
+                self.violations.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=self.module.rel_path,
+                        line=node.lineno,
+                        message=(
+                            f"nested `with` on non-reentrant lock "
+                            f"{_pretty(key)} — self-deadlock"
+                        ),
+                        hint=(
+                            "restructure to acquire once, or make the "
+                            "lock an RLock with a comment saying why"
+                        ),
+                        symbol=self.qual,
+                    )
+                )
+            for outer in self.held:
+                if outer != key:
+                    self.edges.add((outer, key, node.lineno))
+        self.held.extend(keys)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in keys:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call):
+        if self.held:
+            callee = self._callee_qual(node)
+            if callee is not None:
+                callee_acquires = self.info.acquires.get(callee, set())
+                for key in self.held:
+                    if key in callee_acquires and not self.info.locks[key]:
+                        self.violations.append(
+                            core.Violation(
+                                rule=RULE_ID,
+                                path=self.module.rel_path,
+                                line=node.lineno,
+                                message=(
+                                    f"calls {callee}() while holding "
+                                    f"non-reentrant {_pretty(key)}, "
+                                    f"which {callee} also acquires — "
+                                    f"self-deadlock"
+                                ),
+                                hint=(
+                                    "hoist the call out of the locked "
+                                    "region, add a _locked variant, or "
+                                    "make the lock an RLock with a "
+                                    "comment (the goodput trainer_"
+                                    "ledger precedent)"
+                                ),
+                                symbol=self.qual,
+                            )
+                        )
+                for key in callee_acquires:
+                    for outer in self.held:
+                        if outer != key:
+                            self.edges.add((outer, key, node.lineno))
+        self.generic_visit(node)
+
+    def _callee_qual(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and self.cls
+        ):
+            return f"{self.cls}.{f.attr}"
+        if isinstance(f, ast.Name):
+            return f.id if f.id in self.info.acquires else None
+        return None
+
+    # don't descend into nested defs: they execute later, elsewhere
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _pretty(key: str) -> str:
+    return key.split(".", 1)[1] if "." in key else key
+
+
+def _find_cycles(
+    edges: Set[Tuple[str, str, int]]
+) -> List[Tuple[str, str, int]]:
+    """Edges participating in a cycle of the lock-order graph."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b, _ in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reachable(start: str, target: str) -> bool:
+        seen, stack = set(), [start]
+        while stack:
+            n = stack.pop()
+            if n == target:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    return [(a, b, ln) for a, b, ln in edges if reachable(b, a)]
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for rel in files:
+        module = project.module(rel)
+        if module is None:
+            continue
+        info = _ModuleLocks(module)
+        if not info.locks:
+            continue
+        edges: Set[Tuple[str, str, int]] = set()
+        for qual, fn in info._functions():
+            walker = _HoldWalker(info, qual, out, edges)
+            for stmt in fn.body:
+                walker.visit(stmt)
+        for a, b, line in sorted(_find_cycles(edges)):
+            out.append(
+                core.Violation(
+                    rule=RULE_ID,
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"lock-order cycle: {_pretty(a)} held while "
+                        f"acquiring {_pretty(b)}, and elsewhere the "
+                        f"reverse — two threads interleaving deadlock"
+                    ),
+                    hint=(
+                        "impose one module-wide acquisition order "
+                        "(document it at the lock definitions)"
+                    ),
+                    symbol=module.symbol_at(line),
+                )
+            )
+    return out
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="lock-discipline",
+        description=(
+            "no nested non-reentrant lock acquisition; no lock-order "
+            "cycles within a module"
+        ),
+        check=check,
+        paths=("areal_tpu",),
+    )
+)

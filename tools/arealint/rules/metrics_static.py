@@ -1,0 +1,500 @@
+"""ARL003 metrics-hygiene-static: every metric name a surface can emit
+resolves to a ``_METRIC_HELP`` entry and an explicit type registration.
+
+The runtime lint (tests/test_metrics_hygiene.py) renders each surface
+once and checks what it SAW. This rule checks what the code CAN emit —
+including branches the runtime fixtures never take (spec-off engines,
+empty fleets, anomaly gauges that have not fired). PR 11's hygiene
+sweep found exactly such a branch by hand; this is that review,
+automated.
+
+Per surface, emitted names are extracted statically from:
+
+- ``dict(...)`` / ``X.update(...)`` keyword arguments and dict-literal
+  keys inside the declared emitter functions,
+- constant (and resolvable f-string) subscript stores ``m["name"] = v``
+  — loop variables over module-level constant tuples are expanded, so
+  ``m[f"sched_class_{cls}_running"] for cls in SCHED_CLASSES`` resolves
+  to both concrete names,
+- ``bump("name")`` counter calls anywhere in the surface module,
+- declared extra constants for documented-dynamic families (the
+  goodput ledger builds its bucket names from its constructor args; the
+  hub's anomaly gauges iterate the ``ANOMALIES`` tuple).
+
+Each name must be a key of the surface's HELP dict AND of the set of
+names the module passes to ``register_metric_types`` (evaluated with
+the shared constant resolver). The exported
+:func:`static_metric_inventory` is the satellite cross-check input:
+tests/test_metrics_hygiene.py asserts every runtime-observed name is a
+subset of this static inventory, so an emit branch the fixtures don't
+reach is visible instead of invisible.
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.arealint import core
+
+RULE_ID = "ARL003"
+
+
+class Surface:
+    """One /metrics exposition surface: where its HELP lives, which
+    functions (possibly in other modules) feed it, and the documented
+    dynamic name families static extraction cannot see."""
+
+    def __init__(
+        self,
+        name: str,
+        help_module: str,
+        help_dict: str,
+        emitters: Sequence[Tuple[str, Sequence[str]]],
+        bump_modules: Sequence[str] = (),
+        extra_constants: Sequence[Tuple[str, str]] = (),
+        extra_names: Sequence[str] = (),
+    ):
+        self.name = name
+        self.help_module = help_module
+        self.help_dict = help_dict
+        self.emitters = emitters
+        self.bump_modules = bump_modules
+        self.extra_constants = extra_constants
+        self.extra_names = extra_names
+
+
+SURFACES = [
+    Surface(
+        name="engine server",
+        help_module="areal_tpu/inference/server.py",
+        help_dict="_METRIC_HELP",
+        emitters=[
+            (
+                "areal_tpu/inference/engine.py",
+                ["GenerationEngine.metrics"],
+            ),
+            ("areal_tpu/utils/goodput.py", ["CompileTracker.metrics"]),
+        ],
+        # GoodputLedger.metrics builds f"{prefix}{bucket}_frac" from its
+        # constructor's bucket tuple — documented-dynamic family
+        extra_names=[
+            "goodput_prefill_frac", "goodput_decode_frac",
+            "goodput_spec_verify_frac", "goodput_weight_pause_frac",
+            "goodput_compile_frac", "goodput_idle_frac",
+            "goodput_duty_cycle", "goodput_effective_tokens_per_sec",
+            "goodput_wall_s",
+            # latency histograms: per-class series built from the
+            # engine's _hists dict init
+            "queue_wait_seconds", "ttft_seconds",
+            "request_latency_seconds",
+        ],
+    ),
+    Surface(
+        name="router",
+        help_module="areal_tpu/inference/router.py",
+        help_dict="_METRIC_HELP",
+        emitters=[
+            ("areal_tpu/inference/router.py", ["RouterState.metrics"]),
+            (
+                "areal_tpu/inference/fleet.py",
+                [
+                    "FleetMonitor.state_metrics",
+                    "FleetMonitor.metrics",
+                    "FleetAutoscaler.metrics",
+                ],
+            ),
+        ],
+        # per-server labeled lines are rendered by hand in
+        # RouterState.metrics with a {server=...} label; base name only
+        extra_names=["fleet_probe_latency_s"],
+    ),
+    Surface(
+        name="env worker",
+        help_module="areal_tpu/env/service.py",
+        help_dict="_METRIC_HELP",
+        emitters=[
+            ("areal_tpu/env/service.py", ["EnvWorkerState.metrics"]),
+        ],
+        bump_modules=["areal_tpu/env/service.py"],
+    ),
+    Surface(
+        name="verifier",
+        help_module="areal_tpu/reward/verifier_service.py",
+        help_dict="_METRIC_HELP",
+        # every verifier counter moves through bump("name") literals
+        # inside serve_verifier (scanned module-wide); the one
+        # non-counter gauge is stamped as m["draining"] at render time
+        emitters=[],
+        bump_modules=["areal_tpu/reward/verifier_service.py"],
+        extra_names=["draining"],
+    ),
+    Surface(
+        name="telemetry hub",
+        help_module="areal_tpu/utils/telemetry.py",
+        help_dict="_FLEET_METRIC_HELP",
+        emitters=[
+            (
+                "areal_tpu/utils/telemetry.py",
+                ["TelemetryCollector.rollup"],
+            ),
+        ],
+        # anomaly gauges iterate the module ANOMALIES tuple at emit time
+        extra_constants=[("areal_tpu/utils/telemetry.py", "ANOMALIES")],
+        # merged native histograms re-exported from scraped servers
+        extra_names=[
+            "queue_wait_seconds", "ttft_seconds",
+            "request_latency_seconds",
+        ],
+    ),
+]
+
+
+# -- emitted-name extraction -----------------------------------------------
+class _EmitExtractor:
+    """Collect statically-resolvable metric names from one function
+    body, expanding loops over resolvable iterables so f-string keys
+    like ``f"sched_class_{cls}_queued"`` yield their concrete names."""
+
+    def __init__(self, module: core.Module, consts: Dict[str, object]):
+        self.module = module
+        self.resolver = core.ConstResolver(module)
+        self.resolver.consts = dict(consts)
+        self.names: Set[str] = set()
+        self.unresolved = 0
+
+    def _resolve_str(self, node: ast.AST, env: Dict) -> Optional[str]:
+        try:
+            val = self.resolver.eval(node, env)
+        except core.ResolveError:
+            return None
+        return val if isinstance(val, str) else None
+
+    def scan(self, body: Sequence[ast.stmt], env: Dict) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt, env)
+
+    def _scan_stmt(self, stmt: ast.stmt, env: Dict) -> None:
+        if isinstance(stmt, ast.For):
+            expanded = False
+            try:
+                iterable = self.resolver.eval(stmt.iter, env)
+                items = core._iter_items(iterable)
+                if len(items) <= core._MAX_LOOP_ITER:
+                    for item in items:
+                        bound = dict(env)
+                        core._bind_target(stmt.target, item, bound)
+                        self.scan(stmt.body, bound)
+                    expanded = True
+            except core.ResolveError:
+                pass
+            if not expanded:
+                # walk the body anyway: constant keys inside still count
+                self.scan(stmt.body, env)
+            self.scan(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.scan(stmt.body, env)
+            self.scan(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.With):
+            self.scan(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self.scan(stmt.body, env)
+            for h in stmt.handlers:
+                self.scan(h.body, env)
+            self.scan(stmt.orelse, env)
+            self.scan(stmt.finalbody, env)
+            return
+        # expression-level extraction
+        for node in ast.walk(stmt):
+            self._scan_expr(node, env)
+        # let simple assignments update the env (out_stem etc.)
+        if isinstance(stmt, ast.Assign) and isinstance(
+            stmt.targets[0], ast.Name
+        ):
+            try:
+                env[stmt.targets[0].id] = self.resolver.eval(
+                    stmt.value, env
+                )
+            except core.ResolveError:
+                pass
+
+    def _scan_expr(self, node: ast.AST, env: Dict) -> None:
+        # m["name"] = v  /  m[f"..."] += v
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    key = self._resolve_str(t.slice, env)
+                    if key is not None:
+                        self.names.add(key)
+                    elif isinstance(
+                        t.slice, (ast.JoinedStr, ast.Constant)
+                    ):
+                        self.unresolved += 1
+        elif isinstance(node, ast.Call):
+            func = node.func
+            # dict(a=..) and X.update(a=..)
+            is_dict_call = isinstance(func, ast.Name) and func.id == "dict"
+            is_update = (
+                isinstance(func, ast.Attribute) and func.attr == "update"
+            )
+            if is_dict_call or is_update:
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        self.names.add(kw.arg)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is None:
+                    continue
+                key = self._resolve_str(k, env)
+                if key is not None:
+                    self.names.add(key)
+
+
+def _bump_arg_names(node: ast.AST) -> Set[str]:
+    """Constant string(s) a bump() first-arg can evaluate to — plain
+    constants and either branch of a constant conditional (the env
+    worker's rejected_draining/rejected_capacity pattern)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, ast.IfExp):
+        return _bump_arg_names(node.body) | _bump_arg_names(node.orelse)
+    return set()
+
+
+def _collect_bumps(module: core.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and node.args:
+            f = node.func
+            fname = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else ""
+            )
+            if fname == "bump":
+                names |= _bump_arg_names(node.args[0])
+        # counters["name"] = / += pattern (verifier)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "counters"
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    names.add(t.slice.value)
+    return names
+
+
+def _registered_type_names(
+    module: core.Module, consts: Dict[str, object]
+) -> Optional[Set[str]]:
+    """Names the module passes to ``register_metric_types``, evaluated
+    with the constant resolver. None = a call was unresolvable (treat
+    as fully registered rather than fabricate findings)."""
+    resolver = core.ConstResolver(module)
+    resolver.consts = dict(consts)
+    names: Set[str] = set()
+    found = False
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, ast.Call)
+            and module.dotted_call_name(node.func).endswith(
+                "register_metric_types"
+            )
+            and node.args
+        ):
+            found = True
+            try:
+                val = resolver.eval(node.args[0], {})
+            except core.ResolveError:
+                return None
+            if isinstance(val, dict):
+                names |= set(val.keys())
+            else:
+                return None
+    return names if found else None
+
+
+def _surface_inventory(
+    project: core.Project, surface: Surface
+) -> Tuple[Set[str], Dict[str, int], int]:
+    """(emitted names, name → first line seen, unresolved count)."""
+    names: Set[str] = set()
+    lines: Dict[str, int] = {}
+    unresolved = 0
+    for rel, fn_names in surface.emitters:
+        module = project.module(rel)
+        if module is None:
+            continue
+        consts = core.module_constants(module)
+        for fn_name in fn_names:
+            fn = _find_def(module, fn_name)
+            if fn is None:
+                continue
+            ex = _EmitExtractor(module, consts)
+            ex.scan(fn.body, {})
+            for n in ex.names:
+                lines.setdefault(n, fn.lineno)
+            names |= ex.names
+            unresolved += ex.unresolved
+    for rel in surface.bump_modules:
+        module = project.module(rel)
+        if module is None:
+            continue
+        for n in _collect_bumps(module):
+            lines.setdefault(n, 1)
+            names.add(n)
+    for rel, const_name in surface.extra_constants:
+        module = project.module(rel)
+        if module is None:
+            continue
+        consts = core.module_constants(module)
+        val = consts.get(const_name)
+        if isinstance(val, list):
+            for n in val:
+                if isinstance(n, str):
+                    names.add(n)
+                    lines.setdefault(n, 1)
+        elif isinstance(val, dict):
+            for n in val:
+                names.add(n)
+                lines.setdefault(n, 1)
+    for n in surface.extra_names:
+        names.add(n)
+        lines.setdefault(n, 1)
+    return names, lines, unresolved
+
+
+def _find_def(module: core.Module, qualname: str) -> Optional[ast.AST]:
+    body = module.tree.body
+    node = None
+    parts = qualname.split(".")
+    for i, part in enumerate(parts):
+        node = next(
+            (
+                n
+                for n in body
+                if isinstance(
+                    n,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                )
+                and n.name == part
+            ),
+            None,
+        )
+        if node is None:
+            return None
+        if i + 1 < len(parts):
+            body = node.body
+    return node
+
+
+def static_metric_inventory(
+    root: str = core.REPO_ROOT,
+) -> Dict[str, Set[str]]:
+    """Surface name → statically-discovered emittable metric names.
+    tests/test_metrics_hygiene.py asserts runtime-observed ⊆ this, so
+    runtime emit branches the static scan cannot see fail loudly there
+    (add the name to the surface's emitters/extras) instead of hiding."""
+    project = core.Project(root)
+    return {
+        s.name: _surface_inventory(project, s)[0] for s in SURFACES
+    }
+
+
+def check(project: core.Project, files: List[str]) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for surface in SURFACES:
+        help_mod = project.module(surface.help_module)
+        if help_mod is None:
+            continue
+        consts = core.module_constants(help_mod)
+        help_dict = consts.get(surface.help_dict)
+        if not isinstance(help_dict, dict):
+            out.append(
+                core.Violation(
+                    rule=RULE_ID,
+                    path=surface.help_module,
+                    line=1,
+                    message=(
+                        f"{surface.name}: {surface.help_dict} not "
+                        f"statically resolvable"
+                    ),
+                    hint="keep the HELP dict a literal the resolver "
+                    "can evaluate",
+                )
+            )
+            continue
+        typed = _registered_type_names(help_mod, consts)
+        emitted, lines, _ = _surface_inventory(project, surface)
+        for name in sorted(emitted):
+            if name not in help_dict:
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=surface.help_module,
+                        line=lines.get(name, 1),
+                        message=(
+                            f"{surface.name}: emits {name!r} with no "
+                            f"{surface.help_dict} entry (a branch the "
+                            f"runtime lint may never exercise)"
+                        ),
+                        hint=(
+                            f"add {name!r} to "
+                            f"{surface.help_module}:"
+                            f"{surface.help_dict}"
+                        ),
+                        symbol=surface.help_dict,
+                    )
+                )
+            if typed is not None and name not in typed:
+                out.append(
+                    core.Violation(
+                        rule=RULE_ID,
+                        path=surface.help_module,
+                        line=lines.get(name, 1),
+                        message=(
+                            f"{surface.name}: emits {name!r} without an "
+                            f"explicit register_metric_types entry — "
+                            f"the *_total suffix heuristic would guess "
+                            f"its TYPE"
+                        ),
+                        hint=(
+                            "register the name in the module's "
+                            "register_metric_types call"
+                        ),
+                        symbol=surface.help_dict,
+                    )
+                )
+    return out
+
+
+core.register_rule(
+    core.Rule(
+        id=RULE_ID,
+        name="metrics-hygiene-static",
+        description=(
+            "every statically-discoverable metric name resolves to "
+            "_METRIC_HELP + METRIC_TYPES entries"
+        ),
+        check=check,
+        paths=(),
+        anchors=tuple(
+            {s.help_module for s in SURFACES}
+            | {rel for s in SURFACES for rel, _ in s.emitters}
+        ),
+    )
+)
