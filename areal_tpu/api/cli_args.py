@@ -258,6 +258,26 @@ class InferenceEngineConfig:
     traffic: "TrafficConfig" = dataclasses.field(
         default_factory=lambda: TrafficConfig()
     )
+    # zero-pause weight plane (r13): when True, update_weights never
+    # POSTs /pause_generation — the trainer streams chunks at live
+    # servers, each server applies them into a shadow buffer
+    # (inference/weights.WeightStore) and flips at a dispatch boundary.
+    # The client records a `weight_stream` span instead of a
+    # `weight_update_pause` window. False restores the r2 pause
+    # protocol (the bench A/B baseline; also the right setting against
+    # pre-r13 servers, whose chunk ingest stalls decode per chunk).
+    streamed_weight_updates: bool = True
+    # staleness admission mode (api/workflow_api.WorkflowExecutor):
+    # "step" = the legacy global gate ((eta + version + 1) * batch
+    # bounds accepted+running); "trajectory" = per-sample admission —
+    # capacity is bounded by max_concurrent_rollouts alone and wait()
+    # drops any sample whose staleness-at-consumption (trainer version
+    # minus the oldest weight version that produced one of its tokens,
+    # from the LineageLedger) exceeds max_head_offpolicyness, refilling
+    # the batch with a fresh generation. Trajectory mode is what makes
+    # streamed weight flips safe at eta=0-ish targets: the fence is
+    # enforced on what the trainer CONSUMES, not on what may run.
+    staleness_mode: str = "step"
     # trajectory lineage ledger (utils/telemetry.LineageLedger): consumed
     # records are appended here as JSONL when set (the in-memory ledger
     # is always on; recover checkpoints snapshot it either way)
@@ -392,6 +412,12 @@ class JaxGenConfig:
     goodput: "GoodputConfig" = dataclasses.field(
         default_factory=lambda: GoodputConfig()
     )
+    # zero-pause weight plane (inference/weights.WeightStore): streamed
+    # double-buffered weight ingest + atomic flip at a dispatch
+    # boundary, in-flight-request version pinning, staging TTL
+    weights: "WeightTransferConfig" = dataclasses.field(
+        default_factory=lambda: WeightTransferConfig()
+    )
     log_level: str = "info"
     host: str = "127.0.0.1"
     port: int = 0  # 0 = auto
@@ -481,6 +507,15 @@ class JaxGenConfig:
         args.append(f"--deadline-margin={config.deadline_margin_s}")
         if not config.deadline_preemption:
             args.append("--no-deadline-preemption")
+        # zero-pause weight plane (r13): streamed servers must agree
+        # with the client's streamed_weight_updates setting, so the
+        # whole weight config always rides the command line
+        args += [
+            f"--weight-flip-policy={config.weights.flip_policy}",
+            f"--weight-staging-ttl={config.weights.staging_ttl_s}",
+        ]
+        if not config.weights.streaming:
+            args.append("--no-weight-streaming")
         if config.spec.enabled:
             args += [
                 "--spec",
@@ -526,6 +561,37 @@ class SpecConfig:
     # consecutive verify chunks; <= 0 never disables
     accept_floor: float = 0.1
     disable_patience: int = 32
+
+
+@dataclasses.dataclass
+class WeightTransferConfig:
+    """Zero-pause weight plane, server side (inference/weights.py
+    `WeightStore` + the engine flip machinery).
+
+    With ``streaming`` on, weight updates never stop decode: chunked
+    device-path pushes (and disk reloads) are staged into a shadow
+    buffer on the HTTP handler thread while the engine loop keeps
+    dispatching on version N, then the completed buffer flips in
+    atomically BETWEEN dispatches — no ``pause_window`` span is ever
+    emitted. Correctness across the flip is a version fence, not
+    bit-exactness: every token records the weight version that produced
+    it, and in-flight sequences either finish pinned to N
+    (``flip_policy="pin"`` — the store keeps N's buffer alive until its
+    last pinned request drains, and the engine dispatches each version
+    cohort with its own params) or resolve with ``stop_reason="abort"``
+    and resume suffix-exact on N+1 (``flip_policy="resume"`` — the
+    existing interruption contract, minus the fleet-wide pause).
+    ``pin`` needs the compacted decode dispatch (single-device); TP and
+    compaction-off engines degrade to ``resume`` at the flip."""
+
+    streaming: bool = True
+    # "pin" | "resume" (see above). Unknown values are an init error.
+    flip_policy: str = "pin"
+    # abandoned-staging GC: a client that dies mid-stream must not pin
+    # host/HBM staging bytes forever — staging older than this is
+    # dropped (visible via the weight_staging_bytes gauge and the
+    # weight_staging_aborts_total counter); <= 0 disables the sweep
+    staging_ttl_s: float = 120.0
 
 
 @dataclasses.dataclass

@@ -177,6 +177,10 @@ class RolloutStat:
     quarantined: int = 0
     # submissions refused because the sample is already quarantined
     quarantine_skipped: int = 0
+    # samples dropped at consumption by the trajectory-level staleness
+    # fence (staleness_mode="trajectory": the sample's oldest token
+    # lagged the trainer by more than max_head_offpolicyness versions)
+    stale_dropped: int = 0
 
 
 _COUNTER = itertools.count()

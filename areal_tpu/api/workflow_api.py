@@ -12,6 +12,18 @@ control enforces both a concurrency cap and the staleness gate
 so rollouts never run more than ``max_head_offpolicyness`` weight versions
 ahead of what the trainer has consumed (reference workflow_api.py:101-113).
 
+Staleness admission modes (``InferenceEngineConfig.staleness_mode``, r13):
+``"step"`` keeps the global gate above. ``"trajectory"`` — built for the
+zero-pause weight plane, where versions advance mid-decode instead of at
+fleet-wide pause barriers — bounds in-flight work by
+``max_concurrent_rollouts`` alone and enforces η per SAMPLE at
+consumption: ``wait()`` reads each sample's staleness-at-consumption
+(trainer version minus the oldest weight version that produced one of
+its tokens, from the LineageLedger) and DROPS samples beyond η,
+un-counting them from ``accepted`` so the pipeline backfills with fresh
+generations — the fence moves from "what may run" to "what the trainer
+eats".
+
 TPU adaptation: batches are plain dict[str, np.ndarray] (padded layout)
 instead of TensorDicts; the asyncio loop is stock (uvloop is CUDA-image
 baggage the reference carries — not needed here).
@@ -124,6 +136,17 @@ class WorkflowExecutor:
             path=getattr(config, "lineage_path", "") or "",
             max_records=getattr(config, "lineage_max_records", 8192),
         )
+        # staleness admission mode (r13): "step" = the legacy global
+        # version gate in get_capacity; "trajectory" = per-sample
+        # staleness-at-consumption filtering in wait()
+        self.staleness_mode = str(
+            getattr(config, "staleness_mode", "step") or "step"
+        )
+        if self.staleness_mode not in ("step", "trajectory"):
+            raise ValueError(
+                f"staleness_mode={self.staleness_mode!r}: expected "
+                "step | trajectory"
+            )
         # consuming-step attribution: the trainer announces its global
         # step via set_train_step; otherwise consumption is numbered by
         # wait() returns
@@ -249,7 +272,14 @@ class WorkflowExecutor:
             consumer_bs = max(cfg.consumer_batch_size, 1)
             max_concurrent = cfg.max_concurrent_rollouts or consumer_bs
             capacity = max_concurrent - self.rollout_stat.running
-            if cfg.max_head_offpolicyness is not None:
+            if (
+                cfg.max_head_offpolicyness is not None
+                and self.staleness_mode != "trajectory"
+            ):
+                # step mode: the global version-arithmetic gate.
+                # Trajectory mode deliberately skips it — admission is
+                # bounded by concurrency alone and η is enforced on
+                # each CONSUMED sample's recorded staleness in wait()
                 ofp = cfg.max_head_offpolicyness
                 sample_cnt = self.rollout_stat.accepted + self.rollout_stat.running
                 budget = (ofp + version + 1) * consumer_bs - sample_cnt
@@ -368,6 +398,40 @@ class WorkflowExecutor:
                     # — ask for a replacement episode per dropped group
                     refill_fn(1)
                 continue
+            if (
+                self.staleness_mode == "trajectory"
+                and self.config.max_head_offpolicyness is not None
+            ):
+                lag = self._staleness_at_consumption(item)
+                if (
+                    lag is not None
+                    and lag > self.config.max_head_offpolicyness
+                ):
+                    # trajectory-level η enforcement: this sample's
+                    # oldest token lags the trainer too far — drop it
+                    # and let the reopened capacity (or refill_fn)
+                    # generate a fresher replacement
+                    with self._lock:
+                        self.rollout_stat.accepted -= 1
+                        self.rollout_stat.stale_dropped += 1
+                    stats_tracker.counter(**{
+                        "rollout/stale_dropped_total": 1.0,
+                    })
+                    tracer = self._tracer()
+                    if tracer is not None:
+                        tracer.instant(
+                            "stale_drop", item.uid or "?",
+                            staleness=lag,
+                            eta=self.config.max_head_offpolicyness,
+                        )
+                    logger.info(
+                        f"dropped stale sample {item.uid or '?'}: "
+                        f"staleness-at-consumption {lag} > eta="
+                        f"{self.config.max_head_offpolicyness}"
+                    )
+                    if refill_fn is not None:
+                        refill_fn(1)
+                    continue
             results.append(item)
         results.sort(key=lambda r: r.create_time)
         random.shuffle(results)
@@ -386,6 +450,31 @@ class WorkflowExecutor:
             trainer_version=self.engine.get_version(),
         )
         return data_utils.concat_padded_tensors([r.batch for r in results])
+
+    def _staleness_at_consumption(self, item: _ResultItem) -> Optional[int]:
+        """Trainer version minus the OLDEST weight version that produced
+        one of this sample's CONSUMED tokens. The batch's per-token
+        ``versions`` array is the primary source — it reflects exactly
+        the tokens the trainer would train on (prompt positions are
+        stamped -1 and skipped). The LineageLedger record is only the
+        fallback: it unions every retry attempt's segments, so after a
+        failed-and-retried episode it still carries the DISCARDED
+        attempt's old versions and would spuriously drop a fresh
+        sample. None when neither source knows, in which case the
+        sample passes — an unattributable sample is a
+        missing-instrumentation bug, not a staleness verdict."""
+        trainer_v = self.engine.get_version()
+        versions: List[int] = []
+        if hasattr(item.batch, "get"):
+            v = item.batch.get("versions")
+            if v is not None:
+                arr = np.asarray(v).reshape(-1)
+                versions = [int(x) for x in arr[arr >= 0]]
+        if not versions and item.uid:
+            versions = self.lineage.versions_of(item.uid)
+        if not versions:
+            return None
+        return trainer_v - min(versions)
 
     def drain_consumed_uids(self) -> List[str]:
         """Consumed-sample uids since the last drain (recover bookkeeping)."""

@@ -187,9 +187,22 @@ class LocalSyncInferenceEngine(InferenceEngine):
     # ------------------------------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
         """DEVICE path: hand the trainer's live params to the generator —
-        the ICI/HBM analog of the reference's NCCL broadcast."""
+        the ICI/HBM analog of the reference's NCCL broadcast. With the
+        zero-pause weight plane on (both this client's
+        ``streamed_weight_updates`` and the engine's
+        ``weights.streaming``), the copy happens off the engine loop and
+        the new buffer flips in at a dispatch boundary — in-flight slots
+        keep decoding (version-fenced) instead of aborting into a pause
+        window."""
         t_pause = time.monotonic()
-        self.engine.pause()
+        method = (
+            "tensors" if meta.type == WeightUpdateMethod.DEVICE else "disk"
+        )
+        streamed = bool(
+            getattr(self.config, "streamed_weight_updates", True)
+        ) and self.engine.streams_weight_updates(method)
+        if not streamed:
+            self.engine.pause()
 
         def _do():
             try:
@@ -208,10 +221,17 @@ class LocalSyncInferenceEngine(InferenceEngine):
                     )
                 self.set_version(meta.model_version)
             finally:
-                self.engine.continue_generation()
-                stats_tracker.scalar(**{
-                    "rollout/pause_window_s": time.monotonic() - t_pause
-                })
+                if streamed:
+                    stats_tracker.scalar(**{
+                        "rollout/weight_stream_s":
+                            time.monotonic() - t_pause
+                    })
+                else:
+                    self.engine.continue_generation()
+                    stats_tracker.scalar(**{
+                        "rollout/pause_window_s":
+                            time.monotonic() - t_pause
+                    })
 
         return self.executor.submit(_do)
 

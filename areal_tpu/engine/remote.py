@@ -9,9 +9,18 @@ Role of reference areal/engine/sglang_remote.py (`RemoteSGLangEngine`):
   window) re-issue ``/generate`` with accumulated output tokens appended to
   the prompt, so long generations span weight versions
   (sglang_remote.py:186-234);
-- non-blocking disk weight updates: pause all servers → wait for the
-  trainer's name_resolve signal → reload → continue (sglang_remote.py:
-  251-309, 368-409);
+- **zero-pause weight updates** (the r13 default,
+  ``config.streamed_weight_updates``): fresh weights stream at LIVE
+  servers — each server stages them into a shadow buffer
+  (inference/weights.WeightStore) and flips atomically at a dispatch
+  boundary, so no ``/pause_generation`` is ever posted and no
+  ``weight_update_pause`` window is recorded (a ``weight_stream`` span
+  covers the transfer instead). In-flight sequences finish pinned to
+  the old version or resume suffix-exact on the new one; per-token
+  ``output_versions`` keep the staleness fence exact either way. With
+  ``streamed_weight_updates=False`` the legacy r2 protocol applies:
+  pause all servers → wait for the trainer's signal or stream → resume
+  (reference sglang_remote.py:251-309, 368-409);
 - rollout orchestration delegated to WorkflowExecutor.
 """
 
@@ -920,26 +929,27 @@ class RemoteInferenceEngine(InferenceEngine):
     # Weight updates (disk path)
     # ------------------------------------------------------------------
     def update_weights(self, meta: WeightUpdateMeta) -> concurrent.futures.Future:
-        """Non-blocking: pause servers, wait for fresh weights to land
-        (disk signal or device-path transfer), resume (reference
-        sglang_remote.py:251-309). The whole sequence — including the
-        pause posts — runs off-thread so one slow server never stalls the
-        train loop."""
+        """Non-blocking weight push.
 
-        def _alive_addresses():
-            """Fan-out target set: skip servers the fleet already knows
-            are DEAD/DRAINING — posting at them would stall or fail the
-            whole update for capacity that isn't serving anyway.
-            WARMING servers ARE included (is_update_target): a cold
-            server skipped here would finish compiling straight into
-            rotation with stale weights."""
-            if self.fleet is None:
-                return list(self.addresses)
-            in_target = getattr(
-                self.fleet, "is_update_target", self.fleet.is_schedulable
-            )
-            alive = [a for a in self.addresses if in_target(a)]
-            return alive or list(self.addresses)
+        Streamed mode (``config.streamed_weight_updates``, the default):
+        no server is ever paused — the trainer streams chunks (or posts
+        the disk reload) at live servers, each applies into a shadow
+        buffer and flips at a dispatch boundary
+        (inference/weights.WeightStore), and this client records one
+        ``weight_stream`` span (``rollout/weight_stream_s``) instead of
+        a ``weight_update_pause`` window. Legacy mode pauses every
+        update-target server first (reference sglang_remote.py:251-309)
+        and resumes after. Either way the wait/fan-out runs off-thread
+        so one slow server never stalls the train loop."""
+        streamed = bool(
+            getattr(self.config, "streamed_weight_updates", True)
+        )
+
+        # fan-out target set: skip servers the fleet already knows are
+        # DEAD/DRAINING — posting at them would stall or fail the whole
+        # update for capacity that isn't serving anyway; WARMING servers
+        # ARE included (see update_target_addresses)
+        _alive_addresses = self.update_target_addresses
 
         def _pause_all():
             for addr in _alive_addresses():
@@ -956,18 +966,31 @@ class RemoteInferenceEngine(InferenceEngine):
                     logger.error(f"pause_generation {addr} failed: {e}")
                     self._quarantine(addr)
 
-        # Pause SYNCHRONOUSLY before returning (reference pauses inline,
-        # sglang_remote.py:252-254): callers overlap `update_weights(...)`
-        # with `engine.upload_weights(meta)`, and streaming chunks into a
-        # not-yet-paused server would swap weights mid-decode (round-2
-        # advisor finding).
+        # Legacy mode pauses SYNCHRONOUSLY before returning (reference
+        # pauses inline, sglang_remote.py:252-254): callers overlap
+        # `update_weights(...)` with `engine.upload_weights(meta)`, and
+        # streaming chunks into a not-yet-paused LEGACY server would
+        # swap weights mid-decode. Streamed mode skips the pause
+        # entirely — streamed servers stage into a shadow buffer and
+        # flip between dispatches, so live decode is exactly the point.
         t_pause = time.monotonic()
-        _pause_all()
+        if not streamed:
+            _pause_all()
 
         def _record_pause_window():
-            # the full pause→transfer→resume window: rollout capacity the
-            # fleet lost to this weight update
+            # the full transfer window. Legacy: a pause span — rollout
+            # capacity the fleet lost. Streamed: a weight_stream span —
+            # wall time the push took while decode kept running (zero
+            # pause spans is the r13 acceptance invariant,
+            # trace_report --weights --require-zero-pause pins it).
             dur = time.monotonic() - t_pause
+            if streamed:
+                self.tracer.record(
+                    "weight_stream", "__controller__", t_pause,
+                    t_pause + dur, model_version=meta.model_version,
+                )
+                stats_tracker.scalar(**{"rollout/weight_stream_s": dur})
+                return
             self.tracer.record(
                 "weight_update_pause", "__controller__", t_pause,
                 t_pause + dur, model_version=meta.model_version,
@@ -1028,7 +1051,8 @@ class RemoteInferenceEngine(InferenceEngine):
                         )
                     self.set_version(meta.model_version)
                 finally:
-                    self._resume_all_best_effort()
+                    if not streamed:
+                        self._resume_all_best_effort()
                     _record_pause_window()
 
             return self.executor.submit(_do_device_update)
@@ -1098,10 +1122,27 @@ class RemoteInferenceEngine(InferenceEngine):
                 # _on_server_recovered re-pushes this checkpoint
                 self._last_disk_update = (meta.path, meta.model_version)
             finally:
-                self._resume_all_best_effort()
+                if not streamed:
+                    self._resume_all_best_effort()
                 _record_pause_window()
 
         return self.executor.submit(_do_update)
+
+    def update_target_addresses(self) -> List[str]:
+        """The servers a weight push should reach RIGHT NOW: every
+        fleet member that is not DEAD/DRAINING, WARMING included
+        (`FleetMonitor.is_update_target` — a cold server skipped here
+        would finish compiling straight into rotation with stale
+        weights). Callers building a device-path `WeightUpdateMeta`
+        put this in ``meta.addrs`` so `spmd_engine.upload_weights`
+        streams at the same set `update_weights` waits on."""
+        if self.fleet is None:
+            return list(self.addresses)
+        in_target = getattr(
+            self.fleet, "is_update_target", self.fleet.is_schedulable
+        )
+        alive = [a for a in self.addresses if in_target(a)]
+        return alive or list(self.addresses)
 
     def _resume_all_best_effort(self):
         """continue_generation on every server; one dead server must not

@@ -877,9 +877,13 @@ class SPMDTrainEngine(TrainEngine):
                 )
 
         # fan each chunk out to all servers concurrently (the reference's
-        # broadcast reaches every server at once; servers sit paused for
-        # the whole transfer, so wall time matters). The generator is
-        # collective: non-zero ranks drain it without posting.
+        # broadcast reaches every server at once). Streamed-mode servers
+        # (r13, the default) stay LIVE through the transfer — each chunk
+        # lands in a shadow buffer while decode runs — but wall time
+        # still matters: it bounds how stale the flip is by the time it
+        # applies, and legacy servers sit paused for all of it. The
+        # generator is collective: non-zero ranks drain it without
+        # posting.
         with goodput.trainer_bucket("weight_push"), ThreadPoolExecutor(
             max_workers=max(1, len(addrs))
         ) as pool:

@@ -53,6 +53,7 @@ from areal_tpu.inference.cache import (
     RadixPrefixCache,
     init_kv_pool,
 )
+from areal_tpu.inference.weights import WeightStore
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import ModelConfig, load_hf_config
 from areal_tpu.models.transformer import Params
@@ -120,6 +121,11 @@ class _Request:
     # a suffix-resume continuation of an in-flight episode request: it
     # already holds client-side progress, so admission never sheds it
     resumed: bool = False
+    # weight version this request decodes under (and whose KV its pages
+    # hold) — stamped at admission, left behind by a pin-policy flip so
+    # the request drains on the buffer that prefilled it (the store
+    # holds one pin per such request until it finishes/preempts)
+    weight_version: int = 0
     # multimodal payload (VLM serving): pixel_values [P, Dp],
     # vis_seg/vis_pos_h/vis_pos_w [P], mm_index [plen] (-1 = text),
     # mrope_pos [plen, 3]; rope_delta shifts decode rope positions
@@ -420,10 +426,29 @@ class GenerationEngine:
         self._active: Dict[int, _Request] = {}  # slot -> request
         self._pending: List[_Request] = []  # drained but not yet admitted
         self._pending_since: Optional[float] = None
-        # device-path weight staging (chunked receive)
+        # device-path weight staging (chunked receive — the LEGACY
+        # paused path; streamed ingest stages in self.weights instead)
         self._staged: Dict[str, Any] = {}
         self._staging_key = None
         self._staged_chunks: set = set()
+        # --- zero-pause weight plane (r13): versioned buffers + shadow
+        # staging + the flip the loop applies between dispatches ---
+        wt = getattr(config, "weights", None)
+        if wt is None:
+            from areal_tpu.api.cli_args import WeightTransferConfig
+
+            wt = WeightTransferConfig()
+        if wt.flip_policy not in ("pin", "resume"):
+            raise ValueError(
+                f"weights.flip_policy={wt.flip_policy!r}: expected "
+                "pin | resume"
+            )
+        self._wt_cfg = wt
+        self._weights_streaming = bool(wt.streaming)
+        self.weights = WeightStore(staging_ttl_s=wt.staging_ttl_s)
+        self._leaf_shardings: Optional[Dict[str, Any]] = None
+        self._cohort_rr = 0  # round-robin cursor over version cohorts
+        self._sweep_tick = 0
         self._paused = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -660,6 +685,25 @@ class GenerationEngine:
         placed = jax.device_put(params, self._param_shardings)
         return self._jit_cache[key](placed)
 
+    def _place_leaf(self, name: str, arr) -> Any:
+        """Host array → this engine's placement for ONE named parameter
+        leaf. The streamed ingest path places per chunk on the HTTP
+        handler thread, so h2d transfer overlaps live decode instead of
+        bursting at the flip."""
+        x = jnp.asarray(arr, dtype=self.dtype)
+        if self.mesh is None:
+            return x
+        if self._leaf_shardings is None:
+            from areal_tpu.utils.weight_transfer import flatten_params
+
+            # the shardings tree mirrors the params tree, so flattening
+            # it yields the same '/'-joined leaf names the wire uses
+            self._leaf_shardings = dict(
+                flatten_params(self._param_shardings)
+            )
+        sh = self._leaf_shardings.get(name)
+        return jax.device_put(x, sh) if sh is not None else x
+
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
@@ -676,6 +720,11 @@ class GenerationEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # a flip queued after (or racing) the loop's last iteration
+        # would leave its waiter blocked out the full timeout — close
+        # the store: the pending flip fails now and later queue_flip
+        # calls fail fast
+        self.weights.close()
         # non-HTTP deployments: drain remaining spans to the configured
         # JSONL sink (the server path drains via GET /trace instead)
         self.tracer.flush()
@@ -787,7 +836,39 @@ class GenerationEngine:
                 model_version=self.model_version,
             )
 
+    def streams_weight_updates(self, method: str = "chunk") -> bool:
+        """True when ``method`` ("chunk" | "disk" | "tensors") takes the
+        zero-pause streamed route on this engine. The tensors path needs
+        single-device serving (its donation-safe copy would race the
+        loop thread's jit cache under TP); chunk/disk stream anywhere.
+        A stopped engine always uses the legacy command path — there is
+        no loop to apply a flip."""
+        if not (self._weights_streaming and self._running):
+            return False
+        if method == "tensors":
+            return self.mesh is None
+        return True
+
     def update_weights_from_disk(self, path: str, version: Optional[int] = None):
+        if self.streams_weight_updates("disk"):
+            # load + place on THIS (handler) thread while decode runs;
+            # the loop applies the flip between dispatches and the
+            # future resolves once the new version serves
+            host = hf_io.load_params(
+                path, self.model_config, dtype=self.dtype
+            )
+            placed = self._place_params(host)
+            # a half-streamed chunked push is now obsolete: drop its
+            # staged leaves (same supersede rule as the legacy path) so
+            # they don't sit pinned until the TTL — and so its straggler
+            # chunks can't later queue a stale flip
+            self.weights.abort_staging("superseded by disk update")
+            v = version if version is not None else self.model_version + 1
+            out = self.weights.queue_flip(v, placed).result(timeout=600)
+            logger.info(
+                f"weights streamed from {path} → v{out} (no pause)"
+            )
+            return out
         done = Future()
         self._command_queue.put(("update_weights", (path, version), done))
         return done.result(timeout=600)
@@ -796,7 +877,16 @@ class GenerationEngine:
         self, params: Params, version: Optional[int] = None
     ):
         """Colocated path: swap in an already-materialized param pytree
-        (role of the reference's NCCL broadcast receive path)."""
+        (role of the reference's NCCL broadcast receive path). The
+        caller may later DONATE the source buffers, so both routes copy."""
+        if self.streams_weight_updates("tensors"):
+            # single-device only (streams_weight_updates gates it), so
+            # this is the jit-cache-free branch of the placed copy —
+            # safe off the loop thread
+            copied = self._copy_params_placed(params)
+            self.weights.abort_staging("superseded by tensor update")
+            v = version if version is not None else self.model_version + 1
+            return self.weights.queue_flip(v, copied).result(timeout=600)
         done = Future()
         self._command_queue.put(("update_weights_tensors", (params, version), done))
         return done.result(timeout=600)
@@ -804,7 +894,34 @@ class GenerationEngine:
     def update_weights_chunk(self, header: Dict, arrays: Dict[str, Any]):
         """Device-path receive: stage one FFD chunk of host tensors; the
         final chunk assembles + swaps the full pytree (reference NCCL
-        receive side, areal/engine/sglang_remote.py:411)."""
+        receive side, areal/engine/sglang_remote.py:411). Streaming
+        engines stage into the WeightStore's shadow buffer on this
+        thread — decode never stops — and flip at a dispatch boundary;
+        legacy engines stage on the loop thread under the pause."""
+        if self.streams_weight_updates("chunk"):
+            t0 = time.monotonic()
+            self.weights.sweep()
+            out = self.weights.ingest_chunk(
+                header, arrays, self._place_leaf
+            )
+            if self.tracer.enabled:
+                self.tracer.record(
+                    "weight_stream_chunk", "__engine__", t0,
+                    time.monotonic(),
+                    chunk_index=int(header["chunk_index"]),
+                    n_chunks=int(header["n_chunks"]),
+                    leaves=len(arrays),
+                    bytes=sum(
+                        int(spec.get("nbytes", 0))
+                        for spec in header.get("params", [])
+                    ),
+                    model_version=int(header["version"]),
+                )
+            if out is None:
+                return {"staged": self.weights.staged_chunks}
+            version, tree = out
+            v = self.weights.queue_flip(version, tree).result(timeout=600)
+            return {"version": v, "complete": True}
         done = Future()
         self._command_queue.put(("update_weights_chunk", (header, arrays), done))
         return done.result(timeout=600)
@@ -944,6 +1061,17 @@ class GenerationEngine:
             deadline_misses_total=self.deadline_misses_total,
             model_version=self.model_version,
             paused=float(self._paused.is_set()),
+            # zero-pause weight plane (r13): shadow staging + pinned
+            # old-version buffers + applied flips
+            weight_staging_bytes=self.weights.staging_bytes,
+            weight_staging_aborts_total=float(
+                self.weights.staging_aborts_total
+            ),
+            weight_pinned_requests=float(self.weights.pinned_requests()),
+            weight_buffer_versions=float(
+                len(self.weights.buffer_versions())
+            ),
+            weight_flips_total=float(self.weights.flips_total),
             trace_spans=len(self.tracer) if self.tracer.enabled else 0,
             # ring-buffer overflow count: a truncated trace must be
             # VISIBLY truncated, not silently missing its oldest spans
@@ -1000,15 +1128,28 @@ class GenerationEngine:
         led = self.ledger
         while self._running:
             self._maybe_start_profile()
+            did_flip = False
+            if self.weights.flip_pending:
+                # the atomic weight flip — what remains of the old pause
+                # window; booking it to weight_pause keeps the ledger
+                # honest about how little that is (one pipeline drain)
+                with led.bucket("weight_pause"):
+                    did_flip = self._maybe_flip_weights()
+            self._sweep_tick += 1
+            if self._sweep_tick >= 256:
+                # abandoned-staging TTL sweep (cheap, amortized): a
+                # client that died mid-stream must not pin staging
+                self._sweep_tick = 0
+                self.weights.sweep()
             if self._paused.is_set() or not self._command_queue.empty():
                 # command work (weight swaps, aborts) and every paused
                 # moment book to weight_pause — the capacity a weight
                 # update takes from serving, measured from the server's
                 # own clock
                 with led.bucket("weight_pause"):
-                    did_work = self._drain_commands()
+                    did_work = self._drain_commands() or did_flip
             else:
-                did_work = self._drain_commands()
+                did_work = self._drain_commands() or did_flip
             if not self._paused.is_set():
                 if (
                     self._pending
@@ -1099,6 +1240,91 @@ class GenerationEngine:
             except Exception as e:  # profiling must never kill serving
                 logger.warning(f"profiler stop failed: {e}")
 
+    def _maybe_flip_weights(self) -> bool:
+        """Apply a pending streamed weight flip at a dispatch boundary
+        (loop thread). The pipeline is drained first — bounded by
+        ``decode_pipeline`` in-flight chunks, milliseconds, with no
+        client-visible abort — so chunk version attribution stays exact;
+        then the swap is a pointer flip plus a registry flush. Under
+        ``flip_policy="pin"`` the requests in flight keep decoding on
+        the outgoing buffer (one store pin each; the decode loop
+        dispatches each version cohort with its own params); under
+        ``"resume"`` they resolve with ``stop_reason="abort"`` and the
+        client's suffix-resume loop continues them on the new version —
+        either way every token's recorded weight version is exact."""
+        flip = self.weights.take_flip()
+        if flip is None:
+            return False
+        version, params, fut = flip
+        t0 = time.monotonic()
+        try:
+            if version < self.model_version:
+                raise ValueError(
+                    f"stale weight flip: v{version} < served "
+                    f"v{self.model_version}"
+                )
+            self._drain_pipeline()
+            policy = self._wt_cfg.flip_policy
+            if policy == "pin" and not self._compact_enabled:
+                # pinning needs the compacted (cohort-capable) decode
+                # dispatch; full-slot engines abort-and-resume instead
+                policy = "resume"
+            old_version, old_params = self.model_version, self.params
+            pinned = 0
+            if policy == "resume":
+                for slot in list(self._active):
+                    self._finish(slot, "abort")
+            elif version != old_version:
+                for req in self._active.values():
+                    if req.weight_version == old_version:
+                        self.weights.retain(old_version, old_params)
+                        pinned += 1
+            self.params = params
+            self.model_version = version
+            # cached KV (radix tree included) is old-policy: a new
+            # claimant must never ride it. Active slots' own pages are
+            # request-owned and survive the flush.
+            self.registry.flush(self.pm)
+            self.weights.flips_total += 1
+            now = time.monotonic()
+            self.tracer.record(
+                "weight_update", "__engine__", t0, now, cmd="flip",
+                model_version=version,
+            )
+            self.tracer.instant(
+                "weight_flip", "__engine__", model_version=version,
+                policy=policy, pinned=pinned,
+                flip_ms=round((now - t0) * 1e3, 3),
+            )
+            logger.info(
+                f"weights flipped → v{version} (policy={policy}, "
+                f"{pinned} request(s) pinned to v{old_version}, "
+                f"{(now - t0) * 1e3:.1f} ms, no pause)"
+            )
+            fut.set_result(version)
+        except Exception as e:
+            fut.set_exception(e)
+        return True
+
+    def _fence_unpaused_swap(self) -> None:
+        """Guard the LEGACY (command-path) weight swaps against a live
+        engine: a streamed client never pauses, so a
+        ``--no-weight-streaming`` server can receive a swap mid-decode —
+        silently continuing in-flight slots on old KV + new weights
+        (unpinned, mis-stamped) would corrupt the version fence. Abort
+        them into the suffix-resume contract instead; under the legacy
+        paused protocol the pause already aborted everything, so this
+        is a no-op there."""
+        if self._active and not self._paused.is_set():
+            logger.warning(
+                f"legacy weight swap on an unpaused engine: aborting "
+                f"{len(self._active)} in-flight request(s) into "
+                f"suffix-resume (enable weights.streaming for "
+                f"zero-pause flips)"
+            )
+            for slot in list(self._active):
+                self._finish(slot, "abort")
+
     def _drain_commands(self) -> bool:
         did = False
         while True:
@@ -1119,6 +1345,7 @@ class GenerationEngine:
                     done.set_result(True)
                 elif cmd == "update_weights":
                     path, version = arg
+                    self._fence_unpaused_swap()
                     host = hf_io.load_params(
                         path, self.model_config, dtype=self.dtype
                     )
@@ -1128,6 +1355,7 @@ class GenerationEngine:
                     self.registry.flush(self.pm)
                     self._staged = {}
                     self._staging_key = None
+                    self.weights.abort_staging("superseded by disk update")
                     self.model_version = (
                         version
                         if version is not None
@@ -1156,6 +1384,7 @@ class GenerationEngine:
                         unflatten_params,
                     )
 
+                    self._fence_unpaused_swap()
                     host = jax.tree_util.tree_map(
                         lambda a: jnp.asarray(a, dtype=self.dtype),
                         unflatten_params(self._staged),
@@ -1172,11 +1401,15 @@ class GenerationEngine:
                     done.set_result({"version": version, "complete": True})
                 elif cmd == "update_weights_tensors":
                     params, version = arg
+                    self._fence_unpaused_swap()
                     # the caller may later DONATE these buffers — copy
                     self.params = self._copy_params_placed(params)
                     self.registry.flush(self.pm)
                     self._staged = {}
                     self._staging_key = None
+                    self.weights.abort_staging(
+                        "superseded by tensor update"
+                    )
                     self.model_version = (
                         version
                         if version is not None
@@ -1226,7 +1459,20 @@ class GenerationEngine:
             candidates, key=lambda sl: self._active[sl].submit_time
         )
         req = self._active.pop(slot)
-        self._release_slot(slot, park_tokens=req.all_tokens)
+        # a pinned victim's pages hold OLD-version KV: parking them in
+        # the (already-flushed) registry would let a new-version request
+        # claim stale state — release outright, and drop the store pin
+        # (the request re-prefills under the current weights)
+        self._release_slot(
+            slot,
+            park_tokens=(
+                req.all_tokens
+                if req.weight_version == self.model_version
+                else None
+            ),
+        )
+        if req.weight_version != self.model_version:
+            self.weights.release(req.weight_version)
         req.slot = None
         req.preemptions += 1
         self.total_preemptions += 1
@@ -1810,6 +2056,11 @@ class GenerationEngine:
         self, req: _Request, slot: int, pages: List[int], cached: int
     ):
         req.slot = slot
+        # (re-)admission decodes under the CURRENT weights: a preempted
+        # pin-policy request re-prefills here on the new version (its
+        # already-emitted tokens keep their old per-token version stamps
+        # — the recorded-switch half of the fence invariant)
+        req.weight_version = self.model_version
         self._active[slot] = req
         self._slot_pages[slot] = pages
         self._cached_len[slot] = cached
@@ -1889,12 +2140,15 @@ class GenerationEngine:
                 return False
         return False
 
-    def _pages_bound(self, margin_tokens: int) -> int:
+    def _pages_bound(
+        self, margin_tokens: int, slots: Optional[List[int]] = None
+    ) -> int:
         """Static page-window bound: bucketed longest cached length plus
-        the in-flight margin."""
+        the in-flight margin (over ``slots`` when a cohort dispatch
+        passes one, else over every active slot)."""
         bs = self.cache_config.page_size
         max_len = (
-            max(int(self._cached_len[s]) for s in self._active)
+            max(int(self._cached_len[s]) for s in (slots or self._active))
             + margin_tokens
         )
         tokens = min(
@@ -1990,7 +2244,20 @@ class GenerationEngine:
         did = False
         dispatched = False
         drafts: Optional[Dict[int, List[int]]] = None
-        if self._spec_on() and self._active:
+        # version cohorts (r13 pin-policy flips): while ANY active
+        # request is pinned off the current version — including the tail
+        # case where only the pinned cohort remains — each dispatch
+        # covers ONE cohort with its own params (round-robin so neither
+        # starves); speculation sits out the transient — its
+        # drain-for-drafts scheduling assumes one dispatch serves every
+        # active slot
+        versions = (
+            {r.weight_version for r in self._active.values()}
+            if self._active
+            else set()
+        )
+        mixed = bool(versions - {self.model_version})
+        if self._spec_on() and self._active and not mixed:
             if not self._inflight:
                 drafts = self._propose_drafts() or None
             elif self._spec_has_candidates():
@@ -2025,8 +2292,36 @@ class GenerationEngine:
                 margin = self._margin(steps)
                 with led.bucket("decode"):
                     if self._ensure_decode_pages(margin):
-                        self._dispatch_chunk(steps, margin)
-                        dispatched = did = True
+                        # recompute the cohort picture AFTER the page
+                        # walk: _ensure_decode_pages may have preempted
+                        # (or truncated) the last pinned request, which
+                        # releases its pin and drops the old buffer — a
+                        # stale pre-walk snapshot would dispatch an
+                        # empty cohort against a freed buffer and kill
+                        # the loop thread
+                        versions = {
+                            r.weight_version
+                            for r in self._active.values()
+                        }
+                        mixed = bool(versions - {self.model_version})
+                        if mixed:
+                            order = sorted(versions)
+                            v = order[self._cohort_rr % len(order)]
+                            self._cohort_rr += 1
+                            cohort_slots = sorted(
+                                sl
+                                for sl, r in self._active.items()
+                                if r.weight_version == v
+                            )
+                            if cohort_slots:
+                                self._dispatch_chunk(
+                                    steps, margin,
+                                    cohort=(cohort_slots, v),
+                                )
+                                dispatched = did = True
+                        elif self._active:
+                            self._dispatch_chunk(steps, margin)
+                            dispatched = did = True
         if self._inflight and (
             len(self._inflight) > depth or not dispatched
         ):
@@ -2069,6 +2364,7 @@ class GenerationEngine:
         steps: int,
         margin: int,
         drafts: Optional[Dict[int, List[int]]] = None,
+        cohort: Optional[tuple] = None,
     ):
         """One decode dispatch over the (possibly compacted) row bucket.
 
@@ -2078,12 +2374,38 @@ class GenerationEngine:
         (model_runner.spec_verify) — otherwise it is the regular fused
         ``steps``-iteration decode. Both return the same state/result
         contract, so everything downstream (row→slot scatter, packed
-        fetch, _process_chunk) is shared."""
+        fetch, _process_chunk) is shared.
+
+        ``cohort`` = ``(slots, weight_version)`` restricts the dispatch
+        to one weight-version cohort after a pin-policy flip: pinned
+        slots decode with the store's retained buffer while flipped
+        slots decode with ``self.params`` — two interleaved dispatches
+        instead of one, each with exact per-token version attribution.
+        Cohort dispatches always take the compact gather path (a
+        full-width dispatch would run the other cohort's rows under the
+        wrong params)."""
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
-        pps = self._pages_bound(margin)
         s = self.config.max_num_seqs
-        slots = sorted(self._active)
+        if cohort is None:
+            slots = sorted(self._active)
+            params = self.params
+            version = self.model_version
+        else:
+            slots, version = cohort
+            params = (
+                self.params
+                if version == self.model_version
+                else self.weights.params_for(version)
+            )
+            if params is None:
+                # cannot happen while the cohort exists (its requests
+                # hold pins) — decoding them on the wrong weights would
+                # silently corrupt the version fence, so fail loudly
+                raise RuntimeError(
+                    f"no weight buffer for pinned version {version}"
+                )
+        pps = self._pages_bound(margin, slots)
         n_active = len(slots)
         rows = self._decode_rows_bucket(n_active) if self._compact_enabled else s
         want_rope = bool(self._slot_mm.any())
@@ -2110,7 +2432,7 @@ class GenerationEngine:
                 for c in self._inflight
             ) and all(
                 (self._cached_len[sl] - self._align_base[sl]) % cq == 0
-                for sl in slots
+                for sl in self._active
             ):
                 self._spec_replay_off = True
         spec_align = self._spec_configured and not self._spec_replay_off
@@ -2122,9 +2444,14 @@ class GenerationEngine:
             "_cur_tokens", "_temp_dev", "_top_p_dev", "_top_k_dev",
             "_greedy_dev", "_remaining", "_no_stop",
         )
-        if rows >= s:
-            # full-width dispatch: row r IS slot r (the TP path, compact
-            # disabled, and what compaction degrades to at saturation)
+        # full-width = identity row map (row r IS slot r). Cohort
+        # dispatches never take it — the identity map would cover the
+        # OTHER cohort's slots too — and BOTH the gather below and the
+        # post-dispatch scatter key off this one flag (a rows==s cohort
+        # dispatch is still row-gathered, so assigning its row-space
+        # results as slot-space state would corrupt the other cohort)
+        full_width = rows >= s and cohort is None
+        if full_width:
             rows = s
             row_slots = np.arange(s, dtype=np.int32)
             tables_dev = jnp.asarray(self._tables[:, :pps])
@@ -2192,7 +2519,7 @@ class GenerationEngine:
                     self.cache, toks, logps, emitted, active_after,
                     remaining_a, no_stop_a, lens_a, new_last, cur_next,
                 ) = model_runner.spec_verify(
-                    self.params, self.model_config, self.cache,
+                    params, self.model_config, self.cache,
                     tables_dev, lens,
                     st["_cur_tokens"], jnp.asarray(draft_np),
                     jnp.asarray(spec_draft_lens), active, st["_remaining"],
@@ -2215,7 +2542,7 @@ class GenerationEngine:
                 f"rows{rows}|steps{steps}|pps{pps}|replay{replay}",
             ):
                 out = model_runner.decode_multi(
-                    self.params, self.model_config, self.cache,
+                    params, self.model_config, self.cache,
                     tables_dev, lens,
                     st["_cur_tokens"], active, st["_remaining"],
                     st["_no_stop"], stops, key,
@@ -2248,7 +2575,7 @@ class GenerationEngine:
             "_no_stop": no_stop_a,
             "_lens_dev": lens_a,
         }
-        if rows >= s:
+        if full_width:
             for a, v in updates.items():
                 setattr(self, a, v)
             self._last_rows = new_last
@@ -2303,7 +2630,7 @@ class GenerationEngine:
                 # processing must not absorb this chunk's stale results
                 "row_slots": row_slots,
                 "reqs": dict(self._active),
-                "version": self.model_version,
+                "version": version,
             }
         )
 
@@ -2495,13 +2822,21 @@ class GenerationEngine:
                     ),
                 )
         # the slot's pages hold the prompt plus all generated tokens
-        # except the last sampled one (it was never fed back)
+        # except the last sampled one (it was never fed back). A request
+        # that finished pinned to a pre-flip version holds OLD-version
+        # KV: never park it for new-version claimants.
         self._release_slot(
             slot,
             park_tokens=(
-                req.all_tokens if self.config.prefix_reuse_min > 0 else None
+                req.all_tokens
+                if self.config.prefix_reuse_min > 0
+                and req.weight_version == self.model_version
+                else None
             ),
         )
+        if req.weight_version != self.model_version:
+            # last pin out drops the old buffer (HBM back)
+            self.weights.release(req.weight_version)
         now = time.monotonic()
         if reason != "abort":
             # aborts are pause-window resumes, not client-visible

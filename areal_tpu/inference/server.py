@@ -126,6 +126,22 @@ _METRIC_HELP = {
     "total_preemptions": "requests preempted under pool pressure",
     "model_version": "weight version currently being served",
     "paused": "1 while generation is paused for a weight update",
+    # zero-pause weight plane (r13): streamed double-buffered updates
+    "weight_staging_bytes": (
+        "bytes currently staged in the shadow weight buffer (a stuck "
+        "nonzero value means an abandoned stream awaiting its TTL)"
+    ),
+    "weight_staging_aborts_total": (
+        "weight stagings dropped (TTL expiry, re-keyed retry, or a "
+        "superseding full update)"
+    ),
+    "weight_pinned_requests": (
+        "in-flight requests pinned to a pre-flip weight version"
+    ),
+    "weight_buffer_versions": (
+        "old weight buffers kept alive for pinned requests"
+    ),
+    "weight_flips_total": "streamed weight flips applied (no pause)",
     # goodput attribution plane (r11): exclusive wall-time buckets —
     # fractions sum to 1.0 of observed wall so nothing hides
     "goodput_prefill_frac": "fraction of wall time in prefill dispatches",
@@ -233,6 +249,7 @@ _ENGINE_COUNTERS = (
     "spec_chunks_total", "spec_draft_tokens_total",
     "spec_accepted_tokens_total",
     "compile_events_total", "compile_seconds_total",
+    "weight_staging_aborts_total", "weight_flips_total",
 )
 _ENGINE_HISTOGRAMS = (
     "queue_wait_seconds", "ttft_seconds", "request_latency_seconds",
@@ -244,6 +261,8 @@ _ENGINE_GAUGES = (
     "decode_rows_active", "decode_occupancy", "prefix_cache_hit_rate",
     "prefix_claim_hit_rate", "prefix_cache_nodes", "prefix_cache_pages",
     "model_version", "paused", "trace_spans",
+    "weight_staging_bytes", "weight_pinned_requests",
+    "weight_buffer_versions",
     "sched_class_interactive_running", "sched_class_bulk_running",
     "sched_class_interactive_queued", "sched_class_bulk_queued",
     "spec_enabled", "spec_accept_rate", "spec_accept_rate_ewma",
@@ -696,6 +715,25 @@ def main(argv: Optional[list] = None):
         "cycle, effective tok/s) to this JSONL stream",
     )
     p.add_argument(
+        "--no-weight-streaming", action="store_true",
+        help="disable the zero-pause weight plane: weight updates "
+        "apply on the engine loop under the legacy pause protocol "
+        "(the bench A/B baseline)",
+    )
+    p.add_argument(
+        "--weight-flip-policy", default=d.weights.flip_policy,
+        choices=("pin", "resume"),
+        help="in-flight requests at a streamed flip: 'pin' keeps them "
+        "decoding on the outgoing buffer until they drain; 'resume' "
+        "aborts them into the client's suffix-resume loop",
+    )
+    p.add_argument(
+        "--weight-staging-ttl", type=float,
+        default=d.weights.staging_ttl_s,
+        help="seconds before an abandoned chunked weight stream's "
+        "staging is dropped (<= 0 disables the sweep)",
+    )
+    p.add_argument(
         "--router-addr", default="",
         help="router host:port to POST /register to at startup "
         "(dynamic fleet membership without shared name_resolve)",
@@ -753,6 +791,9 @@ def main(argv: Optional[list] = None):
     )
     cfg.tracing.enabled = args.trace
     cfg.tracing.max_spans = args.trace_max_spans
+    cfg.weights.streaming = not args.no_weight_streaming
+    cfg.weights.flip_policy = args.weight_flip_policy
+    cfg.weights.staging_ttl_s = args.weight_staging_ttl
     cfg.goodput.ready_quiet_s = args.ready_quiet
     cfg.goodput.ready_min_requests = args.ready_min_requests
     cfg.goodput.compile_events_path = args.compile_events

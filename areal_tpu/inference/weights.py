@@ -1,0 +1,279 @@
+"""Zero-pause weight plane: the server-side `WeightStore`.
+
+Role: own every parameter buffer a generation engine may be serving at
+once. The r2→r12 weight protocol opened a fleet-wide pause window per
+push (`/pause_generation` → transfer → `/continue_generation`), booked
+as ``weight_pause`` in the goodput ledger — 5.4 s of push plus tens of
+seconds of wait per overlap step in the r5 capture. The store replaces
+the pause with a double buffer and a version fence:
+
+1. **Streamed ingest.** Device-path FFD chunks (the existing
+   ``update_weights_chunk`` wire format, utils/weight_transfer.py) are
+   staged on the HTTP handler thread — each leaf is placed onto the
+   device as it arrives — while the engine loop keeps dispatching on
+   version N. Staging is keyed on ``(version, n_chunks)`` so a retry
+   with a different FFD grouping discards stale leaves, carries a TTL
+   so an abandoned stream (client died mid-push) cannot pin staging
+   bytes forever, and is visible via the ``weight_staging_bytes``
+   gauge.
+
+2. **Atomic flip.** The final chunk assembles the shadow pytree and
+   queues a flip; the engine loop applies it BETWEEN dispatches
+   (``GenerationEngine._maybe_flip_weights``) — at most one in-flight
+   pipeline drain of latency, never a pause span. The caller's future
+   resolves once the flip is live, so the HTTP response still means
+   "this server serves version V".
+
+3. **Version pinning.** Under ``flip_policy="pin"`` the requests active
+   at the flip keep decoding on N: the engine retains N's buffer here
+   (one pin per in-flight request) and dispatches each version cohort
+   with its own params. The buffer is dropped — HBM freed — the moment
+   its last pinned request finishes, preempts, or aborts. Per-token
+   ``output_versions`` record exactly which weights produced every
+   token, so the trainer-side staleness fence stays exact across the
+   flip (correctness is the fence, not bit-exactness).
+
+The store is deliberately engine-agnostic: it never touches jax. The
+engine supplies a ``place_leaf(name, host_array) -> device_array``
+callable, so the store also unit-tests without a device.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.weight_transfer import unflatten_params
+
+logger = logging_util.getLogger("WeightStore")
+
+
+class WeightStore:
+    """Versioned parameter buffers + chunked shadow staging for one
+    generation engine. Thread-safe: ingest runs on HTTP handler
+    threads, flips apply on the engine loop thread, pins are
+    retained/released from the loop thread."""
+
+    def __init__(
+        self,
+        staging_ttl_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.staging_ttl_s = float(staging_ttl_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # --- chunked staging (the shadow buffer being assembled) ---
+        self._staging_key: Optional[Tuple[int, int]] = None
+        self._staged: Dict[str, Any] = {}  # leaf name -> placed array
+        self._staged_chunks: set = set()
+        self._staged_bytes = 0
+        self._staged_touch = 0.0
+        # --- pinned old-version buffers (flip_policy="pin") ---
+        self._buffers: Dict[int, Any] = {}  # version -> params pytree
+        self._pins: Dict[int, int] = {}  # version -> pinned request count
+        # --- pending flip (applied by the engine loop) ---
+        self._pending: Optional[Tuple[int, Any, Future]] = None
+        # set by close(): no loop will ever apply another flip, so
+        # queue_flip must fail fast instead of letting its caller block
+        # out a long result() timeout against a dead consumer
+        self._closed = False
+        # lifetime counters (engine metrics surface)
+        self.flips_total = 0
+        self.staging_aborts_total = 0
+
+    # ------------------------------------------------------------------
+    # Staging / ingest (HTTP handler threads)
+    # ------------------------------------------------------------------
+    def _reset_staging_locked(self) -> None:
+        self._staging_key = None
+        self._staged = {}
+        self._staged_chunks = set()
+        self._staged_bytes = 0
+
+    def _abort_staging_locked(self, reason: str) -> None:
+        if not self._staged and self._staging_key is None:
+            return
+        key, n, b = self._staging_key, len(self._staged), self._staged_bytes
+        self._reset_staging_locked()
+        self.staging_aborts_total += 1
+        logger.warning(
+            f"dropped weight staging {key} ({n} leaves, {b} bytes): "
+            f"{reason}"
+        )
+
+    def abort_staging(self, reason: str = "aborted") -> None:
+        """Drop whatever is staged (full disk/tensor updates supersede a
+        half-streamed push; operators can abort a wedged stream)."""
+        with self._lock:
+            self._abort_staging_locked(reason)
+
+    def sweep(self) -> None:
+        """TTL sweep: an abandoned stream (client died mid-push) must
+        not hold its staged leaves forever. Called from the engine loop
+        and from each ingest."""
+        if self.staging_ttl_s <= 0:
+            return
+        with self._lock:
+            if (
+                self._staging_key is not None
+                and self._clock() - self._staged_touch > self.staging_ttl_s
+            ):
+                self._abort_staging_locked(
+                    f"no chunk for {self.staging_ttl_s:.0f}s (TTL)"
+                )
+
+    def ingest_chunk(
+        self,
+        header: Dict[str, Any],
+        arrays: Dict[str, Any],
+        place_leaf: Callable[[str, Any], Any],
+    ) -> Optional[Tuple[int, Any]]:
+        """Stage one FFD chunk; returns ``(version, params)`` when this
+        chunk completes the set (the caller queues the flip), else None.
+        Staging re-keys on ``(version, n_chunks)``: a retry with a
+        different FFD grouping discards the stale leaves instead of
+        merging two inconsistent streams."""
+        version = int(header["version"])
+        n_chunks = int(header["n_chunks"])
+        stage_key = (version, n_chunks)
+        placed = {name: place_leaf(name, arr) for name, arr in arrays.items()}
+        nbytes = sum(
+            int(spec.get("nbytes", 0)) for spec in header.get("params", [])
+        )
+        with self._lock:
+            if (
+                self._staging_key is not None
+                and self._staging_key != stage_key
+                and self._clock() - self._staged_touch > self.staging_ttl_s > 0
+            ):
+                # count the TTL-expired stream as an abort, not a re-key
+                self._abort_staging_locked(
+                    f"no chunk for {self.staging_ttl_s:.0f}s (TTL)"
+                )
+            if self._staging_key != stage_key:
+                if self._staging_key is not None:
+                    self._abort_staging_locked(
+                        f"re-keyed to {stage_key} (retry with a "
+                        f"different chunking)"
+                    )
+                self._staging_key = stage_key
+            self._staged.update(placed)
+            idx = int(header["chunk_index"])
+            if idx not in self._staged_chunks:
+                # a retried chunk (lost HTTP response) replaces its
+                # leaves but must not double-count the staging gauge
+                self._staged_chunks.add(idx)
+                self._staged_bytes += nbytes
+            self._staged_touch = self._clock()
+            if len(self._staged_chunks) < n_chunks:
+                return None
+            tree = unflatten_params(self._staged)
+            self._reset_staging_locked()
+            return version, tree
+
+    @property
+    def staging_bytes(self) -> int:
+        with self._lock:
+            return self._staged_bytes
+
+    @property
+    def staged_chunks(self) -> int:
+        with self._lock:
+            return len(self._staged_chunks)
+
+    # ------------------------------------------------------------------
+    # Flip queue (producer: any thread; consumer: the engine loop)
+    # ------------------------------------------------------------------
+    def queue_flip(self, version: int, params: Any) -> Future:
+        """Hand a completed buffer to the engine loop; the returned
+        future resolves with the version once the flip is live. A
+        second flip queued before the first applies supersedes it (the
+        trainer serializes pushes, so this only happens on retries) —
+        the superseded future fails loudly rather than resolving for a
+        version that never served."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                fut.set_exception(
+                    RuntimeError(
+                        f"weight store closed (engine stopped); flip to "
+                        f"v{version} will never apply"
+                    )
+                )
+                return fut
+            old = self._pending
+            self._pending = (int(version), params, fut)
+        if old is not None and not old[2].done():
+            old[2].set_exception(
+                RuntimeError(
+                    f"weight flip to v{old[0]} superseded by v{version} "
+                    f"before it applied"
+                )
+            )
+        return fut
+
+    def take_flip(self) -> Optional[Tuple[int, Any, Future]]:
+        with self._lock:
+            pending, self._pending = self._pending, None
+            return pending
+
+    def close(self) -> None:
+        """Engine teardown: refuse future flips and fail the pending one
+        — a handler mid-``queue_flip().result()`` learns NOW, not after
+        its 600 s timeout."""
+        with self._lock:
+            self._closed = True
+            pending, self._pending = self._pending, None
+        if pending is not None and not pending[2].done():
+            pending[2].set_exception(
+                RuntimeError(
+                    "engine stopped before the weight flip applied"
+                )
+            )
+
+    @property
+    def flip_pending(self) -> bool:
+        return self._pending is not None
+
+    # ------------------------------------------------------------------
+    # Version pinning (engine loop thread)
+    # ------------------------------------------------------------------
+    def retain(self, version: int, params: Any) -> None:
+        """One in-flight request stays pinned to ``version``; keep its
+        buffer alive until the last pin releases."""
+        with self._lock:
+            self._pins[version] = self._pins.get(version, 0) + 1
+            self._buffers.setdefault(version, params)
+
+    def release(self, version: int) -> None:
+        with self._lock:
+            n = self._pins.get(version, 0) - 1
+            if n > 0:
+                self._pins[version] = n
+                return
+            self._pins.pop(version, None)
+            if self._buffers.pop(version, None) is not None:
+                logger.info(
+                    f"weight buffer v{version} drained its last pinned "
+                    f"request; buffer dropped"
+                )
+
+    def params_for(self, version: int) -> Optional[Any]:
+        with self._lock:
+            return self._buffers.get(version)
+
+    def pinned_requests(self) -> int:
+        with self._lock:
+            return sum(self._pins.values())
+
+    def buffer_versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._buffers)
+
+    # NOTE: the /metrics surface for these counters lives INLINE in
+    # GenerationEngine.metrics() (weight_staging_bytes,
+    # weight_staging_aborts_total, weight_pinned_requests,
+    # weight_buffer_versions, weight_flips_total) — the arealint ARL003
+    # static scan extracts names from that dict literal, so a helper
+    # here returning a dynamic dict would hide them from the inventory.

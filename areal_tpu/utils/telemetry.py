@@ -255,6 +255,16 @@ class LineageLedger:
         with self._lock:
             return [dict(r) for r in self._records.values()]
 
+    def versions_of(self, uid: str) -> List[int]:
+        """Every weight version that produced one of this sample's
+        tokens (the trajectory-level staleness fence's input — r13
+        WorkflowExecutor admission reads it at consumption time)."""
+        with self._lock:
+            rec = self._records.get(uid)
+            if rec is None:
+                return []
+            return [int(v) for v in rec.get("weight_versions", ())]
+
     def staleness_values(self) -> List[int]:
         """Staleness-at-consumption of every consumed record still in
         the window (the hub's staleness-runaway input)."""

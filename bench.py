@@ -328,6 +328,243 @@ def _scaleup_phase() -> dict:
                 proc.kill()
 
 
+def _weightpush_phase() -> dict:
+    """Paused vs streamed weight push under LIVE decode traffic (r13
+    zero-pause weight plane), measured. Two tiny-model CPU server
+    subprocesses (one per mode — they never contend for the bench chip)
+    each serve a continuous bulk-decode load plus a short-request
+    interactive probe; the phase streams a real chunked device-path
+    push (the `update_weights_from_distributed` wire format) at each
+    and reports push latency, the decode-tok/s dip through the push
+    window, interactive TTFT p95 inside vs outside the window, and the
+    pause-span census from the server's own trace (streamed cell must
+    be zero — `trace_report --weights --require-zero-pause` pins the
+    same invariant in CI)."""
+    import queue as _q
+    import subprocess
+    import threading
+    import urllib.request as _rq
+
+    import jax as _jax
+    import numpy as _np
+
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.utils import weight_transfer as wt
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "genserver_worker.py",
+    )
+    mcfg = tiny_config("qwen2")
+    fresh = _jax.device_get(
+        init_params(mcfg, _jax.random.PRNGKey(5), dtype="float32")
+    )
+    leaves = [
+        (k, _np.asarray(v)) for k, v in wt.flatten_params(fresh)
+    ]
+    plan = wt.chunk_leaves(leaves, 64 * 1024)
+    n_chunks = len(plan)
+
+    def _p95(vals):
+        vals = sorted(vals)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(0.95 * (len(vals) - 1)))], 4)
+
+    def _post(addr, path, body, timeout=120, raw=False):
+        data = body if raw else json.dumps(body).encode()
+        req = _rq.Request(
+            f"http://{addr}{path}", data=data,
+            headers={
+                "Content-Type": (
+                    "application/octet-stream" if raw
+                    else "application/json"
+                )
+            },
+        )
+        with _rq.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _tps(addr):
+        with _rq.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for line in text.splitlines():
+            if line.startswith("areal_tpu_gen_decode_tokens_per_sec"):
+                return float(line.split()[-1])
+        return 0.0
+
+    def run_cell(streamed: bool) -> dict:
+        env = dict(os.environ)
+        env["AREAL_WORKER_TRACE"] = "1"
+        if not streamed:
+            env["AREAL_WORKER_WEIGHT_STREAMING"] = "0"
+        proc = subprocess.Popen(
+            [sys.executable, worker, "0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        lines: "_q.Queue[str]" = _q.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        ).start()
+        try:
+            deadline = time.monotonic() + 240
+            port = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError("weightpush worker died at startup")
+                try:
+                    line = lines.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                if line.startswith("PORT "):
+                    port = int(line.split()[1])
+                    break
+            if port is None:
+                raise RuntimeError("weightpush worker reported no port")
+            addr = f"127.0.0.1:{port}"
+            stop = threading.Event()
+            ttfts = []  # (completion time, ttft_s) from meta_info
+
+            def bulk_loop(seed):
+                # one Generator per thread — numpy Generators are not
+                # thread-safe, and a corrupted shared one would silently
+                # halve the load the A/B cells measure
+                rng = _np.random.default_rng(13 + seed)
+                while not stop.is_set():
+                    try:
+                        _post(addr, "/generate", {
+                            "input_ids": rng.integers(
+                                1, 100, size=8
+                            ).tolist(),
+                            "priority": "bulk",
+                            "sampling_params": {"max_new_tokens": 48},
+                        })
+                    except Exception:
+                        time.sleep(0.05)
+
+            def inter_loop():
+                while not stop.is_set():
+                    try:
+                        out = _post(addr, "/generate", {
+                            "input_ids": [3, 1, 4, 1, 5],
+                            "priority": "interactive",
+                            "sampling_params": {"max_new_tokens": 4},
+                        })
+                        ttfts.append(
+                            (time.monotonic(),
+                             float(out["meta_info"]["ttft"]))
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.05)
+
+            threads = [
+                threading.Thread(target=bulk_loop, args=(i,), daemon=True)
+                for i in range(2)
+            ] + [threading.Thread(target=inter_loop, daemon=True)]
+            for t in threads:
+                t.start()
+            # warm: wait until decode is actually flowing (compile storm)
+            warm_deadline = time.monotonic() + 180
+            while time.monotonic() < warm_deadline and _tps(addr) <= 0:
+                time.sleep(0.5)
+            # baseline window
+            base_tps = []
+            t_base = time.monotonic()
+            while time.monotonic() - t_base < 3.0:
+                base_tps.append(_tps(addr))
+                time.sleep(0.2)
+            # push window (tps sampled concurrently)
+            push_tps = []
+            sampling = threading.Event()
+            sampling.set()
+
+            def sample_loop():
+                while sampling.is_set():
+                    push_tps.append(_tps(addr))
+                    time.sleep(0.1)
+
+            sampler = threading.Thread(target=sample_loop, daemon=True)
+            sampler.start()
+            t0 = time.monotonic()
+            if not streamed:
+                _post(addr, "/pause_generation", {})
+            for i, items in enumerate(plan):
+                body = wt.encode_chunk(7, i, n_chunks, items)
+                out = _post(
+                    addr, "/update_weights_from_distributed", body,
+                    raw=True,
+                )
+            if not streamed:
+                _post(addr, "/continue_generation", {})
+            push_s = time.monotonic() - t0
+            time.sleep(1.0)  # let post-push decode recover into samples
+            sampling.clear()
+            sampler.join(timeout=5)
+            t_end = t0 + push_s + 1.0
+            stop.set()
+            with _rq.urlopen(
+                f"http://{addr}/get_model_info", timeout=30
+            ) as r:
+                info = json.loads(r.read())
+            # pause-span census from the server's own trace
+            with _rq.urlopen(
+                f"http://{addr}/trace?format=jsonl", timeout=30
+            ) as r:
+                trace_lines = r.read().decode().splitlines()
+            pause_spans = sum(
+                1
+                for ln in trace_lines
+                if ln.strip()
+                and json.loads(ln).get("name")
+                in ("pause_window", "weight_update_pause")
+            )
+            base_mean = (
+                sum(base_tps) / len(base_tps) if base_tps else 0.0
+            )
+            push_min = min(push_tps) if push_tps else 0.0
+            in_window = [
+                v for (tc, v) in ttfts if t0 <= tc <= t_end
+            ]
+            outside = [v for (tc, v) in ttfts if tc < t0]
+            return {
+                "push_s": round(push_s, 3),
+                "chunks": n_chunks,
+                "served_version": int(out.get("version", -1))
+                if isinstance(out, dict) else -1,
+                "model_version": int(info.get("model_version", -1)),
+                "decode_tps_baseline": round(base_mean, 1),
+                "decode_tps_push_min": round(push_min, 1),
+                "decode_tps_dip_frac": round(
+                    1.0 - push_min / base_mean, 4
+                ) if base_mean > 0 else None,
+                "interactive_ttft_p95_baseline_s": _p95(outside),
+                "interactive_ttft_p95_push_s": _p95(in_window),
+                "interactive_probes_in_window": len(in_window),
+                "pause_spans": pause_spans,
+            }
+        finally:
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+    cells = {}
+    for name, streamed in (("streamed", True), ("paused", False)):
+        try:
+            cells[name] = run_cell(streamed)
+        except Exception as e:  # per-cell graceful degradation
+            cells[name] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+    return {"configs": cells}
+
+
 def _env_resilience_phase() -> dict:
     """Kill-one-of-two ENV WORKERS under the chaos harness, measured.
     Two env-service subprocesses host the countdown tool env; a wave of
@@ -1549,6 +1786,23 @@ def main():
                 "scaleup_cold_to_serving_s": None,
                 "error": extra["scaleup_error"],
             },
+        )
+
+    # --- weight-push A/B sub-phase (r13): paused vs streamed push under
+    # live decode traffic on two tiny-model CPU server subprocesses —
+    # push latency, decode tok/s dip through the push window,
+    # interactive TTFT p95 in vs out of the window, and the pause-span
+    # census (streamed cell must report zero). Same graceful-degradation
+    # rule as the other auxiliary phases ---
+    try:
+        weightpush = _weightpush_phase()
+        extra["weightpush"] = weightpush
+        emit_phase("weightpush", weightpush)
+    except Exception as e:
+        extra["weightpush_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "weightpush",
+            {"configs": {}, "error": extra["weightpush_error"]},
         )
 
     # --- env-worker-kill resilience sub-phase: two env-service worker

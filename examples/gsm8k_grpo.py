@@ -9,8 +9,10 @@ or against disaggregated generation servers:
     AREAL_LLM_SERVER_ADDRS=host:port,... python examples/gsm8k_grpo.py --config ...
 
 The step loop mirrors the reference main (gsm8k_grpo.py:168-288):
-rollout → [ref logp] → advantages → ppo_update → pause → weight update →
-version bump → save/eval/recover-dump → stats commit → resume.
+rollout → [ref logp] → advantages → ppo_update → weight update (streamed
+zero-pause by default; the legacy pause → update → resume bracket with
+rollout.streamed_weight_updates=false) → version bump →
+save/eval/recover-dump → stats commit.
 """
 
 import itertools
@@ -205,9 +207,16 @@ def main(argv):
         # the host-staged chunked transfer (reference NCCL path semantics)
         # when weight_update_mode == "device", else the disk checkpoint
         if colocated or config.weight_update_mode == "device":
-            return WeightUpdateMeta(
+            meta = WeightUpdateMeta(
                 type=WeightUpdateMethod.DEVICE, model_version=version
             )
+            # stream at every current update TARGET (DEAD/DRAINING
+            # skipped, WARMING included) so upload_weights and the
+            # client's version wait cover the same set
+            targets = getattr(rollout, "update_target_addresses", None)
+            if not colocated and targets is not None:
+                meta.addrs = targets()
+            return meta
         return disk_meta(version)
 
     start_step = StepInfo(steps_per_epoch=ft_spec.steps_per_epoch)
@@ -276,7 +285,18 @@ def main(argv):
             with stats_tracker.record_timing(
                 "weight_update"
             ), goodput.trainer_bucket("weight_push"):
-                if is_main:
+                # zero-pause weight plane (r13, the default): the push
+                # streams at LIVE servers — the rollout executor keeps
+                # launching and the fleet keeps decoding through the
+                # transfer, so there is nothing to pause. Legacy mode
+                # (rollout.streamed_weight_updates=false) restores the
+                # pause → transfer → resume bracket.
+                streamed = bool(
+                    getattr(
+                        config.rollout, "streamed_weight_updates", True
+                    )
+                )
+                if is_main and not streamed:
                     rollout.pause()
                 new_version = engine.get_version() + 1
                 meta = weight_update_meta(new_version)
@@ -292,9 +312,10 @@ def main(argv):
                     if is_main:
                         rollout.update_weights(meta).result(timeout=600)
                 else:
-                    # device path: servers pause first, then the trainer
-                    # streams chunks to them (collective gather, rank 0
-                    # streams)
+                    # device path: the trainer streams chunks straight
+                    # at the fleet (collective gather, rank 0 streams);
+                    # streamed servers apply them into a shadow buffer
+                    # mid-decode, legacy servers sit paused first
                     fut = (
                         rollout.update_weights(meta) if is_main else None
                     )
@@ -302,7 +323,7 @@ def main(argv):
                     if fut is not None:
                         fut.result(timeout=600)
                 engine.set_version(new_version)
-                if is_main:
+                if is_main and not streamed:
                     rollout.resume()
 
             with stats_tracker.record_timing("save_eval_recover"):

@@ -37,6 +37,17 @@ def main():
         gcfg.goodput.ready_quiet_s = float(
             os.environ["AREAL_WORKER_READY_QUIET"]
         )
+    if os.environ.get("AREAL_WORKER_WEIGHT_STREAMING") == "0":
+        # weight-push A/B baseline: the legacy paused ingest path
+        gcfg.weights.streaming = False
+    if os.environ.get("AREAL_WORKER_WEIGHT_FLIP_POLICY"):
+        gcfg.weights.flip_policy = os.environ[
+            "AREAL_WORKER_WEIGHT_FLIP_POLICY"
+        ]
+    if os.environ.get("AREAL_WORKER_WEIGHT_STAGING_TTL"):
+        gcfg.weights.staging_ttl_s = float(
+            os.environ["AREAL_WORKER_WEIGHT_STAGING_TTL"]
+        )
     if os.environ.get("AREAL_WORKER_READY_MIN"):
         # raise the completions-based ready latch so the warming state
         # stays observable past the first served request

@@ -231,13 +231,20 @@ def test_weight_update_device_path(client, server, tmp_path, monkeypatch):
 def test_interruptible_generation_spans_versions(client, server, tmp_path):
     """A long generation interrupted by a weight update must resume with
     accumulated tokens and report mixed per-token versions (reference
-    sglang_remote.py:186-234 interruptible loop)."""
+    sglang_remote.py:186-234 interruptible loop). r13: the zero-pause
+    default never interrupts an in-flight request at all — it finishes
+    pinned to the old version (tests/test_weight_plane.py pins that
+    fence) — so this test opts the CLIENT into the legacy pause
+    protocol (`streamed_weight_updates=False`), which is the
+    configuration where the abort→suffix-resume span-versions contract
+    still applies (and must keep working)."""
     import asyncio
 
     from areal_tpu.api.io_struct import ModelRequest
     from areal_tpu.models import hf_io
 
     gen_eng, _, model_cfg = server
+    client.config.streamed_weight_updates = False  # function-scoped
     gconfig = GenerationHyperparameters(
         n_samples=1, max_new_tokens=40, temperature=1.0
     )

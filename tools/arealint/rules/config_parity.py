@@ -72,9 +72,15 @@ _SERVER_ALIASES: Dict[Tuple[str, str], Optional[str]] = {
     ("GoodputConfig", "ready_quiet_s"): "ready-quiet",
     ("GoodputConfig", "compile_events_path"): "compile-events",
     ("GoodputConfig", "jsonl_path"): "goodput-jsonl",
+    # zero-pause weight plane (r13): bool default True → negative flag
+    ("WeightTransferConfig", "streaming"): "no-weight-streaming",
+    ("WeightTransferConfig", "flip_policy"): "weight-flip-policy",
+    ("WeightTransferConfig", "staging_ttl_s"): "weight-staging-ttl",
 }
 # sub-configs of JaxGenConfig whose fields ride the same server CLI
-_SUBCONFIGS = ("SpecConfig", "TracingConfig", "GoodputConfig")
+_SUBCONFIGS = (
+    "SpecConfig", "TracingConfig", "GoodputConfig", "WeightTransferConfig"
+)
 
 # flags the server declares that no config field maps to (launcher- or
 # operator-supplied identity/opt-in knobs, each with its reason)

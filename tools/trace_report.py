@@ -46,6 +46,14 @@ the ledger alone.
 ``TelemetryCollector.manifest`` / ``GET /manifest``) and prints the
 fleet rollup, the anomaly table, and a per-server line.
 
+``--weights`` switches to the zero-pause weight-plane report (r13):
+``weight_stream_chunk`` spans give the per-push chunk/byte timeline,
+``weight_flip`` instants give flip latency + policy + pinned-request
+counts, client ``weight_stream`` spans give end-to-end push wall time,
+and the pause-span census answers "did this push ever stop decode".
+``--require-zero-pause`` turns a nonzero census into exit 1 — the
+streamed-push CI invariant.
+
 ``--goodput`` reads a goodput JSONL stream (r11: ``utils/goodput.py``
 ledger snapshots and/or ``compile_events.jsonl``) and prints each
 role's wall-time bucket breakdown (fractions sum to 1.0 — the direct
@@ -847,6 +855,130 @@ def format_goodput(gp: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+PAUSE_SPAN_NAMES = ("pause_window", "weight_update_pause")
+
+
+def weights_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Zero-pause weight-plane report (r13). Inputs: client
+    ``weight_stream`` spans (one per push, the transfer wall time),
+    engine ``weight_stream_chunk`` spans (one per ingested FFD chunk —
+    the per-layer stream timeline), ``weight_update`` spans with
+    ``cmd="flip"`` + ``weight_flip`` instants (the atomic flip and its
+    latency/policy/pin count), and any legacy pause spans. The report
+    groups chunks by target version into per-push rows and counts pause
+    spans — a streamed-push trace must carry ZERO
+    (``--require-zero-pause`` turns that into an exit code)."""
+    pushes: Dict[int, Dict[str, Any]] = {}
+    flips: List[Dict[str, Any]] = []
+    streams: List[float] = []
+    pause_spans = 0
+    for s in spans:
+        name = s.get("name")
+        attrs = s.get("attrs") or {}
+        if name in PAUSE_SPAN_NAMES:
+            pause_spans += 1
+        elif name == "weight_stream":
+            streams.append(float(s.get("dur", 0.0)))
+        elif name == "weight_stream_chunk":
+            v = int(attrs.get("model_version", -1))
+            p = pushes.setdefault(
+                v,
+                {
+                    "version": v, "chunks": 0, "n_chunks": 0,
+                    "bytes": 0, "leaves": 0, "stream_s": 0.0,
+                    "t_first": None, "t_last": None, "flip_ms": None,
+                    "policy": None, "pinned": None,
+                },
+            )
+            p["chunks"] += 1
+            p["n_chunks"] = max(
+                p["n_chunks"], int(attrs.get("n_chunks", 0))
+            )
+            p["bytes"] += int(attrs.get("bytes", 0))
+            p["leaves"] += int(attrs.get("leaves", 0))
+            p["stream_s"] += float(s.get("dur", 0.0))
+            ts = float(s.get("ts", 0.0))
+            end = ts + float(s.get("dur", 0.0))
+            p["t_first"] = ts if p["t_first"] is None else min(
+                p["t_first"], ts
+            )
+            p["t_last"] = end if p["t_last"] is None else max(
+                p["t_last"], end
+            )
+        elif name == "weight_flip":
+            v = int(attrs.get("model_version", -1))
+            flips.append(
+                {
+                    "version": v,
+                    "policy": attrs.get("policy"),
+                    "pinned": int(attrs.get("pinned", 0)),
+                    "flip_ms": float(attrs.get("flip_ms", 0.0)),
+                }
+            )
+            if v in pushes:
+                pushes[v]["flip_ms"] = float(attrs.get("flip_ms", 0.0))
+                pushes[v]["policy"] = attrs.get("policy")
+                pushes[v]["pinned"] = int(attrs.get("pinned", 0))
+    rows = []
+    for v in sorted(pushes):
+        p = pushes[v]
+        wall = (
+            (p["t_last"] - p["t_first"])
+            if p["t_first"] is not None and p["t_last"] is not None
+            else 0.0
+        )
+        p.pop("t_first", None)
+        p.pop("t_last", None)
+        rows.append({**p, "wall_s": round(wall, 4)})
+    streams.sort()
+    return {
+        "pushes": rows,
+        "flips": flips,
+        "stream_spans": len(streams),
+        "stream_p50_s": round(_percentile(streams, 0.50), 4),
+        "stream_max_s": round(streams[-1], 4) if streams else 0.0,
+        "pause_spans": pause_spans,
+    }
+
+
+def format_weights(w: Dict[str, Any]) -> str:
+    rows = [
+        f"weight pushes (chunked)  {len(w['pushes'])}",
+        f"flips observed           {len(w['flips'])}",
+        f"client stream spans      {w['stream_spans']}"
+        + (
+            f"  (p50 {w['stream_p50_s']}s, max {w['stream_max_s']}s)"
+            if w["stream_spans"]
+            else ""
+        ),
+        f"pause spans              {w['pause_spans']}"
+        + ("  <-- NOT zero-pause" if w["pause_spans"] else "  (zero-pause)"),
+    ]
+    if w["pushes"]:
+        header = (
+            f"{'version':>8}{'chunks':>8}{'MBytes':>9}{'leaves':>8}"
+            f"{'wall_s':>9}{'flip_ms':>9}{'policy':>8}{'pinned':>8}"
+        )
+        rows += ["", header, "-" * len(header)]
+        for p in w["pushes"]:
+            rows.append(
+                f"{p['version']:>8}{p['chunks']:>8}"
+                f"{p['bytes'] / 1e6:>9.2f}{p['leaves']:>8}"
+                f"{p['wall_s']:>9.4f}"
+                f"{(p['flip_ms'] if p['flip_ms'] is not None else -1):>9.3f}"
+                f"{str(p['policy'] or '?'):>8}"
+                f"{(p['pinned'] if p['pinned'] is not None else 0):>8}"
+            )
+    for f in w["flips"]:
+        if not any(p["version"] == f["version"] for p in w["pushes"]):
+            rows.append(
+                f"flip v{f['version']} (no chunk spans): "
+                f"policy={f['policy']} pinned={f['pinned']} "
+                f"{f['flip_ms']:.3f} ms"
+            )
+    return "\n".join(rows)
+
+
 def format_table(summary: Dict[str, Dict[str, float]]) -> str:
     header = (
         f"{'phase':<24}{'count':>7}{'p50_ms':>10}{'p95_ms':>10}"
@@ -932,6 +1064,19 @@ def main(argv=None) -> int:
         "bill; exit 1 when the file carries neither",
     )
     p.add_argument(
+        "--weights", action="store_true",
+        help="summarize the zero-pause weight plane (weight_stream_chunk"
+        "/weight_flip/weight_stream spans: per-push chunk timeline, "
+        "flip latency, pin counts, pause-span census) instead of the "
+        "latency table; exit 1 when the trace carries no weight events",
+    )
+    p.add_argument(
+        "--require-zero-pause", action="store_true",
+        help="exit 1 if the trace contains ANY pause_window/"
+        "weight_update_pause span — the streamed-push acceptance "
+        "invariant (combine with --weights)",
+    )
+    p.add_argument(
         "--fleet", action="store_true",
         help="treat the input as a telemetry-hub run-manifest JSON "
         "(GET /manifest) and print the fleet rollup + anomaly table; "
@@ -973,6 +1118,39 @@ def main(argv=None) -> int:
             return 1
         return 0
     spans = load_spans(args.trace)
+    if args.require_zero_pause:
+        n_pause = sum(
+            1 for s in spans if s.get("name") in PAUSE_SPAN_NAMES
+        )
+        if n_pause:
+            print(
+                f"REQUIRED zero pause spans, found {n_pause} "
+                f"({'/'.join(PAUSE_SPAN_NAMES)}) — this push paused "
+                f"the fleet",
+                file=sys.stderr,
+            )
+            if not args.weights:
+                return 1
+    if args.weights:
+        w = weights_summary(spans)
+        if args.json:
+            print(json.dumps(w, indent=2))
+        else:
+            print(format_weights(w))
+        if args.require_zero_pause and w["pause_spans"]:
+            return 1
+        if (
+            not w["pushes"]
+            and not w["flips"]
+            and w["stream_spans"] == 0
+        ):
+            print(
+                "no weight-plane spans in trace (tracing off, or no "
+                "streamed push ran)",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
     if args.durability:
         du = durability_summary(spans)
         if args.json:
