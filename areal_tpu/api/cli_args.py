@@ -418,6 +418,12 @@ class JaxGenConfig:
     weights: "WeightTransferConfig" = dataclasses.field(
         default_factory=lambda: WeightTransferConfig()
     )
+    # cold-start elimination (inference/precompile.py): AOT-precompile
+    # the exact shape ladder (or replay a prior run's compile events)
+    # before/while serving, seeding the persistent compile cache
+    precompile: "PrecompileConfig" = dataclasses.field(
+        default_factory=lambda: PrecompileConfig()
+    )
     log_level: str = "info"
     host: str = "127.0.0.1"
     port: int = 0  # 0 = auto
@@ -497,6 +503,18 @@ class JaxGenConfig:
             args.append(
                 f"--compile-events={config.goodput.compile_events_path}"
             )
+        args.append(
+            "--compile-events-max-bytes="
+            f"{config.goodput.compile_events_max_bytes}"
+        )
+        # cold-start elimination (r14): launched servers warm their
+        # shape ladder before/while opening for traffic
+        if config.precompile.mode != "off":
+            args.append(f"--precompile={config.precompile.mode}")
+            if config.precompile.replay_path:
+                args.append(
+                    f"--precompile-replay={config.precompile.replay_path}"
+                )
         if config.goodput.jsonl_path:
             args.append(f"--goodput-jsonl={config.goodput.jsonl_path}")
         if config.max_queued_requests > 0:
@@ -604,8 +622,12 @@ class GoodputConfig:
     # goodput ledger snapshots appended here (one JSON line per export)
     jsonl_path: str = ""
     # one line per XLA backend compile with its triggering phase + shape
-    # signature — the input the shape-ladder AOT precompiler consumes
+    # signature — the input the shape-ladder AOT precompiler consumes.
+    # The stream opens with a header line (ladder fingerprint + jax
+    # version) and rotates to <path>.1 past compile_events_max_bytes,
+    # so restarts can't grow it without bound
     compile_events_path: str = ""
+    compile_events_max_bytes: int = 8_000_000
     # readiness: a server reports /health "warming" from its first XLA
     # compile until its shape ladder is covered, it goes ready_quiet_s
     # without compiling, or it has COMPLETED ready_min_requests
@@ -617,6 +639,33 @@ class GoodputConfig:
     # storm without deadlocking an idle fresh one.
     ready_quiet_s: float = 3.0
     ready_min_requests: int = 1
+
+
+@dataclasses.dataclass
+class PrecompileConfig:
+    """Shape-ladder AOT precompilation (inference/precompile.py): drive
+    the engine's exact compiled-program ladder ahead of traffic so a
+    cold server reaches /health ``ready`` without a traffic-driven
+    compile storm.
+
+    ``mode``: "off" (default), "ladder" (AOT-compile the full
+    enumerated ladder at startup — with a seeded persistent compile
+    cache this is seconds of disk retrieval, not minutes of XLA), or
+    "replay" (warm only the shapes a prior run's compile_events stream
+    actually hit; refuses a stream whose ladder fingerprint doesn't
+    match). The server CLI accepts ``--precompile replay:<path>`` as
+    shorthand for mode=replay + replay_path."""
+
+    mode: str = "off"
+    # compile_events.jsonl from a prior run (mode="replay")
+    replay_path: str = ""
+    # seed artifact (utils/compile_cache.pack_seed tarball) the LAUNCHER
+    # unpacks into compilation_cache_dir before spawning servers —
+    # autoscaler scale-ups and supervisor full-constellation restarts
+    # then warm from disk instead of re-paying the compile storm.
+    # Launcher-side: the server process never reads it (deliberately
+    # not CLI-plumbed; see arealint ARL002 exemption).
+    seed_artifact: str = ""
 
 
 @dataclasses.dataclass

@@ -46,6 +46,7 @@ import numpy as np
 
 from areal_tpu.api.cli_args import JaxGenConfig
 from areal_tpu.inference import model_runner
+from areal_tpu.inference import precompile as precompile_lib
 from areal_tpu.inference.cache import (
     CacheConfig,
     PageManager,
@@ -464,6 +465,9 @@ class GenerationEngine:
         # gathered per row with padding forced to 0, read-only on
         # device — r7 speculative canonical alignment.)
         self._cur_tokens = jnp.zeros(s, jnp.int32)
+        # identity slot map for full-width dispatches (uncommitted, like
+        # the arange decode_multi would otherwise build per dispatch)
+        self._identity_slots = jnp.arange(s, dtype=jnp.int32)
         self._active_dev = jnp.zeros(s, bool)
         self._temp_dev = jnp.ones(s, jnp.float32)
         self._top_p_dev = jnp.ones(s, jnp.float32)
@@ -613,11 +617,26 @@ class GenerationEngine:
         # it (phase + shape signature → compile_events.jsonl + the
         # shape_ladder_coverage gauge readiness consumes), and the loop
         # below books its wall time into exclusive buckets whose
-        # fractions sum to 1.0 of observed wall
+        # fractions sum to 1.0 of observed wall. The coverage
+        # denominator is the EXACT enumerated shape ladder (r14,
+        # inference/precompile.py) — the same rung list the AOT
+        # precompiler drives — so a fully-precompiled engine reads
+        # coverage 1.0 and latches ready with zero traffic.
         gp = getattr(config, "goodput", None)
+        self._ladder = precompile_lib.enumerate_ladder(
+            config, model_config, single_device=self.mesh is None
+        )
+        self._ladder_fingerprint = precompile_lib.ladder_fingerprint(
+            config, model_config, single_device=self.mesh is None,
+            attn_impl=self._attn_impl,
+        )
         self.compiles = goodput.CompileTracker(
             events_path=getattr(gp, "compile_events_path", "") or "",
-            ladder_size=self._ladder_estimate(),
+            ladder_size=len(self._ladder),
+            fingerprint=self._ladder_fingerprint,
+            max_events_bytes=int(
+                getattr(gp, "compile_events_max_bytes", 8_000_000)
+            ),
         )
         self.ledger = goodput.GoodputLedger(
             "engine", goodput.ENGINE_BUCKETS, remainder="idle",
@@ -926,29 +945,20 @@ class GenerationEngine:
         self._command_queue.put(("update_weights_chunk", (header, arrays), done))
         return done.result(timeout=600)
 
-    def _ladder_estimate(self) -> int:
-        """Expected distinct compiled programs for a fully-warm engine —
-        the shape_ladder_coverage denominator. An ESTIMATE (the true
-        ladder depends on traffic: wave shapes, kv buckets, sampling
-        modes), deliberately on the low side so coverage saturates
-        rather than never reaching 1.0; the compile_events stream is the
-        exact record an AOT precompiler replays."""
-        s = max(1, self.config.max_num_seqs)
-        if getattr(self.config, "decode_compact", True):
-            floor = max(1, self.config.decode_compact_min_rows)
-            lo = 1 << (floor - 1).bit_length()
-            row_buckets = max(1, s.bit_length() - lo.bit_length() + 1)
-        else:
-            row_buckets = 1
-        decode_programs = row_buckets
-        sc = getattr(self.config, "spec", None)
-        if sc is not None and sc.enabled:
-            decode_programs *= 2  # verify + regular per row bucket
-        wave = max(1, self.config.admit_wave)
-        prefill_programs = wave.bit_length()  # pow2 wave rows
-        # sampling, pack_host, copy_pages, merge helpers
-        misc = 4
-        return decode_programs + prefill_programs + misc
+    def precompile(self) -> Optional[Dict[str, Any]]:
+        """AOT-precompile the shape ladder per ``config.precompile``
+        (off | ladder | replay). Safe to run concurrently with serving
+        — /health reports ``warming`` with rising coverage until the
+        ladder lands, then latches ready with zero traffic. Returns the
+        precompiler summary (None when mode is off); a mismatched
+        replay stream raises ``precompile_lib.ReplayMismatchError``."""
+        pc = getattr(self.config, "precompile", None)
+        mode = getattr(pc, "mode", "off") if pc is not None else "off"
+        if mode == "off":
+            return None
+        return precompile_lib.Precompiler(self).run(
+            mode, replay_path=getattr(pc, "replay_path", "")
+        )
 
     def readiness(self) -> Dict[str, Any]:
         """Server readiness for /health: ``warming`` while the initial
@@ -985,7 +995,24 @@ class GenerationEngine:
             # latch only once a real warmup ran its course — an idle
             # fresh server is *servable* but still cold, and its first
             # compile storm must still read as warming
-            self._ready_latched = True
+            if not self._ready_latched:
+                self._ready_latched = True
+                # cold-start timeline mark for trace_report --coldstart:
+                # the events stream now spans header → compiles → ready
+                self.compiles.append_event(
+                    {
+                        "kind": "lifecycle",
+                        "event": "ready",
+                        "ladder_coverage": round(cov, 4),
+                        "compiles_total": self.compiles.compiles_total,
+                        "uncached_total": (
+                            self.compiles.uncached_compiles_total
+                        ),
+                        "cache_hits_total": (
+                            self.compiles.cache_hits_total
+                        ),
+                    }
+                )
         return {
             "state": "ready" if ready else "warming",
             "ladder_coverage": round(cov, 4),
@@ -1742,9 +1769,13 @@ class GenerationEngine:
             dst_np = np.full(pad, num_pages, np.int32)
             src_np[: len(cow_src)] = cow_src
             dst_np[: len(cow_dst)] = cow_dst
-            self.cache = model_runner.copy_pages(
-                self.cache, jnp.asarray(src_np), jnp.asarray(dst_np)
-            )
+            src_dev, dst_dev = jnp.asarray(src_np), jnp.asarray(dst_np)
+            with goodput.dispatch_scope(
+                self.compiles, "copy", precompile_lib.copy_sig(pad)
+            ):
+                self.cache = model_runner.copy_pages(
+                    self.cache, src_dev, dst_dev
+                )
             self.total_cow_copies += len(cow_src)
             # the claim's protective refs on the sources: the copy is
             # now ordered before any later pool write, so registry
@@ -1850,18 +1881,29 @@ class GenerationEngine:
             )
             pf_pos3 = jnp.asarray(pos3)
         t_pf_start = time.monotonic()
+        # host→device conversions hoisted OUT of the dispatch scope:
+        # their tiny eager-op compiles belong to the ("engine", "")
+        # catch-all rung, so the prefill rung's compile bill is exactly
+        # the programs the AOT precompiler covers
+        tokens_dev = jnp.asarray(tokens)
+        offsets_dev = jnp.asarray(row_offsets)
+        lens_dev = jnp.asarray(true_lens)
+        tables_dev = jnp.asarray(row_tables)
+        slots_dev = jnp.asarray(row_slots)
         with goodput.dispatch_scope(
             self.compiles, "prefill",
-            f"rows{n_rows}|tp{tp}|pps{pps_pf}|pfb{pf_prefix_bound}"
-            f"|mm{int(pf_embeds is not None)}",
+            precompile_lib.prefill_sig(
+                n_rows, tp, pps_pf, pf_prefix_bound,
+                int(pf_embeds is not None),
+            ),
         ):
             self.cache, wave_logits, pf_last = model_runner.prefill_batch(
                 self.params, self.model_config, self.cache,
-                jnp.asarray(tokens), jnp.asarray(row_offsets),
-                jnp.asarray(true_lens), jnp.asarray(row_tables),
+                tokens_dev, offsets_dev,
+                lens_dev, tables_dev,
                 prefix_bound=pf_prefix_bound,
                 last_rows=self._last_rows,
-                slot_ids=jnp.asarray(row_slots),
+                slot_ids=slots_dev,
                 embeds=pf_embeds,
                 pos3=pf_pos3,
             )
@@ -1921,9 +1963,13 @@ class GenerationEngine:
             dst = np.full(pad, num_pages, np.int32)
             src[: len(copy_src)] = copy_src
             dst[: len(copy_dst)] = copy_dst
-            self.cache = model_runner.copy_pages(
-                self.cache, jnp.asarray(src), jnp.asarray(dst)
-            )
+            src_dev, dst_dev = jnp.asarray(src), jnp.asarray(dst)
+            with goodput.dispatch_scope(
+                self.compiles, "copy", precompile_lib.copy_sig(pad)
+            ):
+                self.cache = model_runner.copy_pages(
+                    self.cache, src_dev, dst_dev
+                )
 
         # --- batched per-slot state update (one scatter per state array) ---
         n = len(admitted)
@@ -2459,7 +2505,10 @@ class GenerationEngine:
             active = self._active_dev
             stops, lens = self._stop_tokens, self._lens_dev
             rope = self._rope_delta_dev if want_rope else None
-            slot_ids_dev = None  # identity — decode_multi default
+            # identity row→slot map, built ONCE (letting decode_multi
+            # default it would re-create the arange eagerly inside the
+            # dispatch scope — a stray compile on the rung's bill)
+            slot_ids_dev = self._identity_slots
             align_dev = self._align_base_dev if spec_align else None
         else:
             # compact dispatch: gather per-slot state into the row space.
@@ -2511,9 +2560,12 @@ class GenerationEngine:
                     m_ = min(len(toks_d), kd)
                     draft_np[r_, :m_] = toks_d[:m_]
                     spec_draft_lens[r_] = m_
+            # hoisted eager conversions (see the prefill dispatch note)
+            draft_dev = jnp.asarray(draft_np)
+            draft_lens_dev = jnp.asarray(spec_draft_lens)
             with goodput.dispatch_scope(
                 self.compiles, "spec_verify",
-                f"rows{rows}|k{steps}|pps{pps}|replay{replay}",
+                precompile_lib.spec_sig(rows, steps, pps, replay),
             ):
                 (
                     self.cache, toks, logps, emitted, active_after,
@@ -2521,8 +2573,8 @@ class GenerationEngine:
                 ) = model_runner.spec_verify(
                     params, self.model_config, self.cache,
                     tables_dev, lens,
-                    st["_cur_tokens"], jnp.asarray(draft_np),
-                    jnp.asarray(spec_draft_lens), active, st["_remaining"],
+                    st["_cur_tokens"], draft_dev,
+                    draft_lens_dev, active, st["_remaining"],
                     st["_no_stop"], stops, key,
                     st["_temp_dev"], st["_top_p_dev"], st["_top_k_dev"],
                     st["_greedy_dev"], k=steps,
@@ -2539,7 +2591,7 @@ class GenerationEngine:
         else:
             with goodput.dispatch_scope(
                 self.compiles, "decode",
-                f"rows{rows}|steps{steps}|pps{pps}|replay{replay}",
+                precompile_lib.decode_sig(rows, steps, pps, replay),
             ):
                 out = model_runner.decode_multi(
                     params, self.model_config, self.cache,
@@ -2560,12 +2612,12 @@ class GenerationEngine:
                 )
             (
                 self.cache, toks, logps, emitted, active_after,
-                remaining_a, no_stop_a, lens_a, new_last,
-            ) = out[:9]
-            # replay-mode chunks return next_tokens: a row that hit its
-            # chunk boundary mid-dispatch resumes from its LAST emitted
-            # token, not from step steps-1's sample
-            cur_next = out[9] if len(out) > 9 else toks[-1]
+                remaining_a, no_stop_a, lens_a, new_last, cur_next,
+            ) = out
+            # next_tokens is the device-computed next input per row: a
+            # replay-mode row that hit its chunk boundary mid-dispatch
+            # resumes from its LAST emitted token; for plain chunks it
+            # equals step steps-1's sample for every live row
         # updated per-slot state: ONE dict drives both the full-width
         # assignment and the compact row→slot scatter (padding rows drop)
         updates = {
@@ -2611,12 +2663,27 @@ class GenerationEngine:
                 )
             self.tracer.instant("decode_chunk", "__engine__", **span_attrs)
         # ONE packed fetch per chunk (lazy: np.asarray in _process_chunk
-        # blocks; until then the device crunches the next chunk)
+        # blocks; until then the device crunches the next chunk). The
+        # pack program's shape follows the dispatch's (rows, steps), so
+        # its compile is attributed to the same ladder rung — the AOT
+        # precompiler compiles it alongside the forward + merge.
+        if drafts is not None:
+            pack_scope = goodput.dispatch_scope(
+                self.compiles, "spec_verify",
+                precompile_lib.spec_sig(rows, steps, pps, replay),
+            )
+        else:
+            pack_scope = goodput.dispatch_scope(
+                self.compiles, "decode",
+                precompile_lib.decode_sig(rows, steps, pps, replay),
+            )
+        with pack_scope:
+            packed = model_runner.pack_host(
+                toks, logps, emitted, active_after
+            )
         self._inflight.append(
             {
-                "packed": model_runner.pack_host(
-                    toks, logps, emitted, active_after
-                ),
+                "packed": packed,
                 "steps": steps,
                 # worst-case token growth of this chunk (for later
                 # dispatches' page margins — verify and regular chunk
@@ -2759,19 +2826,23 @@ class GenerationEngine:
         `only_slots`."""
         self._step_counter += 1
         key = jax.random.fold_in(self._rng_key, self._step_counter)
+        mode = self._sampling_mode()
         with goodput.dispatch_scope(
-            self.compiles, "sample", f"topk{self._sampling_mode()}"
+            self.compiles, "sample", precompile_lib.sample_sig(mode)
         ):
             toks, logps = model_runner.sample_tokens(
                 logits, key, self._temp_dev, self._top_p_dev,
                 self._top_k_dev, self._greedy_dev,
-                topk_bound=self._sampling_mode(),
+                topk_bound=mode,
             )
+            # the packed fetch's program shape is fixed ([S]+[S]) — it
+            # rides the sample rung so its compile never lands untagged
+            fetched = model_runner.pack_host(toks, logps)
         # record sampled tokens as the next decode inputs for these slots
         sl = jnp.asarray(np.asarray(only_slots, np.int32))
         self._cur_tokens = self._cur_tokens.at[sl].set(toks[sl])
         s = self.config.max_num_seqs
-        packed = np.asarray(model_runner.pack_host(toks, logps))
+        packed = np.asarray(fetched)
         host_toks = packed[:s].astype(np.int64)
         host_logps = packed[s:]
         self._append_sampled(host_toks, host_logps, only_slots)

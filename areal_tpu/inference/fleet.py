@@ -60,6 +60,11 @@ class ServerState(str, enum.Enum):
     # reports "warming"). Out of rotation — routing traffic at it buys
     # multi-second first-token stalls — but unlike DEAD it is alive and
     # MUST receive weight updates, or it would re-enter rotation stale.
+    # r14: with `--precompile` + a seeded compile cache the window is
+    # the AOT replay of the exact ladder (seconds of disk retrieval,
+    # ladder_coverage rising to 1.0 with zero traffic) — the same state
+    # machine, just fast enough that autoscaler spawns land inside the
+    # spike they were launched for.
     WARMING = "warming"
 
 

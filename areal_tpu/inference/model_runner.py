@@ -176,7 +176,9 @@ def init_last_rows(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-@functools.partial(jax.jit, static_argnames=("num_pages", "prow", "pack"))
+@functools.partial(
+    jax.jit, static_argnames=("num_pages", "prow", "pack", "merge")
+)
 def assemble_rows(
     tables: jnp.ndarray,  # [N, PPS]
     pos0: jnp.ndarray,  # [N] absolute start position of kv[…, 0]
@@ -188,13 +190,24 @@ def assemble_rows(
     num_pages: int,
     prow: int,
     pack: int,
+    merge: bool = False,
 ):
     """Pack token-order K/V into full 128-lane pool rows.
+
+    ``merge``: the head-merged pool view — K/V arrive [L, N, T, Hkv, D]
+    and are reshaped to [L, N, T, 1, Hkv*D] INSIDE this jit (token-major,
+    a free view; doing it eagerly in merge_tokens cost one stray
+    eager-op compile per shape that dodged the dispatch-scope compile
+    attribution).
 
     Returns (dest [N*NR] flat row ids with row 0 of the pool as the drop
     target for invalid rows, kvals/vvals [N*NR, L, Hkv, FD], new
     last_rows {k,v} [L, N, Hkv, FD]). Pure compute — the pool itself is
     neither read nor written here (see init_last_rows)."""
+    if merge:
+        nl_, n_, t_, hkv_, d_ = kbuf.shape
+        kbuf = kbuf.reshape(nl_, n_, t_, 1, hkv_ * d_)
+        vbuf = vbuf.reshape(nl_, n_, t_, 1, hkv_ * d_)
     nl, n, t, hkv, d = kbuf.shape
     f = pack
     fd = f * d
@@ -331,17 +344,17 @@ def merge_tokens(
     _, hkv_pool, num_pages, prow, fd = cache["k"].shape
     merged, f = layout_from_pool(cache["k"].shape, hkv, d)
     if merged:
-        kbuf = kbuf.reshape(nl, n, t, 1, hkv * d)
-        vbuf = vbuf.reshape(nl, n, t, 1, hkv * d)
         hkv = 1
-        d = kbuf.shape[-1]
     if last_rows is None:
         last_rows = init_last_rows(nl, n, hkv, fd, kbuf.dtype)
     if slot_ids is None:
         slot_ids = jnp.arange(n, dtype=jnp.int32)
+    # the merged-layout buffer reshape happens INSIDE assemble_rows
+    # (static `merge`): an eager reshape here would compile one stray
+    # program per buffer shape outside the dispatch-scope attribution
     dest, kw, vw, new_last = assemble_rows(
         tables, pos0, counts, kbuf, vbuf, last_rows, slot_ids,
-        num_pages=num_pages, prow=prow, pack=f,
+        num_pages=num_pages, prow=prow, pack=f, merge=merged,
     )
     cache = write_rows(cache, dest, kw, vw)
     return cache, new_last
@@ -984,16 +997,17 @@ def decode_multi(
 
     Returns (cache, toks [steps,S], logps [steps,S], emitted [steps,S],
     active_after [S], remaining_after, no_stop_after, lens_after [S],
-    new_last_rows). ``lens_after`` keeps the per-slot cached length
-    device-resident so the host can dispatch chunk N+1 before fetching
-    chunk N's results (the serving loop pipelines dispatch against result
-    processing).
+    new_last_rows, next_tokens [S]). ``lens_after`` keeps the per-slot
+    cached length device-resident so the host can dispatch chunk N+1
+    before fetching chunk N's results (the serving loop pipelines
+    dispatch against result processing).
 
-    With canonical-alignment replay (``align_base`` given, ``replay`` =
-    steps - 1 — speculative engines only, see _decode_core) a trailing
-    ``next_tokens`` [S] joins the return: rows that hit their chunk
-    boundary mid-dispatch go dormant, so the next decode input is their
-    LAST emitted token rather than toks[-1]."""
+    ``next_tokens`` is each row's next decode input: under
+    canonical-alignment replay (``align_base`` given, ``replay`` =
+    steps - 1 — speculative engines, see _decode_core) a row that hit
+    its chunk boundary mid-dispatch goes dormant and resumes from its
+    LAST emitted token; without replay it equals toks[-1] for every row
+    still active at chunk end."""
     if slot_ids is None:
         slot_ids = jnp.arange(tables.shape[0], dtype=jnp.int32)
     (
@@ -1010,13 +1024,15 @@ def decode_multi(
         cache, tables, pos0, clen, kbuf, vbuf, last_rows=last_rows,
         slot_ids=slot_ids,
     )
-    out = (
+    # next_tokens always rides along (r14): for replay == 0 it equals
+    # toks[-1] for every row still active at chunk end (the scan carry
+    # updates while `on`), and inactive rows' inputs are masked and
+    # row-independent — returning it saves the caller an eager [-1]
+    # slice per chunk that would dodge dispatch-scope attribution
+    return (
         cache, toks, logps, emitted, active_a, remaining_a, no_stop_a,
-        lens_a, new_last,
+        lens_a, new_last, next_tokens,
     )
-    if replay > 0 and align_base is not None:
-        return out + (next_tokens,)
-    return out
 
 
 @functools.partial(

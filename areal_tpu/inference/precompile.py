@@ -1,0 +1,1013 @@
+"""Cold-start elimination: exact shape-ladder enumeration + AOT precompile.
+
+A cold generation server burns its first minutes compiling the engine's
+program ladder shape by shape as traffic discovers it (191 backend
+compiles / 378 s in the r5 bench capture) — which makes autoscaler
+spawns useless against a spike and turns every supervisor
+full-constellation restart into a multi-minute outage. This module
+closes the loop the goodput plane (r11) opened:
+
+1. :func:`enumerate_ladder` — walks the engine config and emits the
+   EXACT set of ``phase|signature`` keys the engine's
+   ``goodput.dispatch_scope`` tags can produce: prefill wave rows ×
+   suffix buckets × page windows × prefix bounds (including the
+   signatures only MIXED waves can produce — a wave's signature is the
+   componentwise max over its rows, so multi-row rungs are the join
+   closure of the per-row triple set), compacted decode row buckets ×
+   page windows under every reachable pipeline margin, the spec-verify
+   twins, the sampling-mode rungs, the page-copy pad buckets, and the
+   untagged-helper catch-all. This replaces the r11 ``_ladder_estimate``
+   heuristic, so ``shape_ladder_coverage`` has a true denominator and
+   ``/health`` readiness can genuinely reach 1.0.
+
+2. :class:`Precompiler` — drives every ladder rung AHEAD of traffic by
+   AOT-compiling the same jitted ``model_runner`` entry points the
+   engine dispatches, with ``jax.ShapeDtypeStruct`` inputs (the
+   ``parallel/feasibility.py`` machinery: ``jit(...).lower().compile()``
+   — no real KV traffic, nothing executes). Every compile lands in the
+   persistent XLA compilation cache (``utils/compile_cache.py``), so the
+   engine's first real dispatch per shape is a disk retrieval, not an
+   XLA run; each driven rung is marked in the engine's
+   ``CompileTracker`` so coverage reaches 1.0 (and readiness latches)
+   with ZERO traffic — even on a seeded cache where no backend compile
+   fires at all. Replay mode warms only the shapes a prior run's
+   ``compile_events.jsonl`` actually hit, and REFUSES a stream whose
+   header fingerprint doesn't match this engine's ladder (a mismatched
+   replay would silently compile garbage).
+
+Known exclusions (documented, incremental-compile territory): vision
+(mm=1) waves — their pixel-pad buckets depend on image geometry the
+config doesn't bound; per-request ``top_k`` above ``sample_topk_bound``;
+the post-auto-disable replay-0 twins of a speculative engine; and VLM
+``rope_delta`` decode variants. A fully-precompiled engine may still
+compile those shapes later — readiness LATCHES, so that never drops a
+serving engine out of rotation.
+"""
+
+import dataclasses
+import hashlib
+import json
+import re
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.utils.goodput import jax_version
+
+logger = logging_util.getLogger("Precompile")
+
+# precompile modes the server CLI accepts (``replay:<path>`` rides the
+# "replay" mode with PrecompileConfig.replay_path)
+PRECOMPILE_MODES = ("off", "ladder", "replay")
+
+# the untagged-helper rung: eager device ops the engine loop fires
+# outside any dispatch scope (state gathers/scatters, logits selects)
+# all attribute to the thread-default tracker under this one key
+ENGINE_MISC_RUNG = ("engine", "")
+
+
+class ReplayMismatchError(RuntimeError):
+    """A compile_events stream's header does not match this engine's
+    ladder fingerprint — replaying it would compile (and cache) programs
+    this engine can never dispatch, or miss the ones it will."""
+
+
+# --------------------------------------------------------------------------
+# Signature formatting — ONE source of truth shared with the engine's
+# dispatch_scope tags (engine.py imports these; drift between what the
+# engine stamps and what the enumerator emits would silently break
+# coverage, so both sides call the same functions)
+# --------------------------------------------------------------------------
+def prefill_sig(rows: int, tp: int, pps: int, pfb: int, mm: int) -> str:
+    return f"rows{rows}|tp{tp}|pps{pps}|pfb{pfb}|mm{mm}"
+
+
+def decode_sig(rows: int, steps: int, pps: int, replay: int) -> str:
+    return f"rows{rows}|steps{steps}|pps{pps}|replay{replay}"
+
+
+def spec_sig(rows: int, k: int, pps: int, replay: int) -> str:
+    return f"rows{rows}|k{k}|pps{pps}|replay{replay}"
+
+
+def sample_sig(topk: int) -> str:
+    return f"topk{topk}"
+
+
+def copy_sig(pad: int) -> str:
+    return f"pad{pad}"
+
+
+_SIG_RE = re.compile(r"([a-z]+)(-?\d+)")
+
+
+def parse_signature(signature: str) -> Optional[Dict[str, int]]:
+    """``rows8|steps8|pps16|replay0`` → {"rows": 8, ...}; None when the
+    string doesn't parse (free-form signatures stay mark-only)."""
+    out: Dict[str, int] = {}
+    for part in signature.split("|"):
+        m = _SIG_RE.fullmatch(part)
+        if m is None:
+            return None
+        out[m.group(1)] = int(m.group(2))
+    return out or None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    phase: str
+    signature: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.phase}|{self.signature}"
+
+
+# --------------------------------------------------------------------------
+# Derived engine geometry (mirrors GenerationEngine.__init__ exactly;
+# the engine passes its own resolved values where they depend on
+# runtime state such as device platform)
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class LadderSpace:
+    """Everything the enumerator (and the precompiler's argument
+    builder) needs, derived once from (JaxGenConfig, ModelConfig)."""
+
+    m: int  # max_model_len
+    q: int  # prefill bucket quantum
+    kv: int  # kv_bucket
+    bs: int  # page_size
+    num_pages: int
+    mpps: int  # max_pages_per_seq
+    s: int  # max_num_seqs
+    wave: int
+    steps: int  # decode_chunk
+    depth: int  # decode_pipeline
+    compact: bool
+    min_rows: int
+    spec: bool
+    k: int  # verify window (spec only)
+    replay: int
+    reuse_min: int
+    grain: int  # claim offset alignment (0 = prefix reuse off)
+    p_max: int  # largest admissible prompt length
+    topk_values: Tuple[int, ...]
+    vision: bool
+
+
+def derive_space(config, model_config, single_device: bool = True) -> LadderSpace:
+    m = int(config.max_model_len)
+    bs = int(config.page_size)
+    num_pages = int(config.num_pages)
+    if num_pages <= 0:  # engine auto-provisioning formula
+        num_pages = int(config.max_num_seqs) * (-(-m // bs)) + 1
+    mpps = -(-m // bs)
+    s = max(1, int(config.max_num_seqs))
+    steps = max(1, int(config.decode_chunk))
+    sc = getattr(config, "spec", None)
+    spec = bool(
+        sc is not None
+        and sc.enabled
+        and single_device
+        and not model_config.is_moe
+        and int(config.decode_chunk) >= 2
+    )
+    k = min(max(1, sc.max_draft), steps - 1) + 1 if spec else 0
+    compact = bool(getattr(config, "decode_compact", True)) and single_device
+    reuse_min = int(getattr(config, "prefix_reuse_min", 0))
+    if reuse_min > 0:
+        if getattr(config, "prefix_cache_mode", "radix") == "radix":
+            from areal_tpu.ops.paged_attention import pack_factor
+
+            grain = pack_factor(model_config.head_dim)
+        else:
+            grain = bs  # flat registry: full-page claims only
+    else:
+        grain = 0
+    bound = int(config.sample_topk_bound)
+    topk_values = (-1, 0 if bound <= 0 else bound)
+    return LadderSpace(
+        m=m,
+        q=min(int(config.prefill_chunk), m),
+        kv=int(config.kv_bucket),
+        bs=bs,
+        num_pages=num_pages,
+        mpps=mpps,
+        s=s,
+        wave=max(1, int(config.admit_wave)),
+        steps=steps,
+        depth=max(0, int(config.decode_pipeline)),
+        compact=compact,
+        min_rows=max(1, int(config.decode_compact_min_rows)),
+        spec=spec,
+        k=k,
+        replay=steps - 1 if spec else 0,
+        reuse_min=reuse_min,
+        grain=grain,
+        p_max=max(1, min(m - 1, (num_pages - 1) * bs)),
+        topk_values=topk_values,
+        vision=model_config.vision is not None,
+    )
+
+
+# --------------------------------------------------------------------------
+# Exact enumeration
+# --------------------------------------------------------------------------
+def _pow2ceil(n: int) -> int:
+    return 1 << (max(1, n) - 1).bit_length()
+
+
+def _step_values(f, lo: int, hi: int) -> List[int]:
+    """Distinct values of a NONDECREASING integer step function over
+    [lo, hi], by boundary bisection — O(values × log range) instead of
+    O(range), so 128k-token ladders enumerate in microseconds."""
+    out: List[int] = []
+    x = lo
+    while x <= hi:
+        v = f(x)
+        out.append(v)
+        # find the last x' in [x, hi] with f(x') == v
+        a, b = x, hi
+        while a < b:
+            mid = (a + b + 1) // 2
+            if f(mid) == v:
+                a = mid
+            else:
+                b = mid - 1
+        x = a + 1
+    return out
+
+
+def _prefill_rows(sp: LadderSpace) -> List[int]:
+    top = _pow2ceil(min(sp.wave, sp.s))
+    rows = [1]
+    r = 2
+    while r <= top:
+        rows.append(r)
+        r *= 2
+    return rows
+
+
+def _decode_rows(sp: LadderSpace) -> List[int]:
+    if not sp.compact:
+        return [sp.s]
+    out: Set[int] = set()
+    r = _pow2ceil(sp.min_rows)
+    while r < sp.s:
+        out.add(min(r, sp.s))
+        r *= 2
+    out.add(sp.s)
+    return sorted(out)
+
+
+def _decode_margins(sp: LadderSpace) -> List[int]:
+    """Reachable page-growth margins for a REGULAR decode dispatch:
+    the new chunk plus every in-flight chunk's worst case. At most one
+    verify chunk can be in flight (verify dispatches only on an empty
+    pipeline), regular chunks stack to ``decode_pipeline``."""
+    out: Set[int] = set()
+    for y in (0, 1) if sp.spec else (0,):
+        for x in range(1, sp.depth + 2 - y):
+            out.add(x * sp.steps + y * sp.k)
+    return sorted(out)
+
+
+def _pages_bound_value(sp: LadderSpace, tokens: int) -> int:
+    t = min(sp.m, data_utils.next_bucket_size(tokens, sp.kv))
+    return min(-(-t // sp.bs), sp.mpps)
+
+
+def _decode_pps(sp: LadderSpace, margins: List[int]) -> List[int]:
+    # cached length at dispatch ∈ [1, m]; margins small — the token
+    # range is contiguous, so one boundary walk covers every margin
+    lo = 1 + min(margins)
+    hi = sp.m + max(margins)
+    return _step_values(lambda t: _pages_bound_value(sp, t), lo, hi)
+
+
+def _aligned_offsets(sp: LadderSpace) -> Tuple[int, int, int]:
+    """(o_min, o_max, grain) of reachable nonzero claim offsets, or
+    (0, -1, 0) when prefix reuse is off. Claim offsets are multiples of
+    the registry grain (radix: pool row; flat: page) totalling at least
+    ``prefix_reuse_min`` matched tokens, and always leave >= 1 prompt
+    token uncached."""
+    if sp.grain <= 0:
+        return 0, -1, 0
+    g = sp.grain
+    o_min = -(-max(sp.reuse_min, 1) // g) * g
+    o_max = ((sp.p_max - 1) // g) * g
+    return o_min, o_max, g
+
+
+def _prefill_triples(sp: LadderSpace) -> Set[Tuple[int, int, int]]:
+    """Per-ROW (tp, pps, pfb) contribution set R: one element per
+    reachable (prompt_len, claim_offset) bucket combination. A wave's
+    signature is the componentwise max over its rows (all three
+    components are monotone step functions of their inputs), so the
+    multi-row rungs are joins over this set (see _join_* below)."""
+
+    def tp_of(suffix: int) -> int:
+        return min(data_utils.next_bucket_size(suffix, sp.q), sp.m)
+
+    def pps_of(p: int) -> int:
+        return min(
+            max(1, -(-data_utils.next_bucket_size(p, sp.kv) // sp.bs)),
+            sp.mpps,
+        )
+
+    def pfb_of(o: int) -> int:
+        return 0 if o <= 0 else min(
+            sp.m, data_utils.next_bucket_size(o, sp.kv)
+        )
+
+    triples: Set[Tuple[int, int, int]] = set()
+    o_min, o_max, g = _aligned_offsets(sp)
+    offsets = [0] + (
+        list(range(o_min, o_max + 1, g)) if g > 0 and o_min <= o_max else []
+    )
+    for o in offsets:
+        pfb = pfb_of(o)
+        # for fixed o both tp(p - o) and pps(p) are nondecreasing step
+        # functions of p — walk their merged boundaries
+        lo, hi = o + 1, sp.p_max
+        if lo > hi:
+            continue
+        x = lo
+        while x <= hi:
+            pair = (tp_of(x - o), pps_of(x))
+            triples.add((pair[0], pair[1], pfb))
+            a, b = x, hi
+            while a < b:
+                mid = (a + b + 1) // 2
+                if (tp_of(mid - o), pps_of(mid)) == pair:
+                    a = mid
+                else:
+                    b = mid - 1
+            x = a + 1
+    return triples
+
+
+class _JoinIndex:
+    """Dominance indices over the per-row triple set: answers the
+    witness queries the join-reachability characterization needs.
+
+    A wave of rows {r_i} ⊆ R produces signature T = componentwise max.
+    T is a join of ≤ n elements iff each coordinate's max is witnessed
+    by some row whose OTHER coordinates are dominated by T — with at
+    most n distinct witnesses. n >= 3 (row buckets >= 4) reduces to the
+    full closure test (one witness per coordinate); n == 2 additionally
+    requires one row to witness two coordinates at once."""
+
+    def __init__(self, triples: Set[Tuple[int, int, int]]):
+        self.triples = triples
+        self.by_tp: Dict[int, List[Tuple[int, int]]] = {}
+        self.by_pps: Dict[int, List[Tuple[int, int]]] = {}
+        self.by_pfb: Dict[int, List[Tuple[int, int]]] = {}
+        self.min3: Dict[Tuple[str, int, int], int] = {}
+        for a, b, c in triples:
+            self.by_tp.setdefault(a, []).append((b, c))
+            self.by_pps.setdefault(b, []).append((a, c))
+            self.by_pfb.setdefault(c, []).append((a, b))
+            for key, val in (
+                (("tp_pps", a, b), c),
+                (("tp_pfb", a, c), b),
+                (("pps_pfb", b, c), a),
+            ):
+                if val < self.min3.get(key, 1 << 60):
+                    self.min3[key] = val
+        # Pareto frontiers (minimal pairs) for the dominated-pair tests
+        for d in (self.by_tp, self.by_pps, self.by_pfb):
+            for key, pairs in d.items():
+                pairs.sort()
+                frontier: List[Tuple[int, int]] = []
+                best = 1 << 60
+                for u, v in pairs:
+                    if v < best:
+                        frontier.append((u, v))
+                        best = v
+                d[key] = frontier
+
+    @staticmethod
+    def _dominated(frontier: List[Tuple[int, int]], u: int, v: int) -> bool:
+        """∃ (x, y) in the indexed set with x <= u and y <= v."""
+        for x, y in frontier:
+            if x > u:
+                return False
+            if y <= v:
+                return True
+        return False
+
+    def witness(self, coord: str, val: int, u: int, v: int) -> bool:
+        d = {"tp": self.by_tp, "pps": self.by_pps, "pfb": self.by_pfb}[
+            coord
+        ]
+        fr = d.get(val)
+        return fr is not None and self._dominated(fr, u, v)
+
+    def pair_witness(self, key: str, x: int, y: int, bound: int) -> bool:
+        """∃ row witnessing coordinates (x, y) of `key` exactly with the
+        remaining coordinate <= bound."""
+        return self.min3.get((key, x, y), 1 << 60) <= bound
+
+    def closure_member(self, a: int, b: int, c: int) -> bool:
+        return (
+            self.witness("tp", a, b, c)
+            and self.witness("pps", b, a, c)
+            and self.witness("pfb", c, a, b)
+        )
+
+    def join2_member(self, a: int, b: int, c: int) -> bool:
+        if (a, b, c) in self.triples:
+            return True
+        return (
+            (
+                self.pair_witness("tp_pps", a, b, c)
+                and self.witness("pfb", c, a, b)
+            )
+            or (
+                self.pair_witness("tp_pfb", a, c, b)
+                and self.witness("pps", b, a, c)
+            )
+            or (
+                self.pair_witness("pps_pfb", b, c, a)
+                and self.witness("tp", a, b, c)
+            )
+        )
+
+
+def _copy_pads(sp: LadderSpace) -> List[int]:
+    """Page-copy dispatch pad buckets. Copies exist when pages can hold
+    a partial tail (sibling fan-out, needs >= 2 slots) or a mid-page COW
+    claim (radix reuse with a sub-page grain)."""
+    if sp.bs <= 1:
+        return []
+    sibling = sp.s >= 2
+    cow = sp.grain > 0 and sp.grain < sp.bs
+    if not (sibling or cow):
+        return []
+    max_copies = 1
+    if sibling:
+        max_copies = max(max_copies, sp.s - 1)
+    if cow:
+        max_copies = max(max_copies, min(sp.wave, sp.s))
+    top = data_utils.next_bucket_size(max_copies, 8)
+    return list(range(8, top + 1, 8))
+
+
+# the enumeration is a pure function of the derived LadderSpace, and
+# engines construct constantly in tests — memoize per space (a few
+# hundred ms per distinct serving shape, paid once per process)
+_LADDER_MEMO: Dict[Tuple, List[Rung]] = {}
+
+
+def enumerate_ladder(
+    config,
+    model_config,
+    single_device: bool = True,
+) -> List[Rung]:
+    """The EXACT set of (phase, signature) keys this engine's dispatch
+    scopes can stamp under text traffic — the shape_ladder_coverage
+    denominator AND the precompiler's work list. See the module
+    docstring for the documented exclusions (vision waves, oversized
+    per-request top_k, post-auto-disable spec twins)."""
+    sp = derive_space(config, model_config, single_device)
+    memo_key = dataclasses.astuple(sp)
+    cached = _LADDER_MEMO.get(memo_key)
+    if cached is not None:
+        return list(cached)
+    rungs: List[Rung] = []
+
+    # --- prefill: rows × join-reachable (tp, pps, pfb) triples ---
+    triples = _prefill_triples(sp)
+    idx = _JoinIndex(triples)
+    tp_vals = sorted(idx.by_tp)
+    pps_vals = sorted(idx.by_pps)
+    pfb_vals = sorted(idx.by_pfb)
+    candidates = [
+        (a, b, c)
+        for a in tp_vals
+        for b in pps_vals
+        for c in pfb_vals
+    ]
+    closure = (
+        {t for t in candidates if idx.closure_member(*t)}
+        if len(_prefill_rows(sp)) > 2
+        else set()
+    )
+    join2 = (
+        {t for t in candidates if idx.join2_member(*t)}
+        if len(_prefill_rows(sp)) > 1
+        else set()
+    )
+    for rows in _prefill_rows(sp):
+        if rows == 1:
+            reach = triples
+        elif rows == 2:
+            reach = join2
+        else:
+            reach = closure
+        for (tp, pps, pfb) in sorted(reach):
+            rungs.append(
+                Rung("prefill", prefill_sig(rows, tp, pps, pfb, 0))
+            )
+
+    # --- decode (+ spec verify twins) ---
+    dec_rows = _decode_rows(sp)
+    for pps in _decode_pps(sp, _decode_margins(sp)):
+        for rows in dec_rows:
+            rungs.append(
+                Rung("decode", decode_sig(rows, sp.steps, pps, sp.replay))
+            )
+    if sp.spec:
+        for pps in _decode_pps(sp, [sp.k]):
+            for rows in dec_rows:
+                rungs.append(
+                    Rung("spec_verify", spec_sig(rows, sp.k, pps, sp.replay))
+                )
+
+    # --- sampling modes + page-copy pads + untagged helpers ---
+    for topk in sorted(set(sp.topk_values)):
+        rungs.append(Rung("sample", sample_sig(topk)))
+    for pad in _copy_pads(sp):
+        rungs.append(Rung("copy", copy_sig(pad)))
+    rungs.append(Rung(*ENGINE_MISC_RUNG))
+    _LADDER_MEMO[memo_key] = rungs
+    return list(rungs)
+
+
+def ladder_fingerprint(
+    config,
+    model_config,
+    single_device: bool = True,
+    attn_impl: Optional[str] = None,
+    platform: Optional[str] = None,
+) -> str:
+    """Stable identity of (ladder keys × program contents): the rung
+    set plus everything that changes the compiled programs under a
+    fixed rung key — model geometry, dtype, resolved attention backend,
+    device platform, jax version. Written into the compile_events
+    header; replay refuses a mismatch. Pass the engine's RESOLVED
+    ``attn_impl`` (config "auto" resolves per platform — two machines
+    with the same config can run different programs)."""
+    sp = derive_space(config, model_config, single_device)
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception as e:  # pragma: no cover - stub environments
+            logger.warning(f"no jax backend for fingerprint: {e}")
+            platform = "unknown"
+    memo_key = (
+        dataclasses.astuple(sp), config.dtype,
+        attn_impl or config.attn_impl, platform,
+        getattr(config, "pool_layout", "auto"), model_config,
+    )
+    cached = _FINGERPRINT_MEMO.get(memo_key)
+    if cached is not None:
+        return cached
+    rungs = enumerate_ladder(config, model_config, single_device)
+    ident = {
+        "rungs": sorted(r.key for r in rungs),
+        "jax": jax_version(),
+        "dtype": config.dtype,
+        "attn_impl": attn_impl or config.attn_impl,
+        "platform": platform,
+        "pool_layout": getattr(config, "pool_layout", "auto"),
+        "pages": [sp.num_pages, sp.bs],
+        "model": [
+            model_config.family,
+            model_config.num_layers,
+            model_config.hidden_size,
+            model_config.intermediate_size,
+            model_config.num_heads,
+            model_config.num_kv_heads,
+            model_config.head_dim,
+            model_config.vocab_size,
+        ],
+        "single_device": bool(single_device),
+    }
+    fp = hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    _FINGERPRINT_MEMO[memo_key] = fp
+    return fp
+
+
+_FINGERPRINT_MEMO: Dict[Tuple, str] = {}
+
+
+# --------------------------------------------------------------------------
+# AOT precompiler
+# --------------------------------------------------------------------------
+class Precompiler:
+    """Drives ladder rungs through the engine's jitted entry points with
+    ``jax.ShapeDtypeStruct`` inputs: ``lower().compile()`` populates the
+    persistent XLA compilation cache without executing anything, and
+    each driven rung is marked in the engine's CompileTracker so
+    coverage (and /health readiness) reflects the warm ladder."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.sp = derive_space(
+            engine.config, engine.model_config, engine.mesh is None
+        )
+        self._sds_ready = False
+
+    # -- shared ShapeDtypeStructs (built lazily, shapes only) ----------
+    def _build_sds(self):
+        if self._sds_ready:
+            return
+        import jax
+
+        eng = self.engine
+
+        def sds_of(a):
+            # single-device: a bare SDS lowers exactly like the engine's
+            # committed arrays — attaching SingleDeviceSharding would
+            # stamp "{replicated}" arg annotations into the HLO and
+            # break cache-key identity with the real dispatches. Under
+            # TP the real arrays carry NamedShardings that DO annotate,
+            # so there the SDS must carry them too.
+            if eng.mesh is None:
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            return jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=getattr(a, "sharding", None)
+            )
+
+        self.params_sds = jax.tree_util.tree_map(sds_of, eng.params)
+        self.cache_sds = jax.tree_util.tree_map(sds_of, eng.cache)
+        self.last_rows_sds = jax.tree_util.tree_map(
+            sds_of, eng._last_rows
+        )
+        self.key_sds = jax.ShapeDtypeStruct(
+            eng._rng_key.shape, eng._rng_key.dtype
+        )
+        self._logits_dtype = None  # filled by the first prefill rung
+        self._sds_ready = True
+
+    def _vec(self, n, dtype):
+        import jax
+        import jax.numpy as jnp
+
+        dt = {
+            "i32": jnp.int32,
+            "f32": jnp.float32,
+            "bool": jnp.bool_,
+        }[dtype]
+        return jax.ShapeDtypeStruct((n,), dt)
+
+    def _mat(self, shape, dtype):
+        import jax
+        import jax.numpy as jnp
+
+        dt = {"i32": jnp.int32, "f32": jnp.float32}[dtype]
+        return jax.ShapeDtypeStruct(tuple(shape), dt)
+
+    # -- merge chain (assemble_rows + write_rows), shared by every
+    # dispatch family — mirrors model_runner.merge_tokens on shapes
+    def _compile_merge(self, tables, pos0, counts, kbuf, vbuf, slot_ids):
+        import jax
+
+        from areal_tpu.inference import model_runner
+        from areal_tpu.ops.paged_attention import layout_from_pool
+
+        eng = self.engine
+        k_shape = eng.cache["k"].shape
+        nl, n, t, hkv, d = kbuf.shape
+        merged, f = layout_from_pool(k_shape, hkv, d)
+        _, _, num_pages, prow, _ = k_shape
+        args = (
+            tables, pos0, counts, kbuf, vbuf, self.last_rows_sds,
+            slot_ids,
+        )
+        kw = dict(num_pages=num_pages, prow=prow, pack=f, merge=merged)
+        model_runner.assemble_rows.lower(*args, **kw).compile()
+        # statics can't ride eval_shape's abstraction — bind them in a
+        # closure and abstract only the array arguments
+        dest, kw_buf, vw_buf, _ = jax.eval_shape(
+            lambda *a: model_runner.assemble_rows(*a, **kw), *args
+        )
+        model_runner.write_rows.lower(
+            self.cache_sds, dest, kw_buf, vw_buf
+        ).compile()
+
+    # -- per-family drivers --------------------------------------------
+    def _drive_prefill(self, p: Dict[str, int]):
+        import jax
+
+        from areal_tpu.inference import model_runner
+
+        eng = self.engine
+        rows, tp, pps = p["rows"], p["tp"], p["pps"]
+        tokens = self._mat((rows, tp), "i32")
+        offsets = self._vec(rows, "i32")
+        true_lens = self._vec(rows, "i32")
+        tables = self._mat((rows, pps), "i32")
+        slot_ids = self._vec(rows, "i32")
+        mc = eng.model_config
+        arrays = (
+            self.params_sds, self.cache_sds, tokens, offsets,
+            true_lens, tables,
+        )
+        kw = dict(prefix_bound=p["pfb"], embeds=None, pos3=None)
+        model_runner.prefill_forward.lower(
+            arrays[0], mc, *arrays[1:], **kw
+        ).compile()
+        logits, k_sfx, v_sfx = jax.eval_shape(
+            lambda pp, cc, *a: model_runner.prefill_forward(
+                pp, mc, cc, *a, **kw
+            ),
+            *arrays,
+        )
+        self._logits_dtype = logits.dtype
+        self._compile_merge(
+            tables, offsets, true_lens, k_sfx, v_sfx, slot_ids
+        )
+
+    def _decode_common(self, rows: int):
+        st = {
+            "pos0": self._vec(rows, "i32"),
+            "tokens": self._vec(rows, "i32"),
+            "active": self._vec(rows, "bool"),
+            "remaining": self._vec(rows, "i32"),
+            "no_stop": self._vec(rows, "i32"),
+            "stops": self._mat((rows, 8), "i32"),
+            "temp": self._vec(rows, "f32"),
+            "top_p": self._vec(rows, "f32"),
+            "top_k": self._vec(rows, "i32"),
+            "greedy": self._vec(rows, "bool"),
+            "slot_ids": self._vec(rows, "i32"),
+        }
+        return st
+
+    def _drive_decode(self, p: Dict[str, int]):
+        import jax
+
+        from areal_tpu.inference import model_runner
+
+        eng = self.engine
+        rows, steps, pps, replay = (
+            p["rows"], p["steps"], p["pps"], p["replay"],
+        )
+        st = self._decode_common(rows)
+        tables = self._mat((rows, pps), "i32")
+        align = self._vec(rows, "i32") if replay > 0 else None
+        mc = eng.model_config
+        out = None
+        for topk in sorted(set(self.sp.topk_values)):
+            arrays = (
+                self.params_sds, self.cache_sds, tables, st["pos0"],
+                st["tokens"], st["active"], st["remaining"],
+                st["no_stop"], st["stops"], self.key_sds, st["temp"],
+                st["top_p"], st["top_k"], st["greedy"],
+            )
+            kw = dict(
+                steps=steps, topk_bound=topk, attn_impl=eng._attn_impl,
+                ppcb=eng.config.pages_per_compute_block,
+                spb=eng.config.slots_per_block, rope_delta=None,
+                slot_ids=st["slot_ids"], align_base=align, replay=replay,
+            )
+            model_runner._decode_multi_forward.lower(
+                arrays[0], mc, *arrays[1:], **kw
+            ).compile()
+            out = jax.eval_shape(
+                lambda pp, cc, *a: model_runner._decode_multi_forward(
+                    pp, mc, cc, *a, **kw
+                ),
+                *arrays,
+            )
+        (toks, logps, emitted, active_a, _, _, _, kbuf, vbuf, clen, _) = out
+        self._compile_merge(
+            tables, st["pos0"], clen, kbuf, vbuf, st["slot_ids"]
+        )
+        model_runner.pack_host.lower(
+            toks, logps, emitted, active_a
+        ).compile()
+
+    def _drive_spec(self, p: Dict[str, int]):
+        import jax
+
+        from areal_tpu.inference import model_runner
+
+        eng = self.engine
+        rows, k, pps, replay = p["rows"], p["k"], p["pps"], p["replay"]
+        st = self._decode_common(rows)
+        tables = self._mat((rows, pps), "i32")
+        draft = self._mat((rows, k - 1), "i32")
+        draft_len = self._vec(rows, "i32")
+        align = self._vec(rows, "i32") if replay > 0 else None
+        mc = eng.model_config
+        out = None
+        for topk in sorted(set(self.sp.topk_values)):
+            arrays = (
+                self.params_sds, self.cache_sds, tables, st["pos0"],
+                st["tokens"], draft, draft_len, st["active"],
+                st["remaining"], st["no_stop"], st["stops"],
+                self.key_sds, st["temp"], st["top_p"], st["top_k"],
+                st["greedy"],
+            )
+            kw = dict(
+                k=k, topk_bound=topk, attn_impl=eng._attn_impl,
+                ppcb=eng.config.pages_per_compute_block,
+                spb=eng.config.slots_per_block, rope_delta=None,
+                slot_ids=st["slot_ids"], align_base=align, replay=replay,
+            )
+            model_runner._spec_verify_forward.lower(
+                arrays[0], mc, *arrays[1:], **kw
+            ).compile()
+            out = jax.eval_shape(
+                lambda pp, cc, *a: model_runner._spec_verify_forward(
+                    pp, mc, cc, *a, **kw
+                ),
+                *arrays,
+            )
+        (toks, logps, emitted, active_a, _, _, _, kbuf, vbuf, clen, _) = out
+        self._compile_merge(
+            tables, st["pos0"], clen, kbuf, vbuf, st["slot_ids"]
+        )
+        model_runner.pack_host.lower(
+            toks, logps, emitted, active_a
+        ).compile()
+
+    def _drive_sample(self, p: Dict[str, int]):
+        import jax
+        import jax.numpy as jnp
+
+        from areal_tpu.inference import model_runner
+
+        eng = self.engine
+        ldt = self._logits_dtype or jnp.float32
+        logits = jax.ShapeDtypeStruct(
+            (self.sp.s, eng.model_config.vocab_size), ldt
+        )
+        st = self._decode_common(self.sp.s)
+        topk = p["topk"]
+        model_runner.sample_tokens.lower(
+            logits, self.key_sds, st["temp"], st["top_p"], st["top_k"],
+            st["greedy"], topk_bound=topk,
+        ).compile()
+        toks, logps = jax.eval_shape(
+            lambda *a: model_runner.sample_tokens(*a, topk_bound=topk),
+            logits, self.key_sds, st["temp"], st["top_p"],
+            st["top_k"], st["greedy"],
+        )
+        model_runner.pack_host.lower(toks, logps).compile()
+
+    def _drive_copy(self, p: Dict[str, int]):
+        from areal_tpu.inference import model_runner
+
+        pad = p["pad"]
+        model_runner.copy_pages.lower(
+            self.cache_sds, self._vec(pad, "i32"), self._vec(pad, "i32")
+        ).compile()
+
+    _DRIVERS = {
+        "prefill": (_drive_prefill, ("rows", "tp", "pps", "pfb", "mm")),
+        "decode": (_drive_decode, ("rows", "steps", "pps", "replay")),
+        "spec_verify": (_drive_spec, ("rows", "k", "pps", "replay")),
+        "sample": (_drive_sample, ("topk",)),
+        "copy": (_drive_copy, ("pad",)),
+    }
+
+    # -- entry points ---------------------------------------------------
+    def run(
+        self, mode: str, replay_path: str = ""
+    ) -> Dict[str, Any]:
+        """Drive the full enumerated ladder (``mode="ladder"``) or a
+        prior run's observed shapes (``mode="replay"``). Returns a
+        summary dict; individual rung failures degrade gracefully (a
+        precompile must never take serving down), a mismatched replay
+        header raises :class:`ReplayMismatchError` before any work."""
+        if mode not in ("ladder", "replay"):
+            raise ValueError(
+                f"precompile mode {mode!r}: expected ladder | replay"
+            )
+        from areal_tpu.utils import goodput
+
+        eng = self.engine
+        if mode == "ladder":
+            rungs = list(getattr(eng, "_ladder", None) or enumerate_ladder(
+                eng.config, eng.model_config, eng.mesh is None
+            ))
+        else:
+            rungs = self.replay_rungs(replay_path)
+        self._build_sds()
+        # order: prefill rungs first (they discover the logits dtype the
+        # sample rungs reuse), then everything else as enumerated
+        rungs.sort(key=lambda r: r.phase != "prefill")
+        t0 = time.monotonic()
+        tr = eng.compiles
+        c0, u0 = tr.compiles_total, tr.uncached_compiles_total
+        driven = failed = marked = 0
+        for rung in rungs:
+            driver_entry = self._DRIVERS.get(rung.phase)
+            params = parse_signature(rung.signature)
+            if (
+                driver_entry is None
+                or params is None
+                or (rung.phase == "prefill" and params.get("mm"))
+            ):
+                # untagged-helper catch-all, free-form signatures, and
+                # vision waves (replayed mm=1 rungs — their pixel pads
+                # aren't in the signature): coverage-mark only
+                tr.mark_compiled(rung.phase, rung.signature)
+                marked += 1
+                continue
+            driver, fields = driver_entry
+            if any(f not in params for f in fields if f != "mm"):
+                tr.mark_compiled(rung.phase, rung.signature)
+                marked += 1
+                continue
+            try:
+                with goodput.dispatch_scope(
+                    tr, rung.phase, rung.signature
+                ):
+                    driver(self, params)
+                driven += 1
+                # covered: SUCCESSFUL rungs only. Marking failures
+                # would let a systematic driver breakage latch a
+                # stone-cold server ready at coverage 1.0 — failed
+                # rungs instead keep coverage short and readiness
+                # degrades to the r11 traffic-driven rules (quiet /
+                # completed-requests), exactly like mode=off.
+                tr.mark_compiled(rung.phase, rung.signature)
+            except Exception as e:  # degrade: skip the rung, keep going
+                failed += 1
+                logger.warning(f"precompile rung {rung.key} failed: {e}")
+        wall = time.monotonic() - t0
+        # NOTE: deliberately NOT booked into the engine GoodputLedger —
+        # the precompiler runs on its own thread, usually concurrent
+        # with a serving loop that accounts its own wall; adding this
+        # thread's wall on top would break the fractions-sum-to-1.0
+        # invariant. The warm cost is visible in this summary, the
+        # compile-events stream, and the tracker's compile seconds.
+        summary = {
+            "mode": mode,
+            "rungs": len(rungs),
+            "driven": driven,
+            "marked": marked,
+            "failed": failed,
+            "wall_s": round(wall, 3),
+            "backend_compiles": tr.compiles_total - c0,
+            "uncached_compiles": tr.uncached_compiles_total - u0,
+            "coverage": tr.coverage(),
+        }
+        tr.append_event({"kind": "precompile", **summary})
+        logger.info(
+            f"precompile({mode}): {driven} rungs driven, {marked} "
+            f"marked, {failed} failed in {wall:.1f}s "
+            f"({summary['backend_compiles']} backend compiles, "
+            f"{summary['uncached_compiles']} uncached)"
+        )
+        return summary
+
+    def replay_rungs(self, path: str) -> List[Rung]:
+        """Parse a compile_events stream into the deduped rung list it
+        recorded, refusing a missing/mismatched header fingerprint."""
+        if not path:
+            raise ValueError("replay precompile needs an events path")
+        eng = self.engine
+        want = ladder_fingerprint(
+            eng.config, eng.model_config, eng.mesh is None,
+            attn_impl=getattr(eng, "_attn_impl", None),
+        )
+        seen: Set[Tuple[str, str]] = set()
+        rungs: List[Rung] = []
+        header = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if header is None:
+                    if rec.get("kind") != "header":
+                        raise ReplayMismatchError(
+                            f"{path} has no header line — refusing to "
+                            f"replay an unfingerprinted compile stream"
+                        )
+                    header = rec
+                    if rec.get("fingerprint") != want:
+                        raise ReplayMismatchError(
+                            f"{path} was recorded for ladder "
+                            f"{rec.get('fingerprint')!r} but this engine "
+                            f"is {want!r} (config/model/jax changed) — "
+                            f"replaying it would compile garbage"
+                        )
+                    continue
+                if rec.get("kind") != "compile":
+                    continue
+                key = (str(rec.get("phase")), str(rec.get("signature")))
+                if key not in seen:
+                    seen.add(key)
+                    rungs.append(Rung(*key))
+        if header is None:
+            raise ReplayMismatchError(
+                f"{path} is empty — nothing to replay"
+            )
+        return rungs

@@ -161,13 +161,26 @@ _METRIC_HELP = {
         "delivered tokens over total observed wall time"
     ),
     "goodput_wall_s": "observed wall seconds since the ledger started",
-    # recompile attribution (r11)
+    # recompile attribution (r11) + cold-start elimination (r14)
     "compile_events_total": "XLA backend compilations observed",
     "compile_seconds_total": "wall seconds spent in XLA compilation",
     "compiled_shapes": "distinct (phase, shape signature) programs compiled",
-    "shape_ladder_size": "estimated programs for a fully-warm engine",
+    "shape_ladder_size": (
+        "exact enumerated programs for a fully-warm engine"
+    ),
     "shape_ladder_coverage": "compiled shapes / ladder size (0..1)",
     "server_ready": "1 once warm (ladder covered or compile-quiet)",
+    "compile_cache_hits_total": (
+        "backend compiles served from the persistent XLA cache (a "
+        "seeded engine's warmup is disk retrieval, not XLA)"
+    ),
+    "compile_cache_misses_total": (
+        "backend compiles the persistent XLA cache could not serve"
+    ),
+    "compile_uncached_total": (
+        "backend compiles that actually ran XLA (cache miss or cache "
+        "disabled) — the true cold-start bill"
+    ),
     # native latency histograms (per sched class)
     "queue_wait_seconds": (
         "submit-to-prefill wait per scheduling class (histogram)"
@@ -249,6 +262,8 @@ _ENGINE_COUNTERS = (
     "spec_chunks_total", "spec_draft_tokens_total",
     "spec_accepted_tokens_total",
     "compile_events_total", "compile_seconds_total",
+    "compile_cache_hits_total", "compile_cache_misses_total",
+    "compile_uncached_total",
     "weight_staging_aborts_total", "weight_flips_total",
 )
 _ENGINE_HISTOGRAMS = (
@@ -541,6 +556,13 @@ def serve(
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
     httpd.server_control = control  # for tests/introspection
+    tracker = getattr(engine, "compiles", None)
+    if tracker is not None:
+        # cold-start timeline mark (trace_report --coldstart): the
+        # server answers its port from HERE; ready comes later
+        tracker.append_event(
+            {"kind": "lifecycle", "event": "port", "port": port}
+        )
     if experiment_name and trial_name:
         # register for discovery (reference generation_server.py:159-170);
         # the key is kept so /drain can deregister this server live
@@ -710,6 +732,24 @@ def main(argv: Optional[list] = None):
         "signature + duration) — the AOT precompiler's input",
     )
     p.add_argument(
+        "--compile-events-max-bytes", type=int,
+        default=d.goodput.compile_events_max_bytes,
+        help="rotate the compile-events stream to <path>.1 past this "
+        "size (the stream is otherwise unbounded across restarts)",
+    )
+    p.add_argument(
+        "--precompile", default=d.precompile.mode,
+        help="AOT-precompile the shape ladder before serving traffic: "
+        "off | ladder | replay (replay:<path> is shorthand for "
+        "--precompile replay --precompile-replay <path>)",
+    )
+    p.add_argument(
+        "--precompile-replay", default=d.precompile.replay_path,
+        help="compile_events.jsonl from a prior run to replay "
+        "(--precompile replay); a mismatched ladder fingerprint is "
+        "refused",
+    )
+    p.add_argument(
         "--goodput-jsonl", default="",
         help="append goodput ledger snapshots (bucket fractions, duty "
         "cycle, effective tok/s) to this JSONL stream",
@@ -797,7 +837,27 @@ def main(argv: Optional[list] = None):
     cfg.goodput.ready_quiet_s = args.ready_quiet
     cfg.goodput.ready_min_requests = args.ready_min_requests
     cfg.goodput.compile_events_path = args.compile_events
+    cfg.goodput.compile_events_max_bytes = args.compile_events_max_bytes
     cfg.goodput.jsonl_path = args.goodput_jsonl
+    # --precompile replay:<path> shorthand folds into mode + path
+    pc_mode, pc_path = args.precompile, args.precompile_replay
+    if pc_mode.startswith("replay:"):
+        pc_mode, pc_path = "replay", pc_mode.split(":", 1)[1]
+    if pc_mode not in ("off", "ladder", "replay"):
+        p.error(
+            f"--precompile {args.precompile!r}: expected off | ladder "
+            f"| replay[:<path>]"
+        )
+    if pc_mode == "replay" and not pc_path:
+        # fail at PARSE time: a pathless replay would only surface as a
+        # logged warm-thread error while the server silently serves the
+        # full cold storm the operator asked to skip
+        p.error(
+            "--precompile replay needs a stream: pass "
+            "--precompile-replay <path> (or --precompile replay:<path>)"
+        )
+    cfg.precompile.mode = pc_mode
+    cfg.precompile.replay_path = pc_path
     cfg.spec.enabled = args.spec
     if args.spec:
         cfg.spec.max_draft = args.spec_max_draft
@@ -806,6 +866,20 @@ def main(argv: Optional[list] = None):
         cfg.spec.accept_floor = args.spec_accept_floor
         cfg.spec.disable_patience = args.spec_disable_patience
     engine = GenerationEngine(cfg).start()
+    if cfg.precompile.mode != "off":
+        # warm CONCURRENTLY with serving: the port answers immediately,
+        # /health reports warming with rising ladder coverage, and the
+        # fleet plane keeps the server out of rotation until ready —
+        # a precompile failure degrades to the traffic-driven warmup
+        def _warm():
+            try:
+                engine.precompile()
+            except Exception as e:
+                logger.error(f"precompile failed (serving cold): {e}")
+
+        threading.Thread(
+            target=_warm, name="precompile", daemon=True
+        ).start()
     serve(
         engine,
         host=args.host,

@@ -157,6 +157,19 @@ def launch_servers(
         base_env["JAX_COMPILATION_CACHE_DIR"] = (
             gen_config.compilation_cache_dir
         )
+        seed = getattr(
+            getattr(gen_config, "precompile", None), "seed_artifact", ""
+        )
+        if seed:
+            # cold-start elimination (r14): unpack the warmed-cache seed
+            # artifact into the cache dir BEFORE the spawn, so
+            # autoscaler scale-ups and supervisor full-constellation
+            # restarts warm from disk within the spike instead of
+            # re-paying the compile storm. Idempotent — existing
+            # entries are never clobbered.
+            from areal_tpu.utils.compile_cache import ensure_seeded
+
+            ensure_seeded(gen_config.compilation_cache_dir, seed)
     for i in range(n_servers):
         host = gen_config.host or "127.0.0.1"
         cmd = JaxGenConfig.build_cmd(

@@ -57,6 +57,34 @@ def enable_compilation_cache(cache_dir: str) -> bool:
             jax.config.update(
                 "jax_persistent_cache_min_entry_size_bytes", -1
             )
+            # jax initializes its cache object ONCE, at the first
+            # compile — a process that compiled anything before this
+            # call (param init, another engine) would silently keep the
+            # cache off forever. reset_cache() forces re-initialization
+            # against the directory just configured.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover - private-API drift
+                logger.warning(
+                    "jax compilation_cache.reset_cache unavailable; "
+                    "the cache only applies if nothing compiled yet"
+                )
+            # the zero thresholds are the load-bearing part (the ladder
+            # is many SMALL programs) — verify they survived this jax
+            # version's config plumbing instead of assuming
+            if (
+                float(
+                    jax.config.jax_persistent_cache_min_compile_time_secs
+                )
+                != 0.0
+            ):
+                logger.warning(
+                    "jax_persistent_cache_min_compile_time_secs did not "
+                    "take 0.0 on this jax version — small ladder "
+                    "programs will not persist"
+                )
         except Exception as e:  # noqa: BLE001 — optimization, not a dep
             logger.warning(f"compilation cache disabled: {e}")
             return False
@@ -68,3 +96,90 @@ def enable_compilation_cache(cache_dir: str) -> bool:
 def enabled_dir() -> Optional[str]:
     """The directory the cache is currently pointed at (None = off)."""
     return _enabled_dir
+
+
+def disable_compilation_cache() -> None:
+    """Turn the persistent cache back off (tests; a process that
+    enabled it for one engine must be able to restore the default).
+    The enable is process-global jax config — on this jax version some
+    TRAINER-side programs (donation-heavy sharded train steps on the
+    CPU backend) have been observed to misbehave with the cache
+    enabled, so test suites that exercise both planes in one process
+    must scope the enable to the serving tests."""
+    global _enabled_dir
+    with _lock:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception as e:  # pragma: no cover - API drift
+                logger.warning(f"cache reset unavailable: {e}")
+        except Exception as e:  # noqa: BLE001 — best-effort restore
+            logger.warning(f"compilation cache disable failed: {e}")
+        _enabled_dir = None
+
+
+def pack_seed(cache_dir: str, artifact_path: str) -> int:
+    """Pack a warmed compilation-cache directory into one seed artifact
+    (gzip tarball) a launcher ships to spawned servers. Returns the
+    number of cache entries packed. The artifact is written atomically
+    (tmp + rename) so a concurrent reader never sees a torn tar."""
+    import tarfile
+
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    entries = sorted(
+        f
+        for f in os.listdir(cache_dir)
+        if os.path.isfile(os.path.join(cache_dir, f))
+    )
+    tmp = artifact_path + ".tmp"
+    with tarfile.open(tmp, "w:gz") as tar:
+        for f in entries:
+            tar.add(os.path.join(cache_dir, f), arcname=f)
+    os.replace(tmp, artifact_path)
+    logger.info(
+        f"packed {len(entries)} cache entries → {artifact_path}"
+    )
+    return len(entries)
+
+
+def ensure_seeded(cache_dir: str, artifact_path: str) -> int:
+    """Unpack a seed artifact into ``cache_dir`` (skipping entries that
+    already exist — a live cache is never clobbered). Returns entries
+    extracted; missing/corrupt artifacts degrade to 0 with a warning
+    (the seed is an optimization, never a launch dependency)."""
+    import tarfile
+
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        n = 0
+        with tarfile.open(artifact_path, "r:gz") as tar:
+            for member in tar.getmembers():
+                # flat cache layout only — refuse path traversal
+                name = os.path.basename(member.name)
+                if not member.isfile() or not name:
+                    continue
+                dest = os.path.join(cache_dir, name)
+                if os.path.exists(dest):
+                    continue
+                src = tar.extractfile(member)
+                if src is None:
+                    continue
+                tmp = dest + ".seedtmp"
+                with open(tmp, "wb") as out:
+                    out.write(src.read())
+                os.replace(tmp, dest)
+                n += 1
+        logger.info(
+            f"seeded compile cache {cache_dir} with {n} entries from "
+            f"{artifact_path}"
+        )
+        return n
+    except (OSError, tarfile.TarError) as e:
+        logger.warning(f"seed artifact {artifact_path} unusable: {e}")
+        return 0

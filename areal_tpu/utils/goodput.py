@@ -41,6 +41,7 @@ that should not all thread a ledger handle through their APIs.
 """
 
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -48,6 +49,19 @@ from typing import Any, Dict, List, Optional, Tuple
 from areal_tpu.utils import logging as logging_util
 
 logger = logging_util.getLogger("goodput")
+
+
+def jax_version() -> str:
+    """The running jax version, or "unknown" without a backend — one
+    helper feeding BOTH the compile-events header and the ladder
+    fingerprint (inference/precompile.py), so the two identity fields
+    can never drift apart."""
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:  # pragma: no cover - jax is a baked-in dep
+        return "unknown"
 
 # trainer step loop: what the wall clock of one training process buys
 TRAINER_BUCKETS = (
@@ -66,6 +80,13 @@ ENGINE_PRODUCTIVE = ("prefill", "decode", "spec_verify")
 # backend-compile event is the one counted as "a compile happened"
 _COMPILE_EVENT_PREFIX = "/jax/core/compile"
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent-compilation-cache outcome events (plain count events, not
+# durations). On this jax a cache HIT still fires a backend_compile
+# event for the retrieval, so the hit/miss event that precedes it on
+# the same thread is what distinguishes a real XLA compile from a
+# disk replay — the cold-vs-seeded diagnosis depends on the split.
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 
 # --------------------------------------------------------------------------
@@ -77,6 +98,10 @@ class _ScopeState(threading.local):
     def __init__(self):
         self.stack: List[Tuple["CompileTracker", str, str]] = []
         self.default: Optional[Tuple["CompileTracker", str]] = None
+        # persistent-cache outcome of the compile currently in flight on
+        # this thread ("hit" | "miss" | None); the cache event fires
+        # just before its backend_compile event, which consumes it
+        self.cache_pending: Optional[str] = None
 
 
 _TLS = _ScopeState()
@@ -84,21 +109,47 @@ _LISTENER_LOCK = threading.Lock()
 _LISTENER_INSTALLED = False
 
 
+def _current_tracker() -> Optional[Tuple["CompileTracker", str, str]]:
+    if _TLS.stack:
+        return _TLS.stack[-1]
+    if _TLS.default is not None:
+        tracker, phase = _TLS.default
+        return tracker, phase, ""
+    return None
+
+
 def _on_monitoring_event(event: str, duration: float, **kw) -> None:
     if not event.startswith(_COMPILE_EVENT_PREFIX):
         return
-    if _TLS.stack:
-        tracker, phase, signature = _TLS.stack[-1]
-    elif _TLS.default is not None:
-        tracker, phase = _TLS.default
-        signature = ""
+    cur = _current_tracker()
+    if cur is None:
+        return
+    tracker, phase, signature = cur
+    cached = None
+    if event == _BACKEND_COMPILE_EVENT:
+        cached = _TLS.cache_pending == "hit"
+        _TLS.cache_pending = None
+    tracker._observe(phase, signature, float(duration), event, cached)
+
+
+def _on_count_event(event: str, **kw) -> None:
+    """Plain (count) monitoring events: the persistent-compile-cache
+    hit/miss outcome that classifies the backend compile that follows
+    on the same thread."""
+    if event == _CACHE_HIT_EVENT:
+        kind = "hit"
+    elif event == _CACHE_MISS_EVENT:
+        kind = "miss"
     else:
         return
-    tracker._observe(phase, signature, float(duration), event)
+    _TLS.cache_pending = kind
+    cur = _current_tracker()
+    if cur is not None:
+        cur[0]._observe_cache(kind)
 
 
 def _install_listener() -> bool:
-    """Register the process-wide jax.monitoring listener (idempotent).
+    """Register the process-wide jax.monitoring listeners (idempotent).
     Returns False when jax is unavailable — the tracker then only sees
     durations fed to it directly (unit tests, stub environments)."""
     global _LISTENER_INSTALLED
@@ -112,6 +163,15 @@ def _install_listener() -> bool:
         monitoring.register_event_duration_secs_listener(
             _on_monitoring_event
         )
+        try:
+            # plain count events carry the compilation-cache outcome;
+            # older jax without the hook just loses the hit/miss split
+            monitoring.register_event_listener(_on_count_event)
+        except Exception:  # pragma: no cover - version skew guard
+            logger.warning(
+                "jax.monitoring has no plain-event listener hook; "
+                "compile-cache hit/miss counters will read 0"
+            )
         _LISTENER_INSTALLED = True
         return True
 
@@ -153,24 +213,39 @@ class CompileTracker:
 
     Tracks total compiles / compile seconds, a per-``(phase, signature)``
     breakdown (the shape ladder actually paid for), per-thread compile
-    seconds (the ledger carve-out input), and optionally appends one
-    JSONL line per backend compile to ``events_path``."""
+    seconds (the ledger carve-out input), persistent-compile-cache
+    hit/miss counters, and optionally appends one JSONL line per backend
+    compile to ``events_path`` — a stream that starts with a HEADER line
+    (``fingerprint`` of the owner's shape ladder + jax version) so a
+    later AOT replay can refuse a mismatched ladder, and that rotates to
+    ``<path>.1`` once it exceeds ``max_events_bytes`` (the stream is
+    otherwise unbounded append across restarts)."""
 
     def __init__(
         self,
         events_path: str = "",
         ladder_size: int = 0,
         time_fn=time.monotonic,
+        fingerprint: str = "",
+        max_events_bytes: int = 8_000_000,
     ):
         self.events_path = events_path
         # expected distinct (phase, signature) programs for a fully-warm
         # owner; 0 = unknown (coverage reports 0 and readiness falls
         # back to the compile-quiet rule alone)
         self.ladder_size = int(ladder_size)
+        self.fingerprint = fingerprint
+        self.max_events_bytes = int(max_events_bytes)
         self._time = time_fn
         self._lock = threading.Lock()
+        self._events_lock = threading.Lock()
         self.compiles_total = 0
         self.compile_seconds_total = 0.0
+        # backend compiles NOT served by the persistent cache: the true
+        # XLA bill (a seeded engine's "compiles" are disk retrievals)
+        self.uncached_compiles_total = 0
+        self.cache_hits_total = 0
+        self.cache_misses_total = 0
         # (phase, signature) -> {"count", "seconds"}
         self.signatures: Dict[Tuple[str, str], Dict[str, float]] = {}
         self.last_compile_t: Optional[float] = None
@@ -178,10 +253,56 @@ class CompileTracker:
         self._epoch_unix = time.time()
         self._epoch_mono = time.monotonic()
         _install_listener()
+        if self.events_path:
+            # write the header EAGERLY: its timestamp is the stream's
+            # launch anchor (trace_report --coldstart measures port /
+            # warming / ready leads against it), so it must mark owner
+            # construction, not whenever the first compile happens. An
+            # EXISTING stream whose header fingerprint doesn't match
+            # this owner is rotated out first — appending new-config
+            # compiles under an old header would make a later replay
+            # trust (and drive) the wrong ladder.
+            try:
+                with self._events_lock:
+                    fresh = (
+                        not os.path.exists(self.events_path)
+                        or os.path.getsize(self.events_path) == 0
+                    )
+                    if not fresh:
+                        with open(self.events_path) as f:
+                            try:
+                                head = json.loads(f.readline())
+                            except json.JSONDecodeError:
+                                head = {}
+                        if (
+                            head.get("kind") != "header"
+                            or head.get("fingerprint") != self.fingerprint
+                        ):
+                            os.replace(
+                                self.events_path, self.events_path + ".1"
+                            )
+                            logger.info(
+                                f"compile events {self.events_path}: "
+                                f"prior stream has a different ladder "
+                                f"fingerprint — rotated to .1"
+                            )
+                            fresh = True
+                    if fresh:
+                        with open(self.events_path, "a") as f:
+                            self._write_header(f)
+            except OSError as e:  # never kill the owner
+                logger.warning(
+                    f"compile events header write failed: {e}"
+                )
 
     # -- ingestion -----------------------------------------------------
     def _observe(
-        self, phase: str, signature: str, duration: float, event: str
+        self,
+        phase: str,
+        signature: str,
+        duration: float,
+        event: str,
+        cached: Optional[bool] = None,
     ) -> None:
         tid = threading.get_ident()
         is_backend = event == _BACKEND_COMPILE_EVENT
@@ -193,32 +314,98 @@ class CompileTracker:
             )
             if is_backend:
                 self.compiles_total += 1
+                if not cached:
+                    self.uncached_compiles_total += 1
                 sig = self.signatures.setdefault(
-                    (phase, signature), {"count": 0, "seconds": 0.0}
+                    (phase, signature),
+                    {"count": 0, "seconds": 0.0, "uncached": 0},
                 )
                 sig["count"] += 1
+                if not cached:
+                    sig["uncached"] = sig.get("uncached", 0) + 1
             else:
                 sig = self.signatures.get((phase, signature))
             if sig is not None:
                 sig["seconds"] += duration
         if is_backend and self.events_path:
-            rec = {
-                "kind": "compile",
-                "ts_unix": self._epoch_unix
-                + (time.monotonic() - self._epoch_mono),
-                "phase": phase,
-                "signature": signature,
-                "duration_s": round(duration, 6),
-                "event": event,
-            }
-            try:
-                with open(self.events_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            except OSError as e:  # attribution must never kill the owner
-                logger.warning(
-                    f"compile event append to {self.events_path} "
-                    f"failed: {e}"
+            self.append_event(
+                {
+                    "kind": "compile",
+                    "phase": phase,
+                    "signature": signature,
+                    "duration_s": round(duration, 6),
+                    "cached": bool(cached),
+                    "event": event,
+                }
+            )
+
+    def _observe_cache(self, kind: str) -> None:
+        with self._lock:
+            if kind == "hit":
+                self.cache_hits_total += 1
+            else:
+                self.cache_misses_total += 1
+
+    def mark_compiled(self, phase: str, signature: str) -> None:
+        """Record ``(phase, signature)`` as covered WITHOUT counting a
+        compile: the AOT precompiler calls this per driven ladder rung
+        so coverage reaches 1.0 even when the persistent cache already
+        held every program (a seeded engine compiles nothing, but its
+        ladder is just as warm)."""
+        with self._lock:
+            self.signatures.setdefault(
+                (phase, signature), {"count": 0, "seconds": 0.0}
+            )
+
+    # -- events stream -------------------------------------------------
+    def _write_header(self, f) -> None:
+        f.write(
+            json.dumps(
+                {
+                    "kind": "header",
+                    "ts_unix": self._epoch_unix
+                    + (time.monotonic() - self._epoch_mono),
+                    "fingerprint": self.fingerprint,
+                    "jax": jax_version(),
+                    "ladder_size": self.ladder_size,
+                }
+            )
+            + "\n"
+        )
+
+    def append_event(self, rec: Dict[str, Any]) -> None:
+        """Append one JSONL record to the events stream (compile lines,
+        server lifecycle marks). Creates the stream with its header
+        line, and rotates to ``<path>.1`` past ``max_events_bytes`` —
+        the stream must stay bounded across restarts. Never raises."""
+        if not self.events_path:
+            return
+        rec.setdefault(
+            "ts_unix",
+            self._epoch_unix + (time.monotonic() - self._epoch_mono),
+        )
+        try:
+            with self._events_lock:
+                fresh = (
+                    not os.path.exists(self.events_path)
+                    or os.path.getsize(self.events_path) == 0
                 )
+                if (
+                    not fresh
+                    and self.max_events_bytes > 0
+                    and os.path.getsize(self.events_path)
+                    >= self.max_events_bytes
+                ):
+                    os.replace(self.events_path, self.events_path + ".1")
+                    fresh = True
+                with open(self.events_path, "a") as f:
+                    if fresh:
+                        self._write_header(f)
+                    f.write(json.dumps(rec) + "\n")
+        except OSError as e:  # attribution must never kill the owner
+            logger.warning(
+                f"compile event append to {self.events_path} failed: {e}"
+            )
 
     # -- carve-out support ---------------------------------------------
     def thread_seconds(self) -> float:
@@ -270,6 +457,15 @@ class CompileTracker:
                 ),
                 "compiled_shapes": float(len(self.signatures)),
                 "shape_ladder_size": float(self.ladder_size),
+                # cold vs seeded is diagnosable from /metrics alone:
+                # a seeded engine shows hits ~= compiles and uncached ~= 0
+                "compile_cache_hits_total": float(self.cache_hits_total),
+                "compile_cache_misses_total": float(
+                    self.cache_misses_total
+                ),
+                "compile_uncached_total": float(
+                    self.uncached_compiles_total
+                ),
             }
         out["shape_ladder_coverage"] = round(self.coverage(), 4)
         return out
@@ -283,6 +479,7 @@ class CompileTracker:
                     "phase": ph,
                     "signature": sig,
                     "count": int(v["count"]),
+                    "uncached": int(v.get("uncached", 0)),
                     "seconds": round(v["seconds"], 4),
                 }
                 for (ph, sig), v in self.signatures.items()

@@ -231,14 +231,14 @@ def _resilience_phase() -> dict:
                     proc.kill()
 
 
-def _scaleup_phase() -> dict:
-    """Autoscaler cold→serving lead time, measured (ROADMAP item 3's
-    'scale-up lead time as a first-class bench metric'). One tiny-model
-    CPU server subprocess (never contends for the bench chip) is
-    launched cold; the phase stamps process launch → first /health
-    answer → first WARMING report (warmup traffic triggers the compile
-    storm) → first READY report, with the ladder coverage the server
-    claimed along the way."""
+def _scaleup_cell(
+    env_extra: dict, send_traffic: bool = True, deadline_s: float = 240.0
+) -> dict:
+    """One scale-up measurement: launch a tiny-model CPU server
+    subprocess, stamp process launch → first /health answer → first
+    WARMING report → first READY report. With ``send_traffic`` off the
+    readiness must come from the precompiler's ladder coverage alone
+    (the AOT cell's whole point)."""
     import queue as _q
     import subprocess
     import threading
@@ -253,6 +253,7 @@ def _scaleup_phase() -> dict:
     # quiet-driven readiness for the measurement: the first completed
     # request must not latch ready while the compile storm still runs
     env["AREAL_WORKER_READY_MIN"] = "1000000"
+    env.update(env_extra)
     t_launch = time.monotonic()
     proc = subprocess.Popen(
         [sys.executable, worker, "0"],
@@ -265,7 +266,7 @@ def _scaleup_phase() -> dict:
         daemon=True,
     ).start()
     try:
-        deadline = time.monotonic() + 240
+        deadline = time.monotonic() + deadline_s
         port = None
         while time.monotonic() < deadline:
             if proc.poll() is not None:
@@ -281,20 +282,21 @@ def _scaleup_phase() -> dict:
             raise RuntimeError("scale-up worker never reported a port")
         addr = f"127.0.0.1:{port}"
         t_port = time.monotonic()
-        # warmup traffic starts the compile storm the readiness rule
-        # watches (a real spawn gets this from the router/auto-warmer)
-        body = json.dumps(
-            {
-                "input_ids": [1, 2, 3, 4, 5],
-                "sampling_params": {"max_new_tokens": 8},
-            }
-        ).encode()
-        req = _rq.Request(
-            f"http://{addr}/generate", data=body,
-            headers={"Content-Type": "application/json"},
-        )
-        with _rq.urlopen(req, timeout=120) as r:
-            r.read()
+        if send_traffic:
+            # warmup traffic starts the compile storm the readiness
+            # rule watches (a real spawn gets this from the router)
+            body = json.dumps(
+                {
+                    "input_ids": [1, 2, 3, 4, 5],
+                    "sampling_params": {"max_new_tokens": 8},
+                }
+            ).encode()
+            req = _rq.Request(
+                f"http://{addr}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with _rq.urlopen(req, timeout=120) as r:
+                r.read()
         t_warming = t_ready = None
         coverage = -1.0
         while time.monotonic() < deadline:
@@ -304,20 +306,26 @@ def _scaleup_phase() -> dict:
             if h.get("status") == "warming" and t_warming is None:
                 t_warming = time.monotonic()
             if h.get("status") == "ok":
+                if not send_traffic and coverage < 1.0:
+                    # an IDLE fresh server reports ok (ready-unlatched)
+                    # before its first compile — the AOT cell is only
+                    # done when the precompiler covered the ladder
+                    time.sleep(0.1)
+                    continue
                 # ready — with or without an observed warming window (a
                 # fast warmup can latch before the first poll; spinning
                 # out the deadline would just lose the measurement)
-                if t_warming is not None:
+                if t_warming is not None or not send_traffic:
                     t_ready = time.monotonic()
                 break
             time.sleep(0.1)
         return {
-            "scaleup_port_s": round(t_port - t_launch, 3),
-            "scaleup_warming_observed": t_warming is not None,
-            "scaleup_cold_to_serving_s": (
+            "port_s": round(t_port - t_launch, 3),
+            "warming_observed": t_warming is not None,
+            "cold_to_serving_s": (
                 round(t_ready - t_launch, 3) if t_ready else None
             ),
-            "scaleup_ladder_coverage": round(coverage, 4),
+            "ladder_coverage": round(coverage, 4),
         }
     finally:
         if proc.poll() is None:
@@ -326,6 +334,75 @@ def _scaleup_phase() -> dict:
                 proc.wait(timeout=10)
             except Exception:
                 proc.kill()
+
+
+def _scaleup_phase() -> dict:
+    """Autoscaler cold→serving lead time, measured as a cold / seeded /
+    AOT A/B (ROADMAP item 3 + ISSUE 14's headline number). Three
+    tiny-model CPU server subprocesses (they never contend for the
+    bench chip):
+
+    - ``cold``: fresh persistent-compile-cache dir, traffic-driven
+      warmup — the pre-r14 experience, and the run that WARMS the cache
+      the next two cells seed from.
+    - ``seeded``: same cache dir, traffic-driven warmup — every compile
+      is a disk retrieval.
+    - ``aot_ladder_cold``: same cache dir plus ``--precompile ladder``
+      — readiness latches from exact ladder coverage with ZERO traffic,
+      but this first AOT run pays the FULL ladder's compiles (traffic
+      only warmed the shapes it hit) — it is the cell that builds the
+      production seed.
+    - ``aot_ladder_seeded``: the production scale-up path — AOT ladder
+      over the now FULLY-warmed cache: complete coverage, zero traffic,
+      disk-retrieval lead time.
+
+    Per-cell graceful degradation: one failed cell nulls its numbers
+    and the others still report."""
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_scaleup_cache_")
+    cells = {}
+    aot_env = {
+        "AREAL_WORKER_COMPILE_CACHE": cache_dir,
+        "AREAL_WORKER_PRECOMPILE": "ladder",
+    }
+    specs = {
+        "cold": ({"AREAL_WORKER_COMPILE_CACHE": cache_dir}, True),
+        "seeded": ({"AREAL_WORKER_COMPILE_CACHE": cache_dir}, True),
+        "aot_ladder_cold": (aot_env, False),
+        "aot_ladder_seeded": (aot_env, False),
+    }
+    for name, (env_extra, traffic) in specs.items():
+        try:
+            cells[name] = _scaleup_cell(env_extra, send_traffic=traffic)
+        except Exception as e:  # per-cell degradation
+            cells[name] = {
+                "cold_to_serving_s": None,
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }
+    cold = cells.get("cold", {})
+    seeded = cells.get("seeded", {})
+    out = {
+        # legacy keys = the cold cell (continuity with r11-r13 records)
+        "scaleup_port_s": cold.get("port_s"),
+        "scaleup_warming_observed": cold.get("warming_observed", False),
+        "scaleup_cold_to_serving_s": cold.get("cold_to_serving_s"),
+        "scaleup_ladder_coverage": cold.get("ladder_coverage"),
+        "scaleup_seeded_lead_s": seeded.get("cold_to_serving_s"),
+        # the production scale-up path: full-ladder AOT over a warmed
+        # seed cache — complete coverage with zero traffic
+        "scaleup_aot_lead_s": cells.get("aot_ladder_seeded", {}).get(
+            "cold_to_serving_s"
+        ),
+        "scaleup_aot_warmer_lead_s": cells.get(
+            "aot_ladder_cold", {}
+        ).get("cold_to_serving_s"),
+        "scaleup_cells": cells,
+    }
+    c, s = cold.get("cold_to_serving_s"), seeded.get("cold_to_serving_s")
+    if c is not None and s is not None:
+        out["scaleup_seeded_speedup"] = round(c / max(s, 1e-9), 2)
+    return out
 
 
 def _weightpush_phase() -> dict:

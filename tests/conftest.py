@@ -58,6 +58,26 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     )
 
 
+@pytest.fixture(scope="session", autouse=True)
+def shared_worker_compile_cache(tmp_path_factory):
+    """One persistent XLA compile cache shared by every
+    genserver_worker SUBPROCESS in the session (r14 cold-start plane,
+    tests/genserver_worker.py AREAL_WORKER_COMPILE_CACHE): the chaos /
+    failover / weight tests spawn many tiny servers with identical
+    shapes, and each used to re-pay the same compile storm — the first
+    worker warms the cache, the rest replay from disk. Fresh per
+    session (tmp dir), so runs stay hermetic; tests that need a COLD
+    subprocess (test_precompile's cold control) override the env var
+    per spawn."""
+    if os.environ.get("AREAL_WORKER_COMPILE_CACHE"):
+        yield
+        return
+    d = str(tmp_path_factory.mktemp("worker_xla_cache"))
+    os.environ["AREAL_WORKER_COMPILE_CACHE"] = d
+    yield
+    os.environ.pop("AREAL_WORKER_COMPILE_CACHE", None)
+
+
 @pytest.fixture
 def memory_name_resolve():
     from areal_tpu.utils import name_resolve

@@ -54,7 +54,32 @@ def main():
         gcfg.goodput.ready_min_requests = int(
             os.environ["AREAL_WORKER_READY_MIN"]
         )
+    if os.environ.get("AREAL_WORKER_COMPILE_CACHE"):
+        # persistent XLA compile cache (cold vs seeded scale-up cells)
+        gcfg.compilation_cache_dir = os.environ[
+            "AREAL_WORKER_COMPILE_CACHE"
+        ]
+    if os.environ.get("AREAL_WORKER_COMPILE_EVENTS"):
+        gcfg.goodput.compile_events_path = os.environ[
+            "AREAL_WORKER_COMPILE_EVENTS"
+        ]
+    pre = os.environ.get("AREAL_WORKER_PRECOMPILE", "")
+    if pre:
+        # "ladder" or "replay:<path>" — same grammar as the server CLI
+        if pre.startswith("replay:"):
+            gcfg.precompile.mode = "replay"
+            gcfg.precompile.replay_path = pre.split(":", 1)[1]
+        else:
+            gcfg.precompile.mode = pre
     eng = GenerationEngine(gcfg, model_config=cfg, params=params).start()
+    if gcfg.precompile.mode != "off":
+        # same concurrent-warm shape as server main(): the port answers
+        # immediately, /health reports warming until coverage lands
+        import threading
+
+        threading.Thread(
+            target=eng.precompile, daemon=True
+        ).start()
     # lineage tests label servers with distinct weight VERSIONS while
     # keeping identical seed-0 weights (version is an accounting label;
     # greedy token streams stay comparable across the pair)

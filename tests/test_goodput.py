@@ -156,10 +156,14 @@ class TestCompileTracker:
         recs = [
             json.loads(line) for line in open(events) if line.strip()
         ]
-        assert recs and recs[0]["kind"] == "compile"
-        assert recs[0]["phase"] == "decode"
-        assert recs[0]["signature"] == "rows4|steps8"
-        assert recs[0]["duration_s"] > 0
+        # r14: the stream opens with a fingerprint header line
+        assert recs and recs[0]["kind"] == "header"
+        assert recs[0]["jax"]
+        compiles = [r for r in recs if r["kind"] == "compile"]
+        assert compiles and compiles[0]["phase"] == "decode"
+        assert compiles[0]["signature"] == "rows4|steps8"
+        assert compiles[0]["duration_s"] > 0
+        assert "cached" in compiles[0]
         # cached second call: no new compile events
         n = tracker.compiles_total
         with goodput.dispatch_scope(tracker, "decode", "rows4|steps8"):
@@ -556,10 +560,13 @@ class TestEngineGoodput:
             for line in open(gcfg.goodput.compile_events_path)
             if line.strip()
         ]
-        assert any(r["phase"] == "prefill" for r in recs)
+        # r14: the stream opens with the ladder-fingerprint header
+        assert recs[0]["kind"] == "header" and recs[0]["fingerprint"]
+        compiles = [r for r in recs if r.get("kind") == "compile"]
+        assert any(r["phase"] == "prefill" for r in compiles)
         assert any(
             r["phase"] == "decode" and "rows" in r["signature"]
-            for r in recs
+            for r in compiles
         )
         # quiet window passes → ready, and it LATCHES
         deadline = time.monotonic() + 15

@@ -84,8 +84,9 @@ class Harness:
         out = model_runner.decode_multi(
             params, cfg, cache, *args, last_rows=self.last, **kw
         )
-        self.last = out[-1]
-        return out[:-1]
+        # r14: decode_multi always returns (..., new_last, next_tokens)
+        self.last = out[8]
+        return out[:8]
 
 
 def _full_forward_argmax(params, cfg, tokens):

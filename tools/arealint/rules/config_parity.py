@@ -76,10 +76,19 @@ _SERVER_ALIASES: Dict[Tuple[str, str], Optional[str]] = {
     ("WeightTransferConfig", "streaming"): "no-weight-streaming",
     ("WeightTransferConfig", "flip_policy"): "weight-flip-policy",
     ("WeightTransferConfig", "staging_ttl_s"): "weight-staging-ttl",
+    # cold-start elimination (r14)
+    ("PrecompileConfig", "mode"): "precompile",
+    ("PrecompileConfig", "replay_path"): "precompile-replay",
+    # PrecompileConfig.seed_artifact: LAUNCHER-side — launch_servers
+    # unpacks the seed tarball into compilation_cache_dir BEFORE the
+    # spawn (concurrent per-server unpacks of one artifact would race);
+    # the server process only ever sees the already-seeded cache dir
+    ("PrecompileConfig", "seed_artifact"): None,
 }
 # sub-configs of JaxGenConfig whose fields ride the same server CLI
 _SUBCONFIGS = (
-    "SpecConfig", "TracingConfig", "GoodputConfig", "WeightTransferConfig"
+    "SpecConfig", "TracingConfig", "GoodputConfig",
+    "WeightTransferConfig", "PrecompileConfig",
 )
 
 # flags the server declares that no config field maps to (launcher- or

@@ -64,7 +64,7 @@ the per-shape XLA compile bill, most expensive shape first.
 import argparse
 import json
 import sys
-from typing import Any, Dict, Iterable, List
+from typing import Any, Dict, Iterable, List, Optional
 
 
 def load_spans(path: str) -> List[Dict[str, Any]]:
@@ -855,6 +855,141 @@ def format_goodput(gp: Dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
+def load_coldstart(path: str) -> Dict[str, Any]:
+    """Read a compile_events JSONL stream (utils/goodput.CompileTracker):
+    header (ladder fingerprint + jax version), per-compile lines (phase,
+    signature, duration, cached), lifecycle marks (port/ready), and
+    precompile summaries."""
+    out: Dict[str, Any] = {
+        "header": None, "compiles": [], "lifecycle": {},
+        "precompile": None,
+    }
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            if kind == "header" and out["header"] is None:
+                out["header"] = rec
+            elif kind == "compile":
+                out["compiles"].append(rec)
+            elif kind == "lifecycle":
+                # first occurrence wins: the timeline measures the COLD
+                # start, not a later re-warm
+                out["lifecycle"].setdefault(rec.get("event"), rec)
+            elif kind == "precompile":
+                out["precompile"] = rec
+    return out
+
+
+def coldstart_summary(cs: Dict[str, Any]) -> Dict[str, Any]:
+    """launch→port→warming→ready timeline + per-shape compile bill +
+    persistent-cache hit rate, from one compile_events stream. 'launch'
+    is the header timestamp (written at engine construction — the
+    earliest mark the stream itself carries)."""
+    header = cs["header"] or {}
+    t0 = header.get("ts_unix")
+    compiles = cs["compiles"]
+
+    def lead(event: str) -> Optional[float]:
+        rec = cs["lifecycle"].get(event)
+        if rec is None or t0 is None:
+            return None
+        # clock anchors share one epoch pair; clamp sub-ms skew to 0
+        return round(max(0.0, float(rec["ts_unix"]) - float(t0)), 3)
+
+    first_compile = (
+        round(float(compiles[0]["ts_unix"]) - float(t0), 3)
+        if compiles and t0 is not None
+        else None
+    )
+    cached = sum(1 for c in compiles if c.get("cached"))
+    shapes: Dict[tuple, Dict[str, float]] = {}
+    for ev in compiles:
+        key = (ev.get("phase", "?"), ev.get("signature", ""))
+        agg = shapes.setdefault(
+            key, {"count": 0, "cached": 0, "seconds": 0.0}
+        )
+        agg["count"] += 1
+        agg["cached"] += 1 if ev.get("cached") else 0
+        agg["seconds"] += float(ev.get("duration_s", 0.0))
+    shape_rows = [
+        {
+            "phase": ph, "signature": sig, "count": int(v["count"]),
+            "cached": int(v["cached"]), "seconds": round(v["seconds"], 3),
+        }
+        for (ph, sig), v in shapes.items()
+    ]
+    shape_rows.sort(key=lambda r: -r["seconds"])
+    ready = cs["lifecycle"].get("ready") or {}
+    return {
+        "fingerprint": header.get("fingerprint"),
+        "jax": header.get("jax"),
+        "ladder_size": header.get("ladder_size"),
+        "port_s": lead("port"),
+        "first_compile_s": first_compile,  # warming begins here
+        "ready_s": lead("ready"),
+        "ready_coverage": ready.get("ladder_coverage"),
+        "compiles": len(compiles),
+        "cache_hits": cached,
+        "cache_hit_rate": round(cached / max(1, len(compiles)), 4),
+        "uncached": len(compiles) - cached,
+        "compile_seconds": round(
+            sum(r["seconds"] for r in shape_rows), 3
+        ),
+        "precompile": cs["precompile"],
+        "shapes": shape_rows,
+    }
+
+
+def format_coldstart(cw: Dict[str, Any]) -> str:
+    rows = [
+        f"coldstart  ladder={cw['ladder_size']}  "
+        f"fingerprint={cw['fingerprint']}  jax={cw['jax']}"
+    ]
+    for label, key in (
+        ("port answered", "port_s"),
+        ("warming (first compile)", "first_compile_s"),
+        ("READY", "ready_s"),
+    ):
+        v = cw[key]
+        rows.append(
+            f"  {label:<26}"
+            + (f"+{v:.3f}s" if v is not None else "(not reached)")
+        )
+    rows.append(
+        f"  compile bill: {cw['compiles']} compiles "
+        f"({cw['cache_hits']} cache hits, {cw['uncached']} uncached, "
+        f"hit rate {cw['cache_hit_rate']:.2%}), "
+        f"{cw['compile_seconds']:.1f}s total"
+    )
+    if cw["precompile"]:
+        pc = cw["precompile"]
+        rows.append(
+            f"  precompile[{pc.get('mode')}]: {pc.get('driven')} rungs "
+            f"driven in {pc.get('wall_s')}s "
+            f"({pc.get('uncached_compiles')} uncached)"
+        )
+    if cw["shapes"]:
+        header = (
+            f"  {'phase':<12}{'signature':<34}{'count':>6}"
+            f"{'hit':>5}{'sec':>9}"
+        )
+        rows.append(header)
+        rows.append("  " + "-" * (len(header) - 2))
+        for r in cw["shapes"][:15]:
+            rows.append(
+                f"  {r['phase']:<12}{r['signature']:<34}"
+                f"{r['count']:>6d}{r['cached']:>5d}{r['seconds']:>9.3f}"
+            )
+    return "\n".join(rows)
+
+
 PAUSE_SPAN_NAMES = ("pause_window", "weight_update_pause")
 
 
@@ -1077,12 +1212,56 @@ def main(argv=None) -> int:
         "invariant (combine with --weights)",
     )
     p.add_argument(
+        "--coldstart", action="store_true",
+        help="treat the input as a compile_events JSONL stream "
+        "(utils/goodput.CompileTracker) and print the launch→port→"
+        "warming→ready timeline, the per-shape compile bill, and the "
+        "persistent-cache hit rate; exit 1 when the stream has no "
+        "header",
+    )
+    p.add_argument(
+        "--require-max-lead", type=float, default=0.0,
+        help="exit 1 when the coldstart ready lead exceeds this many "
+        "seconds (or ready was never reached) — the seeded scale-up "
+        "CI gate (combine with --coldstart)",
+    )
+    p.add_argument(
         "--fleet", action="store_true",
         help="treat the input as a telemetry-hub run-manifest JSON "
         "(GET /manifest) and print the fleet rollup + anomaly table; "
         "exit 1 when no server was ever scraped",
     )
     args = p.parse_args(argv)
+    if args.coldstart:
+        cw = coldstart_summary(load_coldstart(args.trace))
+        if args.json:
+            print(json.dumps(cw, indent=2))
+        else:
+            print(format_coldstart(cw))
+        if cw["fingerprint"] is None:
+            # headerless ≠ usable: the timeline anchors on the header
+            # timestamp, so a pre-r14 stream full of compile lines
+            # still renders a meaningless report — fail it
+            print("no compile-events header in file", file=sys.stderr)
+            return 1
+        if args.require_max_lead > 0:
+            if cw["ready_s"] is None:
+                print(
+                    "REQUIRED ready lead <= "
+                    f"{args.require_max_lead}s but the stream carries "
+                    "no ready mark",
+                    file=sys.stderr,
+                )
+                return 1
+            if cw["ready_s"] > args.require_max_lead:
+                print(
+                    f"REQUIRED ready lead <= {args.require_max_lead}s, "
+                    f"measured {cw['ready_s']}s — cold-start budget "
+                    f"blown",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
     if args.goodput:
         gp = goodput_summary(load_goodput(args.trace))
         if args.json:
