@@ -297,6 +297,23 @@ class JaxGenConfig:
     max_num_seqs: int = 64  # decode slots
     max_model_len: int = 4096
     prefill_chunk: int = 512
+    # --- chunked prefill (r15): bounded interactive TTFT ---
+    # split a long prompt's prefill into page-aligned chunks admitted
+    # across successive waves and interleaved with decode dispatches:
+    # each chunk publishes its committed pages into the prefix cache
+    # (publish-at-chunk-commit) and the next chunk resumes by claiming
+    # them, so time-to-first-token for a request admitted behind a bulk
+    # prompt is bounded by ~one chunk's latency instead of the longest
+    # prefill in flight — and chunk boundaries become cheap preemption
+    # points for deadline-pressed interactive traffic. Requires a
+    # prefix cache (prefix_reuse_min > 0). Greedy streams are
+    # bit-identical chunked on/off; off is a strict no-op (unchanged
+    # programs, no new metric keys).
+    chunked_prefill: bool = False
+    # per-dispatch prefill token budget when chunking (floored to a
+    # page multiple, min one page, must be >= prefix_reuse_min;
+    # 0 = auto: 2 x prefill_chunk)
+    prefill_chunk_tokens: int = 0
     # decode steps fused into one device dispatch (amortizes the host
     # round-trip; stop handling happens on device so at most one dispatch
     # of latency is added to a finished request)
@@ -465,7 +482,10 @@ class JaxGenConfig:
         # missing here means subprocess servers silently run defaults
         # (the deadline_margin_s bug class; arealint ARL002 pins the
         # field ↔ flag ↔ build_cmd parity)
+        if config.chunked_prefill:
+            args.append("--chunked-prefill")
         args += [
+            f"--prefill-chunk-tokens={config.prefill_chunk_tokens}",
             f"--prefill-chunk={config.prefill_chunk}",
             f"--decode-chunk={config.decode_chunk}",
             f"--decode-pipeline={config.decode_pipeline}",

@@ -151,7 +151,15 @@ class PrefixRegistry:
         self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
     ) -> None:
         """Park `pages` (full pages caching `tokens`); takes ownership of
-        one reference per page (caller must NOT release them)."""
+        one reference per page (caller must NOT release them).
+
+        An existing entry that is a strict PREFIX of the new one on the
+        very same leading pages is superseded (its references released
+        — live claimants keep theirs): claims always prefer the longest
+        match, so the shorter entry adds nothing, and without the sweep
+        a k-chunk prompt's publish-at-chunk-commit stream (r15 chunked
+        prefill parks its growing committed prefix each wave) would pin
+        O(k^2) page references in stale entries."""
         n_full = min(len(pages), len(tokens) // self.page_size)
         if n_full == 0 or self.min_match <= 0:
             pm.release(pages)
@@ -159,10 +167,21 @@ class PrefixRegistry:
         keep = tuple(pages[:n_full])
         if n_full < len(pages):
             pm.release(pages[n_full:])
-        self._entries.append(
-            (np.asarray(tokens[: n_full * self.page_size], np.int32), keep,
-             time.monotonic())
+        new_tokens = np.asarray(
+            tokens[: n_full * self.page_size], np.int32
         )
+        survivors: List[Tuple[np.ndarray, Tuple[int, ...], float]] = []
+        for toks, pgs, stamp in self._entries:
+            if (
+                len(toks) <= len(new_tokens)
+                and pgs == keep[: len(pgs)]
+                and np.array_equal(toks, new_tokens[: len(toks)])
+            ):
+                pm.release(list(pgs))
+                continue
+            survivors.append((toks, pgs, stamp))
+        self._entries = survivors
+        self._entries.append((new_tokens, keep, time.monotonic()))
 
     def claim(
         self, pm: PageManager, prompt: Sequence[int]
@@ -413,9 +432,18 @@ class RadixPrefixCache:
     def add(
         self, pm: PageManager, tokens: np.ndarray, pages: Sequence[int]
     ) -> None:
-        """Free-time park (ownership transfer, the PrefixRegistry.add
-        contract): publish, then release the caller's references —
-        pages that duplicated existing tree content are freed."""
+        """Ownership-transfer park (the PrefixRegistry.add contract):
+        publish, then release the caller's references — pages that
+        duplicated existing tree content are freed.
+
+        Two callers: free-time parking of a finished request's full
+        sequence, and publish-at-CHUNK-commit (r15 chunked prefill) —
+        the engine parks a still-prefilling prompt's committed
+        page-aligned prefix here between chunks, making the tree the
+        prefix's only holder; the next admission wave's claim resumes
+        prefill exactly at the commit (and GRPO siblings / overlapping
+        prompts ride the finished chunks while the owner is still
+        prefilling)."""
         if self.min_match > 0 and len(tokens) > 0:
             self.publish(pm, tokens, pages)
         pm.release(pages)

@@ -7,6 +7,18 @@ and interleaves admissions (prefill) with fused multi-step decode. Every
 device program is compiled once per shape bucket — continuous batching
 never recompiles.
 
+Chunked prefill (r15, ``JaxGenConfig.chunked_prefill``): a long prompt's
+admission is capped at ``prefill_chunk_tokens`` suffix tokens per wave;
+the committed page-aligned prefix is published into the prefix cache at
+chunk commit and the request requeues, so the NEXT wave's claim resumes
+exactly there — later chunks are claims against the prompt's own
+committed pages, decode dispatches interleave between chunks, and
+time-to-first-token for a request admitted behind a bulk prompt is
+bounded by ~one chunk's latency instead of the whole prefill. Chunk
+boundaries double as cheap preemption points (deadline pressure defers
+the next bulk chunk). Greedy streams are bit-identical chunked on/off;
+off is a strict no-op.
+
 Memory model (the radix prefix cache, inference/cache.py):
 - prompts and generations live in refcounted pages; GRPO siblings *share*
   full prompt pages (one prefill, no copy) and copy at most one partial
@@ -122,6 +134,23 @@ class _Request:
     # a suffix-resume continuation of an in-flight episode request: it
     # already holds client-side progress, so admission never sheds it
     resumed: bool = False
+    # --- chunked prefill (r15) ---
+    # prefill chunks this request has committed so far (0 = never
+    # chunk-capped); prefill_pos is the committed token position after
+    # the last chunk — the next wave's prefix-cache claim resumes at or
+    # beyond it, and a claim that regresses below it counts a stall
+    # (chunk_stalls >= 2 admits the remainder whole: cache thrash must
+    # never livelock a prompt). chunk_deferred marks an in-progress
+    # deferral episode (the preemption counter records it ONCE, not
+    # once per scheduler tick); first_dispatch_time is the wave that
+    # first served this request (chunk 0) — queue-wait metrics end
+    # there, not at the final chunk (being prefilled is not queueing).
+    # All are reset at _install (one slot life per admission).
+    chunk_index: int = 0
+    prefill_pos: int = 0
+    chunk_stalls: int = 0
+    chunk_deferred: bool = False
+    first_dispatch_time: Optional[float] = None
     # weight version this request decodes under (and whose KV its pages
     # hold) — stamped at admission, left behind by a pin-policy flip so
     # the request drains on the buffer that prefilled it (the store
@@ -609,6 +638,40 @@ class GenerationEngine:
         self.requests_shed_total = 0
         self.deadline_preemptions_total = 0
         self.deadline_misses_total = 0
+        # --- chunked prefill (r15): bounded interactive TTFT ---
+        # resolved per-dispatch prefill token budget (0 = off). A long
+        # prompt's admission is capped at this many suffix tokens; the
+        # committed page-aligned prefix is published into the prefix
+        # cache at chunk commit and the request requeues — the next
+        # wave's claim resumes exactly there, so later chunks are
+        # claims against the prompt's own committed pages and every
+        # admission dispatch stays ~one chunk wide. Chunk boundaries
+        # are the new preemption points: deadline pressure defers bulk
+        # chunks instead of killing whole prefills.
+        self._chunk_budget = precompile_lib.resolve_chunk_budget(config)
+        if (
+            getattr(config, "chunked_prefill", False)
+            and self._chunk_budget <= 0
+        ):
+            logger.warning(
+                "chunked prefill requested but unavailable: needs a "
+                "prefix cache (0 < prefix_reuse_min <= the page-aligned "
+                "chunk budget — committed chunks resume via claims) and "
+                "a budget below max_model_len — admitting prompts whole"
+            )
+        self.prefill_chunks_total = 0
+        self.prefill_chunk_preemptions_total = 0
+        # stall-escape admissions (uncapped dispatches under cache
+        # thrash): ttft_bounded reports whether the chunk bound has
+        # held for EVERY admission dispatch so far — a gauge that
+        # echoed the config while an escape re-created the
+        # head-of-line block would lie to the CI gate reading it
+        self.prefill_chunk_stall_escapes = 0
+        # unsynced chunk-wave dispatch handles: chunk waves never fetch
+        # logits, so without a bound the loop could queue an entire
+        # prompt's chunks on device ahead of a just-arrived interactive
+        # request — recreating the head-of-line block chunking removes
+        self._prefill_inflight: List[Any] = []
         # request-lifecycle spans (strict no-op unless config.tracing is
         # enabled — the scheduler loop only ever pays an attribute read)
         self.tracer = SpanTracer(getattr(config, "tracing", None))
@@ -1128,6 +1191,24 @@ class GenerationEngine:
             m[f"sched_class_{cls}_submitted_total"] = (
                 self._class_submitted[cls]
             )
+        if self._chunk_budget > 0:
+            # chunked-prefill surface (r15): present ONLY when chunking
+            # resolved on — chunking off is a strict no-op, metric keys
+            # included
+            m.update(
+                prefill_chunks_total=self.prefill_chunks_total,
+                prefill_chunk_preemptions_total=(
+                    self.prefill_chunk_preemptions_total
+                ),
+                # 1 while EVERY admission dispatch so far stayed within
+                # ~one chunk of prefill — a stall-escape admission
+                # (uncapped dispatch under cache thrash) zeroes it, so
+                # the gauge is a measurement of the serving history,
+                # not a config echo
+                ttft_bounded=float(
+                    self.prefill_chunk_stall_escapes == 0
+                ),
+            )
         if self._spec_configured:
             # spec gauges exist ONLY when speculation is configured —
             # spec off is a strict no-op, metric surface included
@@ -1514,17 +1595,15 @@ class GenerationEngine:
         )
         return True
 
-    def _maybe_deadline_preempt(self) -> bool:
-        """Deadline-aware preemption: a queued INTERACTIVE request that
-        would miss its soft deadline — already inside the margin, or
-        having burned half its deadline budget waiting with no free slot
-        — evicts the youngest BULK request. The victim re-queues through
-        the existing preemption path (its KV parks in the prefix cache,
-        so resuming costs at most one partial-page re-prefill): bulk
-        loses latency, never work."""
+    def _deadline_waiter(self) -> Optional[_Request]:
+        """The first queued INTERACTIVE request about to miss its soft
+        deadline: inside ``deadline_margin_s`` of it, or having burned
+        half its deadline budget waiting. This one predicate drives
+        BOTH deadline preemption (evict a running bulk victim) and the
+        chunked-prefill scheduler's chunk-boundary deferral (hold the
+        next bulk chunk so the wave belongs to the waiter)."""
         margin = float(getattr(self.config, "deadline_margin_s", 0.25))
         now = time.monotonic()
-        waiter = None
         for r in self._pending:
             if r.priority != "interactive" or r.deadline_at is None:
                 continue
@@ -1533,8 +1612,19 @@ class GenerationEngine:
                 now >= r.deadline_at - margin
                 or now - r.submit_time >= 0.5 * budget
             ):
-                waiter = r
-                break
+                return r
+        return None
+
+    def _maybe_deadline_preempt(self) -> bool:
+        """Deadline-aware preemption: a queued INTERACTIVE request that
+        would miss its soft deadline — already inside the margin, or
+        having burned half its deadline budget waiting with no free slot
+        — evicts the youngest BULK request. The victim re-queues through
+        the existing preemption path (its KV parks in the prefix cache,
+        so resuming costs at most one partial-page re-prefill): bulk
+        loses latency, never work."""
+        now = time.monotonic()
+        waiter = self._deadline_waiter()
         if waiter is None:
             return False
         if not any(
@@ -1621,6 +1711,43 @@ class GenerationEngine:
         b = data_utils.next_bucket_size(n, quantum)
         return min(b, self.config.max_model_len)
 
+    def _has_chunkable_pending(self) -> bool:
+        """Some pending request's next wave is expected to be a
+        SLOTLESS chunk dispatch (remaining suffix beyond the budget):
+        admission can make prefill progress even with zero free decode
+        slots. Preempted requests are excluded — their re-admission
+        usually re-claims its cached prefix whole and needs a slot
+        immediately (a wrong guess here only costs one deferred claim
+        per loop iteration, never correctness)."""
+        if self._chunk_budget <= 0:
+            return False
+        return any(
+            r.mm is None
+            and r.preemptions == 0
+            and len(r.all_tokens) - r.prefill_pos > self._chunk_budget
+            for r in self._pending
+        )
+
+    def _prefill_backlog_ok(self) -> bool:
+        """Bound the UNSYNCED chunk-wave dispatches in flight (chunked
+        prefill only). Chunk waves never fetch logits — there is no
+        first token yet — so without this gate the admission loop could
+        queue an entire long prompt's chunks on device ahead of a
+        just-arrived interactive request, recreating exactly the
+        head-of-line blocking chunking exists to break. Completed
+        dispatches are pruned via ``Array.is_ready``; a jax without it
+        degrades to an unbounded backlog (never a stall)."""
+        keep = []
+        for h in self._prefill_inflight:
+            try:
+                ready = bool(h.is_ready())
+            except AttributeError:
+                ready = True
+            if not ready:
+                keep.append(h)
+        self._prefill_inflight = keep
+        return len(keep) <= max(1, self.config.decode_pipeline)
+
     def _admit(self) -> bool:
         """Admit queued requests: identical prompts (GRPO siblings) group
         behind ONE prefill row, sharing full prompt pages and copying at
@@ -1653,7 +1780,24 @@ class GenerationEngine:
             # victim (stable within each class, so bulk FIFO is
             # preserved)
             self._pending.sort(key=lambda r: r.priority != "interactive")
-        if not self._pending or not self._free_slots:
+        # chunked prefill: capture deadline pressure BEFORE wave
+        # selection moves the waiter out of _pending — a
+        # deadline-critical interactive request defers this wave's bulk
+        # chunks, so its first token rides an interactive-only dispatch
+        # instead of sharing the wave with a bulk chunk
+        deadline_pressed = (
+            self._chunk_budget > 0
+            and self._deadline_waiter() is not None
+        )
+        if not self._pending:
+            return False
+        if not self._free_slots and not self._has_chunkable_pending():
+            # slotless chunk work may still proceed: a mid-prefill
+            # prompt's next chunk needs no slot until its FINAL chunk,
+            # so a fully-occupied decode house must not stall bulk
+            # prefill (that would serialize the prefill behind decode
+            # completions — exactly the head-of-line coupling chunking
+            # exists to break)
             return False
         if self._pending_since is None:
             self._pending_since = time.monotonic()
@@ -1665,6 +1809,13 @@ class GenerationEngine:
         saturated = (
             len(self._pending) >= len(self._free_slots)
             or len({tuple(r.all_tokens) for r in self._pending}) >= wave
+            # chunk continuations bypass the wave-filling hold: a
+            # mid-prefill prompt's next chunk must dispatch this
+            # iteration, not admit_hold_s from now (prefill_pos > 0 is
+            # the mid-chunk marker — it resets at install, so a
+            # once-chunked request that later re-queues does not
+            # disable wave batching forever)
+            or any(r.prefill_pos > 0 for r in self._pending)
         )
         if (
             not saturated
@@ -1693,12 +1844,25 @@ class GenerationEngine:
         budget = len(self._free_slots)
         for req in self._pending:
             key = (tuple(req.all_tokens), req.mm_key)
+            # a request whose next wave is expected to be a SLOTLESS
+            # chunk dispatch may open a group without consuming slot
+            # budget — chunk prefill progresses through a fully-busy
+            # decode house (the claim loop defers it back if its claim
+            # turns out to leave a one-wave suffix needing a slot)
+            chunkable = (
+                self._chunk_budget > 0
+                and req.mm is None
+                and req.preemptions == 0
+                and len(req.all_tokens) - req.prefill_pos
+                > self._chunk_budget
+            )
             if budget > 0 and key in groups:
                 groups[key].append(req)
                 budget -= 1
-            elif budget > 0 and len(groups) < wave:
+            elif len(groups) < wave and (budget > 0 or chunkable):
                 groups[key] = [req]
-                budget -= 1
+                if budget > 0:
+                    budget -= 1
             else:
                 rest.append(req)
         self._pending = rest + later
@@ -1708,17 +1872,70 @@ class GenerationEngine:
         m = self.config.max_model_len
         bs = self.cache_config.page_size
         num_pages = self.cache_config.num_pages
+        s = self.config.max_num_seqs
         reps = [g[0] for g in groups.values()]
+        # --- chunked prefill (r15): one chunk-capped row per wave (the
+        # dispatch wall stays ~one chunk even with several long prompts
+        # queued — they alternate chunks across waves), gated on the
+        # unsynced-chunk backlog; deadline pressure defers BULK chunks
+        # entirely, so the wave belongs to the interactive waiter
+        # (chunk boundaries are the preemption points) ---
+        budget_c = self._chunk_budget
+        chunk_quota = (
+            1 if budget_c > 0 and self._prefill_backlog_ok() else 0
+        )
+        pressure = deadline_pressed
+        deferred: List[_Request] = []
         # --- prefix claim + page allocation per representative ---
-        rep_slots: List[int] = []
+        rep_slots: List[int] = []  # s = slotless chunk-capped row
         offsets: List[int] = []
+        # cache-served tokens NET of the request's own chunk commits: a
+        # continuation re-claiming the prefix it committed last wave is
+        # not a cache hit — counting it would inflate the hit-rate
+        # gauges quadratically in chunk count (only tokens beyond the
+        # request's own committed position are cross-request reuse)
+        novel_offs: List[int] = []
         rep_pages: List[List[int]] = []
         admitted_groups: List[List[_Request]] = []
+        chunk_ends: List[int] = []  # committed end (== plen: complete)
         cow_src: List[int] = []
         cow_dst: List[int] = []
         for rep, group in zip(reps, groups.values()):
             prompt = rep.all_tokens
+            plen = len(prompt)
             src = None
+            if (
+                budget_c > 0
+                and rep.mm is None
+                and rep.chunk_stalls < 2
+                and plen - rep.prefill_pos > budget_c
+                and (
+                    chunk_quota <= 0
+                    or (pressure and rep.priority == "bulk")
+                )
+            ):
+                # chunk-boundary deferral BEFORE the claim: a group
+                # expected to need a chunk this wave (remaining suffix
+                # beyond the budget) defers under quota/deadline
+                # pressure without touching the prefix cache — a
+                # deferred group re-forms every scheduler tick, and
+                # paying a claim per tick would make
+                # prefix_claim_hit_rate measure ticks, refresh LRU
+                # stamps spuriously, and churn refcounts. Committed
+                # chunks stay published; nothing is lost. The deferral
+                # is counted ONCE per episode (chunk_deferred), not
+                # once per tick.
+                if pressure and rep.priority == "bulk":
+                    if not rep.chunk_deferred:
+                        rep.chunk_deferred = True
+                        self.prefill_chunk_preemptions_total += 1
+                        self.tracer.instant(
+                            "prefill_chunk_preempt", rep.rid,
+                            committed=rep.prefill_pos,
+                            prompt_tokens=plen,
+                        )
+                deferred.extend(group)
+                continue
             if rep.mm is not None:
                 # pixel-conditioned KV: no token-keyed prefix reuse
                 shared, off = [], 0
@@ -1728,7 +1945,58 @@ class GenerationEngine:
                 )
             else:
                 shared, off = self.registry.claim(self.pm, prompt)
-            need = -(-len(prompt) // bs) - len(shared)
+            end = plen
+            stalled = escaped = False
+            if budget_c > 0 and rep.mm is None and plen - off > budget_c:
+                if chunk_quota <= 0 or (
+                    pressure and rep.priority == "bulk"
+                ):
+                    # the pre-claim expectation missed (the claim
+                    # regressed below prefill_pos, so the suffix is
+                    # chunk-sized after all): same deferral, same
+                    # once-per-episode counting, claim refs returned
+                    self.pm.release(shared)
+                    if src is not None:
+                        self.pm.release([src])
+                    if pressure and rep.priority == "bulk":
+                        if not rep.chunk_deferred:
+                            rep.chunk_deferred = True
+                            self.prefill_chunk_preemptions_total += 1
+                            self.tracer.instant(
+                                "prefill_chunk_preempt", rep.rid,
+                                committed=rep.prefill_pos,
+                                prompt_tokens=plen,
+                            )
+                    deferred.extend(group)
+                    continue
+                # stall escape: a continuation whose claims regressed
+                # on two DISPATCHED waves (eviction keeps eating the
+                # committed prefix) admits in full — chunking must
+                # never livelock a prompt under cache thrash. Both the
+                # strike and the escape's side effects are recorded
+                # only when this row actually dispatches (below), so
+                # deferrals/alloc failures can neither double-count a
+                # single regression nor spam the counter per loop tick.
+                stalled = rep.chunk_index > 0 and off < rep.prefill_pos
+                if rep.chunk_stalls + (1 if stalled else 0) >= 2:
+                    escaped = True  # end stays plen: uncapped dispatch
+                else:
+                    # cap this row at a PAGE-ALIGNED end: commits must
+                    # publish full pages so both cache modes (and the
+                    # flat registry's full-page claims) resume exactly
+                    # here. budget >= page_size guarantees end > off.
+                    end = ((off + budget_c) // bs) * bs
+                    chunk_quota -= 1
+            if end == plen and not self._free_slots:
+                # selected on chunk eligibility, but the claim leaves a
+                # suffix that fits one wave — the FINAL chunk samples a
+                # first token and needs a decode slot; wait for one
+                self.pm.release(shared)
+                if src is not None:
+                    self.pm.release([src])
+                deferred.extend(group)
+                continue
+            need = -(-end // bs) - len(shared)
             fresh = self._alloc_pages(need)
             if fresh is None:
                 # pool exhausted — return the whole group to pending
@@ -1737,6 +2005,22 @@ class GenerationEngine:
                     self.pm.release([src])
                 self._pending = group + self._pending
                 continue
+            if stalled:
+                rep.chunk_stalls += 1
+            if escaped:
+                # the uncapped dispatch is now certain: the TTFT bound
+                # is violated for this wave and ttft_bounded reports it
+                self.prefill_chunk_stall_escapes += 1
+                self.tracer.instant(
+                    "prefill_chunk_stall_escape", rep.rid,
+                    committed=rep.prefill_pos,
+                    prompt_tokens=plen,
+                )
+                logger.warning(
+                    f"chunked prefill stall escape for {rep.rid}: "
+                    f"claims regressed twice (cache thrash) — "
+                    f"admitting {plen - off} suffix tokens whole"
+                )
             if src is not None:
                 # COW claim: the match extends into a cached page (a
                 # partial tail, or divergence within a full page) —
@@ -1744,13 +2028,25 @@ class GenerationEngine:
                 # resume prefill mid-page from the row-aligned offset
                 cow_src.append(src)
                 cow_dst.append(fresh[0])
-            slot = self._free_slots.pop()
             pages = shared + fresh
-            rep_slots.append(slot)
+            if end < plen:
+                # chunk-capped: the row rides the wave SLOTLESS — no
+                # first token is sampled yet, so slot/sampling state
+                # and the install wait for the final chunk's wave
+                rep_slots.append(s)
+            else:
+                rep_slots.append(self._free_slots.pop())
             offsets.append(off)
+            novel_offs.append(off - min(off, rep.prefill_pos))
             rep_pages.append(pages)
             admitted_groups.append(group)
-        if not rep_slots:
+            chunk_ends.append(end)
+            # the deferral episode (if any) ended in a dispatch: the
+            # next pressure deferral is a new episode and counts again
+            rep.chunk_deferred = False
+        if deferred:
+            self._pending = deferred + self._pending
+        if not admitted_groups:
             # a COW claim with no admitted rep cannot happen (the claim
             # only survives when its rep allocates), but release holds
             # defensively if a future edit changes that
@@ -1783,16 +2079,18 @@ class GenerationEngine:
             self.pm.release(cow_src)
 
         # suffix bucket (offsets are pool-ROW-aligned — page-aligned for
-        # full-page claims, mid-page for COW claims — and < prompt len)
+        # full-page claims, mid-page for COW claims — and < prompt len).
+        # Chunk-capped rows contribute their CHUNK's suffix, so with
+        # chunking on every admission dispatch is bounded by ~one chunk
         tp = self._prefill_bucket(
             max(
-                len(g[0].all_tokens) - off
-                for g, off in zip(admitted_groups, offsets)
+                end - off
+                for end, off in zip(chunk_ends, offsets)
             )
         )
         # rows whose suffix exceeds the bucket fall back to offset 0?
         # cannot happen: offset <= len(prompt)-1 and bucket >= max suffix.
-        self.total_cached_prompt_tokens += sum(offsets)
+        self.total_cached_prompt_tokens += sum(novel_offs)
         pf_prefix_bound = 0
         if max(offsets) > 0:
             pf_prefix_bound = min(
@@ -1801,10 +2099,12 @@ class GenerationEngine:
                     max(offsets), self.config.kv_bucket
                 ),
             )
+        # page window covers each row's COMMITTED end (chunk-capped rows
+        # only write/attend up to their chunk), not the full prompt
         pps_pf = max(
             1,
             -(-data_utils.next_bucket_size(
-                max(len(g[0].all_tokens) for g in admitted_groups),
+                max(chunk_ends),
                 self.config.kv_bucket,
             ) // bs),
         )
@@ -1822,7 +2122,7 @@ class GenerationEngine:
             zip(admitted_groups, rep_slots, offsets, rep_pages)
         ):
             prompt = group[0].all_tokens
-            suffix = prompt[off:]
+            suffix = prompt[off : chunk_ends[i]]
             tokens[i, : len(suffix)] = suffix
             true_lens[i] = len(suffix)
             row_offsets[i] = off
@@ -1912,28 +2212,99 @@ class GenerationEngine:
             # the radix tree NOW (the merge dispatch is already ordered
             # on device), so siblings/turns arriving in later waves
             # claim them while these owners are still decoding — the
-            # flat registry only ever parked pages at free time
-            for group, pages in zip(admitted_groups, rep_pages):
-                if group[0].mm is None:
+            # flat registry only ever parked pages at free time.
+            # Chunk-capped rows are handled below (publish-at-CHUNK-
+            # commit is an ownership transfer, not a share)
+            for i, (group, pages) in enumerate(
+                zip(admitted_groups, rep_pages)
+            ):
+                if group[0].mm is None and chunk_ends[i] == len(
+                    group[0].all_tokens
+                ):
                     self.registry.publish(
                         self.pm,
                         np.asarray(group[0].all_tokens, np.int32),
                         pages,
                     )
 
+        # --- publish-at-chunk-commit (r15): a chunk-capped row's
+        # committed page-aligned prefix enters the prefix cache as an
+        # OWNERSHIP TRANSFER (`add` publishes, then releases this
+        # wave's claim+alloc refs — between chunks the cache is the
+        # prefix's only holder), and the group requeues at the front of
+        # pending. The next wave's claim resumes exactly here; GRPO
+        # siblings and overlapping prompts already ride the finished
+        # chunks while the owner is still prefilling. ---
+        requeue: List[_Request] = []
+        if budget_c > 0:
+            t_commit = time.monotonic()
+            for i, (group, pages) in enumerate(
+                zip(admitted_groups, rep_pages)
+            ):
+                end = chunk_ends[i]
+                rep = group[0]
+                plen = len(rep.all_tokens)
+                if end == plen:
+                    continue
+                self.registry.add(
+                    self.pm,
+                    np.asarray(rep.all_tokens[:end], np.int32),
+                    pages,
+                )
+                rep.chunk_index += 1
+                rep.prefill_pos = end
+                if rep.first_dispatch_time is None:
+                    # the wave that first served this request: queue
+                    # wait ends HERE — the later chunk waves are the
+                    # prompt being prefilled, not queued
+                    rep.first_dispatch_time = t_pf_start
+                self.prefill_chunks_total += 1
+                if self.tracer.enabled:
+                    # chunk spans measure DISPATCH wall (the wave is
+                    # not synced — no first token to fetch); the final
+                    # chunk's span carries end-to-end timing as usual
+                    self.tracer.record(
+                        "prefill", rep.rid, t_pf_start, t_commit,
+                        slot=-1, wave_rows=len(rep_slots),
+                        prompt_tokens=plen,
+                        cached_offset=int(offsets[i]),
+                        cached_tokens=int(novel_offs[i]),
+                        chunk_index=rep.chunk_index - 1,
+                        chunk_count=rep.chunk_index
+                        + max(1, -(-(plen - end) // budget_c)),
+                        committed=end,
+                        partial=1,
+                    )
+                requeue.extend(group)
+            if requeue:
+                # keep one unsynced-dispatch handle per chunk wave so
+                # _prefill_backlog_ok can bound device queue depth
+                self._prefill_inflight.append(wave_logits)
+                self._pending = requeue + self._pending
+
         # --- sibling fan-out: share full prompt pages, copy the partial
-        # tail page (if any) ---
+        # tail page (if any) — chunk-capped rows skip (their installs
+        # and sibling fan-out wait for the final chunk's wave) ---
         copy_src: List[int] = []
         copy_dst: List[int] = []
         admitted: List[tuple] = []  # (req, slot, logits_row)
         adm_cached: List[int] = []  # cache-served prompt tokens per req
+        # (chunk_index, first_dispatch_time) captured BEFORE _install
+        # resets them: the final chunk's span attrs and the queue-wait
+        # end need this admission's values, not the fresh slot life's
+        adm_meta: List[tuple] = []
         for i, (group, slot, pages) in enumerate(
             zip(admitted_groups, rep_slots, rep_pages)
         ):
             plen = len(group[0].all_tokens)
+            if chunk_ends[i] < plen:
+                continue
+            adm_meta.append(
+                (group[0].chunk_index, group[0].first_dispatch_time)
+            )
             self._install(group[0], slot, pages, plen)
             admitted.append((group[0], slot, i))
-            adm_cached.append(int(offsets[i]))
+            adm_cached.append(int(novel_offs[i]))
             n_full = plen // bs
             for sib in group[1:]:
                 if not self._free_slots:
@@ -1953,6 +2324,7 @@ class GenerationEngine:
                     copy_dst.append(tail[0])
                     sib_pages += tail
                 sslot = self._free_slots.pop()
+                adm_meta.append((0, None))
                 self._install(sib, sslot, sib_pages, plen)
                 admitted.append((sib, sslot, i))
                 adm_cached.append(plen)
@@ -1972,6 +2344,13 @@ class GenerationEngine:
                 )
 
         # --- batched per-slot state update (one scatter per state array) ---
+        if not admitted:
+            # chunk-only wave: nothing installed, no first token to
+            # fetch — the dispatch stays unsynced (the backlog handle
+            # above bounds device queue depth) and the loop proceeds
+            # straight to decode, which is the whole point: decode
+            # dispatches interleave between a long prompt's chunks
+            return True
         n = len(admitted)
         slots_np = np.zeros(n, np.int32)
         deltas = np.zeros(n, np.int32)
@@ -2067,22 +2446,39 @@ class GenerationEngine:
                 inst if self._prefill_tps == 0.0
                 else 0.8 * self._prefill_tps + 0.2 * inst
             )
-        for (req, _, _) in admitted:
+        for (req, _, _), (_, first_disp) in zip(admitted, adm_meta):
             # native queue-wait histogram per class: the durable latency
-            # source (span percentiles vanish with every /trace drain)
+            # source (span percentiles vanish with every /trace drain).
+            # A chunked prompt's wait ends at its FIRST chunk wave —
+            # the later waves are the prompt being prefilled, and
+            # counting them as queueing would corrupt the bulk class's
+            # priority-isolation SLO signal
             self._hists["queue_wait_seconds"][req.priority].observe(
-                t_pf_start - req.submit_time
+                (first_disp or t_pf_start) - req.submit_time
             )
         if self.tracer.enabled:
-            for (req, slot, row), ctok in zip(admitted, adm_cached):
+            for (req, slot, row), ctok, (chunk_idx, first_disp) in zip(
+                admitted, adm_cached, adm_meta
+            ):
                 self.tracer.record(
-                    "queue_wait", req.rid, req.submit_time, t_pf_start,
+                    "queue_wait", req.rid, req.submit_time,
+                    first_disp or t_pf_start,
                     preemptions=req.preemptions,
                     # per-class queue-wait is THE priority-isolation SLO
                     # signal (trace_report --slo aggregates it)
                     sched_class=req.priority,
                     **({"tenant": req.tenant} if req.tenant else {}),
                 )
+                chunk_attrs = {}
+                if self._chunk_budget > 0:
+                    # chunked engines stamp every prefill span with its
+                    # chunk position (final chunk = index chunk_index of
+                    # chunk_index+1) — trace_report --ttft builds the
+                    # chunks-per-prompt histogram from these
+                    chunk_attrs = dict(
+                        chunk_index=chunk_idx,
+                        chunk_count=chunk_idx + 1,
+                    )
                 self.tracer.record(
                     "prefill", req.rid, t_pf_start, t_pf_end,
                     slot=slot, wave_rows=len(rep_slots),
@@ -2095,6 +2491,7 @@ class GenerationEngine:
                     # prefill; a claimant's = its claim offset) —
                     # trace_report --cache aggregates these
                     cached_tokens=int(ctok),
+                    **chunk_attrs,
                 )
         return True
 
@@ -2114,6 +2511,16 @@ class GenerationEngine:
         self._tables[slot, : len(pages)] = pages
         self._slot_mm[slot] = req.mm is not None
         self._align_base[slot] = cached
+        # a fresh slot life resets the chunk bookkeeping: a preempted
+        # request's next life may legitimately re-claim less (no stall
+        # strike), and its re-claims of its own PARKED pages count as
+        # cache hits again (pre-chunking accounting — prefill_pos only
+        # discounts a still-prefilling prompt's own chunk commits)
+        req.chunk_stalls = 0
+        req.prefill_pos = 0
+        req.chunk_index = 0
+        req.chunk_deferred = False
+        req.first_dispatch_time = None
         if self._proposer is not None:
             # full history (resumed/preempted requests re-enter with
             # their accumulated output): the n-gram index rebuilds here

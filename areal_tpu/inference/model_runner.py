@@ -391,6 +391,16 @@ def prefill_forward(
     cache's COW claims — the per-token window masks below are exact for
     any offset, and row alignment is what the MERGE needs, since
     assemble_rows consults last_rows only for mid-row starts).
+
+    This one entry point is ALSO the chunk-resume prefill (r15 chunked
+    prefill): a continuation chunk is dispatched with ``offsets`` = the
+    committed page-aligned prefix the engine re-claimed from the cache
+    and ``true_lens`` = the chunk width — identical in shape and
+    numerics to a radix-claim resume, which is what makes chunked
+    greedy streams bit-identical to unchunked ones. Chunk-capped rows
+    ride a wave slotless (slot id = max_num_seqs): their last_rows
+    gather clips harmlessly because page-aligned ends mean the first
+    row of the NEXT chunk is never mid-row.
     """
     n, tp = tokens.shape
     d = cfg.head_dim

@@ -73,6 +73,36 @@ class ReplayMismatchError(RuntimeError):
     this engine can never dispatch, or miss the ones it will."""
 
 
+def resolve_chunk_budget(config) -> int:
+    """Resolved per-dispatch prefill token budget for chunked prefill
+    (r15); 0 = chunking off or unavailable. ONE source of truth shared
+    by the engine's admission cap and this module's ladder enumerator —
+    drift between the two would put unenumerated chunk shapes on the
+    serving path.
+
+    The budget is floored to a page multiple (chunk commits publish
+    FULL pages so both cache modes — including the flat registry's
+    full-page-only claims — resume exactly at the commit), must be at
+    least ``prefix_reuse_min`` (a committed prefix the cache refuses to
+    match would re-prefill from zero forever), and must leave something
+    to split (below ``max_model_len``)."""
+    if not bool(getattr(config, "chunked_prefill", False)):
+        return 0
+    reuse = int(getattr(config, "prefix_reuse_min", 0))
+    if reuse <= 0:
+        return 0  # no prefix cache, no chunk-resume point
+    bs = int(config.page_size)
+    budget = int(getattr(config, "prefill_chunk_tokens", 0))
+    if budget <= 0:
+        budget = 2 * int(config.prefill_chunk)  # auto
+    budget = max(bs, (budget // bs) * bs)
+    if budget < reuse:
+        return 0  # committed chunks would never match the claim floor
+    if budget >= int(config.max_model_len):
+        return 0  # nothing to split
+    return budget
+
+
 # --------------------------------------------------------------------------
 # Signature formatting — ONE source of truth shared with the engine's
 # dispatch_scope tags (engine.py imports these; drift between what the
@@ -154,6 +184,10 @@ class LadderSpace:
     p_max: int  # largest admissible prompt length
     topk_values: Tuple[int, ...]
     vision: bool
+    # chunked prefill (r15): resolved per-dispatch suffix token budget
+    # (0 = off). With chunking on, every prefill row's suffix is capped
+    # here, and page-floored chunk-end triples join the reachable set
+    chunk: int = 0
 
 
 def derive_space(config, model_config, single_device: bool = True) -> LadderSpace:
@@ -208,6 +242,7 @@ def derive_space(config, model_config, single_device: bool = True) -> LadderSpac
         p_max=max(1, min(m - 1, (num_pages - 1) * bs)),
         topk_values=topk_values,
         vision=model_config.vision is not None,
+        chunk=resolve_chunk_budget(config),
     )
 
 
@@ -326,25 +361,45 @@ def _prefill_triples(sp: LadderSpace) -> Set[Tuple[int, int, int]]:
     offsets = [0] + (
         list(range(o_min, o_max + 1, g)) if g > 0 and o_min <= o_max else []
     )
+    # chunked prefill (r15): a row whose suffix exceeds the chunk
+    # budget is capped at a page-floored end — its suffix never exceeds
+    # the budget, and its (pps, table) window covers only the committed
+    # end. The chunk-commit offsets themselves (page multiples >= the
+    # budget) are already in the claim-offset grid: commits publish
+    # full pages and the resolved budget is >= prefix_reuse_min, so
+    # every continuation claim lands on a grain multiple the grid
+    # enumerates. Documented exclusion: the stall-escape valve (a
+    # continuation whose claims regressed twice admits its remainder
+    # WHOLE) can dispatch an uncapped suffix under cache thrash —
+    # readiness latches, so that lone incremental compile never drops
+    # a serving engine out of rotation.
+    cap = sp.chunk
     for o in offsets:
         pfb = pfb_of(o)
         # for fixed o both tp(p - o) and pps(p) are nondecreasing step
         # functions of p — walk their merged boundaries
-        lo, hi = o + 1, sp.p_max
-        if lo > hi:
-            continue
-        x = lo
-        while x <= hi:
-            pair = (tp_of(x - o), pps_of(x))
-            triples.add((pair[0], pair[1], pfb))
-            a, b = x, hi
-            while a < b:
-                mid = (a + b + 1) // 2
-                if (tp_of(mid - o), pps_of(mid)) == pair:
-                    a = mid
-                else:
-                    b = mid - 1
-            x = a + 1
+        lo = o + 1
+        hi = sp.p_max if cap <= 0 else min(sp.p_max, o + cap)
+        if lo <= hi:
+            x = lo
+            while x <= hi:
+                pair = (tp_of(x - o), pps_of(x))
+                triples.add((pair[0], pair[1], pfb))
+                a, b = x, hi
+                while a < b:
+                    mid = (a + b + 1) // 2
+                    if (tp_of(mid - o), pps_of(mid)) == pair:
+                        a = mid
+                    else:
+                        b = mid - 1
+                x = a + 1
+        if cap > 0 and o + cap < sp.p_max:
+            # chunk-capped row at this offset: exactly one reachable
+            # triple — end is the page-floored chunk boundary (the
+            # engine's ``end = ((off + budget) // bs) * bs``)
+            e = ((o + cap) // sp.bs) * sp.bs
+            if e > o:
+                triples.add((tp_of(e - o), pps_of(e), pfb))
     return triples
 
 

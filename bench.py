@@ -642,6 +642,211 @@ def _weightpush_phase() -> dict:
     return {"configs": cells}
 
 
+def _ttft_ab_phase() -> dict:
+    """Chunked vs unchunked prefill under bulk saturation (r15),
+    measured. Two tiny-model CPU server subprocesses (one per cell —
+    they force the host platform, so they never contend for the bench
+    chip) each serve a continuous stream of LONG bulk prompts while an
+    interactive probe submits short deadline-carrying requests; the
+    numbers of record are per-class TTFT p50/p95, prefill tok/s, and
+    the chunk counters. The acceptance shape: the chunked cell's
+    interactive TTFT p95 is bounded by ~one chunk's latency and
+    measurably below the unchunked cell, where a probe admitted behind
+    a bulk prompt waits out that prompt's entire prefill."""
+    import queue as _q
+    import subprocess
+    import threading
+    import urllib.request as _rq
+
+    import numpy as _np
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "genserver_worker.py",
+    )
+
+    def _p(vals, q):
+        vals = sorted(vals)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(q * (len(vals) - 1)))], 4)
+
+    def _post(addr, body, timeout=120):
+        req = _rq.Request(
+            f"http://{addr}/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _rq.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _metric(addr, name):
+        with _rq.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for line in text.splitlines():
+            if line.startswith(f"areal_tpu_gen_{name} ") or (
+                line.startswith(f"areal_tpu_gen_{name}{{")
+            ):
+                try:
+                    return float(line.split()[-1])
+                except ValueError:
+                    return None
+        return None
+
+    def run_cell(chunked: bool) -> dict:
+        env = dict(os.environ)
+        # long prompts + small pages so the chunk budget (64 tokens = 4
+        # pages) genuinely splits the bulk prefill into ~6 chunks
+        env["AREAL_WORKER_MAX_MODEL_LEN"] = "512"
+        env["AREAL_WORKER_PAGE_SIZE"] = "16"
+        if chunked:
+            env["AREAL_WORKER_CHUNKED_PREFILL"] = "64"
+        else:
+            env.pop("AREAL_WORKER_CHUNKED_PREFILL", None)
+        proc = subprocess.Popen(
+            [sys.executable, worker, "0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        lines: "_q.Queue[str]" = _q.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        ).start()
+        try:
+            deadline = time.monotonic() + 240
+            port = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError("ttft_ab worker died at startup")
+                try:
+                    line = lines.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                if line.startswith("PORT "):
+                    port = int(line.split()[1])
+                    break
+            if port is None:
+                raise RuntimeError("ttft_ab worker reported no port")
+            addr = f"127.0.0.1:{port}"
+            stop = threading.Event()
+            bulk_ttfts, inter_ttfts = [], []
+
+            def bulk_loop(seed):
+                rng = _np.random.default_rng(29 + seed)
+                while not stop.is_set():
+                    try:
+                        out = _post(addr, {
+                            "input_ids": rng.integers(
+                                1, 100, size=400
+                            ).tolist(),
+                            "priority": "bulk",
+                            "sampling_params": {
+                                "max_new_tokens": 8, "greedy": True,
+                            },
+                        })
+                        bulk_ttfts.append(
+                            float(out["meta_info"]["ttft"])
+                        )
+                    except Exception:
+                        time.sleep(0.05)
+
+            def inter_loop():
+                rng = _np.random.default_rng(97)
+                while not stop.is_set():
+                    try:
+                        out = _post(addr, {
+                            "input_ids": rng.integers(
+                                1, 100, size=6
+                            ).tolist(),
+                            "priority": "interactive",
+                            "deadline_s": 2.0,
+                            "sampling_params": {
+                                "max_new_tokens": 4, "greedy": True,
+                            },
+                        })
+                        inter_ttfts.append(
+                            float(out["meta_info"]["ttft"])
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.1)
+
+            bulk_threads = [
+                threading.Thread(target=bulk_loop, args=(i,), daemon=True)
+                for i in range(2)
+            ]
+            for t in bulk_threads:
+                t.start()
+            # warm: let the compile storm pass under bulk-only load
+            warm_deadline = time.monotonic() + 240
+            while (
+                time.monotonic() < warm_deadline and len(bulk_ttfts) < 2
+            ):
+                time.sleep(0.5)
+            warm_bulk = len(bulk_ttfts)
+            inter = threading.Thread(target=inter_loop, daemon=True)
+            inter.start()
+            # measurement window: interactive arrivals against a
+            # saturating bulk prefill stream
+            time.sleep(20.0)
+            stop.set()
+            inter.join(timeout=120)
+            for t in bulk_threads:
+                t.join(timeout=120)
+            measured_bulk = bulk_ttfts[warm_bulk:]
+            return {
+                "chunked": chunked,
+                "interactive_ttft_p50_s": _p(inter_ttfts, 0.50),
+                "interactive_ttft_p95_s": _p(inter_ttfts, 0.95),
+                "interactive_probes": len(inter_ttfts),
+                "bulk_ttft_p50_s": _p(measured_bulk, 0.50),
+                "bulk_ttft_p95_s": _p(measured_bulk, 0.95),
+                "bulk_completions": len(measured_bulk),
+                "prefill_tokens_per_sec": _metric(
+                    addr, "prefill_tokens_per_sec"
+                ),
+                "prefill_chunks_total": _metric(
+                    addr, "prefill_chunks_total"
+                ),
+                "prefill_chunk_preemptions_total": _metric(
+                    addr, "prefill_chunk_preemptions_total"
+                ),
+                "ttft_bounded": _metric(addr, "ttft_bounded"),
+            }
+        finally:
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+    cells = {}
+    for name, chunked in (("chunked", True), ("unchunked", False)):
+        try:
+            cells[name] = run_cell(chunked)
+        except Exception as e:  # per-cell graceful degradation
+            cells[name] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+    on = cells.get("chunked", {})
+    off = cells.get("unchunked", {})
+    speedup = None
+    if (
+        isinstance(on.get("interactive_ttft_p95_s"), float)
+        and isinstance(off.get("interactive_ttft_p95_s"), float)
+        and on["interactive_ttft_p95_s"] > 0
+    ):
+        speedup = round(
+            off["interactive_ttft_p95_s"] / on["interactive_ttft_p95_s"],
+            3,
+        )
+    return {
+        "configs": cells,
+        "interactive_ttft_p95_speedup": speedup,
+    }
+
+
 def _env_resilience_phase() -> dict:
     """Kill-one-of-two ENV WORKERS under the chaos harness, measured.
     Two env-service subprocesses host the countdown tool env; a wave of
@@ -1880,6 +2085,23 @@ def main():
         emit_phase(
             "weightpush",
             {"configs": {}, "error": extra["weightpush_error"]},
+        )
+
+    # --- chunked-prefill TTFT A/B sub-phase (r15): chunked vs
+    # unchunked under bulk saturation on two tiny-model CPU server
+    # subprocesses — per-class TTFT p50/p95, prefill tok/s, and the
+    # chunk counters per cell (the acceptance shape: chunked
+    # interactive TTFT p95 bounded by ~one chunk and measurably below
+    # the unchunked cell). Same graceful-degradation rule ---
+    try:
+        ttft_ab = _ttft_ab_phase()
+        extra["ttft_ab"] = ttft_ab
+        emit_phase("ttft_ab", ttft_ab)
+    except Exception as e:
+        extra["ttft_ab_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "ttft_ab",
+            {"configs": {}, "error": extra["ttft_ab_error"]},
         )
 
     # --- env-worker-kill resilience sub-phase: two env-service worker

@@ -32,6 +32,19 @@ def main():
     if os.environ.get("AREAL_WORKER_TRACE"):
         # request-lifecycle spans for stitched cross-process trace tests
         gcfg.tracing.enabled = True
+    chunk = os.environ.get("AREAL_WORKER_CHUNKED_PREFILL", "")
+    if chunk:
+        # chunked prefill (r15): "1" = on with the auto budget, any
+        # other value = the per-dispatch token budget
+        gcfg.chunked_prefill = True
+        if chunk != "1":
+            gcfg.prefill_chunk_tokens = int(chunk)
+    if os.environ.get("AREAL_WORKER_MAX_MODEL_LEN"):
+        # the chunked-prefill TTFT A/B needs prompts much longer than
+        # the default 64-token shell (and pages small enough to split)
+        gcfg.max_model_len = int(os.environ["AREAL_WORKER_MAX_MODEL_LEN"])
+    if os.environ.get("AREAL_WORKER_PAGE_SIZE"):
+        gcfg.page_size = int(os.environ["AREAL_WORKER_PAGE_SIZE"])
     if os.environ.get("AREAL_WORKER_READY_QUIET"):
         # readiness tests/bench shrink the warming→ready quiet window
         gcfg.goodput.ready_quiet_s = float(

@@ -614,6 +614,93 @@ def test_interactive_shed_only_past_double_bound(traffic_engine):
         assert f.result(timeout=120)["output_ids"]
 
 
+def test_deadline_interactive_lands_mid_bulk_prefill_chunked():
+    """Deadline preemption x chunked prefill (r15): an interactive
+    deadline request submitted while a LONG bulk prompt is still
+    mid-prefill gets its first token after ~one chunk's worth of
+    waiting (the bulk prefill yields at a chunk boundary instead of
+    holding the engine for the whole prompt), and the interrupted bulk
+    prompt still completes with output bit-identical to an undisturbed
+    unchunked run."""
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    import numpy as np
+
+    rng = np.random.default_rng(77)
+    bulk_prompt = rng.integers(1, 100, size=200).tolist()
+
+    # both engines use test_chunked_prefill's race geometry VERBATIM,
+    # so every program here is already warm in the process jit cache
+    # (tier-1 wall-time guard)
+    from areal_tpu.api.cli_args import SpecConfig
+
+    race = dict(
+        dtype="float32", prefill_chunk=16, admit_hold_s=0.0,
+        page_size=16, max_num_seqs=8, max_model_len=256, num_pages=24,
+        decode_chunk=4, decode_pipeline=2, decode_compact=True,
+        decode_compact_min_rows=2, decode_compact_hysteresis=1,
+        admit_wave=4, prefix_reuse_min=4,
+        spec=SpecConfig(
+            enabled=True, max_draft=3, ngram_min=2, ngram_max=3,
+            accept_floor=0.0,
+        ),
+    )
+    ref = GenerationEngine(
+        JaxGenConfig(**race), model_config=cfg, params=params
+    ).start()
+    try:
+        ref_out = ref.generate({
+            "input_ids": bulk_prompt,
+            "sampling_params": {"max_new_tokens": 6, "greedy": True},
+        }, timeout=600)
+    finally:
+        ref.stop()
+
+    eng = GenerationEngine(
+        JaxGenConfig(
+            **race, chunked_prefill=True, prefill_chunk_tokens=32,
+            deadline_margin_s=10.0,
+        ),
+        model_config=cfg, params=params,
+    ).start()
+    try:
+        bulk = eng.submit({
+            "rid": "bulk", "priority": "bulk",
+            "input_ids": bulk_prompt,
+            "sampling_params": {"max_new_tokens": 6, "greedy": True},
+        })
+        # wait until the bulk prefill is genuinely mid-flight (some
+        # chunks committed, more to go)
+        deadline = time.monotonic() + 120
+        while (
+            eng.prefill_chunks_total < 2 and time.monotonic() < deadline
+        ):
+            time.sleep(0.001)
+        assert eng.prefill_chunks_total >= 2
+        assert bulk.done() is False
+        inter = eng.submit({
+            "rid": "inter", "priority": "interactive",
+            "deadline_s": 5.0, "input_ids": [7, 8, 9],
+            "sampling_params": {"max_new_tokens": 2, "greedy": True},
+        })
+        inter_out = inter.result(timeout=120)
+        bulk_out = bulk.result(timeout=600)
+        m = eng.metrics()
+    finally:
+        eng.stop()
+    assert len(inter_out["output_ids"]) == 2
+    # first token within ~one chunk budget of engine work: its TTFT is
+    # far below the bulk prompt's (which carries the whole chunked
+    # prefill), and the deadline-pressed waiter deferred bulk chunks
+    assert (
+        inter_out["meta_info"]["ttft"] < bulk_out["meta_info"]["ttft"]
+    )
+    assert m["prefill_chunk_preemptions_total"] >= 1
+    # the interrupted bulk prompt lost no work and no exactness
+    assert bulk_out["output_ids"] == ref_out["output_ids"]
+    assert m["prefill_chunks_total"] >= 3
+
+
 def test_resume_storm_does_not_shed_interactive(traffic_engine):
     """Post-pause resume storms are bound-exempt bulk traffic; they
     must not inflate the queue count that sheds the INTERACTIVE class

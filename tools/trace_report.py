@@ -59,6 +59,14 @@ ledger snapshots and/or ``compile_events.jsonl``) and prints each
 role's wall-time bucket breakdown (fractions sum to 1.0 — the direct
 answer to "what did every second of trainer/server wall time buy") plus
 the per-shape XLA compile bill, most expensive shape first.
+
+``--ttft`` switches to the chunked-prefill TTFT report (r15): the
+per-class TTFT p50/p95 table from a ``/metrics`` snapshot's native
+``ttft_seconds`` histograms (r11 — the durable latency source), and the
+chunks-per-prompt histogram from chunk-stamped ``prefill`` spans. Pass
+``--require-max-ttft <s>`` (optionally ``--ttft-class``) to turn a
+blown TTFT bound into exit 1 — the bounded-interactive-TTFT CI gate,
+mirroring ``--require-max-lead``.
 """
 
 import argparse
@@ -463,6 +471,164 @@ def format_slo(sl: Dict[str, Any]) -> str:
             rows += ["", f"{title:<20}{'count':>7}"]
             for k, v in table.items():
                 rows.append(f"{k:<20}{v:>7}")
+    return "\n".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# Chunked-prefill TTFT report (r15)
+# ---------------------------------------------------------------------------
+_TTFT_SERIES = "ttft_seconds"
+
+
+def _parse_ttft_histograms(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse the engine's native per-class ``ttft_seconds`` histograms
+    out of a Prometheus ``/metrics`` snapshot (r11 format:
+    ``..._ttft_seconds_bucket{sched_class="x",le="..."} n`` plus
+    ``_sum``/``_count``). Returns {class: {buckets, sum, count}} with
+    ``buckets`` as sorted ``(le, cumulative)`` pairs ending at +Inf."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, val_s = line.rsplit(None, 1)
+            val = float(val_s)
+        except ValueError:
+            continue
+        if _TTFT_SERIES not in name_part:
+            continue
+        labels: Dict[str, str] = {}
+        base = name_part
+        if "{" in name_part and name_part.endswith("}"):
+            base, lab_s = name_part[:-1].split("{", 1)
+            for part in lab_s.split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    labels[k.strip()] = v.strip().strip('"')
+        cls = labels.get("sched_class", "?")
+        rec = out.setdefault(
+            cls, {"buckets": [], "sum": 0.0, "count": 0.0}
+        )
+        if base.endswith(f"{_TTFT_SERIES}_bucket"):
+            le_s = labels.get("le", "+Inf")
+            le = float("inf") if le_s in ("+Inf", "inf") else float(le_s)
+            rec["buckets"].append((le, val))
+        elif base.endswith(f"{_TTFT_SERIES}_sum"):
+            rec["sum"] = val
+        elif base.endswith(f"{_TTFT_SERIES}_count"):
+            rec["count"] = val
+    for rec in out.values():
+        rec["buckets"].sort(key=lambda p: p[0])
+    return {cls: rec for cls, rec in out.items() if rec["buckets"]}
+
+
+def _hist_quantile(
+    buckets: List[tuple], count: float, q: float
+) -> float:
+    """q-quantile from cumulative ``(le, cum)`` pairs: linear
+    interpolation inside the winning bucket (mirrors the engine's
+    ``Histogram.quantile``); the +Inf bucket answers its lower bound."""
+    if count <= 0 or not buckets:
+        return 0.0
+    target = q * count
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return round(prev_le, 6)
+            width = cum - prev_cum
+            frac = (target - prev_cum) / width if width > 0 else 1.0
+            return round(prev_le + frac * (le - prev_le), 6)
+        prev_le, prev_cum = le, cum
+    return round(prev_le, 6)
+
+
+def load_ttft(path: str) -> Dict[str, Any]:
+    """Load ``--ttft`` input: a Prometheus ``/metrics`` snapshot (the
+    per-class TTFT histograms) and/or a span trace (``prefill`` spans
+    with chunked-prefill ``chunk_index``/``chunk_count`` attrs). Either
+    file kind works; the report renders whatever is present."""
+    with open(path) as f:
+        text = f.read()
+    hists = _parse_ttft_histograms(text)
+    spans: List[Dict[str, Any]] = []
+    if not hists:
+        try:
+            spans = load_spans(path)
+        except (json.JSONDecodeError, KeyError):
+            spans = []
+    return {"hists": hists, "spans": spans}
+
+
+def ttft_summary(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Chunked-prefill TTFT report: per-class TTFT p50/p95 from the
+    engine's native histograms (the durable latency source — span
+    percentiles vanish with every /trace drain), plus the
+    chunks-per-prompt histogram from chunk-stamped ``prefill`` spans —
+    together the direct answer to "is interactive TTFT bounded by one
+    chunk under bulk saturation"."""
+    by_class: Dict[str, Dict[str, float]] = {}
+    for cls, rec in sorted(data.get("hists", {}).items()):
+        count = rec["count"] or (
+            rec["buckets"][-1][1] if rec["buckets"] else 0
+        )
+        by_class[cls] = {
+            "n": int(count),
+            "p50_s": _hist_quantile(rec["buckets"], count, 0.50),
+            "p95_s": _hist_quantile(rec["buckets"], count, 0.95),
+            "mean_s": (
+                round(rec["sum"] / count, 6) if count else 0.0
+            ),
+        }
+    # chunks-per-prompt: every chunk-capped dispatch and the final
+    # admission stamp a prefill span with chunk_index; a prompt's chunk
+    # count is its highest index + 1
+    per_rid: Dict[str, int] = {}
+    chunked_spans = 0
+    for s in data.get("spans", []):
+        if s.get("name") != "prefill":
+            continue
+        attrs = s.get("attrs") or {}
+        if "chunk_index" not in attrs:
+            continue
+        chunked_spans += 1
+        rid = str(s.get("rid", "?"))
+        idx = int(attrs.get("chunk_index", 0))
+        per_rid[rid] = max(per_rid.get(rid, 0), idx + 1)
+    chunk_hist: Dict[str, int] = {}
+    for n in per_rid.values():
+        key = str(n)
+        chunk_hist[key] = chunk_hist.get(key, 0) + 1
+    return {
+        "ttft_by_class": by_class,
+        "chunked_prefill_spans": chunked_spans,
+        "prompts_with_chunk_attrs": len(per_rid),
+        "chunks_per_prompt_hist": {
+            k: chunk_hist[k] for k in sorted(chunk_hist, key=int)
+        },
+        "chunks_per_prompt_max": max(per_rid.values(), default=0),
+    }
+
+
+def format_ttft(tt: Dict[str, Any]) -> str:
+    rows = [f"{'class':<14}{'n':>7}{'p50_s':>10}{'p95_s':>10}{'mean_s':>10}"]
+    for cls, st in tt["ttft_by_class"].items():
+        rows.append(
+            f"{cls:<14}{st['n']:>7}{st['p50_s']:>10.4f}"
+            f"{st['p95_s']:>10.4f}{st['mean_s']:>10.4f}"
+        )
+    if not tt["ttft_by_class"]:
+        rows.append("(no ttft histograms — pass a /metrics snapshot)")
+    rows += [
+        "",
+        f"chunk-stamped prefill spans  {tt['chunked_prefill_spans']}",
+        f"prompts with chunk attrs     {tt['prompts_with_chunk_attrs']}",
+    ]
+    if tt["chunks_per_prompt_hist"]:
+        rows += ["", f"{'chunks/prompt':<16}{'prompts':>9}"]
+        for k, v in tt["chunks_per_prompt_hist"].items():
+            rows.append(f"{k:<16}{v:>9}")
     return "\n".join(rows)
 
 
@@ -1231,7 +1397,58 @@ def main(argv=None) -> int:
         "(GET /manifest) and print the fleet rollup + anomaly table; "
         "exit 1 when no server was ever scraped",
     )
+    p.add_argument(
+        "--ttft", action="store_true",
+        help="chunked-prefill TTFT report: per-class TTFT p50/p95 from "
+        "a /metrics snapshot's native ttft_seconds histograms, and/or "
+        "the chunks-per-prompt histogram from chunk-stamped prefill "
+        "spans; exit 1 when the input carries neither",
+    )
+    p.add_argument(
+        "--require-max-ttft", type=float, default=0.0,
+        help="exit 1 when the gated class's TTFT p95 exceeds this many "
+        "seconds (or the class has no histogram) — the bounded-TTFT CI "
+        "gate (combine with --ttft; see --ttft-class)",
+    )
+    p.add_argument(
+        "--ttft-class", default="interactive",
+        help="scheduling class --require-max-ttft gates on "
+        "(default: interactive)",
+    )
     args = p.parse_args(argv)
+    if args.ttft:
+        tt = ttft_summary(load_ttft(args.trace))
+        if args.json:
+            print(json.dumps(tt, indent=2))
+        else:
+            print(format_ttft(tt))
+        if not tt["ttft_by_class"] and tt["chunked_prefill_spans"] == 0:
+            print(
+                "no ttft histograms or chunk-stamped prefill spans in "
+                "file (pass a /metrics snapshot or a chunked-engine "
+                "trace)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.require_max_ttft > 0:
+            st = tt["ttft_by_class"].get(args.ttft_class)
+            if st is None or st["n"] == 0:
+                print(
+                    f"REQUIRED {args.ttft_class} TTFT p95 <= "
+                    f"{args.require_max_ttft}s but the snapshot carries "
+                    f"no {args.ttft_class} ttft histogram",
+                    file=sys.stderr,
+                )
+                return 1
+            if st["p95_s"] > args.require_max_ttft:
+                print(
+                    f"REQUIRED {args.ttft_class} TTFT p95 <= "
+                    f"{args.require_max_ttft}s, measured {st['p95_s']}s "
+                    f"— the chunked-prefill TTFT bound is blown",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
     if args.coldstart:
         cw = coldstart_summary(load_coldstart(args.trace))
         if args.json:
