@@ -365,6 +365,24 @@ class JaxGenConfig:
     # "flat" (the r1-r8 free-time-only linear-scan registry, kept as the
     # bench A/B baseline). prefix_reuse_min=0 disables both.
     prefix_cache_mode: str = "radix"
+    # --- hierarchical KV tiers (r16, inference/kv_tiers.py) ---
+    # spill radix leaves to a host-RAM tier on eviction instead of
+    # dropping them; claims promote spilled pages back to the device
+    # pool (batched scatter) BEFORE the wave dispatches. Radix mode
+    # only. Off = strict no-op (greedy streams bit-identical, no new
+    # metric keys).
+    kv_spill: bool = False
+    # host-tier capacity in bytes (per server); LRU pages past the
+    # budget drop to disk when kv_disk_path is set, else vanish
+    host_kv_bytes: int = 1 << 30
+    # optional third tier: directory for LRU-overflow page files
+    # (empty = no disk tier)
+    kv_disk_path: str = ""
+    # cross-server prefix shipping: serve GET/POST /kv_export and
+    # accept /kv_import + /generate kv_ship_from hints, so a router
+    # affinity miss re-homes a session's committed prefix instead of
+    # re-prefilling it. Radix mode only; independent of kv_spill.
+    kv_ship: bool = False
     # --- paged KV pool (the radix/paged-cache analog) ---
     page_size: int = 256  # tokens per KV page
     # total pages in the pool; 0 = auto (full provisioning: every slot can
@@ -511,6 +529,17 @@ class JaxGenConfig:
             args.append("--no-decode-compact")
         if not config.enable_metrics:
             args.append("--disable-metrics")
+        # hierarchical KV tiers (r16): spill/ship servers must agree
+        # with the client's config or affinity misses re-prefill
+        if config.kv_spill:
+            args += [
+                "--kv-spill",
+                f"--host-kv-bytes={config.host_kv_bytes}",
+            ]
+            if config.kv_disk_path:
+                args.append(f"--kv-disk-path={config.kv_disk_path}")
+        if config.kv_ship:
+            args.append("--kv-ship")
         args += [
             f"--prefix-cache-mode={config.prefix_cache_mode}",
             f"--prefix-reuse-min={config.prefix_reuse_min}",
@@ -811,6 +840,12 @@ class TrafficConfig:
     down_consecutive: int = 6
     # minimum seconds between scaling actions (either direction)
     cooldown_s: float = 30.0
+    # cross-server prefix shipping (r16): when a qid's affine server
+    # dies or is rebalanced away, attach the previous owner's address
+    # to the fresh assignment (kv_ship_from) so the replacement server
+    # fetches the session's committed prefix over /kv_export instead of
+    # re-prefilling it. Requires --kv-ship on the target servers.
+    kv_ship: bool = False
 
 
 @dataclasses.dataclass

@@ -118,6 +118,10 @@ class RemoteInferenceEngine(InferenceEngine):
         # config.router_addr is set): the stickiness key its
         # previous_server fast path checks against
         self._router_version = -1
+        # rid → previous-owner address from the router's kv_ship_from
+        # hint (r16): consumed by the NEXT /generate payload for that
+        # rid so the fresh server prefix-fetches before admission
+        self._ship_hints: Dict[str, str] = {}
         self._lock = threading.Lock()
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
         self.workflow_executor: Optional[WorkflowExecutor] = None
@@ -561,6 +565,8 @@ class RemoteInferenceEngine(InferenceEngine):
             self._router_version = int(
                 out.get("version", self._router_version)
             )
+            if out.get("kv_ship_from"):
+                self._ship_hints[req.rid] = str(out["kv_ship_from"])
             self._rid_to_address[req.rid] = addr
             self._rid_to_address.move_to_end(req.rid)
             while len(self._rid_to_address) > 16384:
@@ -685,6 +691,13 @@ class RemoteInferenceEngine(InferenceEngine):
                         "max_new_tokens": ask,
                     },
                 }
+                with self._lock:
+                    ship_from = self._ship_hints.pop(req.rid, None)
+                if ship_from and ship_from != server:
+                    # router affinity-miss hint (r16): the target server
+                    # fetches this session's committed prefix from its
+                    # previous owner before admitting the request
+                    payload["kv_ship_from"] = ship_from
                 deadline_left = (
                     deadline_at - time.monotonic()
                     if deadline_at is not None

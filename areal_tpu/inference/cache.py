@@ -242,9 +242,16 @@ class _RadixNode:
     content (== page_size for full/interior nodes; shorter only for a
     tail leaf, whose owner may still be decoding into the same physical
     page — tails are therefore claimable only by COPY, never by share).
-    Children are keyed by their first token for O(1) descent."""
+    Children are keyed by their first token for O(1) descent.
 
-    __slots__ = ("page", "tokens", "children", "parent", "stamp")
+    Tier states (r16, only when a KvTierManager is attached):
+    ``page`` set = RESIDENT (one tree reference on the device page);
+    ``page`` None + ``spill`` set = SPILLED (content lives host-side);
+    ``page`` None + ``spill`` None on a non-root node = a hole (the host
+    tier dropped the copy — a claim reaching it stops and re-prefills,
+    and a later publish of the same block heals it in place)."""
+
+    __slots__ = ("page", "tokens", "children", "parent", "stamp", "spill")
 
     def __init__(self, page: Optional[int], tokens: np.ndarray, parent):
         self.page = page
@@ -252,6 +259,7 @@ class _RadixNode:
         self.children: Dict[int, List["_RadixNode"]] = {}
         self.parent = parent
         self.stamp = 0
+        self.spill = None
 
 
 class RadixPrefixCache:
@@ -291,7 +299,16 @@ class RadixPrefixCache:
         self.grain = max(1, grain)
         self.root = _RadixNode(None, np.empty(0, np.int32), None)
         self.node_count = 0
+        self.resident_count = 0  # nodes holding a device page reference
         self._clock = 0
+        # hierarchical KV tiers (r16): None = classic drop-eviction; a
+        # KvTierManager turns eviction into demotion and claims into
+        # promotions (attach_tiers)
+        self._tiers = None
+        # nodes on an in-progress claim descent: a promotion-triggered
+        # nested eviction must never demote them out from under the
+        # claim (their pages are shared only AFTER the descent)
+        self._protect: set = set()
         # lifetime counters (engine /metrics)
         self.claims = 0
         self.hits = 0
@@ -299,13 +316,19 @@ class RadixPrefixCache:
         self.inserted_pages = 0
         self.evicted_pages = 0
 
+    def attach_tiers(self, tiers) -> None:
+        """Attach a ``kv_tiers.KvTierManager``: eviction demotes leaves
+        host-side and claim descents promote spilled nodes back."""
+        self._tiers = tiers
+
     def __len__(self) -> int:
         return self.node_count
 
     @property
     def pages(self) -> int:
-        """Pool pages the tree holds a reference on (== nodes)."""
-        return self.node_count
+        """Pool pages the tree holds a reference on (== resident
+        nodes; spilled nodes keep their tokens but no device page)."""
+        return self.resident_count
 
     # -- internals -----------------------------------------------------
     def _touch(self, node: _RadixNode) -> None:
@@ -323,6 +346,7 @@ class RadixPrefixCache:
         parent.children.setdefault(int(tokens[0]), []).append(child)
         pm.share([page])
         self.node_count += 1
+        self.resident_count += 1
         self._touch(child)
         return child
 
@@ -337,7 +361,13 @@ class RadixPrefixCache:
         if not sibs:
             del node.parent.children[key]
         node.parent = None
-        pm.release([node.page])
+        if self._tiers is not None:
+            # un-queue any pending promotion (its garbage page is the
+            # reference released below) and drop the host/disk copy
+            self._tiers.forget(node)
+        if node.page is not None:
+            pm.release([node.page])
+            self.resident_count -= 1
         self.node_count -= 1
 
     # -- publish / add -------------------------------------------------
@@ -383,6 +413,16 @@ class RadixPrefixCache:
                     upgrade.tokens = block.copy()
                     nxt = upgrade
                 if nxt is not None:
+                    if nxt.page is None and self._tiers is not None:
+                        # heal a SPILLED/dropped node in place: adopt
+                        # the publisher's page (fresh reference) — a
+                        # free re-promotion, the host/disk copy is now
+                        # redundant
+                        pm.share([page])
+                        nxt.page = page
+                        self._tiers.forget(nxt)
+                        self.resident_count += 1
+                        inserted += 1
                     self._touch(nxt)
                     node = nxt
                     depth += bs
@@ -476,15 +516,109 @@ class RadixPrefixCache:
           ``grain`` (row-aligned resume, see class docstring).
         """
         self.claims += 1
+        promoted = 0
+        try:
+            if self.min_match <= 0 or self.node_count == 0:
+                return [], 0, None, 0
+            arr = np.asarray(prompt, np.int32)
+            limit = len(arr) - 1
+            bs = self.page_size
+            node = self.root
+            path: List[_RadixNode] = []
+            depth = 0
+            while depth + bs <= limit:
+                block = arr[depth : depth + bs]
+                nxt = None
+                for child in self._children(node, block[0]):
+                    if len(child.tokens) == bs and np.array_equal(
+                        child.tokens, block
+                    ):
+                        nxt = child
+                        break
+                if nxt is None:
+                    break
+                if nxt.page is None:
+                    # SPILLED node on the match path: promote it back
+                    # into a fresh device page NOW (the engine flushes
+                    # the queued host→device scatter before this wave
+                    # dispatches). A hole or a dry pool ends the match
+                    # — the suffix re-prefills.
+                    if self._tiers is None or not self._promote(pm, nxt):
+                        break
+                    promoted += 1
+                self._protect.add(id(nxt))
+                path.append(nxt)
+                node = nxt
+                depth += bs
+            cow_node: Optional[_RadixNode] = None
+            cow_len = 0
+            if allow_cow and depth < limit:
+                rest = arr[depth:limit]
+                for child in self._children(node, rest[0]):
+                    if child.page is None:
+                        # COW sources must be resident: the device copy
+                        # reads the page this dispatch
+                        continue
+                    n = min(len(child.tokens), len(rest))
+                    eq = child.tokens[:n] == rest[:n]
+                    m = n if eq.all() else int(np.argmin(eq))
+                    m = (m // self.grain) * self.grain
+                    if m > cow_len:
+                        cow_len, cow_node = m, child
+                if cow_len <= 0:
+                    cow_node = None
+            total = depth + cow_len
+            if total < max(self.min_match, 1):
+                return [], 0, None, 0
+            self.hits += 1
+            pages = [nd.page for nd in path]
+            pm.share(pages)
+            for nd in path:
+                self._touch(nd)
+            if cow_node is not None:
+                pm.share([cow_node.page])
+                self._touch(cow_node)
+                self.cow_claims += 1
+                return pages, total, cow_node.page, cow_len
+            return pages, total, None, 0
+        finally:
+            self._protect.clear()
+            if self._tiers is not None:
+                self._tiers.note_claim(promoted)
+
+    def _promote(self, pm: PageManager, node: _RadixNode) -> bool:
+        """Bring a SPILLED node back device-side: allocate a fresh page
+        (evicting/demoting colder leaves if the pool is dry — the claim
+        path itself is protected) and queue the host copy for the
+        engine's batched pre-dispatch scatter. The new page's single
+        reference is the tree's."""
+        if node.spill is None:
+            return False  # hole: the host tier dropped the copy
+        if pm.n_free < 1:
+            self.evict(pm, 1)
+            if pm.n_free < 1:
+                return False
+        page = pm.alloc(1)[0]
+        node.page = page
+        self.resident_count += 1
+        self._tiers.begin_promotion(node, page)
+        self._touch(node)
+        return True
+
+    def match_pages(self, prompt: Sequence[int]) -> List[_RadixNode]:
+        """Full-page descent WITHOUT refcount or LRU effects: the
+        leading contiguous run of nodes (resident or spilled) caching
+        ``prompt`` — the kv-shipping export walk. Stops at a hole (no
+        data to ship) and allows matching the full prompt (the importer
+        side's claim re-applies the one-uncached-token rule)."""
         if self.min_match <= 0 or self.node_count == 0:
-            return [], 0, None, 0
+            return []
         arr = np.asarray(prompt, np.int32)
-        limit = len(arr) - 1
         bs = self.page_size
         node = self.root
-        path: List[_RadixNode] = []
+        out: List[_RadixNode] = []
         depth = 0
-        while depth + bs <= limit:
+        while depth + bs <= len(arr):
             block = arr[depth : depth + bs]
             nxt = None
             for child in self._children(node, block[0]):
@@ -493,38 +627,12 @@ class RadixPrefixCache:
                 ):
                     nxt = child
                     break
-            if nxt is None:
+            if nxt is None or (nxt.page is None and nxt.spill is None):
                 break
-            path.append(nxt)
+            out.append(nxt)
             node = nxt
             depth += bs
-        cow_node: Optional[_RadixNode] = None
-        cow_len = 0
-        if allow_cow and depth < limit:
-            rest = arr[depth:limit]
-            for child in self._children(node, rest[0]):
-                n = min(len(child.tokens), len(rest))
-                eq = child.tokens[:n] == rest[:n]
-                m = n if eq.all() else int(np.argmin(eq))
-                m = (m // self.grain) * self.grain
-                if m > cow_len:
-                    cow_len, cow_node = m, child
-            if cow_len <= 0:
-                cow_node = None
-        total = depth + cow_len
-        if total < max(self.min_match, 1):
-            return [], 0, None, 0
-        self.hits += 1
-        pages = [nd.page for nd in path]
-        pm.share(pages)
-        for nd in path:
-            self._touch(nd)
-        if cow_node is not None:
-            pm.share([cow_node.page])
-            self._touch(cow_node)
-            self.cow_claims += 1
-            return pages, total, cow_node.page, cow_len
-        return pages, total, None, 0
+        return out
 
     # -- eviction / flush ---------------------------------------------
     def evict(self, pm: PageManager, pages_needed: int) -> int:
@@ -537,6 +645,8 @@ class RadixPrefixCache:
         evicted = 0
         if self.node_count == 0 or pm.n_free >= pages_needed:
             return 0
+        if self._tiers is not None:
+            return self._evict_demote(pm, pages_needed)
         heap: List[tuple] = []
         stack = [self.root]
         while stack:
@@ -557,14 +667,115 @@ class RadixPrefixCache:
         self.evicted_pages += evicted
         return evicted
 
-    def flush(self, pm: PageManager) -> None:
-        """Drop everything (weight update → cached KV is stale)."""
+    def _demotion_victims(
+        self, pm: PageManager, pages_needed: int, cap: int = 64
+    ) -> List[_RadixNode]:
+        """LRU-first demotion candidates: RESIDENT nodes none of whose
+        children are resident (their subtree already left the device, so
+        demoting them keeps the promotion chain claim-walkable top-down).
+        Also removes childless holes opportunistically (free hygiene —
+        no device page involved). Claim-protected nodes are excluded:
+        a promotion's nested eviction must not eat the descent path."""
+        import heapq
+
+        heap: List[tuple] = []
+        holes: List[_RadixNode] = []
         stack = [self.root]
         while stack:
             nd = stack.pop()
             for lst in nd.children.values():
                 stack.extend(lst)
-            if nd is not self.root:
+            if nd is self.root or id(nd) in self._protect:
+                continue
+            if nd.page is None:
+                if nd.spill is None and not nd.children:
+                    holes.append(nd)
+                continue
+            if all(
+                c.page is None
+                for lst in nd.children.values()
+                for c in lst
+            ):
+                heapq.heappush(heap, (nd.stamp, id(nd), nd))
+        for nd in holes:
+            if nd.parent is not None:
+                self._remove_leaf(pm, nd)
+        victims: List[_RadixNode] = []
+        projected = pm.n_free
+        while heap and projected < pages_needed and len(victims) < cap:
+            _, _, nd = heapq.heappop(heap)
+            victims.append(nd)
+            if pm.refcount[nd.page] == 1:
+                projected += 1
+        return victims
+
+    def _evict_demote(self, pm: PageManager, pages_needed: int) -> int:
+        """Tiered eviction: demote LRU leaves host-side instead of
+        dropping them. Runs in rounds (each round one batched
+        device→host gather) so a demoted layer's parents become the
+        next round's candidates. Partial tails never spill (they are
+        COW-only and their owner may still be writing the page) — they
+        are removed as before. Returns pages that left the device."""
+        bs = self.page_size
+        evicted = 0
+        while pm.n_free < pages_needed:
+            victims = self._demotion_victims(pm, pages_needed)
+            if not victims:
+                break
+            progress = 0
+            to_demote: List[tuple] = []
+            for nd in victims:
+                if len(nd.tokens) < bs:
+                    # partial tail: terminal by construction → a leaf
+                    self._remove_leaf(pm, nd)
+                    progress += 1
+                elif self._tiers.has_pending(nd):
+                    # an unflushed promotion: the page holds garbage
+                    # until the scatter, so it can only be CANCELED
+                    # (host copy re-filed for free), never snapshotted.
+                    # And only when the tree is its sole holder — a
+                    # claimant still referencing it is waiting on the
+                    # flush to make the page real; canceling would hand
+                    # it garbage (and free no page anyway).
+                    if pm.refcount[nd.page] > 1:
+                        continue
+                    page = self._tiers.cancel_promotion(nd)
+                    nd.page = None
+                    self.resident_count -= 1
+                    pm.release([page])
+                    progress += 1
+                elif self._tiers.can_store():
+                    to_demote.append((nd, nd.page))
+                elif not nd.children:
+                    # degenerate capacity (one page exceeds the whole
+                    # host budget, no disk): classic drop-eviction
+                    self._remove_leaf(pm, nd)
+                    progress += 1
+            if to_demote:
+                self._tiers.demote(to_demote)
+                for nd, page in to_demote:
+                    nd.page = None
+                    self.resident_count -= 1
+                    pm.release([page])
+                progress += len(to_demote)
+            evicted += progress
+            if progress == 0:
+                break
+        self.evicted_pages += evicted
+        return evicted
+
+    def flush(self, pm: PageManager) -> None:
+        """Drop everything (weight update → cached KV is stale),
+        spill tiers included — host/disk replicas hold old-policy KV."""
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            for lst in nd.children.values():
+                stack.extend(lst)
+            if nd is not self.root and nd.page is not None:
                 pm.release([nd.page])
+        if self._tiers is not None:
+            self._tiers.flush()
         self.root = _RadixNode(None, np.empty(0, np.int32), None)
         self.node_count = 0
+        self.resident_count = 0

@@ -151,6 +151,11 @@ class _Request:
     chunk_stalls: int = 0
     chunk_deferred: bool = False
     first_dispatch_time: Optional[float] = None
+    # prompt tokens served from the prefix cache at the LAST install
+    # (sibling fan-out counts the whole shared prompt) — surfaced in
+    # the result's meta_info so clients (and the cross-server shipping
+    # e2e test) can observe cache effectiveness per request
+    cached_tokens: int = 0
     # weight version this request decodes under (and whose KV its pages
     # hold) — stamped at admission, left behind by a pin-policy flip so
     # the request drains on the buffer that prefilled it (the store
@@ -433,6 +438,32 @@ class GenerationEngine:
         else:
             self.registry = PrefixRegistry(bs, config.prefix_reuse_min)
         self._radix = cache_mode == "radix"
+        # --- hierarchical KV tiers (r16): host-RAM (optionally disk)
+        # spill store under the radix tree. Strictly no-op when off:
+        # no manager, no tree hook, no metric keys.
+        self._kv_tiers = None
+        if getattr(config, "kv_spill", False):
+            if not self._radix:
+                raise ValueError(
+                    "kv_spill requires prefix_cache_mode='radix' "
+                    "(the spill tier lives under the radix tree)"
+                )
+            from areal_tpu.inference.kv_tiers import KvTierManager
+
+            self._kv_tiers = KvTierManager(
+                host_bytes=int(getattr(config, "host_kv_bytes", 1 << 30)),
+                gather_fn=self._gather_pages_host,
+                disk_path=getattr(config, "kv_disk_path", "") or "",
+            )
+            self.registry.attach_tiers(self._kv_tiers)
+        # cross-server prefix shipping (r16): /kv_export service + the
+        # /generate-side fetch from a session's previous owner
+        self._kv_ship = bool(getattr(config, "kv_ship", False))
+        if self._kv_ship and not self._radix:
+            raise ValueError(
+                "kv_ship requires prefix_cache_mode='radix' (shipped "
+                "pages enter through the radix publish/claim contract)"
+            )
         s = config.max_num_seqs
         self._free_slots: List[int] = list(range(s - 1, -1, -1))
         self._tables = np.full(
@@ -623,6 +654,16 @@ class GenerationEngine:
         self.total_prompt_tokens = 0
         self.total_cached_prompt_tokens = 0  # prompt tokens served from KV reuse
         self.total_cow_copies = 0  # COW page copies for mid-page claims
+        # r16 KV tiers: cached prompt tokens that came back from the
+        # HOST tier (a subset of total_cached_prompt_tokens — the rest
+        # were device-resident hits); ship counters cover the
+        # cross-server /kv_export import/export traffic
+        self.total_host_cached_tokens = 0
+        self.kv_ship_exports_total = 0
+        self.kv_ship_imports_total = 0
+        self.kv_ship_pages_out_total = 0
+        self.kv_ship_pages_in_total = 0
+        self.kv_ship_failures_total = 0
         self.total_requests = 0
         self.total_aborted = 0
         self.total_preemptions = 0
@@ -1224,6 +1265,45 @@ class GenerationEngine:
                 ),
                 spec_accept_rate_ewma=round(gate.ewma or 0.0, 4),
             )
+        if self._kv_tiers is not None:
+            # KV tier surface (r16): present ONLY with kv_spill on —
+            # spill off is a strict no-op, metric keys included
+            t = self._kv_tiers
+            m.update(
+                kv_tier_host_pages=t.host_pages,
+                kv_tier_host_bytes=t.host_bytes_used,
+                kv_tier_host_capacity_bytes=t.host_capacity,
+                kv_tier_pending_pages=t.pending_pages,
+                kv_tier_spilled_pages_total=t.spilled_pages_total,
+                kv_tier_spilled_bytes_total=t.spilled_bytes_total,
+                kv_tier_promoted_pages_total=t.promoted_pages_total,
+                kv_tier_promoted_bytes_total=t.promoted_bytes_total,
+                kv_tier_dropped_pages_total=t.dropped_pages_total,
+                kv_tier_dropped_bytes_total=t.dropped_bytes_total,
+                kv_tier_host_claim_hits_total=t.claims_promoted_total,
+                # fraction of claims that touched the host tier — the
+                # "returning session saved by spill" signal
+                kv_tier_host_claim_hit_rate=round(
+                    t.claims_promoted_total
+                    / max(1, getattr(self.registry, "claims", 0)), 4
+                ),
+                kv_tier_host_cached_tokens_total=(
+                    self.total_host_cached_tokens
+                ),
+                kv_tier_disk_pages=t.disk_pages,
+                kv_tier_disk_bytes=t.disk_bytes_used,
+                kv_tier_disk_spilled_pages_total=t.disk_spilled_pages_total,
+                kv_tier_disk_loaded_pages_total=t.disk_loaded_pages_total,
+            )
+        if self._kv_ship:
+            # shipping surface (r16): present ONLY with kv_ship on
+            m.update(
+                kv_ship_exports_total=self.kv_ship_exports_total,
+                kv_ship_imports_total=self.kv_ship_imports_total,
+                kv_ship_pages_out_total=self.kv_ship_pages_out_total,
+                kv_ship_pages_in_total=self.kv_ship_pages_in_total,
+                kv_ship_failures_total=self.kv_ship_failures_total,
+            )
         return m
 
     # ------------------------------------------------------------------
@@ -1524,6 +1604,10 @@ class GenerationEngine:
                         else self.model_version + 1
                     )
                     done.set_result(self.model_version)
+                elif cmd == "kv_export":
+                    done.set_result(self._kv_export(arg))
+                elif cmd == "kv_import":
+                    done.set_result(self._kv_import(*arg))
                 else:  # pragma: no cover
                     done.set_exception(ValueError(f"unknown command {cmd}"))
                 if cmd.startswith("update_weights"):
@@ -1545,6 +1629,223 @@ class GenerationEngine:
             self.registry.evict(self.pm, n)
             pages = self.pm.alloc(n)
         return pages
+
+    # ------------------------------------------------------------------
+    # Hierarchical KV tiers (r16): demotion gather / promotion scatter,
+    # and the cross-server prefix shipping export/import pair
+    # ------------------------------------------------------------------
+    def _gather_pages_host(self, pages: List[int]):
+        """Blocking device→host read of ``pages``: [L, Hp, n, rows,
+        lane] per tensor in the pool's native layout. The device_get
+        orders after every dispatched write to those pages, so demotion
+        snapshots and exports always see committed content."""
+        n = len(pages)
+        pad = data_utils.next_bucket_size(n, 8)
+        idx = np.zeros(pad, np.int32)  # padding reads the trash page
+        idx[:n] = pages
+        idx_dev = jnp.asarray(idx)
+        with goodput.dispatch_scope(
+            self.compiles, "kv_gather", precompile_lib.kv_gather_sig(pad)
+        ):
+            k, v = model_runner.gather_pages(self.cache, idx_dev)
+        k = np.asarray(jax.device_get(k))[:, :, :n]
+        v = np.asarray(jax.device_get(v))[:, :, :n]
+        return k, v
+
+    def _scatter_pages(self, pages: List[int], k_pool, v_pool) -> None:
+        """One batched host→device write of pool-layout page data into
+        ``pages`` (promotion flush and shipping import share it)."""
+        n = len(pages)
+        pad = data_utils.next_bucket_size(n, 8)
+        num_pages = self.cache_config.num_pages
+        nl, hp, _, rows, lane = self.cache["k"].shape
+        dt = self.cache["k"].dtype
+        dst = np.full(pad, num_pages, np.int32)
+        dst[:n] = pages
+        k_np = np.zeros((nl, hp, pad, rows, lane), dt)
+        v_np = np.zeros_like(k_np)
+        k_np[:, :, :n] = k_pool
+        v_np[:, :, :n] = v_pool
+        dst_dev = jnp.asarray(dst)
+        k_dev, v_dev = jnp.asarray(k_np), jnp.asarray(v_np)
+        with goodput.dispatch_scope(
+            self.compiles, "kv_scatter", precompile_lib.kv_scatter_sig(pad)
+        ):
+            self.cache = model_runner.scatter_pages(
+                self.cache, dst_dev, k_dev, v_dev
+            )
+
+    def _flush_kv_promotions(self) -> None:
+        """Dispatch every queued spill-tier promotion as one batched
+        scatter. MUST run after a claim loop and before any dispatch
+        that could read the promoted pages (the wave prefill and the
+        COW copies attend through them); flushing when the wave later
+        defers is harmless — the pages are tree-owned and resident."""
+        if self._kv_tiers is None:
+            return
+        pend = self._kv_tiers.drain_pending()
+        if not pend:
+            return
+        nl, hp, _, rows, lane = self.cache["k"].shape
+        dt = self.cache["k"].dtype
+        n = len(pend)
+        k_pool = np.zeros((nl, hp, n, rows, lane), dt)
+        v_pool = np.zeros_like(k_pool)
+        for i, (_page, sp) in enumerate(pend):
+            k_pool[:, :, i] = sp.k
+            v_pool[:, :, i] = sp.v
+        self._scatter_pages([p for p, _ in pend], k_pool, v_pool)
+
+    def _kv_export(self, tokens: List[int]) -> Dict[str, Any]:
+        """Loop-thread kv_export command: the longest committed
+        full-page prefix of ``tokens``, in the layout-independent
+        canonical form ([L, Hkv, T, D] token-major) shipping needs.
+        Reads replicas only — no refcount or LRU effects; spilled pages
+        are served straight from the host/disk tier."""
+        from areal_tpu.inference import kv_tiers as kv_tiers_lib
+
+        bs = self.cache_config.page_size
+        out: Dict[str, Any] = {
+            "pages": 0,
+            "tokens_matched": 0,
+            "page_size": bs,
+            "model_version": self.model_version,
+        }
+        if not self._radix:
+            return out
+        # promoted-but-unflushed pages hold garbage on device and truth
+        # in the pending queue — flush first so resident means readable
+        self._flush_kv_promotions()
+        nodes = self.registry.match_pages(np.asarray(tokens, np.int32))
+        if not nodes:
+            return out
+        nl, hp, _, rows, lane = self.cache["k"].shape
+        dt = self.cache["k"].dtype
+        n = len(nodes)
+        k_all = np.zeros((nl, hp, n, rows, lane), dt)
+        v_all = np.zeros_like(k_all)
+        res_idx = [i for i, nd in enumerate(nodes) if nd.page is not None]
+        if res_idx:
+            k_res, v_res = self._gather_pages_host(
+                [nodes[i].page for i in res_idx]
+            )
+            for j, i in enumerate(res_idx):
+                k_all[:, :, i] = k_res[:, :, j]
+                v_all[:, :, i] = v_res[:, :, j]
+        for i, nd in enumerate(nodes):
+            if nd.page is None:
+                k_sp, v_sp = self._kv_tiers.export_data(nd)
+                k_all[:, :, i] = k_sp
+                v_all[:, :, i] = v_sp
+        canon_k = kv_tiers_lib.canonical_from_pool(
+            k_all, self.model_config.num_kv_heads,
+            self.model_config.head_dim,
+        )
+        canon_v = kv_tiers_lib.canonical_from_pool(
+            v_all, self.model_config.num_kv_heads,
+            self.model_config.head_dim,
+        )
+        out.update(
+            pages=n,
+            tokens_matched=n * bs,
+            dtype=canon_k.dtype.name,
+            k=canon_k,
+            v=canon_v,
+        )
+        self.kv_ship_exports_total += 1
+        self.kv_ship_pages_out_total += n
+        return out
+
+    def _kv_import(
+        self, tokens: List[int], k, v, src_version: Optional[int]
+    ) -> int:
+        """Loop-thread kv_import command: re-pack shipped canonical
+        pages into this pool's layout, scatter them into freshly
+        allocated pages, and hand them to the radix tree as an
+        ownership transfer (``add``) — the very next claim serves them
+        like any locally-cached prefix. Soft-fails (returns 0) on
+        version/geometry mismatch or a dry pool: shipping is an
+        optimization, never a correctness dependency."""
+        from areal_tpu.inference import kv_tiers as kv_tiers_lib
+
+        if not self._radix:
+            return 0
+        if (
+            src_version is not None
+            and int(src_version) != int(self.model_version)
+        ):
+            # the exporter prefilled under different weights: its KV is
+            # another policy's cache, not ours
+            self.kv_ship_failures_total += 1
+            return 0
+        bs = self.cache_config.page_size
+        k = np.asarray(k)
+        v = np.asarray(v)
+        mc = self.model_config
+        if (
+            k.ndim != 4
+            or k.shape[0] != mc.num_layers
+            or k.shape[1] != mc.num_kv_heads
+            or k.shape[3] != mc.head_dim
+            or k.shape[2] % bs
+            or k.shape != v.shape
+        ):
+            self.kv_ship_failures_total += 1
+            return 0
+        n = min(k.shape[2] // bs, len(tokens) // bs)
+        if n <= 0:
+            return 0
+        dt = self.cache["k"].dtype
+        k_pool = kv_tiers_lib.pool_from_canonical(
+            np.ascontiguousarray(k[:, :, : n * bs]).astype(dt),
+            self.cache["k"].shape,
+        )
+        v_pool = kv_tiers_lib.pool_from_canonical(
+            np.ascontiguousarray(v[:, :, : n * bs]).astype(dt),
+            self.cache["v"].shape,
+        )
+        pages = self._alloc_pages(n)
+        if pages is None:
+            return 0  # pool dry: the turn just re-prefills
+        self._scatter_pages(pages, k_pool, v_pool)
+        # ownership transfer: the tree becomes the prefix's only holder
+        # (pages duplicating existing tree content are freed by add)
+        self.registry.add(
+            self.pm, np.asarray(tokens[: n * bs], np.int32), pages
+        )
+        self.kv_ship_imports_total += 1
+        self.kv_ship_pages_in_total += n
+        return n * bs
+
+    @property
+    def kv_ship_enabled(self) -> bool:
+        return self._kv_ship
+
+    def export_prefix(
+        self, tokens: List[int], timeout: float = 120.0
+    ) -> Dict[str, Any]:
+        """Cross-thread kv export (server /kv_export): runs on the loop
+        thread behind a pipeline drain, like every engine command."""
+        done = Future()
+        self._command_queue.put(("kv_export", list(tokens), done))
+        return done.result(timeout=timeout)
+
+    def import_prefix(
+        self,
+        tokens: List[int],
+        k,
+        v,
+        src_version: Optional[int] = None,
+        timeout: float = 120.0,
+    ) -> int:
+        """Cross-thread kv import (server /kv_import and the
+        /generate-side ship fetch). Returns tokens entered into the
+        prefix cache (0 = soft-dropped)."""
+        done = Future()
+        self._command_queue.put(
+            ("kv_import", (list(tokens), k, v, src_version), done)
+        )
+        return done.result(timeout=timeout)
 
     def _preempt_youngest(
         self,
@@ -1895,6 +2196,7 @@ class GenerationEngine:
         # gauges quadratically in chunk count (only tokens beyond the
         # request's own committed position are cross-request reuse)
         novel_offs: List[int] = []
+        host_offs: List[int] = []  # claim tokens served from host tier
         rep_pages: List[List[int]] = []
         admitted_groups: List[List[_Request]] = []
         chunk_ends: List[int] = []  # committed end (== plen: complete)
@@ -1904,6 +2206,7 @@ class GenerationEngine:
             prompt = rep.all_tokens
             plen = len(prompt)
             src = None
+            host_toks = 0
             if (
                 budget_c > 0
                 and rep.mm is None
@@ -1943,6 +2246,10 @@ class GenerationEngine:
                 shared, off, src, _cow_n = self.registry.claim_cow(
                     self.pm, prompt
                 )
+                if self._kv_tiers is not None:
+                    # pages the descent promoted from the host tier —
+                    # the hit-rate split between device and host tiers
+                    host_toks = self._kv_tiers.last_claim_promoted * bs
             else:
                 shared, off = self.registry.claim(self.pm, prompt)
             end = plen
@@ -2038,12 +2345,21 @@ class GenerationEngine:
                 rep_slots.append(self._free_slots.pop())
             offsets.append(off)
             novel_offs.append(off - min(off, rep.prefill_pos))
+            host_offs.append(min(host_toks, off))
             rep_pages.append(pages)
             admitted_groups.append(group)
             chunk_ends.append(end)
             # the deferral episode (if any) ended in a dispatch: the
             # next pressure deferral is a new episode and counts again
             rep.chunk_deferred = False
+        # flush claim-time promotions NOW, before anything downstream
+        # can read the promoted pages: the COW copy dispatch below and
+        # the wave prefill both attend through shared pages, and a page
+        # promoted this loop holds garbage until its scatter lands.
+        # Deferred/failed claims above may also have queued promotions —
+        # their pages are tree-owned and resident, so flushing them
+        # unconditionally is correct (and keeps them claimable).
+        self._flush_kv_promotions()
         if deferred:
             self._pending = deferred + self._pending
         if not admitted_groups:
@@ -2091,6 +2407,7 @@ class GenerationEngine:
         # rows whose suffix exceeds the bucket fall back to offset 0?
         # cannot happen: offset <= len(prompt)-1 and bucket >= max suffix.
         self.total_cached_prompt_tokens += sum(novel_offs)
+        self.total_host_cached_tokens += sum(host_offs)
         pf_prefix_bound = 0
         if max(offsets) > 0:
             pf_prefix_bound = min(
@@ -2274,6 +2591,11 @@ class GenerationEngine:
                         + max(1, -(-(plen - end) // budget_c)),
                         committed=end,
                         partial=1,
+                        **(
+                            {"host_cached_tokens": int(host_offs[i])}
+                            if self._kv_tiers is not None
+                            else {}
+                        ),
                     )
                 requeue.extend(group)
             if requeue:
@@ -2289,6 +2611,7 @@ class GenerationEngine:
         copy_dst: List[int] = []
         admitted: List[tuple] = []  # (req, slot, logits_row)
         adm_cached: List[int] = []  # cache-served prompt tokens per req
+        adm_host: List[int] = []  # of those, tokens from the host tier
         # (chunk_index, first_dispatch_time) captured BEFORE _install
         # resets them: the final chunk's span attrs and the queue-wait
         # end need this admission's values, not the fresh slot life's
@@ -2302,9 +2625,11 @@ class GenerationEngine:
             adm_meta.append(
                 (group[0].chunk_index, group[0].first_dispatch_time)
             )
+            group[0].cached_tokens = int(novel_offs[i])
             self._install(group[0], slot, pages, plen)
             admitted.append((group[0], slot, i))
             adm_cached.append(int(novel_offs[i]))
+            adm_host.append(int(min(host_offs[i], novel_offs[i])))
             n_full = plen // bs
             for sib in group[1:]:
                 if not self._free_slots:
@@ -2325,9 +2650,13 @@ class GenerationEngine:
                     sib_pages += tail
                 sslot = self._free_slots.pop()
                 adm_meta.append((0, None))
+                sib.cached_tokens = plen
                 self._install(sib, sslot, sib_pages, plen)
                 admitted.append((sib, sslot, i))
                 adm_cached.append(plen)
+                # siblings ride the representative's DEVICE pages —
+                # their cache hit never touches the host tier
+                adm_host.append(0)
                 self.total_cached_prompt_tokens += plen
         if copy_src:
             pad = data_utils.next_bucket_size(len(copy_src), 8)
@@ -2457,9 +2786,9 @@ class GenerationEngine:
                 (first_disp or t_pf_start) - req.submit_time
             )
         if self.tracer.enabled:
-            for (req, slot, row), ctok, (chunk_idx, first_disp) in zip(
-                admitted, adm_cached, adm_meta
-            ):
+            for (req, slot, row), ctok, htok, (
+                chunk_idx, first_disp,
+            ) in zip(admitted, adm_cached, adm_host, adm_meta):
                 self.tracer.record(
                     "queue_wait", req.rid, req.submit_time,
                     first_disp or t_pf_start,
@@ -2492,6 +2821,11 @@ class GenerationEngine:
                     # trace_report --cache aggregates these
                     cached_tokens=int(ctok),
                     **chunk_attrs,
+                    **(
+                        {"host_cached_tokens": int(htok)}
+                        if self._kv_tiers is not None
+                        else {}
+                    ),
                 )
         return True
 
@@ -3356,6 +3690,7 @@ class GenerationEngine:
                 "ttft": (req.first_token_time or now) - req.submit_time,
                 "model_version": self.model_version,
                 "preemptions": req.preemptions,
+                "cached_tokens": req.cached_tokens,
             },
         }
         if not req.future.done():

@@ -1,0 +1,362 @@
+"""Hierarchical KV tiers (r16): host-RAM spill store under the radix tree.
+
+The device page pool is tier 0. When ``RadixPrefixCache`` eviction runs
+with a ``KvTierManager`` attached, LRU leaves are *demoted* instead of
+dropped: the page's K/V rows are gathered to host memory (one batched
+device→host copy per eviction round), the device page is released, and
+the radix node stays in the tree marked SPILLED (``page=None``,
+``spill`` holds the host copy). A later claim descending through a
+spilled node *promotes* it: a fresh device page is allocated on the
+spot, the host copy is queued, and the engine flushes every queued
+promotion as one batched host→device scatter BEFORE the wave that
+claimed them dispatches — a spill-tier hit costs a copy, not a
+re-prefill, and the restored page is bit-identical to what was demoted.
+
+Tier 2 is optional disk: when the host tier overflows its byte budget
+and ``disk_path`` is set, the LRU host entry is written to a file
+instead of dropped; promotion reads it back and deletes the file. With
+no disk path, overflow drops the entry outright (the node becomes a
+hole — still in the tree, but a claim reaching it stops and the suffix
+re-prefills).
+
+Ownership contract (mirrors the tree's one-reference-per-node rule):
+
+- a RESIDENT node holds exactly one PageManager reference;
+- demotion moves the *content* host-side, then releases that reference
+  (pages still shared by live claimants survive — their refcount stays
+  positive and the host copy is a second, independent replica);
+- promotion allocates a fresh page whose single reference becomes the
+  tree's; until the engine flushes the pending scatter the device page
+  holds garbage, so any transition that would free or snapshot it first
+  CANCELS the pending promotion (``cancel_promotion`` re-files the host
+  copy; the page goes back to the allocator untouched).
+
+Cross-server shipping reuses the same canonical page form this module
+defines: ``canonical_from_pool`` / ``pool_from_canonical`` convert
+between a pool-layout page batch and the layout-independent
+``[L, Hkv, tokens, D]`` token-major form (the r9 COW grain guarantees
+token counts agree across layouts), so a prefix exported from a
+token-packed pool imports cleanly into a head-merged one.
+"""
+
+import os
+from collections import OrderedDict
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("kv_tiers")
+
+
+def resolve_np_dtype(name: str) -> np.dtype:
+    """``np.dtype`` from a dtype name, covering the ml_dtypes names
+    (``bfloat16`` et al.) numpy itself does not register."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def canonical_from_pool(
+    k: np.ndarray, num_kv_heads: int, head_dim: int
+) -> np.ndarray:
+    """Pool-layout page batch ``[L, Hp, n, rows, lane]`` → canonical
+    token-major ``[L, Hkv, n*page_size, D]`` (page-order contiguous).
+
+    Handles both pool layouts (ops/paged_attention.pool_layout): the
+    token-packed lane is ``f`` consecutive tokens of one head, the
+    head-merged lane is ``f'`` tokens × all heads, token-major — the
+    same ordering model_runner's unpack uses."""
+    nl, hp, n, rows, lane = k.shape
+    merged = hp == 1 and num_kv_heads > 1
+    if merged:
+        f = lane // (num_kv_heads * head_dim)
+        x = k.reshape(nl, n * rows * f, num_kv_heads, head_dim)
+        return np.ascontiguousarray(x.transpose(0, 2, 1, 3))
+    f = lane // head_dim
+    return np.ascontiguousarray(k.reshape(nl, hp, n * rows * f, head_dim))
+
+
+def pool_from_canonical(
+    canon: np.ndarray, pool_shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Canonical ``[L, Hkv, T, D]`` → pool-layout ``[L, Hp, n, rows,
+    lane]`` for the target pool's page geometry (``pool_shape`` is the
+    pool array's shape; ``n = T // page_size`` pages are produced)."""
+    nl, hkv, t, d = canon.shape
+    _, hp, _, rows, lane = pool_shape
+    merged = hp == 1 and hkv > 1
+    if merged:
+        f = lane // (hkv * d)
+        n = t // (rows * f)
+        x = canon.transpose(0, 2, 1, 3)  # [L, T, Hkv, D] token-major
+        return np.ascontiguousarray(
+            x.reshape(nl, 1, n, rows, f * hkv * d)
+        )
+    f = lane // d
+    n = t // (rows * f)
+    return np.ascontiguousarray(canon.reshape(nl, hkv, n, rows, f * d))
+
+
+class SpilledPage:
+    """One demoted page's host copy. ``path`` set = disk-resident (k/v
+    are None until loaded); ``nbytes`` is the in-memory footprint either
+    way (disk files hold the same bytes)."""
+
+    __slots__ = ("k", "v", "nbytes", "path", "shape", "dtype")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray):
+        self.k = k
+        self.v = v
+        self.nbytes = int(k.nbytes + v.nbytes)
+        self.path: Optional[str] = None
+        self.shape = tuple(k.shape)
+        self.dtype = k.dtype.name
+
+
+class KvTierManager:
+    """Host (and optional disk) spill tiers under one engine's radix
+    tree. Single-threaded by contract: every method runs on the engine
+    loop thread (the tree's owner); metric attributes are plain ints a
+    metrics() snapshot may read racily."""
+
+    def __init__(
+        self,
+        host_bytes: int,
+        gather_fn: Callable[[List[int]], Tuple[np.ndarray, np.ndarray]],
+        disk_path: str = "",
+    ):
+        self.host_capacity = int(host_bytes)
+        self._gather = gather_fn
+        self.disk_path = disk_path
+        if disk_path:
+            os.makedirs(disk_path, exist_ok=True)
+        # id(node) → node, insertion order ≈ LRU of demotion
+        self._host: "OrderedDict[int, object]" = OrderedDict()
+        self._disk: "OrderedDict[int, object]" = OrderedDict()
+        # pending promotions: id(node) → (node, device page) — queued at
+        # claim time, flushed by the engine as one batched scatter
+        self._pending: "OrderedDict[int, tuple]" = OrderedDict()
+        self.host_bytes_used = 0
+        self.disk_bytes_used = 0
+        self._file_seq = 0
+        self._page_nbytes = 0  # learned from the first demotion
+        # lifetime counters (engine /metrics, gated on kv_spill)
+        self.spilled_pages_total = 0
+        self.spilled_bytes_total = 0
+        self.promoted_pages_total = 0
+        self.promoted_bytes_total = 0
+        self.dropped_pages_total = 0
+        self.dropped_bytes_total = 0
+        self.disk_spilled_pages_total = 0
+        self.disk_loaded_pages_total = 0
+        self.claims_promoted_total = 0
+        self.last_claim_promoted = 0
+
+    # -- gauges ---------------------------------------------------------
+    @property
+    def host_pages(self) -> int:
+        return len(self._host)
+
+    @property
+    def disk_pages(self) -> int:
+        return len(self._disk)
+
+    @property
+    def pending_pages(self) -> int:
+        return len(self._pending)
+
+    # -- demotion -------------------------------------------------------
+    def can_store(self) -> bool:
+        """False only in the degenerate config where one page exceeds
+        the whole host budget and there is no disk tier — the tree then
+        falls back to drop-eviction."""
+        if self.disk_path:
+            return True
+        if self._page_nbytes == 0:
+            return self.host_capacity > 0
+        return self._page_nbytes <= self.host_capacity
+
+    def demote(self, items: List[tuple]) -> int:
+        """Snapshot ``[(node, page), ...]`` host-side (one batched
+        gather) and mark each node spilled. The caller releases the
+        device pages afterwards — the gather is a blocking device→host
+        read, so every in-flight write to those pages has landed."""
+        if not items:
+            return 0
+        k, v = self._gather([page for _, page in items])
+        for i, (node, _page) in enumerate(items):
+            sp = SpilledPage(
+                np.ascontiguousarray(k[:, :, i]),
+                np.ascontiguousarray(v[:, :, i]),
+            )
+            self._page_nbytes = sp.nbytes
+            node.spill = sp
+            self._host[id(node)] = node
+            self.host_bytes_used += sp.nbytes
+            self.spilled_pages_total += 1
+            self.spilled_bytes_total += sp.nbytes
+        self._enforce_host_budget()
+        return len(items)
+
+    def _enforce_host_budget(self) -> None:
+        while self.host_bytes_used > self.host_capacity and self._host:
+            _, node = self._host.popitem(last=False)
+            sp = node.spill
+            self.host_bytes_used -= sp.nbytes
+            if self.disk_path:
+                self._to_disk(node, sp)
+            else:
+                node.spill = None  # hole: the claim chain ends here
+                self.dropped_pages_total += 1
+                self.dropped_bytes_total += sp.nbytes
+
+    def _to_disk(self, node, sp: SpilledPage) -> None:
+        self._file_seq += 1
+        path = os.path.join(
+            self.disk_path, f"kvpage_{self._file_seq:08d}.npz"
+        )
+        np.savez(
+            path,
+            k=sp.k.view(np.uint8).reshape(-1),
+            v=sp.v.view(np.uint8).reshape(-1),
+        )
+        sp.path = path
+        sp.k = None
+        sp.v = None
+        self._disk[id(node)] = node
+        self.disk_bytes_used += sp.nbytes
+        self.disk_spilled_pages_total += 1
+
+    def _from_disk(self, sp: SpilledPage) -> None:
+        dt = resolve_np_dtype(sp.dtype)
+        with np.load(sp.path) as z:
+            sp.k = z["k"].view(dt).reshape(sp.shape)
+            sp.v = z["v"].view(dt).reshape(sp.shape)
+        self.disk_loaded_pages_total += 1
+
+    # -- promotion ------------------------------------------------------
+    def begin_promotion(self, node, page: int) -> None:
+        """Move ``node`` out of the spill store and queue its host copy
+        for the engine's next batched scatter into ``page``. The node is
+        resident from the caller's perspective (it set ``node.page``);
+        ``node.spill`` stays set until the flush so demote-cancel and
+        export can still reach the data."""
+        sp = node.spill
+        key = id(node)
+        if key in self._disk:
+            del self._disk[key]
+            self.disk_bytes_used -= sp.nbytes
+            self._from_disk(sp)
+            if sp.path:
+                try:
+                    os.remove(sp.path)
+                except OSError:
+                    pass
+                sp.path = None
+        elif key in self._host:
+            del self._host[key]
+            self.host_bytes_used -= sp.nbytes
+        self._pending[key] = (node, page)
+        self.last_claim_promoted += 1
+
+    def has_pending(self, node) -> bool:
+        return id(node) in self._pending
+
+    def cancel_promotion(self, node) -> Optional[int]:
+        """Un-queue a pending promotion (the scatter never dispatched):
+        the host copy goes back into the store and the device page —
+        still garbage — is returned for the caller to release."""
+        entry = self._pending.pop(id(node), None)
+        if entry is None:
+            return None
+        _, page = entry
+        self._host[id(node)] = node
+        self.host_bytes_used += node.spill.nbytes
+        self._enforce_host_budget()
+        return page
+
+    def drain_pending(self) -> List[tuple]:
+        """Hand the engine every queued ``(page, SpilledPage)`` for one
+        batched scatter; the nodes become plainly resident."""
+        out = []
+        for node, page in self._pending.values():
+            sp = node.spill
+            node.spill = None
+            out.append((page, sp))
+            self.promoted_pages_total += 1
+            self.promoted_bytes_total += sp.nbytes
+        self._pending.clear()
+        return out
+
+    def note_claim(self, promoted: int) -> None:
+        """Per-claim accounting hook (the tree calls it as each claim
+        descent finishes): claims_promoted_total counts CLAIMS that
+        touched the host tier, not pages."""
+        self.last_claim_promoted = promoted
+        if promoted:
+            self.claims_promoted_total += 1
+
+    # -- export / removal ----------------------------------------------
+    def export_data(self, node) -> Tuple[np.ndarray, np.ndarray]:
+        """Read a spilled node's K/V without consuming the entry (kv
+        shipping reads replicas; ownership stays put)."""
+        sp = node.spill
+        if sp.k is None:
+            dt = resolve_np_dtype(sp.dtype)
+            with np.load(sp.path) as z:
+                return (
+                    z["k"].view(dt).reshape(sp.shape),
+                    z["v"].view(dt).reshape(sp.shape),
+                )
+        return sp.k, sp.v
+
+    def forget(self, node) -> None:
+        """Drop every trace of ``node`` (leaf removal / publish
+        adoption): pending promotion un-queued WITHOUT re-filing (the
+        caller owns the node's page and releases it), spill data and
+        disk file discarded."""
+        key = id(node)
+        self._pending.pop(key, None)
+        sp = node.spill
+        if sp is None:
+            return
+        if key in self._host:
+            del self._host[key]
+            self.host_bytes_used -= sp.nbytes
+        if key in self._disk:
+            del self._disk[key]
+            self.disk_bytes_used -= sp.nbytes
+        if sp.path:
+            try:
+                os.remove(sp.path)
+            except OSError:
+                pass
+        node.spill = None
+
+    def flush(self) -> None:
+        """Weight update: every tier's KV is stale. The tree walk
+        releases resident pages (pending promotions included — their
+        pages are ordinary tree references); this clears the host/disk
+        replicas."""
+        for node in list(self._host.values()):
+            node.spill = None
+        for node in list(self._disk.values()):
+            sp = node.spill
+            if sp is not None and sp.path:
+                try:
+                    os.remove(sp.path)
+                except OSError:
+                    pass
+            node.spill = None
+        for node, _page in self._pending.values():
+            node.spill = None
+        self._host.clear()
+        self._disk.clear()
+        self._pending.clear()
+        self.host_bytes_used = 0
+        self.disk_bytes_used = 0

@@ -643,6 +643,33 @@ def copy_pages(
     return {"k": k, "v": v}
 
 
+@jax.jit
+def gather_pages(
+    cache: Dict[str, jnp.ndarray],
+    pages: jnp.ndarray,  # [P] int32 (padding rows use page 0: trash)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Read pool pages for a host-side demotion snapshot (r16 KV spill
+    tier) or a kv-shipping export: [L, Hp, P, rows, lane] per tensor.
+    Non-donating — the pool stays live; the caller's device_get blocks
+    until every in-flight write to those pages has landed."""
+    return cache["k"][:, :, pages], cache["v"][:, :, pages]
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def scatter_pages(
+    cache: Dict[str, jnp.ndarray],
+    dst: jnp.ndarray,  # [P] int32 (>= num_pages rows dropped)
+    k_new: jnp.ndarray,  # [L, Hp, P, rows, lane]
+    v_new: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Write host-restored pages back into the pool (spill-tier
+    promotion flush / kv-shipping import). Padding rows use
+    dst >= num_pages, same drop contract as copy_pages."""
+    k = cache["k"].at[:, :, dst].set(k_new, mode="drop")
+    v = cache["v"].at[:, :, dst].set(v_new, mode="drop")
+    return {"k": k, "v": v}
+
+
 # ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------

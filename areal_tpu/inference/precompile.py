@@ -129,6 +129,19 @@ def copy_sig(pad: int) -> str:
     return f"pad{pad}"
 
 
+def kv_gather_sig(pad: int) -> str:
+    """KV tier demotion gather (r16). Attribution-only: demotion and
+    promotion are host-driven copies off the request path, so their
+    programs are NOT ladder rungs — no new precompile shapes."""
+    return f"pad{pad}"
+
+
+def kv_scatter_sig(pad: int) -> str:
+    """KV tier promotion / shipping-import scatter (r16). Attribution-
+    only, same rationale as kv_gather_sig."""
+    return f"pad{pad}"
+
+
 _SIG_RE = re.compile(r"([a-z]+)(-?\d+)")
 
 

@@ -77,6 +77,10 @@ _METRIC_HELP = {
     "qid_affinity_entries": "live qid→server affinity entries",
     "failovers_total": "requests that hopped servers",
     "requests_migrated_total": "failovers carrying accumulated tokens",
+    "kv_ship_hints_total": (
+        "schedules carrying a kv_ship_from prefix-fetch hint (present "
+        "only with --kv-ship)"
+    ),
     "tracing_dropped_spans_total": "router spans lost to ring overflow",
     "sched_class_interactive_total": "interactive schedule decisions",
     "sched_class_bulk_total": "bulk schedule decisions",
@@ -115,6 +119,7 @@ _ROUTER_COUNTERS = (
     "accepted", "finished", "sched_total", "sched_affinity_hits",
     "sched_rid_affinity_hits", "sched_qid_affinity_hits",
     "failovers_total", "requests_migrated_total",
+    "kv_ship_hints_total",
     "tracing_dropped_spans_total", "sched_class_interactive_total",
     "sched_class_bulk_total", "requests_shed_total",
     "tenant_rejections_total", "autoscale_up_total",
@@ -157,6 +162,15 @@ class RouterState:
         # growth between bumps on long-offpolicyness runs)
         self.qid_cache_size = max(1, qid_cache_size)
         self._qid_server: "OrderedDict[str, str]" = OrderedDict()
+        # cross-server prefix shipping (r16, traffic.kv_ship): previous
+        # owner of a qid whose affinity broke (server died or was
+        # rebalanced away) — the NEXT schedule for that session attaches
+        # it as a kv_ship_from hint so the fresh server fetches the
+        # committed prefix over /kv_export instead of re-prefilling.
+        # Same LRU cap as the affinity map; cleared on version bumps
+        # (old-version KV must never ship).
+        self._qid_prev: "OrderedDict[str, str]" = OrderedDict()
+        self.kv_ship_hints_total = 0
         self._requests: Dict[str, int] = {a: 0 for a in addresses}
         self._tokens: Dict[str, float] = {a: 0.0 for a in addresses}
         # rid/qid-affinity effectiveness: hits land a request back on the
@@ -425,6 +439,8 @@ class RouterState:
                     self.sched_qid_affinity_hits += 1
                     self._qid_server.move_to_end(qid)
                     return {"url": addr, "version": self.version}
+                if self.traffic.kv_ship:
+                    self._remember_prev_owner_locked(qid, addr)
                 del self._qid_server[qid]  # dead-server affinity eviction
                 redirected = True
             if redirected:
@@ -440,7 +456,15 @@ class RouterState:
                 addr = min(
                     candidates, key=lambda a: self._tokens.get(a, 0.0)
                 )
+            out = {"url": addr, "version": self.version}
             if qid:
+                if self.traffic.kv_ship:
+                    prev_owner = self._qid_prev.pop(qid, None)
+                    if prev_owner and prev_owner != addr:
+                        # affinity miss for a known session: tell the
+                        # fresh server where the prefix lives
+                        out["kv_ship_from"] = prev_owner
+                        self.kv_ship_hints_total += 1
                 self._qid_server[qid] = addr
                 self._qid_server.move_to_end(qid)
                 while len(self._qid_server) > self.qid_cache_size:
@@ -455,7 +479,13 @@ class RouterState:
                 float(meta.get("new_token_budget", 0))
                 * max(1, int(meta.get("group_size", 1)))
             )
-            return {"url": addr, "version": self.version}
+            return out
+
+    def _remember_prev_owner_locked(self, qid: str, addr: str) -> None:
+        self._qid_prev[qid] = addr
+        self._qid_prev.move_to_end(qid)
+        while len(self._qid_prev) > self.qid_cache_size:
+            self._qid_prev.popitem(last=False)
 
     # -- fleet membership / failure handling ---------------------------
     def register(self, addr: str) -> Dict:
@@ -513,6 +543,11 @@ class RouterState:
                 q for q, a in self._qid_server.items() if a == addr
             ]
             for q in stale:
+                if self.traffic.kv_ship:
+                    # the server may still ANSWER /kv_export (retire /
+                    # rebalance evictions, not crashes) — park it as the
+                    # shipping source for each displaced session
+                    self._remember_prev_owner_locked(q, addr)
                 del self._qid_server[q]
             if count_migrations:
                 self.requests_migrated_total += len(stale)
@@ -601,8 +636,10 @@ class RouterState:
         with self.lock:
             self.version = version
             # fresh version invalidates the qid affinity map (the cached
-            # prefixes it pointed at were flushed by the servers)
+            # prefixes it pointed at were flushed by the servers) — and
+            # the shipping hints with it (old-version KV never ships)
             self._qid_server.clear()
+            self._qid_prev.clear()
             if path:
                 self._last_weight_update = (path, version)
         return {"success": True, "version": version, "servers": results}
@@ -699,6 +736,10 @@ class RouterState:
                 # fleet when no autoscaler is attached)
                 "fleet_target_size": float(len(self.addresses)),
             }
+            if self.traffic.kv_ship:
+                # shipping surface (r16): present ONLY with --kv-ship —
+                # off keeps the metric namespace bit-identical
+                own["kv_ship_hints_total"] = self.kv_ship_hints_total
             if self.autoscaler is not None:
                 own.update(self.autoscaler.metrics())
         if self.fleet is not None:
@@ -1018,6 +1059,12 @@ def main(argv=None):
         "expires (crashed clients must not leak tenant capacity)",
     )
     p.add_argument(
+        "--kv-ship", action="store_true",
+        help="attach kv_ship_from hints to affinity-miss schedules so "
+        "replacement servers fetch the session prefix via /kv_export "
+        "(servers must run with --kv-ship too)",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="record per-schedule route spans (drain via GET /trace)",
     )
@@ -1045,6 +1092,7 @@ def main(argv=None):
             interactive_weight=args.interactive_weight,
             bulk_weight=args.bulk_weight,
             inflight_ttl_s=args.inflight_ttl,
+            kv_ship=args.kv_ship,
         ),
     )
 

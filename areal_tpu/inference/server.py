@@ -33,12 +33,15 @@ injected 500s, latency spikes, and hard kills, all deterministic.
 """
 
 import argparse
+import base64
 import json
 import os
 import threading
 import time
 import urllib.parse
 import urllib.request
+
+import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -257,6 +260,52 @@ _METRIC_HELP = {
         "chunk of prefill (a stall-escape admission under cache "
         "thrash zeroes it — the TTFT bound is measured, not assumed)"
     ),
+    # hierarchical KV tiers (r16) — present only with --kv-spill
+    "kv_tier_host_pages": "KV pages currently parked in the host tier",
+    "kv_tier_host_bytes": "bytes the host tier currently holds",
+    "kv_tier_host_capacity_bytes": "configured host-tier byte budget",
+    "kv_tier_pending_pages": (
+        "promoted pages awaiting their batched device scatter (nonzero "
+        "only mid-admission; stuck nonzero means a missed flush)"
+    ),
+    "kv_tier_spilled_pages_total": (
+        "pages demoted device→host instead of dropped at eviction"
+    ),
+    "kv_tier_spilled_bytes_total": "bytes moved device→host by demotion",
+    "kv_tier_promoted_pages_total": (
+        "spilled pages promoted host→device by claim-time prefetch"
+    ),
+    "kv_tier_promoted_bytes_total": "bytes moved host→device by promotion",
+    "kv_tier_dropped_pages_total": (
+        "host-tier pages discarded by the LRU byte budget (no disk tier)"
+    ),
+    "kv_tier_dropped_bytes_total": "bytes discarded by the host-tier LRU",
+    "kv_tier_host_claim_hits_total": (
+        "prefix claims that promoted at least one spilled page"
+    ),
+    "kv_tier_host_claim_hit_rate": (
+        "fraction of prefix claims served (partly) from the host tier"
+    ),
+    "kv_tier_host_cached_tokens_total": (
+        "claimed prompt tokens whose KV came back from the host tier"
+    ),
+    "kv_tier_disk_pages": "KV pages currently in the disk tier",
+    "kv_tier_disk_bytes": "bytes the disk tier currently holds",
+    "kv_tier_disk_spilled_pages_total": (
+        "host-tier LRU overflow pages written to the disk tier"
+    ),
+    "kv_tier_disk_loaded_pages_total": (
+        "pages read back from the disk tier (promotion or export)"
+    ),
+    # cross-server prefix shipping (r16) — present only with --kv-ship
+    "kv_ship_exports_total": "prefix exports served to peer servers",
+    "kv_ship_imports_total": "prefix imports accepted from peer servers",
+    "kv_ship_pages_out_total": "KV pages shipped out via /kv_export",
+    "kv_ship_pages_in_total": "KV pages imported into the local pool",
+    "kv_ship_failures_total": (
+        "shipping attempts dropped (version/geometry mismatch or an "
+        "unreachable peer) — shipping soft-fails to a plain re-prefill"
+    ),
 }
 
 # explicit metric-type registry for the engine surface: every name the
@@ -280,6 +329,14 @@ _ENGINE_COUNTERS = (
     "compile_uncached_total",
     "weight_staging_aborts_total", "weight_flips_total",
     "prefill_chunks_total", "prefill_chunk_preemptions_total",
+    "kv_tier_spilled_pages_total", "kv_tier_spilled_bytes_total",
+    "kv_tier_promoted_pages_total", "kv_tier_promoted_bytes_total",
+    "kv_tier_dropped_pages_total", "kv_tier_dropped_bytes_total",
+    "kv_tier_host_claim_hits_total", "kv_tier_host_cached_tokens_total",
+    "kv_tier_disk_spilled_pages_total", "kv_tier_disk_loaded_pages_total",
+    "kv_ship_exports_total", "kv_ship_imports_total",
+    "kv_ship_pages_out_total", "kv_ship_pages_in_total",
+    "kv_ship_failures_total",
 )
 _ENGINE_HISTOGRAMS = (
     "queue_wait_seconds", "ttft_seconds", "request_latency_seconds",
@@ -302,6 +359,10 @@ _ENGINE_GAUGES = (
     "goodput_effective_tokens_per_sec", "goodput_wall_s",
     "compiled_shapes", "shape_ladder_size", "shape_ladder_coverage",
     "server_ready", "ttft_bounded",
+    "kv_tier_host_pages", "kv_tier_host_bytes",
+    "kv_tier_host_capacity_bytes", "kv_tier_pending_pages",
+    "kv_tier_host_claim_hit_rate", "kv_tier_disk_pages",
+    "kv_tier_disk_bytes",
 )
 _METRIC_TYPES = {
     **{n: "counter" for n in _ENGINE_COUNTERS},
@@ -350,6 +411,84 @@ class _Handler(BaseHTTPRequestHandler):
         if length == 0:
             return {}
         return json.loads(self.rfile.read(length))
+
+    # --- cross-server prefix shipping (r16) ---
+    @staticmethod
+    def _kv_export_body(eng, tokens) -> dict:
+        """JSON form of an engine prefix export: canonical-layout K/V
+        pages ride base64-encoded raw bytes + (shape, dtype), which is
+        layout-independent — the importer re-packs into its own pool."""
+        out = eng.export_prefix(tokens)
+        body = {
+            k: out[k]
+            for k in (
+                "pages", "tokens_matched", "page_size", "model_version",
+            )
+        }
+        if out.get("pages"):
+            k, v = out["k"], out["v"]
+            body.update(
+                dtype=out["dtype"],
+                shape=list(k.shape),
+                k=base64.b64encode(
+                    np.ascontiguousarray(k).tobytes()
+                ).decode(),
+                v=base64.b64encode(
+                    np.ascontiguousarray(v).tobytes()
+                ).decode(),
+            )
+        return body
+
+    @staticmethod
+    def _kv_import_body(eng, payload) -> int:
+        from areal_tpu.inference import kv_tiers
+
+        shape = tuple(int(s) for s in payload["shape"])
+        dt = kv_tiers.resolve_np_dtype(payload["dtype"])
+        k = np.frombuffer(
+            base64.b64decode(payload["k"]), dtype=dt
+        ).reshape(shape)
+        v = np.frombuffer(
+            base64.b64decode(payload["v"]), dtype=dt
+        ).reshape(shape)
+        return eng.import_prefix(
+            [int(t) for t in payload["tokens"]], k, v,
+            src_version=payload.get("model_version"),
+        )
+
+    def _ship_prefix(self, eng, peer: str, payload: dict) -> None:
+        """Best-effort prefix fetch from the session's previous owner
+        (the router's kv_ship_from hint): ask the peer to export the
+        committed prefix of this prompt, import it locally, and let the
+        admission claim pick it up. Every failure mode degrades to a
+        plain re-prefill — shipping must never fail a request."""
+        tokens = payload.get("input_ids") or []
+        bs = int(eng.config.page_size)
+        if len(tokens) < bs:
+            return  # nothing committed could match a sub-page prompt
+        base = peer if "://" in peer else f"http://{peer}"
+        try:
+            req = urllib.request.Request(
+                f"{base}/kv_export",
+                data=json.dumps(
+                    {"tokens": [int(t) for t in tokens]}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                out = json.loads(r.read())
+            if not out.get("pages"):
+                return
+            out["tokens"] = tokens[: int(out["tokens_matched"])]
+            imported = self._kv_import_body(eng, out)
+            logger.info(
+                f"kv_ship: imported {imported} prefix tokens "
+                f"({out['pages']} pages) from {peer}"
+            )
+        except Exception as e:
+            # metric-only failure: the request re-prefills locally
+            eng.kv_ship_failures_total += 1
+            logger.warning(f"kv_ship fetch from {peer} failed: {e}")
 
     def _send_text(self, body: bytes, content_type: str):
         self.send_response(200)
@@ -422,6 +561,23 @@ class _Handler(BaseHTTPRequestHandler):
             # assembles the full timeline without unbounded server memory
             body, ctype = trace_response(eng.tracer, url.query)
             self._send_text(body, ctype)
+        elif url.path == "/kv_export":
+            # GET form: ?tokens=1,2,3 (the POST body form is canonical;
+            # this one exists for curl-ability and the endpoint pair
+            # symmetry the shipping contract documents)
+            if not getattr(eng, "kv_ship_enabled", False):
+                self._send_json(
+                    {"error": "kv shipping disabled "
+                     "(start the server with --kv-ship)"}, 403
+                )
+                return
+            q = urllib.parse.parse_qs(url.query)
+            toks = [
+                int(t)
+                for t in q.get("tokens", [""])[0].split(",")
+                if t != ""
+            ]
+            self._send_json(self._kv_export_body(eng, toks))
         else:
             self._send_json({"error": f"unknown path {self.path}"}, 404)
 
@@ -456,6 +612,12 @@ class _Handler(BaseHTTPRequestHandler):
                 trace_id = self.headers.get(TRACE_HEADER)
                 if trace_id and "trace_ctx" not in payload:
                     payload["trace_ctx"] = trace_id
+                # router affinity-miss hint (r16): fetch the session's
+                # committed prefix from its previous owner BEFORE the
+                # claim, so this request's admission serves it cached
+                ship_from = payload.pop("kv_ship_from", None)
+                if ship_from and getattr(eng, "kv_ship_enabled", False):
+                    self._ship_prefix(eng, ship_from, payload)
                 try:
                     result = eng.generate(payload)
                 except AdmissionRejectedError as e:
@@ -536,6 +698,32 @@ class _Handler(BaseHTTPRequestHandler):
                 header, arrays = decode_chunk(self.rfile.read(n))
                 out = eng.update_weights_chunk(header, arrays)
                 self._send_json({"success": True, **out})
+            elif self.path == "/kv_export":
+                payload = self._read_json()
+                if not getattr(eng, "kv_ship_enabled", False):
+                    self._send_json(
+                        {"error": "kv shipping disabled "
+                         "(start the server with --kv-ship)"}, 403
+                    )
+                    return
+                self._send_json(
+                    self._kv_export_body(
+                        eng,
+                        [int(t) for t in payload.get("tokens", [])],
+                    )
+                )
+            elif self.path == "/kv_import":
+                payload = self._read_json()
+                if not getattr(eng, "kv_ship_enabled", False):
+                    self._send_json(
+                        {"error": "kv shipping disabled "
+                         "(start the server with --kv-ship)"}, 403
+                    )
+                    return
+                imported = self._kv_import_body(eng, payload)
+                self._send_json(
+                    {"success": True, "imported_tokens": imported}
+                )
             else:
                 self._send_json({"error": f"unknown path {self.path}"}, 404)
         except Exception as e:  # surface engine errors as 500s
@@ -716,6 +904,26 @@ def main(argv: Optional[list] = None):
         "(0 disables prefix reuse entirely)",
     )
     p.add_argument(
+        "--kv-spill", action="store_true",
+        help="hierarchical KV tiers: spill radix leaves to host RAM "
+        "on eviction and promote them back at claim time (radix "
+        "cache mode only)",
+    )
+    p.add_argument(
+        "--host-kv-bytes", type=int, default=d.host_kv_bytes,
+        help="host spill-tier byte budget with --kv-spill",
+    )
+    p.add_argument(
+        "--kv-disk-path", default=d.kv_disk_path,
+        help="directory for the disk tier (host-LRU overflow pages); "
+        "empty = no disk tier",
+    )
+    p.add_argument(
+        "--kv-ship", action="store_true",
+        help="cross-server prefix shipping: serve /kv_export + "
+        "/kv_import and honor the router's kv_ship_from hints",
+    )
+    p.add_argument(
         "--spec", action="store_true",
         help="enable draft-free speculative decoding (n-gram proposals "
         "+ multi-token verify; greedy streams stay bit-identical)",
@@ -854,6 +1062,10 @@ def main(argv: Optional[list] = None):
         compilation_cache_dir=args.compilation_cache_dir,
         prefix_cache_mode=args.prefix_cache_mode,
         prefix_reuse_min=args.prefix_reuse_min,
+        kv_spill=args.kv_spill,
+        host_kv_bytes=args.host_kv_bytes,
+        kv_disk_path=args.kv_disk_path,
+        kv_ship=args.kv_ship,
         max_queued_requests=args.max_queued_requests,
         shed_retry_after_s=args.shed_retry_after,
         deadline_preemption=not args.no_deadline_preemption,

@@ -82,6 +82,177 @@ if _flag not in os.environ.get("LIBTPU_INIT_ARGS", ""):
 BASELINE_EFFECTIVE_TOKENS_PER_SEC_PER_DEVICE = 2520.0
 
 
+def kv_tiers_ab_phase(
+    model_cfg,
+    params,
+    *,
+    dtype="bfloat16",
+    page_size=256,
+    num_pages=96,
+    host_kv_bytes=1 << 31,
+    plen=1024,
+    sessions=24,
+    max_new=128,
+    max_num_seqs=16,
+    max_model_len=4096,
+    prefill_chunk=128,
+):
+    """r16 A/B: host-RAM KV spill tier vs discard eviction under a
+    returning-session workload.
+
+    ``sessions`` distinct long-prefix sessions each run turn 1 and park;
+    the device pool is sized so that by the time a session RETURNS for
+    turn 2 its pages have been evicted — demoted host-side with
+    --kv-spill, dropped without. Turn 2 then measures what eviction cost:
+    re-prefilled tokens and TTFT. Same prompts, same order, both cells.
+    Runs per-cell degraded (an error records the cell, keeps the other)
+    and checkpoints via emit_phase("kv_tiers", ...)."""
+    import gc
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+
+    # pool deliberately smaller than the parked working set: with
+    # ~plen/page_size full pages per session, `sessions` sessions need
+    # ~sessions*(plen/page_size) pages — num_pages must undercut that
+    pages_per_session = plen // page_size
+    results = {}
+    for name, spill in (("discard", False), ("spill", True)):
+        rng = np.random.default_rng(1234)  # identical prompts per cell
+        prompts = [
+            rng.integers(1, model_cfg.vocab_size, size=plen).tolist()
+            for _ in range(sessions)
+        ]
+        g = None
+        try:
+            g = GenerationEngine(
+                JaxGenConfig(
+                    dtype=dtype, max_num_seqs=max_num_seqs,
+                    max_model_len=max_model_len, page_size=page_size,
+                    num_pages=num_pages, prefill_chunk=prefill_chunk,
+                    admit_wave=4, prefix_reuse_min=page_size,
+                    kv_spill=spill, host_kv_bytes=host_kv_bytes,
+                ),
+                model_config=model_cfg,
+                params=params,
+            ).start()
+
+            def turn(prompt):
+                return g.generate({
+                    "input_ids": [int(t) for t in prompt],
+                    "sampling_params": {
+                        "max_new_tokens": max_new, "greedy": True,
+                    },
+                }, timeout=600)
+
+            # warm off the record with a RETURNING session: turn 1,
+            # enough distinct churn turns to evict its pages, then
+            # turn 2 with full history. This warms the turn-2 prefill
+            # shapes in both cells and — because the churn demoted the
+            # warm session's pages — the promotion gather/scatter
+            # programs in the spill cell, keeping compile debt out of
+            # the measured TTFTs
+            wp = rng.integers(1, model_cfg.vocab_size, size=plen).tolist()
+            wr = turn(wp)
+            for _ in range(num_pages // max(1, pages_per_session) + 1):
+                turn(rng.integers(
+                    1, model_cfg.vocab_size, size=plen).tolist())
+            turn([int(t) for t in wp] + wr["output_ids"])
+            # turn 1: every session prefs + decodes + parks, serially
+            # enough that session 0's pages are long evicted when it
+            # returns (serial submit = maximal churn between returns)
+            histories = []
+            for p in prompts:
+                r = turn(p)
+                histories.append([int(t) for t in p] + r["output_ids"])
+            m1 = g.metrics()
+            # turn 2: the sessions RETURN with their full history
+            t0 = time.perf_counter()
+            ttfts, cached = [], 0
+            for h in histories:
+                r = turn(h)
+                ttfts.append(r["meta_info"]["ttft"])
+                cached += int(r["meta_info"]["cached_tokens"])
+            wall = time.perf_counter() - t0
+            m2 = g.metrics()
+            pt = int(m2["total_prompt_tokens"] - m1["total_prompt_tokens"])
+            results[name] = {
+                "turn2_prompt_tokens": pt,
+                "turn2_cached_tokens": cached,
+                "turn2_reprefill_tokens": pt - cached,
+                "turn2_cached_fraction": round(cached / max(1, pt), 4),
+                "turn2_ttft_mean_ms": round(
+                    1000 * statistics.mean(ttfts), 1
+                ),
+                "turn2_ttft_median_ms": round(
+                    1000 * statistics.median(ttfts), 1
+                ),
+                "turn2_ttft_p90_ms": round(
+                    1000 * sorted(ttfts)[int(0.9 * (len(ttfts) - 1))], 1
+                ),
+                "turn2_wall_s": round(wall, 2),
+                "evicted_pages": int(m2.get(
+                    "prefix_evicted_pages_total", 0)),
+                **({
+                    "spilled_pages": int(
+                        m2["kv_tier_spilled_pages_total"]),
+                    "promoted_pages": int(
+                        m2["kv_tier_promoted_pages_total"]),
+                    "host_claim_hits": int(
+                        m2["kv_tier_host_claim_hits_total"]),
+                    "host_claim_hit_rate": float(
+                        m2["kv_tier_host_claim_hit_rate"]),
+                    "host_cached_tokens": int(
+                        m2["kv_tier_host_cached_tokens_total"]),
+                    "dropped_pages": int(
+                        m2["kv_tier_dropped_pages_total"]),
+                } if spill else {}),
+            }
+        except Exception as e:  # degrade per-cell, keep the other
+            results[name] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+        finally:
+            if g is not None:
+                try:
+                    g.stop()
+                except Exception:
+                    pass
+                del g
+            gc.collect()
+    a, b = results.get("discard", {}), results.get("spill", {})
+    summary = {}
+    if "turn2_reprefill_tokens" in a and "turn2_reprefill_tokens" in b:
+        summary = {
+            "reprefill_tokens_saved": (
+                a["turn2_reprefill_tokens"] - b["turn2_reprefill_tokens"]
+            ),
+            "reprefill_reduction": round(
+                1.0
+                - b["turn2_reprefill_tokens"]
+                / max(1, a["turn2_reprefill_tokens"]),
+                4,
+            ),
+            "ttft_mean_delta_ms": round(
+                a["turn2_ttft_mean_ms"] - b["turn2_ttft_mean_ms"], 1
+            ),
+            "ttft_median_delta_ms": round(
+                a["turn2_ttft_median_ms"] - b["turn2_ttft_median_ms"], 1
+            ),
+        }
+    payload = {
+        "configs": results,
+        "summary": summary,
+        "workload": {
+            "sessions": sessions, "plen": plen, "max_new": max_new,
+            "page_size": page_size, "num_pages": num_pages,
+            "pages_per_session": pages_per_session, "dtype": dtype,
+        },
+    }
+    emit_phase("kv_tiers", payload)
+    return payload
+
+
 def _resilience_phase() -> dict:
     """Kill-one-of-two under the chaos harness, measured. Two tiny-model
     CPU server subprocesses (tests/genserver_worker.py — they force the
@@ -1401,6 +1572,15 @@ def main():
     prefix_ab_compiles = _px_c1["count"] - _px_c0["count"]
     prefix_ab_compile_s = round(_px_c1["secs"] - _px_c0["secs"], 1)
 
+    # --- kv-tiers A/B sub-phase (r16): host-RAM spill tier vs discard
+    # eviction under returning sessions whose pages the pool evicted
+    # between turns (full per-cell record in BENCH_<round>_kv_tiers.json)
+    _kv_c0 = compile_snap()
+    kv_tiers_ab = kv_tiers_ab_phase(model_cfg, params, dtype="bfloat16")
+    _kv_c1 = compile_snap()
+    kv_tiers_ab_compiles = _kv_c1["count"] - _kv_c0["count"]
+    kv_tiers_ab_compile_s = round(_kv_c1["secs"] - _kv_c0["secs"], 1)
+
     gen_cfg = JaxGenConfig(
         dtype="bfloat16",
         max_num_seqs=n_samples,
@@ -1589,10 +1769,11 @@ def main():
         # keep the A/B phases' compile bills out of the warmup counter
         # (comparable to the r5 baseline: main-loop warmup only)
         "count": warm_compiles["count"] - decode_ab_compiles
-        - spec_ab_compiles - prefix_ab_compiles,
+        - spec_ab_compiles - prefix_ab_compiles - kv_tiers_ab_compiles,
         "secs": warm_compiles["secs"] - (_ab_c1["secs"] - _ab_c0["secs"])
         - (_sp_c1["secs"] - _sp_c0["secs"])
-        - (_px_c1["secs"] - _px_c0["secs"]),
+        - (_px_c1["secs"] - _px_c0["secs"])
+        - (_kv_c1["secs"] - _kv_c0["secs"]),
     }
 
     # --- serial measurement (rollout -> train, no overlap) ---
@@ -1801,6 +1982,13 @@ def main():
         "prefix_ab": prefix_ab,
         "prefix_ab_compiles": prefix_ab_compiles,
         "prefix_ab_compile_s": prefix_ab_compile_s,
+        # r16: host-KV spill tier vs discard eviction on returning
+        # sessions (full per-cell record in BENCH_<round>_kv_tiers.json):
+        # turn-2 re-prefill tokens and TTFT with the pool thrashed
+        # between a session's turns
+        "kv_tiers_ab": kv_tiers_ab,
+        "kv_tiers_ab_compiles": kv_tiers_ab_compiles,
+        "kv_tiers_ab_compile_s": kv_tiers_ab_compile_s,
         "compile_cache_dir": cache_dir,
         "compile_cache_hits": cache_events["hits"],
         # r11: goodput attribution — trainer + engine wall-time bucket
@@ -2160,5 +2348,42 @@ def main():
     print(json.dumps(compact))
 
 
+def _kv_tiers_standalone(tiny: bool) -> None:
+    """Run ONLY the kv-tiers A/B (``python bench.py --kv-tiers-only``).
+
+    ``--tiny`` shrinks the model/workload to a CPU-feasible shape —
+    same mechanism under test (pool sized below the parked working
+    set, sessions returning after eviction), scaled geometry. The
+    full-size cell runs inside main() on TPU rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models.config import ModelConfig, tiny_config
+    from areal_tpu.models.transformer import init_params
+
+    if tiny:
+        cfg = tiny_config("qwen2")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        payload = kv_tiers_ab_phase(
+            cfg, params, dtype="float32", page_size=32, num_pages=48,
+            host_kv_bytes=1 << 27, plen=384, sessions=12, max_new=16,
+            max_num_seqs=8, max_model_len=512, prefill_chunk=64,
+        )
+    else:
+        cfg = ModelConfig(
+            vocab_size=32768, hidden_size=896, intermediate_size=4864,
+            num_layers=24, num_heads=14, num_kv_heads=2, head_dim=64,
+            max_position_embeddings=32768, rope_theta=1e6,
+            rms_norm_eps=1e-6, tie_word_embeddings=True,
+            attention_bias=True, family="qwen2",
+        )
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        payload = kv_tiers_ab_phase(cfg, params, dtype="bfloat16")
+    print(json.dumps(payload, indent=2, default=str))
+
+
 if __name__ == "__main__":
-    main()
+    if "--kv-tiers-only" in sys.argv:
+        _kv_tiers_standalone(tiny="--tiny" in sys.argv)
+    else:
+        main()

@@ -247,6 +247,33 @@ def test_prefix_registry_claim_and_evict():
     assert pm.n_free == 8
 
 
+def test_prefix_registry_claim_refreshes_lru_stamp():
+    """Regression (r16): a claim HIT must refresh the entry's LRU
+    stamp, so a hot shared prefix (system prompt) parked early outlives
+    cold one-off entries under eviction pressure. Without the refresh,
+    insertion order alone decides eviction and the hottest entry —
+    necessarily the oldest — dies first."""
+    pm = PageManager(8)
+    reg = PrefixRegistry(page_size=4, min_match=4)
+    hot = np.arange(100, 108, dtype=np.int32)
+    reg.add(pm, hot, pm.alloc(2))  # parked FIRST → oldest stamp
+    cold_pages = pm.alloc(2)
+    reg.add(pm, np.arange(200, 208, dtype=np.int32), cold_pages)
+    # the hot prefix keeps getting hit; the cold one never is
+    for _ in range(3):
+        shared, off = reg.claim(pm, list(hot) + [7])
+        assert off == 8
+        pm.release(shared)
+    # pressure: need pages for 2 more → exactly one entry must go,
+    # and it must be the cold one despite its younger insertion
+    evicted = reg.evict(pm, pages_needed=6)
+    assert evicted == 1
+    assert pm.refcount[cold_pages[0]] == 0  # cold entry died
+    shared, off = reg.claim(pm, list(hot) + [7])
+    assert off == 8  # hot entry survived
+    pm.release(shared)
+
+
 # ---------------------------------------------------------------------------
 # Engine-level reuse
 # ---------------------------------------------------------------------------

@@ -278,6 +278,7 @@ def cache_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     served = 0
     prompt_tokens = 0
     cached_tokens = 0
+    host_cached = 0
     depth_hist: Dict[str, int] = {}
     for s in spans:
         if s.get("name") != "prefill":
@@ -288,6 +289,8 @@ def cache_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         requests += 1
         prompt_tokens += pt
         cached_tokens += ct
+        # kv_spill engines stamp the host-tier share of each claim
+        host_cached += int(attrs.get("host_cached_tokens", 0))
         if ct > 0:
             served += 1
             # pow2 token buckets: reuse depth spans 1-token partial-page
@@ -303,6 +306,10 @@ def cache_summary(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "cached_tokens": cached_tokens,
         "token_hit_rate": (
             round(cached_tokens / prompt_tokens, 4) if prompt_tokens else 0.0
+        ),
+        "host_cached_tokens": host_cached,
+        "host_token_share": (
+            round(host_cached / cached_tokens, 4) if cached_tokens else 0.0
         ),
         "mean_reuse_depth": (
             round(cached_tokens / served, 1) if served else 0.0
@@ -322,12 +329,155 @@ def format_cache(ca: Dict[str, Any]) -> str:
         f"prompt tokens        {ca['prompt_tokens']}",
         f"cached tokens        {ca['cached_tokens']}"
         f" ({ca['token_hit_rate'] * 100:.1f}%)",
+    ]
+    if ca.get("host_cached_tokens"):
+        rows.append(
+            f"  from host tier     {ca['host_cached_tokens']}"
+            f" ({ca['host_token_share'] * 100:.1f}% of cached)"
+        )
+    rows += [
         f"mean reuse depth     {ca['mean_reuse_depth']} tokens",
         "",
         f"{'reuse depth':<14}{'requests':>10}",
     ]
     for bucket, count in ca["reuse_depth_hist"].items():
         rows.append(f"{bucket:<14}{count:>10}")
+    return "\n".join(rows)
+
+
+def _parse_cache_metrics(text: str) -> Dict[str, float]:
+    """Pull the prefix-cache / KV-tier / shipping series out of a
+    Prometheus ``/metrics`` snapshot (names with or without the
+    ``areal_tpu_gen_`` prefix). Returns {} for non-snapshot input."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        name = parts[0].split("{", 1)[0]
+        if name.startswith("areal_tpu_gen_"):
+            name = name[len("areal_tpu_gen_"):]
+        if name.startswith(("prefix_", "kv_tier_", "kv_ship_")) or name in (
+            "total_prompt_tokens", "total_cached_prompt_tokens",
+        ):
+            try:
+                out[name] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+def load_cache(path: str) -> Dict[str, Any]:
+    """Load ``--cache`` input: a ``/metrics`` snapshot (prefix + KV-tier
+    counters — the durable source) or a span trace (``prefill`` spans).
+    Either file kind works; the report renders whichever is present."""
+    with open(path) as f:
+        text = f.read()
+    metrics = _parse_cache_metrics(text)
+    spans: List[Dict[str, Any]] = []
+    if not metrics:
+        try:
+            spans = load_spans(path)
+        except (json.JSONDecodeError, KeyError):
+            spans = []
+    return {"metrics": metrics, "spans": spans}
+
+
+def cache_metrics_summary(m: Dict[str, float]) -> Dict[str, Any]:
+    """Per-tier prefix-cache report from a ``/metrics`` snapshot: the
+    device/host/disk hit + volume split a span trace cannot carry (tier
+    counters survive /trace drains and tracing-off runs). Tier and
+    shipping sections appear only when the snapshot carries their keys
+    — i.e. only when the server ran with --kv-spill / --kv-ship."""
+
+    def g(k: str) -> float:
+        return m.get(k, 0.0)
+
+    host_tokens = int(g("kv_tier_host_cached_tokens_total"))
+    cached = int(g("total_cached_prompt_tokens"))
+    out: Dict[str, Any] = {
+        "source": "metrics",
+        "prompt_tokens": int(g("total_prompt_tokens")),
+        "cached_tokens": cached,
+        "token_hit_rate": g("prefix_cache_hit_rate"),
+        "claim_hit_rate": g("prefix_claim_hit_rate"),
+        "cow_copies": int(g("prefix_cow_copies_total")),
+        "evicted_pages": int(g("prefix_evicted_pages_total")),
+        "tiers": None,
+        "ship": None,
+    }
+    if "kv_tier_spilled_pages_total" in m:
+        out["tiers"] = {
+            "device_cached_tokens": max(0, cached - host_tokens),
+            "host_cached_tokens": host_tokens,
+            "host_claim_hit_rate": g("kv_tier_host_claim_hit_rate"),
+            "host_claim_hits": int(g("kv_tier_host_claim_hits_total")),
+            "host_pages": int(g("kv_tier_host_pages")),
+            "host_bytes": int(g("kv_tier_host_bytes")),
+            "spilled_pages": int(g("kv_tier_spilled_pages_total")),
+            "spilled_bytes": int(g("kv_tier_spilled_bytes_total")),
+            "promoted_pages": int(g("kv_tier_promoted_pages_total")),
+            "promoted_bytes": int(g("kv_tier_promoted_bytes_total")),
+            "dropped_pages": int(g("kv_tier_dropped_pages_total")),
+            "disk_pages": int(g("kv_tier_disk_pages")),
+            "disk_spilled_pages": int(
+                g("kv_tier_disk_spilled_pages_total")
+            ),
+            "disk_loaded_pages": int(g("kv_tier_disk_loaded_pages_total")),
+        }
+    if "kv_ship_exports_total" in m:
+        out["ship"] = {
+            "exports": int(g("kv_ship_exports_total")),
+            "imports": int(g("kv_ship_imports_total")),
+            "pages_out": int(g("kv_ship_pages_out_total")),
+            "pages_in": int(g("kv_ship_pages_in_total")),
+            "failures": int(g("kv_ship_failures_total")),
+        }
+    return out
+
+
+def format_cache_metrics(ca: Dict[str, Any]) -> str:
+    rows = [
+        f"prompt tokens        {ca['prompt_tokens']}",
+        f"cached tokens        {ca['cached_tokens']}"
+        f" ({ca['token_hit_rate'] * 100:.1f}%)",
+        f"claim hit rate       {ca['claim_hit_rate'] * 100:.1f}%",
+        f"cow copies           {ca['cow_copies']}",
+        f"evicted pages        {ca['evicted_pages']}",
+    ]
+    t = ca.get("tiers")
+    if t:
+        rows += [
+            "",
+            "kv tiers (--kv-spill)",
+            f"  device cached tok  {t['device_cached_tokens']}",
+            f"  host cached tok    {t['host_cached_tokens']}",
+            f"  host claim hits    {t['host_claim_hits']}"
+            f" ({t['host_claim_hit_rate'] * 100:.1f}% of claims)",
+            f"  host pages/bytes   {t['host_pages']} / {t['host_bytes']}",
+            f"  spilled pages      {t['spilled_pages']}"
+            f" ({t['spilled_bytes']} B)",
+            f"  promoted pages     {t['promoted_pages']}"
+            f" ({t['promoted_bytes']} B)",
+            f"  dropped pages      {t['dropped_pages']}",
+            f"  disk pages         {t['disk_pages']}"
+            f" (spilled {t['disk_spilled_pages']},"
+            f" loaded {t['disk_loaded_pages']})",
+        ]
+    sh = ca.get("ship")
+    if sh:
+        rows += [
+            "",
+            "prefix shipping (--kv-ship)",
+            f"  exports            {sh['exports']}"
+            f" ({sh['pages_out']} pages out)",
+            f"  imports            {sh['imports']}"
+            f" ({sh['pages_in']} pages in)",
+            f"  failures           {sh['failures']}",
+        ]
     return "\n".join(rows)
 
 
@@ -1321,9 +1471,17 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--cache", action="store_true",
-        help="summarize prefix-cache reuse (prefill spans' "
-        "cached_tokens: hit rates + reuse-depth histogram) instead of "
-        "the latency table; exit 1 when the trace carries no prefills",
+        help="summarize prefix-cache reuse instead of the latency "
+        "table: from prefill spans (hit rates + reuse-depth histogram) "
+        "or from a /metrics snapshot (per-tier device/host/disk hit "
+        "rates, spill/promote volumes, shipping counters); exit 1 when "
+        "the input carries neither",
+    )
+    p.add_argument(
+        "--require-min-hit-rate", type=float, default=0.0,
+        help="exit 1 when the prefix-cache TOKEN hit rate falls below "
+        "this fraction (or the input carries no cache data) — the "
+        "cache-effectiveness CI gate (combine with --cache)",
     )
     p.add_argument(
         "--env", action="store_true",
@@ -1513,6 +1671,40 @@ def main(argv=None) -> int:
             print("manifest names no servers", file=sys.stderr)
             return 1
         return 0
+    if args.cache:
+        # like --ttft, --cache accepts a /metrics snapshot — handle it
+        # before load_spans (which would choke on Prometheus text)
+        data = load_cache(args.trace)
+        if data["metrics"]:
+            ca = cache_metrics_summary(data["metrics"])
+            empty = ca["prompt_tokens"] == 0
+            out_str = format_cache_metrics(ca)
+        else:
+            ca = cache_summary(data["spans"])
+            empty = ca["prefill_requests"] == 0
+            out_str = format_cache(ca)
+        if args.json:
+            print(json.dumps(ca, indent=2))
+        else:
+            print(out_str)
+        if empty:
+            print(
+                "no prefill spans or cache metrics in file (tracing "
+                "off, or the engine never admitted a request)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.require_min_hit_rate > 0:
+            if ca["token_hit_rate"] < args.require_min_hit_rate:
+                print(
+                    f"REQUIRED token hit rate >= "
+                    f"{args.require_min_hit_rate}, measured "
+                    f"{ca['token_hit_rate']} — prefix-cache "
+                    f"effectiveness below the gate",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
     spans = load_spans(args.trace)
     if args.require_zero_pause:
         n_pause = sum(
@@ -1571,20 +1763,6 @@ def main(argv=None) -> int:
             print(
                 "no spec_verify spans in trace (tracing off, or "
                 "speculation never engaged)",
-                file=sys.stderr,
-            )
-            return 1
-        return 0
-    if args.cache:
-        ca = cache_summary(spans)
-        if args.json:
-            print(json.dumps(ca, indent=2))
-        else:
-            print(format_cache(ca))
-        if ca["prefill_requests"] == 0:
-            print(
-                "no prefill spans in trace (tracing off, or the engine "
-                "never admitted a request)",
                 file=sys.stderr,
             )
             return 1
