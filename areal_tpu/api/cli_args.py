@@ -453,6 +453,13 @@ class JaxGenConfig:
     weights: "WeightTransferConfig" = dataclasses.field(
         default_factory=lambda: WeightTransferConfig()
     )
+    # multi-policy serving plane (inference/policies.PolicyRegistry):
+    # named policy handles with independent version lines, canary
+    # splits, per-(policy, version) KV namespaces, and LRU HBM→host
+    # demotion of cold policy buffers
+    policy: "PolicyConfig" = dataclasses.field(
+        default_factory=lambda: PolicyConfig()
+    )
     # cold-start elimination (inference/precompile.py): AOT-precompile
     # the exact shape ladder (or replay a prior run's compile events)
     # before/while serving, seeding the persistent compile cache
@@ -580,6 +587,7 @@ class JaxGenConfig:
         args += [
             f"--weight-flip-policy={config.weights.flip_policy}",
             f"--weight-staging-ttl={config.weights.staging_ttl_s}",
+            f"--policy-max-resident={config.policy.max_resident}",
         ]
         if not config.weights.streaming:
             args.append("--no-weight-streaming")
@@ -659,6 +667,24 @@ class WeightTransferConfig:
     # dropped (visible via the weight_staging_bytes gauge and the
     # weight_staging_aborts_total counter); <= 0 disables the sweep
     staging_ttl_s: float = 120.0
+
+
+@dataclasses.dataclass
+class PolicyConfig:
+    """Multi-policy serving plane (inference/policies.PolicyRegistry).
+
+    Named policy handles (``actor``, ``opponent``, ...) each carry an
+    independent version line on one engine: per-line stable + canary
+    buffers, deterministic canary traffic splits, per-(policy, version)
+    KV namespaces in the radix cache, and per-request pins so a buffer
+    serving in-flight decodes can never be dropped. Single-policy mode
+    (no named push) is a strict no-op — greedy streams and the metric
+    namespace are bit-identical to an engine without this plane."""
+
+    # named policy weight buffers kept resident in HBM; colder
+    # (unpinned) buffers LRU-demote to host RAM and reload on the next
+    # request targeting them (<= 0 disables demotion)
+    max_resident: int = 2
 
 
 @dataclasses.dataclass
@@ -846,6 +872,13 @@ class TrafficConfig:
     # fetches the session's committed prefix over /kv_export instead of
     # re-prefilling it. Requires --kv-ship on the target servers.
     kv_ship: bool = False
+    # multi-policy canary routing (r19): per-line canary splits the
+    # router resolves BEFORE scheduling, grammar
+    # "name=STABLE[:CANARY:FRACTION][,name=...]" (e.g.
+    # "actor=12:13:0.1,opponent=7" routes 10% of actor traffic to v13).
+    # Empty = requests pass their policy handle through unresolved and
+    # the server's registry split applies instead.
+    policy_split: str = ""
 
 
 @dataclasses.dataclass

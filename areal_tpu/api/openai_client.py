@@ -265,7 +265,12 @@ class _ChatCompletions:
             input_ids=list(input_ids),
             gconfig=gconfig,
             rid=f"chatcmpl-{uuid.uuid4().hex}",
-            metadata={"qid": c.session_id, "priority": c.priority},
+            metadata={
+                "qid": c.session_id,
+                "priority": c.priority,
+                # named policy handle (r19): "" rides the default line
+                **({"policy": c.policy} if c.policy else {}),
+            },
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
@@ -324,6 +329,7 @@ class ArealOpenAI:
         tool_parser: Callable[[str], List[ToolCall]] = hermes_tool_parser,
         session_id: Optional[str] = None,
         priority: str = "interactive",
+        policy: str = "",
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -334,6 +340,12 @@ class ArealOpenAI:
         # client should pass priority="bulk" so their rollouts stay
         # shed-able under load)
         self.priority = priority
+        # multi-policy serving plane (r19): named policy handle stamped
+        # into every request ("actor", "actor@v13", "opponent", ...);
+        # "" keeps the single-policy default path. Self-play clients
+        # bind one ArealOpenAI per side ("actor" vs "opponent") against
+        # the SAME engine/fleet.
+        self.policy = policy
         # session/affinity key stamped into every request's metadata
         # ("qid"): all of an agentic episode's turns steer to one
         # server, where each turn's growing history rides the previous

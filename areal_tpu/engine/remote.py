@@ -490,6 +490,7 @@ class RemoteInferenceEngine(InferenceEngine):
         self, session, req: ModelRequest, failed: set, headers,
         qid: Optional[str] = None,
         priority: str = "bulk", tenant: str = "", resumed: bool = False,
+        policy: str = "",
     ) -> Optional[str]:
         """Router-scheduled mode (config.router_addr): ask the fronting
         router for a server, forwarding the trace context so the
@@ -526,6 +527,11 @@ class RemoteInferenceEngine(InferenceEngine):
         }
         if req.metadata.get("group_size"):
             meta["group_size"] = int(req.metadata["group_size"])
+        if policy:
+            # named policy handle (r19): the router keys its qid
+            # affinity per policy line and may resolve a bare name to
+            # an exact version through its canary splitter
+            meta["policy"] = policy
         if prev is not None and prev not in failed:
             meta["previous_server"] = prev
             meta["previous_version"] = prev_version
@@ -561,6 +567,11 @@ class RemoteInferenceEngine(InferenceEngine):
         addr = out.get("url")
         if not addr or addr in failed:
             return None
+        if out.get("policy"):
+            # sticky resolution: the router's canary splitter picked an
+            # exact version for this request — resumes carry it back so
+            # a request never flips version mid-flight
+            req.metadata["policy"] = str(out["policy"])
         with self._lock:
             self._router_version = int(
                 out.get("version", self._router_version)
@@ -668,11 +679,20 @@ class RemoteInferenceEngine(InferenceEngine):
                     # the exclusions (one may have recovered) rather than
                     # fail closed; max_failovers still bounds total hops
                     failed.clear()
+                # named policy handle (r19): workflows stamp
+                # metadata["policy"] ("actor", "actor@v13", ...);
+                # re-read each chunk because the router's canary
+                # splitter writes the resolved exact-version handle
+                # back, keeping resumes on the same version
+                policy = str(req.metadata.get("policy") or "")
                 router_server = await self._schedule_via_router(
                     session, req, failed, hdrs, qid=qid,
                     priority=priority, tenant=tenant,
                     resumed=len(accumulated) > 0,
+                    policy=policy,
                 )
+                policy = str(req.metadata.get("policy") or policy)
+                lineage.policy = policy
                 routed = routed or router_server is not None
                 server = router_server or self.choose_server(
                     req.rid, exclude=failed, qid=qid
@@ -691,6 +711,8 @@ class RemoteInferenceEngine(InferenceEngine):
                         "max_new_tokens": ask,
                     },
                 }
+                if policy:
+                    payload["policy"] = policy
                 with self._lock:
                     ship_from = self._ship_hints.pop(req.rid, None)
                 if ship_from and ship_from != server:
@@ -871,6 +893,7 @@ class RemoteInferenceEngine(InferenceEngine):
             # BEFORE the best-effort router notify below: a cancelled
             # await there must not cost the ledger its record.
             if episode is not None:
+                lineage.ttft_s = ttft
                 episode.add_request(lineage)
             # release the router's in-flight ledger entry (tenant/class
             # capacity) — on failure paths too, but ONLY for rids the
@@ -928,6 +951,24 @@ class RemoteInferenceEngine(InferenceEngine):
                 "rollout/aborts_per_request": float(n_aborts),
                 "rollout/failovers_per_request": float(n_failovers),
             })
+            pol = str(req.metadata.get("policy") or "")
+            if pol:
+                # per-policy staleness attribution (r19): same lag
+                # measure keyed by the line name, so canary vs stable
+                # drift is separable on the trainer's dashboards
+                pname = pol.split("@", 1)[0]
+                stats_tracker.scalar(**{
+                    f"rollout/policy/{pname}/staleness_lag_mean": (
+                        sum(lags) / len(lags)
+                    ),
+                    f"rollout/policy/{pname}/staleness_lag_max": float(
+                        max(lags)
+                    ),
+                    f"rollout/policy/{pname}/output_tokens": float(
+                        len(accumulated)
+                    ),
+                    f"rollout/policy/{pname}/latency_s": now - start,
+                })
         return ModelResponse(
             input_tokens=list(req.input_ids),
             output_tokens=accumulated,

@@ -66,6 +66,7 @@ from areal_tpu.inference.cache import (
     RadixPrefixCache,
     init_kv_pool,
 )
+from areal_tpu.inference.policies import PolicyRegistry, UnknownPolicyError
 from areal_tpu.inference.weights import WeightStore
 from areal_tpu.models import hf_io
 from areal_tpu.models.config import ModelConfig, load_hf_config
@@ -161,6 +162,16 @@ class _Request:
     # the request drains on the buffer that prefilled it (the store
     # holds one pin per such request until it finishes/preempts)
     weight_version: int = 0
+    # --- multi-policy plane (r19) ---
+    # named policy line this request decodes on ("" = the default line:
+    # self.params / WeightStore, exactly the pre-r19 engine). submit()
+    # resolves the raw handle (name[@vN|@stable|@canary], canary split
+    # applied ONCE there) to (policy, policy_version); admission
+    # re-checks liveness and stamps weight_version = the line's version
+    # (version ints are per-line, so every (policy, weight_version)
+    # comparison must carry the name)
+    policy: str = ""
+    policy_version: int = 0
     # multimodal payload (VLM serving): pixel_values [P, Dp],
     # vis_seg/vis_pos_h/vis_pos_w [P], mm_index [plen] (-1 = text),
     # mrope_pos [plen, 3]; rope_delta shifts decode rope positions
@@ -254,6 +265,7 @@ def _parse_request(payload: Dict[str, Any], fut: Future) -> _Request:
             else None
         ),
         resumed=bool(payload.get("resumed")),
+        policy=str(payload.get("policy") or ""),
         submit_time=submit_time,
         input_ids=list(payload["input_ids"]),
         max_new_tokens=int(sp.get("max_new_tokens", 128)),
@@ -509,6 +521,26 @@ class GenerationEngine:
         self.weights = WeightStore(staging_ttl_s=wt.staging_ttl_s)
         self._leaf_shardings: Optional[Dict[str, Any]] = None
         self._cohort_rr = 0  # round-robin cursor over version cohorts
+        # --- multi-policy serving plane (r19): N named policy lines on
+        # this one engine, each with its own buffers/pins/KV namespace.
+        # Strictly no-op until the first named push: `active` stays
+        # False (the hot-loop gate), no namespace caches exist, and
+        # metrics() emits zero policy keys. Cold named buffers demote
+        # to host RAM (LRU past max_resident; pinned = undemotable) and
+        # reload on the next request that resolves to them.
+        pol = getattr(config, "policy", None)
+        self._policies = PolicyRegistry(
+            to_host=jax.device_get,
+            to_device=self._place_params,
+            max_resident=int(getattr(pol, "max_resident", 2) or 0),
+            staging_ttl_s=wt.staging_ttl_s,
+        )
+        # (name, version) -> that namespace's own prefix cache sharing
+        # self.pm — a canary's pages can never be claimed by the stable
+        # line because claims/publishes never cross namespaces. KV
+        # tiers/shipping stay default-namespace-only (the spill store
+        # and /kv_export are keyed by token content, not policy).
+        self._policy_caches: Dict[tuple, Any] = {}
         self._sweep_tick = 0
         self._paused = threading.Event()
         self._running = False
@@ -848,6 +880,7 @@ class GenerationEngine:
         # the store: the pending flip fails now and later queue_flip
         # calls fail fast
         self.weights.close()
+        self._policies.close()
         # non-HTTP deployments: drain remaining spans to the configured
         # JSONL sink (the server path drains via GET /trace instead)
         self.tracer.flush()
@@ -867,6 +900,19 @@ class GenerationEngine:
             # span this engine records for the rid joins the originating
             # episode's timeline
             self.tracer.bind_trace(req.rid, str(trace_ctx))
+        if req.policy:
+            # resolve the handle on the CALLER thread — an unknown name
+            # or dead selector is the client's mistake, rejected as a
+            # typed 4xx (never retried) before the request touches the
+            # queue. The canary split advances HERE, exactly once per
+            # request (admission only re-checks liveness).
+            try:
+                name, ver = self._policies.resolve(req.policy)
+            except UnknownPolicyError as e:
+                self.tracer.unbind_trace(req.rid)
+                fut.set_exception(e)
+                return fut
+            req.policy, req.policy_version = name, ver
         bs = self.cache_config.page_size
         if len(req.input_ids) >= self.config.max_model_len:
             fut.set_exception(
@@ -1048,6 +1094,88 @@ class GenerationEngine:
         done = Future()
         self._command_queue.put(("update_weights_chunk", (header, arrays), done))
         return done.result(timeout=600)
+
+    # ------------------------------------------------------------------
+    # Multi-policy plane (r19): named-handle weight pushes + lifecycle.
+    # All of these run on HTTP handler threads — the registry is
+    # thread-safe and a push never touches self.params, so there is no
+    # flip, no pipeline drain, and NO pause span by construction: a new
+    # named version simply starts serving at its next admission wave.
+    # ------------------------------------------------------------------
+    def _check_policy_capable(self):
+        if not self._compact_enabled:
+            # named cohorts ride the row-gathered (compact) decode
+            # dispatch; the full-slot TP dispatch cannot split params
+            # per cohort
+            raise RuntimeError(
+                "multi-policy serving needs the compacted decode "
+                "dispatch (single-device serving with "
+                "decode_compact=true)"
+            )
+
+    def update_policy_from_disk(
+        self,
+        name: str,
+        path: str,
+        version: Optional[int] = None,
+        canary_fraction: float = 0.0,
+    ) -> int:
+        """Install checkpoint ``path`` on named line ``name`` (register
+        on first push; ``canary_fraction > 0`` stages it as the line's
+        canary at that traffic split). Load + place happen on THIS
+        handler thread while decode runs."""
+        self._check_policy_capable()
+        host = hf_io.load_params(path, self.model_config, dtype=self.dtype)
+        placed = self._place_params(host)
+        v = self._policies.push(
+            name, placed, version=version,
+            canary_fraction=canary_fraction,
+        )
+        self.tracer.instant(
+            "policy_push", "__engine__", policy=name, version=v,
+            canary_fraction=canary_fraction,
+        )
+        return v
+
+    def update_policy_chunk(
+        self, name: str, header: Dict, arrays: Dict[str, Any]
+    ):
+        """Streamed FFD-chunk push targeting a named line (the wire
+        format of ``update_weights_chunk`` plus a policy name; the
+        final chunk's header may carry ``canary_fraction``)."""
+        self._check_policy_capable()
+        out = self._policies.ingest_chunk(
+            name, header, arrays, self._place_leaf
+        )
+        if out is None:
+            return {"staged": int(header["chunk_index"]) + 1}
+        self.tracer.instant(
+            "policy_push", "__engine__", policy=name, version=out,
+            canary_fraction=float(header.get("canary_fraction", 0.0)),
+        )
+        return {"version": out, "complete": True, "policy": name}
+
+    def promote_policy(self, name: str) -> int:
+        """Canary → stable on line ``name``. Registry state only: no
+        buffer movement, no pause span, and the promoted version's KV
+        namespace survives (its version int is unchanged)."""
+        v = self._policies.promote(name)
+        self.tracer.instant(
+            "policy_promote", "__engine__", policy=name, version=v
+        )
+        return v
+
+    def retire_policy(self, name: str):
+        self._policies.retire(name)
+        self.tracer.instant(
+            "policy_retire", "__engine__", policy=name
+        )
+
+    def set_policy_split(self, name: str, canary_fraction: float):
+        self._policies.set_split(name, canary_fraction)
+
+    def policy_status(self) -> Dict[str, Any]:
+        return self._policies.stats()
 
     def precompile(self) -> Optional[Dict[str, Any]]:
         """AOT-precompile the shape ladder per ``config.precompile``
@@ -1304,6 +1432,24 @@ class GenerationEngine:
                 kv_ship_pages_in_total=self.kv_ship_pages_in_total,
                 kv_ship_failures_total=self.kv_ship_failures_total,
             )
+        if self._policies.active:
+            # multi-policy surface (r19): present ONLY once a named
+            # policy has been pushed — single-policy mode is a strict
+            # no-op, metric keys included. Literal kwargs (not a blind
+            # dict merge) so ARL003's static extraction sees every name.
+            pstats = self._policies.metrics()
+            m.update(
+                policy_lines=pstats["policy_lines"],
+                policy_buffers_resident=pstats["policy_buffers_resident"],
+                policy_buffers_host=pstats["policy_buffers_host"],
+                policy_pinned_requests=pstats["policy_pinned_requests"],
+                policy_pushes_total=pstats["policy_pushes_total"],
+                policy_promotes_total=pstats["policy_promotes_total"],
+                policy_demotions_total=pstats["policy_demotions_total"],
+                policy_reloads_total=pstats["policy_reloads_total"],
+                policy_staging_bytes=pstats["policy_staging_bytes"],
+                policy_cache_namespaces=float(len(self._policy_caches)),
+            )
         return m
 
     # ------------------------------------------------------------------
@@ -1329,6 +1475,14 @@ class GenerationEngine:
                 # client that died mid-stream must not pin staging
                 self._sweep_tick = 0
                 self.weights.sweep()
+                if self._policies.active:
+                    self._policies.sweep()
+            if self._policies.dirty:
+                # a push/promote/retire superseded a (policy, version):
+                # its KV namespace is garbage for future claimants —
+                # flush it here because the loop thread owns the
+                # namespace map (the registry only signals)
+                self._flush_retired_policies()
             if self._paused.is_set() or not self._command_queue.empty():
                 # command work (weight swaps, aborts) and every paused
                 # moment book to weight_pause — the capacity a weight
@@ -1460,11 +1614,16 @@ class GenerationEngine:
             old_version, old_params = self.model_version, self.params
             pinned = 0
             if policy == "resume":
+                # a default-line flip only aborts DEFAULT-line requests:
+                # named policy cohorts decode on their own registry
+                # buffers and are untouched (a canary push on `actor`
+                # must not disturb `opponent` traffic — same rule)
                 for slot in list(self._active):
-                    self._finish(slot, "abort")
+                    if not self._active[slot].policy:
+                        self._finish(slot, "abort")
             elif version != old_version:
                 for req in self._active.values():
-                    if req.weight_version == old_version:
+                    if not req.policy and req.weight_version == old_version:
                         self.weights.retain(old_version, old_params)
                         pinned += 1
             self.params = params
@@ -1503,14 +1662,20 @@ class GenerationEngine:
         them into the suffix-resume contract instead; under the legacy
         paused protocol the pause already aborted everything, so this
         is a no-op there."""
-        if self._active and not self._paused.is_set():
+        default_slots = [
+            sl for sl, r in self._active.items() if not r.policy
+        ]
+        if default_slots and not self._paused.is_set():
             logger.warning(
                 f"legacy weight swap on an unpaused engine: aborting "
-                f"{len(self._active)} in-flight request(s) into "
+                f"{len(default_slots)} in-flight request(s) into "
                 f"suffix-resume (enable weights.streaming for "
                 f"zero-pause flips)"
             )
-            for slot in list(self._active):
+            # named policy cohorts keep decoding: the swap replaces the
+            # DEFAULT line's params only, and their KV namespaces are
+            # (policy, version)-keyed
+            for slot in default_slots:
                 self._finish(slot, "abort")
 
     def _drain_commands(self) -> bool:
@@ -1623,12 +1788,54 @@ class GenerationEngine:
     # Page accounting
     # ------------------------------------------------------------------
     def _alloc_pages(self, n: int) -> Optional[List[int]]:
-        """Allocate n pages, evicting the prefix registry if needed."""
+        """Allocate n pages, evicting the prefix registry if needed
+        (default namespace first — it is the hot one — then the named
+        policy namespaces)."""
         pages = self.pm.alloc(n)
         if pages is None:
             self.registry.evict(self.pm, n)
+            for cache in self._policy_caches.values():
+                if self.pm.n_free >= n:
+                    break
+                cache.evict(self.pm, n - self.pm.n_free)
             pages = self.pm.alloc(n)
         return pages
+
+    # ------------------------------------------------------------------
+    # Multi-policy plane (r19): per-(policy, version) KV namespaces
+    # ------------------------------------------------------------------
+    def _policy_cache(self, name: str, version: int):
+        """The prefix cache for one (policy, version) namespace, built
+        lazily on first admission. Same mode/grain as the default
+        registry, same page pool — isolation is by construction: claims
+        and publishes never cross namespaces, so a canary's pages can
+        never serve the stable line (or vice versa)."""
+        key = (name, version)
+        cache = self._policy_caches.get(key)
+        if cache is None:
+            bs = self.cache_config.page_size
+            if self._radix:
+                from areal_tpu.ops.paged_attention import pack_factor
+
+                cache = RadixPrefixCache(
+                    bs, self.config.prefix_reuse_min,
+                    grain=pack_factor(self.model_config.head_dim),
+                )
+            else:
+                cache = PrefixRegistry(bs, self.config.prefix_reuse_min)
+            self._policy_caches[key] = cache
+        return cache
+
+    def _flush_retired_policies(self):
+        """Flush KV namespaces whose (policy, version) no longer serves
+        (superseded by a push, or the line retired). Loop thread only —
+        it owns the namespace map. Active slots' pages are request-owned
+        and survive (same contract as the default registry flush at a
+        weight flip)."""
+        for key in self._policies.drain_retired():
+            cache = self._policy_caches.pop(key, None)
+            if cache is not None:
+                cache.flush(self.pm)
 
     # ------------------------------------------------------------------
     # Hierarchical KV tiers (r16): demotion gather / promotion scatter,
@@ -1871,17 +2078,33 @@ class GenerationEngine:
         # a pinned victim's pages hold OLD-version KV: parking them in
         # the (already-flushed) registry would let a new-version request
         # claim stale state — release outright, and drop the store pin
-        # (the request re-prefills under the current weights)
-        self._release_slot(
-            slot,
-            park_tokens=(
-                req.all_tokens
-                if req.weight_version == self.model_version
-                else None
-            ),
-        )
-        if req.weight_version != self.model_version:
-            self.weights.release(req.weight_version)
+        # (the request re-prefills under the current weights). A NAMED
+        # victim parks into its own (policy, version) namespace while
+        # that pair still serves, and always drops its registry pin.
+        if req.policy:
+            self._release_slot(
+                slot,
+                park_tokens=(
+                    req.all_tokens
+                    if self._policies.is_live(
+                        req.policy, req.weight_version
+                    )
+                    else None
+                ),
+                ns=(req.policy, req.weight_version),
+            )
+            self._policies.release(req.policy, req.weight_version)
+        else:
+            self._release_slot(
+                slot,
+                park_tokens=(
+                    req.all_tokens
+                    if req.weight_version == self.model_version
+                    else None
+                ),
+            )
+            if req.weight_version != self.model_version:
+                self.weights.release(req.weight_version)
         req.slot = None
         req.preemptions += 1
         self.total_preemptions += 1
@@ -1950,12 +2173,21 @@ class GenerationEngine:
         )
         return True
 
-    def _release_slot(self, slot: int, park_tokens: Optional[List[int]]):
+    def _release_slot(
+        self,
+        slot: int,
+        park_tokens: Optional[List[int]],
+        ns: Optional[tuple] = None,
+    ):
         """Free a slot; its pages go to the registry (shared-prefix pool)
         or straight back to the allocator. While decode chunks are in
         flight the release is DEFERRED — an in-flight chunk may still
         write into these pages (host-backstop stops finish a slot the
-        device considers active)."""
+        device considers active). ``ns`` = the (policy, version) KV
+        namespace the pages belong to (None = the default registry);
+        carried by KEY through the deferral so a namespace retired
+        while the release waits degrades to a plain free, never a park
+        into an orphaned cache."""
         pages = self._slot_pages.pop(slot, [])
         cached = int(self._cached_len[slot])
         if self._proposer is not None:
@@ -1982,20 +2214,28 @@ class GenerationEngine:
             else None
         )
         if self._inflight:
-            self._deferred_release.append((pages, tokens))
+            self._deferred_release.append((pages, tokens, ns))
         else:
-            self._do_release(pages, tokens)
+            self._do_release(pages, tokens, ns)
 
-    def _do_release(self, pages: List[int], tokens: Optional[np.ndarray]):
-        if tokens is not None:
-            self.registry.add(self.pm, tokens, pages)
+    def _do_release(
+        self,
+        pages: List[int],
+        tokens: Optional[np.ndarray],
+        ns: Optional[tuple] = None,
+    ):
+        cache = (
+            self.registry if ns is None else self._policy_caches.get(ns)
+        )
+        if tokens is not None and cache is not None:
+            cache.add(self.pm, tokens, pages)
         else:
             self.pm.release(pages)
 
     def _flush_deferred(self):
         if not self._inflight:
-            for pages, tokens in self._deferred_release:
-                self._do_release(pages, tokens)
+            for pages, tokens, ns in self._deferred_release:
+                self._do_release(pages, tokens, ns)
             self._deferred_release.clear()
 
     def _drain_pipeline(self):
@@ -2138,6 +2378,67 @@ class GenerationEngine:
             self._pending = [
                 r for r in self._pending if (r.mm is not None) == kind_mm
             ]
+        # --- one policy cohort per wave (r19): each wave prefills under
+        # ONE param buffer, so mixed-policy pendings split across waves
+        # (the modality-split deferral pattern above). Named requests
+        # re-resolve to their line's CURRENT effective version — a push
+        # that dropped the version they resolved at submit redirects
+        # them to the new stable instead of failing them; a line
+        # retired while they queued fails them typed. The whole block
+        # is gated on `active`, so the single-policy path never runs it.
+        wave_params = self.params
+        wave_ns: Optional[tuple] = None
+        if self._policies.active and self._pending:
+            keep: List[_Request] = []
+            have_key = False
+            wave_key: Optional[tuple] = None
+            for r in self._pending:
+                try:
+                    key = (
+                        (
+                            r.policy,
+                            self._policies.effective_version(
+                                r.policy, r.policy_version
+                            ),
+                        )
+                        if r.policy
+                        else None
+                    )
+                except UnknownPolicyError as e:
+                    self.tracer.unbind_trace(r.rid)
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                    continue
+                if not have_key:
+                    wave_key, have_key = key, True
+                if key == wave_key:
+                    if r.policy:
+                        r.policy_version = key[1]
+                    keep.append(r)
+                else:
+                    later.append(r)
+            if wave_key is not None and keep:
+                try:
+                    # fetch (and, for a host-demoted buffer, reload)
+                    # the cohort's params now — the wave's prefill and
+                    # mm-embed dispatches both run under this buffer
+                    wave_params = self._policies.params_for(*wave_key)
+                    wave_ns = wave_key
+                except UnknownPolicyError:
+                    # the version died between resolve and fetch (push
+                    # race): requeue — next tick re-resolves to the
+                    # line's new stable or fails typed
+                    later.extend(keep)
+                    keep = []
+            self._pending = keep
+            if not self._pending:
+                self._pending = later
+                return False
+        wave_cache = (
+            self.registry
+            if wave_ns is None
+            else self._policy_cache(*wave_ns)
+        )
         # --- select: group identical prompts; <= wave unique prompts,
         # total admitted <= free slots ---
         groups: Dict[tuple, List[_Request]] = {}
@@ -2243,15 +2544,17 @@ class GenerationEngine:
                 # pixel-conditioned KV: no token-keyed prefix reuse
                 shared, off = [], 0
             elif self._radix:
-                shared, off, src, _cow_n = self.registry.claim_cow(
+                shared, off, src, _cow_n = wave_cache.claim_cow(
                     self.pm, prompt
                 )
-                if self._kv_tiers is not None:
+                if self._kv_tiers is not None and wave_ns is None:
                     # pages the descent promoted from the host tier —
                     # the hit-rate split between device and host tiers
+                    # (tiers attach to the DEFAULT tree only; a named
+                    # wave's claim never touches the spill store)
                     host_toks = self._kv_tiers.last_claim_promoted * bs
             else:
-                shared, off = self.registry.claim(self.pm, prompt)
+                shared, off = wave_cache.claim(self.pm, prompt)
             end = plen
             stalled = escaped = False
             if budget_c > 0 and rep.mm is None and plen - off > budget_c:
@@ -2492,7 +2795,7 @@ class GenerationEngine:
                     )
                     pos3[i, n_p:L] = ext[:, None]
             pf_embeds = model_runner.mm_prompt_embeds(
-                self.params, self.model_config, jnp.asarray(tokens),
+                wave_params, self.model_config, jnp.asarray(tokens),
                 jnp.asarray(pix), jnp.asarray(seg), jnp.asarray(ph),
                 jnp.asarray(pw), jnp.asarray(ords),
             )
@@ -2515,7 +2818,7 @@ class GenerationEngine:
             ),
         ):
             self.cache, wave_logits, pf_last = model_runner.prefill_batch(
-                self.params, self.model_config, self.cache,
+                wave_params, self.model_config, self.cache,
                 tokens_dev, offsets_dev,
                 lens_dev, tables_dev,
                 prefix_bound=pf_prefix_bound,
@@ -2538,7 +2841,7 @@ class GenerationEngine:
                 if group[0].mm is None and chunk_ends[i] == len(
                     group[0].all_tokens
                 ):
-                    self.registry.publish(
+                    wave_cache.publish(
                         self.pm,
                         np.asarray(group[0].all_tokens, np.int32),
                         pages,
@@ -2563,7 +2866,7 @@ class GenerationEngine:
                 plen = len(rep.all_tokens)
                 if end == plen:
                     continue
-                self.registry.add(
+                wave_cache.add(
                     self.pm,
                     np.asarray(rep.all_tokens[:end], np.int32),
                     pages,
@@ -2836,8 +3139,15 @@ class GenerationEngine:
         # (re-)admission decodes under the CURRENT weights: a preempted
         # pin-policy request re-prefills here on the new version (its
         # already-emitted tokens keep their old per-token version stamps
-        # — the recorded-switch half of the fence invariant)
-        req.weight_version = self.model_version
+        # — the recorded-switch half of the fence invariant). A NAMED
+        # request decodes under its line's resolved version instead,
+        # and holds one registry pin for this slot life — the buffer is
+        # undemotable and undroppable until _finish/_preempt releases.
+        if req.policy:
+            req.weight_version = req.policy_version
+            self._policies.retain(req.policy, req.policy_version)
+        else:
+            req.weight_version = self.model_version
         self._active[slot] = req
         self._slot_pages[slot] = pages
         self._cached_len[slot] = cached
@@ -2896,6 +3206,10 @@ class GenerationEngine:
                     # mechanism at decode_pipeline=2)
                     return False
                 self.registry.evict(self.pm, shortfall)
+                for cache in self._policy_caches.values():
+                    if shortfall <= self.pm.n_free:
+                        break
+                    cache.evict(self.pm, shortfall - self.pm.n_free)
             if shortfall <= self.pm.n_free:
                 for slot, n in grow:
                     pages = self.pm.alloc(n)
@@ -3038,12 +3352,16 @@ class GenerationEngine:
         # starves); speculation sits out the transient — its
         # drain-for-drafts scheduling assumes one dispatch serves every
         # active slot
+        # cohort keys are (policy, version) — version ints are per-LINE
+        # (actor@v12 and opponent@v12 are different buffers), so the
+        # bare int the r13 flip machinery used would collide across
+        # lines. The default line's key is ("", model_version).
         versions = (
-            {r.weight_version for r in self._active.values()}
+            {(r.policy, r.weight_version) for r in self._active.values()}
             if self._active
             else set()
         )
-        mixed = bool(versions - {self.model_version})
+        mixed = bool(versions - {("", self.model_version)})
         if self._spec_on() and self._active and not mixed:
             if not self._inflight:
                 drafts = self._propose_drafts() or None
@@ -3087,23 +3405,25 @@ class GenerationEngine:
                         # empty cohort against a freed buffer and kill
                         # the loop thread
                         versions = {
-                            r.weight_version
+                            (r.policy, r.weight_version)
                             for r in self._active.values()
                         }
-                        mixed = bool(versions - {self.model_version})
+                        mixed = bool(
+                            versions - {("", self.model_version)}
+                        )
                         if mixed:
                             order = sorted(versions)
-                            v = order[self._cohort_rr % len(order)]
+                            ck = order[self._cohort_rr % len(order)]
                             self._cohort_rr += 1
                             cohort_slots = sorted(
                                 sl
                                 for sl, r in self._active.items()
-                                if r.weight_version == v
+                                if (r.policy, r.weight_version) == ck
                             )
                             if cohort_slots:
                                 self._dispatch_chunk(
                                     steps, margin,
-                                    cohort=(cohort_slots, v),
+                                    cohort=(cohort_slots, ck),
                                 )
                                 dispatched = did = True
                         elif self._active:
@@ -3163,11 +3483,12 @@ class GenerationEngine:
         contract, so everything downstream (row→slot scatter, packed
         fetch, _process_chunk) is shared.
 
-        ``cohort`` = ``(slots, weight_version)`` restricts the dispatch
-        to one weight-version cohort after a pin-policy flip: pinned
-        slots decode with the store's retained buffer while flipped
-        slots decode with ``self.params`` — two interleaved dispatches
-        instead of one, each with exact per-token version attribution.
+        ``cohort`` = ``(slots, (policy, version))`` restricts the
+        dispatch to one weight cohort — a pin-policy flip's survivors
+        decode with the store's retained buffer, a NAMED policy's
+        requests with its registry buffer, while current default slots
+        decode with ``self.params`` — interleaved dispatches, each with
+        exact per-token version attribution.
         Cohort dispatches always take the compact gather path (a
         full-width dispatch would run the other cohort's rows under the
         wrong params)."""
@@ -3179,18 +3500,26 @@ class GenerationEngine:
             params = self.params
             version = self.model_version
         else:
-            slots, version = cohort
-            params = (
-                self.params
-                if version == self.model_version
-                else self.weights.params_for(version)
-            )
+            slots, (cname, version) = cohort
+            if cname:
+                # named cohort: the registry holds the buffer (the
+                # cohort's requests pin it, so it cannot have been
+                # demoted or dropped; a host reload here is impossible
+                # while pins are held but would be correct anyway)
+                params = self._policies.params_for(cname, version)
+            else:
+                params = (
+                    self.params
+                    if version == self.model_version
+                    else self.weights.params_for(version)
+                )
             if params is None:
                 # cannot happen while the cohort exists (its requests
                 # hold pins) — decoding them on the wrong weights would
                 # silently corrupt the version fence, so fail loudly
                 raise RuntimeError(
-                    f"no weight buffer for pinned version {version}"
+                    f"no weight buffer for pinned version "
+                    f"{cname or 'default'}@v{version}"
                 )
         pps = self._pages_bound(margin, slots)
         n_active = len(slots)
@@ -3598,7 +3927,10 @@ class GenerationEngine:
                 req.first_token_time = time.monotonic()
             req.output_ids.append(int(toks[i]))
             req.output_logprobs.append(float(logps[i]))
-            req.output_versions.append(self.model_version)
+            # the admission-time first token: _install just stamped
+            # weight_version (== model_version on the default line, the
+            # resolved line version on a named one) — exact either way
+            req.output_versions.append(req.weight_version)
             if self._proposer is not None:
                 self._proposer.extend(slot, [int(toks[i])])
             self.total_generated_tokens += 1
@@ -3636,19 +3968,38 @@ class GenerationEngine:
         # the slot's pages hold the prompt plus all generated tokens
         # except the last sampled one (it was never fed back). A request
         # that finished pinned to a pre-flip version holds OLD-version
-        # KV: never park it for new-version claimants.
-        self._release_slot(
-            slot,
-            park_tokens=(
-                req.all_tokens
-                if self.config.prefix_reuse_min > 0
-                and req.weight_version == self.model_version
-                else None
-            ),
-        )
-        if req.weight_version != self.model_version:
-            # last pin out drops the old buffer (HBM back)
-            self.weights.release(req.weight_version)
+        # KV: never park it for new-version claimants. A NAMED request
+        # parks into its own (policy, version) namespace — but only
+        # while that pair still serves (no future claimants otherwise)
+        # — and always drops its registry pin.
+        if req.policy:
+            self._release_slot(
+                slot,
+                park_tokens=(
+                    req.all_tokens
+                    if self.config.prefix_reuse_min > 0
+                    and self._policies.is_live(
+                        req.policy, req.weight_version
+                    )
+                    else None
+                ),
+                ns=(req.policy, req.weight_version),
+            )
+            self._policies.release(req.policy, req.weight_version)
+            self._policies.note_tokens(req.policy, len(req.output_ids))
+        else:
+            self._release_slot(
+                slot,
+                park_tokens=(
+                    req.all_tokens
+                    if self.config.prefix_reuse_min > 0
+                    and req.weight_version == self.model_version
+                    else None
+                ),
+            )
+            if req.weight_version != self.model_version:
+                # last pin out drops the old buffer (HBM back)
+                self.weights.release(req.weight_version)
         now = time.monotonic()
         if reason != "abort":
             # aborts are pause-window resumes, not client-visible
@@ -3691,6 +4042,16 @@ class GenerationEngine:
                 "model_version": self.model_version,
                 "preemptions": req.preemptions,
                 "cached_tokens": req.cached_tokens,
+                # named requests carry their handle resolution; the
+                # default line adds NO new keys (strict no-op contract)
+                **(
+                    {
+                        "policy": req.policy,
+                        "policy_version": req.weight_version,
+                    }
+                    if req.policy
+                    else {}
+                ),
             },
         }
         if not req.future.done():

@@ -1,0 +1,708 @@
+"""Multi-policy serving plane: the server-side `PolicyRegistry`.
+
+Role: generalize the r13 `WeightStore` — one linear version history per
+engine — to N NAMED policy handles, each with its own version line,
+pins, and cohort dispatches, so one engine serves e.g. ``actor@v12``
+(90%), ``actor@v13`` (10% canary), and ``opponent@v7`` concurrently.
+
+The handle contract (stringly-typed, stamped end to end —
+workflow metadata → ``remote.agenerate`` → router schedule → /generate
+payload → engine admission):
+
+- ``""`` / absent      — the DEFAULT line: the engine's own
+  ``self.params`` / ``self.model_version`` served exactly as before
+  this subsystem existed. The registry never touches it; with no named
+  line registered the whole plane is a strict no-op (bit-identical
+  greedy streams, zero new metric keys).
+- ``"name"``           — the named line's deterministic stable/canary
+  split (below); with no canary staged, its stable version.
+- ``"name@stable"``    — the stable version explicitly.
+- ``"name@canary"``    — the canary version (error if none staged).
+- ``"name@v<N>"``      — version N exactly (error if N is not live).
+
+An unknown name (or a dead version selector) raises
+:class:`UnknownPolicyError` — typed, carrying ``status=400`` so the
+server answers a 4xx that ``utils/http.py``'s 5xx-only retry policy
+propagates immediately instead of hammering a request that can never
+succeed.
+
+Line lifecycle: ``push`` (register-on-first-push; replaces stable, or
+stages a canary when a split fraction rides along) → ``promote`` (the
+canary becomes stable — pure registry state, no buffer movement, no
+pause span; the canary's per-(policy, version) KV namespace stays valid
+because the version int didn't change) → ``retire`` (drop the line;
+refused while any request pins one of its buffers).
+
+Canary split: a per-line DETERMINISTIC error accumulator
+(``err += fraction; err >= 1 → canary, err -= 1``) rather than RNG —
+a 90/10 split lands within one request of exact over any window, which
+is what the ±3%-over-200-requests acceptance gate measures. The router
+runs the same accumulator fleet-side; the engine's copy covers
+direct-to-server callers and keeps single-server tests deterministic.
+
+HBM pressure: cold named buffers demote to host RAM (the r16 spill
+pattern applied to parameter pytrees) past ``max_resident`` resident
+named buffers, LRU, and reload on the next request that resolves to
+them. A pinned buffer — any in-flight request decoding on it — is
+never demotable, so eviction of an in-use buffer is impossible by
+construction, not by timing.
+
+Like `WeightStore`, the registry is deliberately engine-agnostic: it
+never imports jax. The engine supplies ``to_host(tree)`` /
+``to_device(tree)`` callables (and `WeightStore`'s ``place_leaf`` for
+chunked ingest), so the registry unit-tests without a device.
+
+NOTE: the /metrics surface (policy_lines, policy_buffers_resident,
+policy_buffers_host, policy_demotions_total, policy_reloads_total,
+policy_pinned_requests, policy_pushes_total, policy_promotes_total and
+the per-policy ``policy_*{policy="..."}`` families) lives INLINE in
+``GenerationEngine.metrics()`` — the arealint ARL003 static scan
+extracts names from that literal, same as the WeightStore counters.
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.inference.weights import WeightStore
+from areal_tpu.utils import logging as logging_util
+
+logger = logging_util.getLogger("PolicyRegistry")
+
+
+class UnknownPolicyError(Exception):
+    """A request named a policy handle this server does not serve.
+
+    Carries ``status = 400``: the request itself is wrong (a typo, a
+    retired line, a dead pinned version) — retrying it verbatim can
+    never succeed, so the server must answer a 4xx that the client's
+    5xx-only retry policy (utils/http.py) propagates immediately. A
+    500 here would burn the whole retry budget per request and then
+    surface as a server-health failure, poisoning failover decisions
+    for a client-side mistake."""
+
+    status = 400
+
+    def __init__(self, handle: str, reason: str = "unknown policy"):
+        self.handle = handle
+        self.reason = reason
+        super().__init__(f"{reason}: {handle!r}")
+
+
+def parse_handle(handle: str) -> Tuple[str, Optional[Any]]:
+    """``handle`` → ``(name, selector)`` where selector is None (split),
+    ``"stable"``, ``"canary"``, or an int version. Grammar errors raise
+    :class:`UnknownPolicyError` (they are client mistakes, 4xx)."""
+    handle = str(handle)
+    if "@" not in handle:
+        if not handle:
+            raise UnknownPolicyError(handle, "empty policy handle")
+        return handle, None
+    name, _, sel = handle.partition("@")
+    if not name or not sel:
+        raise UnknownPolicyError(handle, "malformed policy handle")
+    if sel in ("stable", "canary"):
+        return name, sel
+    if sel.startswith("v") and sel[1:].isdigit():
+        return name, int(sel[1:])
+    raise UnknownPolicyError(
+        handle, "bad version selector (want @stable, @canary, or @v<N>)"
+    )
+
+
+class _PolicyLine:
+    """One named policy's version line: stable (+ optional canary)
+    buffers, per-version pins, chunked-push staging, split state."""
+
+    __slots__ = (
+        "name", "stable_version", "canary_version", "canary_fraction",
+        "split_err", "buffers", "host_buffers", "pins", "last_used",
+        "staging", "requests_total", "tokens_total",
+    )
+
+    def __init__(self, name: str, staging_ttl_s: float):
+        self.name = name
+        self.stable_version = 0
+        self.canary_version: Optional[int] = None
+        self.canary_fraction = 0.0
+        self.split_err = 0.0
+        # version -> device params (resident) / host params (demoted).
+        # A version lives in exactly one of the two maps.
+        self.buffers: Dict[int, Any] = {}
+        self.host_buffers: Dict[int, Any] = {}
+        self.pins: Dict[int, int] = {}
+        self.last_used = 0.0
+        # chunked streamed pushes reuse the WeightStore staging machinery
+        # (re-key on (version, n_chunks), TTL sweep, staging gauges)
+        self.staging = WeightStore(staging_ttl_s=staging_ttl_s)
+        self.requests_total = 0
+        self.tokens_total = 0
+
+    def live_versions(self) -> List[int]:
+        out = [self.stable_version]
+        if self.canary_version is not None:
+            out.append(self.canary_version)
+        return out
+
+
+class PolicyRegistry:
+    """Named policy lines for one generation engine. Thread-safe:
+    pushes/ingest run on HTTP handler threads, resolution runs on the
+    submit (caller) thread, pins/params lookups run on the engine loop
+    thread. ``active`` is a lock-free hot-loop gate — False until the
+    first line registers, so the single-policy engine loop pays one
+    attribute read and nothing else."""
+
+    def __init__(
+        self,
+        to_host: Optional[Callable[[Any], Any]] = None,
+        to_device: Optional[Callable[[Any], Any]] = None,
+        max_resident: int = 0,
+        staging_ttl_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._to_host = to_host
+        self._to_device = to_device
+        self.max_resident = int(max_resident)
+        self.staging_ttl_s = float(staging_ttl_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._lines: Dict[str, _PolicyLine] = {}
+        # (name, version) pairs whose KV namespaces became garbage (a
+        # push superseded the version, or the line retired); the engine
+        # loop drains this and flushes each namespace — namespace maps
+        # are loop-owned, so the registry only signals.
+        self._retired: List[Tuple[str, int]] = []
+        self.active = False  # lock-free: engine hot-loop gate
+        # lifetime counters (engine /metrics surface, inline literal)
+        self.pushes_total = 0
+        self.promotes_total = 0
+        self.demotions_total = 0
+        self.reloads_total = 0
+
+    # ------------------------------------------------------------------
+    # Lifecycle: push / promote / retire (HTTP handler threads)
+    # ------------------------------------------------------------------
+    def push(
+        self,
+        name: str,
+        params: Any,
+        version: Optional[int] = None,
+        canary_fraction: float = 0.0,
+    ) -> int:
+        """Install a buffer on line ``name`` (registering the line on
+        first push). With ``canary_fraction > 0`` the buffer becomes the
+        line's CANARY at that split fraction; otherwise it replaces
+        stable outright. Returns the installed version. Superseded
+        unpinned buffers drop immediately (pinned ones drain with their
+        last release); their KV namespaces queue for the engine's flush
+        sweep either way."""
+        if not name:
+            raise ValueError("policy name must be non-empty")
+        if not (0.0 <= canary_fraction < 1.0):
+            raise ValueError(
+                f"canary_fraction must be in [0, 1), got {canary_fraction}"
+            )
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:
+                line = _PolicyLine(name, self.staging_ttl_s)
+                self._lines[name] = line
+                self.active = True
+                fresh = True
+            else:
+                fresh = False
+            if version is None:
+                version = max(line.live_versions()) + 1 if not fresh else 1
+            version = int(version)
+            if not fresh and version in line.live_versions():
+                raise ValueError(
+                    f"policy {name!r} already serves v{version}"
+                )
+            line.buffers[version] = params
+            if canary_fraction > 0.0 and not fresh:
+                old_canary = line.canary_version
+                line.canary_version = version
+                line.canary_fraction = float(canary_fraction)
+                line.split_err = 0.0
+                if old_canary is not None:
+                    self._drop_version_locked(line, old_canary)
+            else:
+                old_stable = None if fresh else line.stable_version
+                line.stable_version = version
+                if canary_fraction > 0.0:
+                    # first push with a fraction: nothing to split
+                    # against yet — the buffer IS the line
+                    logger.warning(
+                        f"policy {name!r}: canary_fraction on the first "
+                        f"push ignored (no stable to split against)"
+                    )
+                if old_stable is not None:
+                    self._drop_version_locked(line, old_stable)
+            line.last_used = self._clock()
+            self.pushes_total += 1
+            self._maybe_demote_locked(keep=(name, version))
+            logger.info(
+                f"policy {name!r} ← v{version}"
+                + (
+                    f" (canary, split {canary_fraction:.2%})"
+                    if canary_fraction > 0.0 and not fresh
+                    else " (stable)"
+                )
+            )
+            return version
+
+    def promote(self, name: str) -> int:
+        """Canary → stable. Pure registry state: no buffer moves, no
+        pause span, and the canary's (policy, version) KV namespace
+        stays valid because the version int is unchanged — promote is
+        zero-cost for in-flight and cached work alike."""
+        with self._lock:
+            line = self._line_locked(name)
+            if line.canary_version is None:
+                raise UnknownPolicyError(
+                    f"{name}@canary", "no canary staged to promote"
+                )
+            old_stable = line.stable_version
+            line.stable_version = line.canary_version
+            line.canary_version = None
+            line.canary_fraction = 0.0
+            line.split_err = 0.0
+            self._drop_version_locked(line, old_stable)
+            self.promotes_total += 1
+            logger.info(
+                f"policy {name!r}: promoted v{line.stable_version} "
+                f"(was v{old_stable})"
+            )
+            return line.stable_version
+
+    def retire(self, name: str) -> None:
+        """Drop a line entirely. Refused while any request pins one of
+        its buffers — retiring mid-decode would dispatch a cohort
+        against a freed buffer."""
+        with self._lock:
+            line = self._line_locked(name)
+            pinned = sum(line.pins.values())
+            if pinned:
+                raise RuntimeError(
+                    f"policy {name!r} has {pinned} pinned request(s); "
+                    f"drain before retiring"
+                )
+            line.staging.close()
+            for v in list(line.buffers) + list(line.host_buffers):
+                self._retired.append((name, v))
+            self._lines.pop(name)
+            self.active = bool(self._lines)
+            logger.info(f"policy {name!r} retired")
+
+    def set_split(self, name: str, canary_fraction: float) -> None:
+        """Adjust a staged canary's traffic fraction in place."""
+        if not (0.0 <= canary_fraction < 1.0):
+            raise ValueError(
+                f"canary_fraction must be in [0, 1), got {canary_fraction}"
+            )
+        with self._lock:
+            line = self._line_locked(name)
+            if line.canary_version is None:
+                raise UnknownPolicyError(
+                    f"{name}@canary", "no canary staged to split"
+                )
+            line.canary_fraction = float(canary_fraction)
+            line.split_err = 0.0
+
+    # ------------------------------------------------------------------
+    # Chunked streamed push (HTTP handler threads)
+    # ------------------------------------------------------------------
+    def ingest_chunk(
+        self,
+        name: str,
+        header: Dict[str, Any],
+        arrays: Dict[str, Any],
+        place_leaf: Callable[[str, Any], Any],
+    ) -> Optional[int]:
+        """Stage one FFD chunk for line ``name`` (registering the line
+        lazily at completion). Returns the installed version when this
+        chunk completes the set, else None. The final chunk's header may
+        carry ``canary_fraction``."""
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:
+                # stage into a provisional line so parallel pushes to
+                # different new names don't share a staging buffer
+                line = _PolicyLine(name, self.staging_ttl_s)
+                self._lines[name] = line
+                self.active = True
+                line.stable_version = -1  # marks "no buffer yet"
+            staging = line.staging
+        done = staging.ingest_chunk(header, arrays, place_leaf)
+        if done is None:
+            return None
+        version, tree = done
+        with self._lock:
+            if line.stable_version == -1:
+                # first completed push registers the line proper
+                line.stable_version = int(version)
+                line.buffers[int(version)] = tree
+                line.last_used = self._clock()
+                self.pushes_total += 1
+                self._maybe_demote_locked(keep=(name, int(version)))
+                logger.info(f"policy {name!r} ← v{version} (stable)")
+                return int(version)
+        return self.push(
+            name, tree, version=int(version),
+            canary_fraction=float(header.get("canary_fraction", 0.0)),
+        )
+
+    def sweep(self) -> None:
+        """Per-line staging TTL sweep (abandoned streamed pushes)."""
+        with self._lock:
+            lines = list(self._lines.values())
+        for line in lines:
+            line.staging.sweep()
+
+    # ------------------------------------------------------------------
+    # Resolution (submit/caller threads) + admission helpers (loop)
+    # ------------------------------------------------------------------
+    def resolve(self, handle: str) -> Tuple[str, int]:
+        """``handle`` → ``(name, version)``. A bare name runs the
+        deterministic stable/canary split — mutating split state, so
+        call this exactly ONCE per request (at submit). Raises
+        :class:`UnknownPolicyError` for unknown names and dead
+        selectors."""
+        name, sel = parse_handle(handle)
+        with self._lock:
+            line = self._line_locked(name, handle=handle)
+            if sel is None:
+                if line.canary_version is None or line.canary_fraction <= 0:
+                    return name, line.stable_version
+                line.split_err += line.canary_fraction
+                if line.split_err >= 1.0:
+                    line.split_err -= 1.0
+                    return name, line.canary_version
+                return name, line.stable_version
+            if sel == "stable":
+                return name, line.stable_version
+            if sel == "canary":
+                if line.canary_version is None:
+                    raise UnknownPolicyError(handle, "no canary staged")
+                return name, line.canary_version
+            if sel in line.buffers or sel in line.host_buffers:
+                return name, int(sel)
+            raise UnknownPolicyError(handle, "version not live")
+
+    def effective_version(self, name: str, version: int) -> int:
+        """The version a request resolved at submit, unless a push
+        dropped that buffer while it queued — then the line's CURRENT
+        stable (re-resolving keeps long-queued requests serveable; the
+        per-token version stamps stay exact because admission stamps
+        the effective version). Read-only: never advances split state."""
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:
+                raise UnknownPolicyError(name, "policy retired while queued")
+            if version in line.buffers or version in line.host_buffers:
+                return int(version)
+            return line.stable_version
+
+    def is_live(self, name: str, version: int) -> bool:
+        """True while (name, version) still serves — the park-at-finish
+        gate: a finished request's pages only enter the (policy,
+        version) namespace while future claimants can exist."""
+        with self._lock:
+            line = self._lines.get(name)
+            return line is not None and version in line.live_versions()
+
+    # ------------------------------------------------------------------
+    # Buffers + pins (engine loop thread)
+    # ------------------------------------------------------------------
+    def params_for(self, name: str, version: int) -> Any:
+        """The buffer for (name, version), reloading a host-demoted one
+        onto the device first. Raises if the pair died — the caller
+        (admission/dispatch) must never run a cohort on the wrong
+        weights silently."""
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:
+                raise UnknownPolicyError(name, "policy retired")
+            line.last_used = self._clock()
+            params = line.buffers.get(version)
+            if params is not None:
+                return params
+            host = line.host_buffers.pop(version, None)
+            if host is None:
+                raise UnknownPolicyError(
+                    f"{name}@v{version}", "version not live"
+                )
+            if self._to_device is None:
+                params = host
+            else:
+                t0 = self._clock()
+                params = self._to_device(host)
+                logger.info(
+                    f"policy {name!r} v{version}: reloaded from host RAM "
+                    f"({(self._clock() - t0) * 1e3:.1f} ms)"
+                )
+            line.buffers[version] = params
+            self.reloads_total += 1
+            self._maybe_demote_locked(keep=(name, version))
+            return params
+
+    def retain(self, name: str, version: int) -> None:
+        """One in-flight request decodes on (name, version): its buffer
+        becomes undemotable (and undropppable) until the pin releases."""
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:  # pragma: no cover - retire refuses pins
+                raise UnknownPolicyError(name, "policy retired")
+            line.pins[version] = line.pins.get(version, 0) + 1
+            line.requests_total += 1
+
+    def release(self, name: str, version: int) -> None:
+        with self._lock:
+            line = self._lines.get(name)
+            if line is None:
+                return  # line retired after the pin drained (shutdown)
+            n = line.pins.get(version, 0) - 1
+            if n > 0:
+                line.pins[version] = n
+                return
+            line.pins.pop(version, None)
+            if version not in line.live_versions():
+                # a superseded buffer just drained its last pin
+                self._drop_version_locked(line, version)
+
+    def note_tokens(self, name: str, n: int) -> None:
+        with self._lock:
+            line = self._lines.get(name)
+            if line is not None:
+                line.tokens_total += n
+
+    def pinned_requests(self) -> int:
+        with self._lock:
+            return sum(
+                sum(line.pins.values()) for line in self._lines.values()
+            )
+
+    # ------------------------------------------------------------------
+    # LRU host demotion (the PR 16 spill pattern, applied to params)
+    # ------------------------------------------------------------------
+    def _resident_named_locked(self) -> List[Tuple[float, str, int]]:
+        out = []
+        for line in self._lines.values():
+            for v in line.buffers:
+                out.append((line.last_used, line.name, v))
+        return sorted(out)
+
+    def _maybe_demote_locked(self, keep: Tuple[str, int]) -> None:
+        """Demote cold unpinned named buffers to host RAM past the
+        ``max_resident`` device budget, LRU by line. ``keep`` (the
+        buffer just installed/used) and every pinned buffer are exempt
+        — eviction of an in-use buffer is impossible, not just
+        unlikely."""
+        if self.max_resident <= 0 or self._to_host is None:
+            return
+        resident = self._resident_named_locked()
+        excess = len(resident) - self.max_resident
+        for _, name, v in resident:
+            if excess <= 0:
+                break
+            if (name, v) == keep:
+                continue
+            line = self._lines[name]
+            if line.pins.get(v, 0) > 0:
+                continue
+            params = line.buffers.pop(v)
+            line.host_buffers[v] = self._to_host(params)
+            self.demotions_total += 1
+            excess -= 1
+            logger.info(
+                f"policy {name!r} v{v}: demoted to host RAM "
+                f"(LRU, {self.max_resident} resident buffers kept)"
+            )
+
+    # ------------------------------------------------------------------
+    # Retired-namespace drain (engine loop thread)
+    # ------------------------------------------------------------------
+    def _drop_version_locked(self, line: _PolicyLine, version: int) -> None:
+        if line.pins.get(version, 0) > 0:
+            # pinned: the buffer drains with its last release(); only
+            # the KV namespace retires now (no future claimants)
+            self._retired.append((line.name, version))
+            return
+        line.buffers.pop(version, None)
+        line.host_buffers.pop(version, None)
+        self._retired.append((line.name, version))
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._retired)
+
+    def drain_retired(self) -> List[Tuple[str, int]]:
+        """(name, version) pairs whose KV namespaces must flush — the
+        engine loop owns the namespace map, so it consumes this."""
+        with self._lock:
+            out, self._retired = self._retired, []
+            return out
+
+    # ------------------------------------------------------------------
+    # Introspection (metrics/endpoints)
+    # ------------------------------------------------------------------
+    def _line_locked(
+        self, name: str, handle: Optional[str] = None
+    ) -> _PolicyLine:
+        line = self._lines.get(name)
+        if line is None or line.stable_version < 0:
+            raise UnknownPolicyError(handle or name)
+        return line
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                n for n, l in self._lines.items() if l.stable_version >= 0
+            )
+
+    def staging_bytes(self) -> int:
+        with self._lock:
+            lines = list(self._lines.values())
+        return sum(line.staging.staging_bytes for line in lines)
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-line snapshot for /metrics families and GET /policy."""
+        with self._lock:
+            out: Dict[str, Dict[str, Any]] = {}
+            for name, line in sorted(self._lines.items()):
+                if line.stable_version < 0:
+                    continue  # provisional (mid-first-push)
+                out[name] = {
+                    "stable_version": line.stable_version,
+                    "canary_version": line.canary_version,
+                    "canary_fraction": line.canary_fraction,
+                    "buffers_resident": len(line.buffers),
+                    "buffers_host": len(line.host_buffers),
+                    "pinned_requests": sum(line.pins.values()),
+                    "requests_total": line.requests_total,
+                    "tokens_total": line.tokens_total,
+                }
+            return out
+
+    def metrics(self) -> Dict[str, float]:
+        """Aggregate gauges/counters. Only merged into the engine's
+        /metrics dict while ``active`` — single-policy mode surfaces
+        zero new keys (the off-mode discipline)."""
+        with self._lock:
+            resident = sum(len(l.buffers) for l in self._lines.values())
+            host = sum(len(l.host_buffers) for l in self._lines.values())
+            pinned = sum(
+                sum(l.pins.values()) for l in self._lines.values()
+            )
+            n = sum(
+                1 for l in self._lines.values() if l.stable_version >= 0
+            )
+        return {
+            "policy_lines": float(n),
+            "policy_buffers_resident": float(resident),
+            "policy_buffers_host": float(host),
+            "policy_pinned_requests": float(pinned),
+            "policy_pushes_total": float(self.pushes_total),
+            "policy_promotes_total": float(self.promotes_total),
+            "policy_demotions_total": float(self.demotions_total),
+            "policy_reloads_total": float(self.reloads_total),
+            "policy_staging_bytes": float(self.staging_bytes()),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            for line in self._lines.values():
+                line.staging.close()
+
+
+class CanarySplitter:
+    """Router-side deterministic stable/canary splitter for one policy
+    name: the same error-accumulator arithmetic the engine registry
+    runs, so a fleet-side split lands within one request of exact over
+    any window. Not thread-safe — callers hold the router lock."""
+
+    __slots__ = (
+        "name", "stable_version", "canary_version", "fraction", "err",
+        "stable_total", "canary_total",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        stable_version: int,
+        canary_version: Optional[int] = None,
+        fraction: float = 0.0,
+    ):
+        if not (0.0 <= fraction < 1.0):
+            raise ValueError(
+                f"canary fraction must be in [0, 1), got {fraction}"
+            )
+        self.name = name
+        self.stable_version = int(stable_version)
+        self.canary_version = (
+            int(canary_version) if canary_version is not None else None
+        )
+        self.fraction = float(fraction)
+        self.err = 0.0
+        self.stable_total = 0
+        self.canary_total = 0
+
+    def pick(self) -> str:
+        """Resolve one bare-name schedule to an exact-version handle."""
+        if self.canary_version is not None and self.fraction > 0.0:
+            self.err += self.fraction
+            if self.err >= 1.0:
+                self.err -= 1.0
+                self.canary_total += 1
+                return f"{self.name}@v{self.canary_version}"
+        self.stable_total += 1
+        return f"{self.name}@v{self.stable_version}"
+
+    def promote(self) -> None:
+        if self.canary_version is None:
+            raise ValueError(f"policy {self.name!r}: no canary to promote")
+        self.stable_version = self.canary_version
+        self.canary_version = None
+        self.fraction = 0.0
+        self.err = 0.0
+
+
+def parse_split_spec(spec: str) -> Dict[str, CanarySplitter]:
+    """Parse the router's ``--policy-split`` grammar:
+    ``name=STABLE[:CANARY:FRACTION][,name=...]`` — e.g.
+    ``actor=12:13:0.1,opponent=7``. Empty string → no splits."""
+    out: Dict[str, CanarySplitter] = {}
+    spec = (spec or "").strip()
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad --policy-split entry {part!r} "
+                f"(want name=STABLE[:CANARY:FRACTION])"
+            )
+        name, _, rhs = part.partition("=")
+        if not name:
+            raise ValueError(
+                f"bad --policy-split entry {part!r}: empty policy name"
+            )
+        fields = rhs.split(":")
+        try:
+            if len(fields) == 1:
+                out[name] = CanarySplitter(name, int(fields[0]))
+            elif len(fields) == 3:
+                out[name] = CanarySplitter(
+                    name, int(fields[0]), int(fields[1]), float(fields[2])
+                )
+            else:
+                raise ValueError(rhs)
+        except ValueError as e:
+            raise ValueError(
+                f"bad --policy-split entry {part!r}: {e} "
+                f"(want name=STABLE[:CANARY:FRACTION])"
+            ) from None
+    return out

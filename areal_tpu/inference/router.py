@@ -48,6 +48,7 @@ from areal_tpu.api.cli_args import (
     TrafficConfig,
 )
 from areal_tpu.inference.fleet import FleetMonitor, ServerState
+from areal_tpu.inference.policies import parse_split_spec
 from areal_tpu.utils import logging as logging_util
 from areal_tpu.utils import name_resolve, names, network
 from areal_tpu.utils.tracing import (
@@ -114,6 +115,23 @@ _METRIC_HELP = {
     "fleet_probe_failures_total": "health probes that failed",
     "fleet_probe_latency_s": "per-server /health probe latency",
     "fleet_server_up": "1 while the labeled server is schedulable",
+    # multi-policy plane (r19): per-policy affinity eviction split —
+    # a default-line weight bump evicts only default-keyed entries, a
+    # named-policy push evicts only that line's entries
+    "qid_affinity_evictions_default_total": (
+        "qid affinities evicted by default-line weight bumps"
+    ),
+    "qid_affinity_evictions_policy_total": (
+        "qid affinities evicted by named-policy pushes/retires"
+    ),
+    # present only with --policy-split configured
+    "policy_splits": "policy lines with a router-side canary split",
+    "policy_stable_schedules_total": (
+        "bare-name schedules the router resolved to a stable version"
+    ),
+    "policy_canary_schedules_total": (
+        "bare-name schedules the router resolved to a canary version"
+    ),
 }
 _ROUTER_COUNTERS = (
     "accepted", "finished", "sched_total", "sched_affinity_hits",
@@ -126,6 +144,9 @@ _ROUTER_COUNTERS = (
     "autoscale_down_total", "autoscale_cold_to_serving_total",
     "fleet_cold_to_serving_total", "fleet_probes_total",
     "fleet_probe_failures_total",
+    "qid_affinity_evictions_default_total",
+    "qid_affinity_evictions_policy_total",
+    "policy_stable_schedules_total", "policy_canary_schedules_total",
 )
 _METRIC_TYPES = {
     n: ("counter" if n in _ROUTER_COUNTERS else "gauge")
@@ -202,6 +223,17 @@ class RouterState:
         # traffic.inflight_ttl_s so a crashed client cannot leak tenant
         # capacity forever.
         self.traffic = traffic or TrafficConfig()
+        # --- multi-policy plane (r19) ---
+        # named traffic keys its affinity entries "name\x00qid" so a
+        # weight push on ONE policy line evicts only ITS entries (the
+        # default line keeps bare-qid keys); the eviction counter
+        # splits the same way on /metrics
+        self.qid_evictions_default_total = 0
+        self.qid_evictions_policy_total = 0
+        # router-side canary splits (traffic.policy_split grammar):
+        # name → CanarySplitter; bare-name handles resolve to exact
+        # versions HERE so the split is honored fleet-wide
+        self._splits = parse_split_spec(self.traffic.policy_split)
         self._inflight_reqs: "OrderedDict[str, tuple]" = OrderedDict()
         self._tenant_inflight: Dict[str, int] = {}
         self._class_inflight = {"interactive": 0, "bulk": 0}
@@ -390,6 +422,21 @@ class RouterState:
                     tenant0, cls0, _ = self._inflight_reqs.pop(rid)
                     self._inflight_reqs[rid] = (tenant0, cls0, now)
             qid = str(meta.get("qid") or meta.get("rid") or "")
+            policy = str(meta.get("policy") or "")
+            pol_name = policy.split("@", 1)[0]
+            resolved = policy
+            if pol_name and "@" not in policy and pol_name in self._splits:
+                # bare-name handle with a configured split: resolve to
+                # an exact version HERE (deterministic accumulator, so
+                # the fleet-wide split is exact within one request).
+                # Resumes/chunk resubmits carry the resolved handle
+                # back and skip re-resolution — a request never flips
+                # version mid-flight.
+                resolved = self._splits[pol_name].pick()
+            if pol_name and qid:
+                # per-policy affinity namespace: a push on one line
+                # must not evict another line's group affinities
+                qid = pol_name + "\x00" + qid
             candidates = [
                 a for a in self.addresses
                 if a not in excl and self._schedulable(a)
@@ -426,7 +473,10 @@ class RouterState:
                 ):
                     self.sched_affinity_hits += 1
                     self.sched_rid_affinity_hits += 1
-                    return {"url": prev, "version": self.version}
+                    return {
+                        "url": prev, "version": self.version,
+                        **({"policy": resolved} if resolved else {}),
+                    }
                 redirected = True  # sticky target unhealthy → reroute
             if qid and qid in self._qid_server:
                 addr = self._qid_server[qid]
@@ -438,7 +488,10 @@ class RouterState:
                     self.sched_affinity_hits += 1
                     self.sched_qid_affinity_hits += 1
                     self._qid_server.move_to_end(qid)
-                    return {"url": addr, "version": self.version}
+                    return {
+                        "url": addr, "version": self.version,
+                        **({"policy": resolved} if resolved else {}),
+                    }
                 if self.traffic.kv_ship:
                     self._remember_prev_owner_locked(qid, addr)
                 del self._qid_server[qid]  # dead-server affinity eviction
@@ -457,6 +510,8 @@ class RouterState:
                     candidates, key=lambda a: self._tokens.get(a, 0.0)
                 )
             out = {"url": addr, "version": self.version}
+            if resolved:
+                out["policy"] = resolved
             if qid:
                 if self.traffic.kv_ship:
                     prev_owner = self._qid_prev.pop(qid, None)
@@ -480,6 +535,29 @@ class RouterState:
                 * max(1, int(meta.get("group_size", 1)))
             )
             return out
+
+    def _evict_affinity_locked(self, policy: Optional[str]) -> int:
+        """Drop the affinity + shipping entries of ONE policy line
+        (``None`` = the default line, whose keys carry no name prefix).
+        The per-line scope is the r19 eviction contract: a canary push
+        on ``actor`` must not evict ``opponent``'s group affinities —
+        their KV namespaces on the servers survive untouched."""
+        def _mine(key: str) -> bool:
+            named = "\x00" in key
+            if policy is None:
+                return not named
+            return named and key.split("\x00", 1)[0] == policy
+
+        stale = [k for k in self._qid_server if _mine(k)]
+        for k in stale:
+            del self._qid_server[k]
+        for k in [k for k in self._qid_prev if _mine(k)]:
+            del self._qid_prev[k]
+        if policy is None:
+            self.qid_evictions_default_total += len(stale)
+        else:
+            self.qid_evictions_policy_total += len(stale)
+        return len(stale)
 
     def _remember_prev_owner_locked(self, qid: str, addr: str) -> None:
         self._qid_prev[qid] = addr
@@ -599,7 +677,11 @@ class RouterState:
     # -- weight update fan-out ----------------------------------------
     def update_weights(self, meta: Dict) -> Dict:
         """pause → update_weights_from_disk → continue on every server
-        (strict ordering per server; version bump re-opens the gate)."""
+        (strict ordering per server; version bump re-opens the gate).
+        A ``policy`` key reroutes to the named-line push: zero pause,
+        no router-version bump, per-policy affinity eviction only."""
+        if meta.get("policy"):
+            return self.update_policy_weights(str(meta["policy"]), meta)
         path = meta.get("path", "")
         version = int(meta.get("version", self.version + 1))
         results = {}
@@ -635,14 +717,103 @@ class RouterState:
                     logger.error(f"continue_generation {addr}: {e}")
         with self.lock:
             self.version = version
-            # fresh version invalidates the qid affinity map (the cached
-            # prefixes it pointed at were flushed by the servers) — and
-            # the shipping hints with it (old-version KV never ships)
-            self._qid_server.clear()
-            self._qid_prev.clear()
+            # fresh version invalidates the DEFAULT-line affinity map
+            # (the cached prefixes it pointed at were flushed by the
+            # servers) — and the shipping hints with it (old-version KV
+            # never ships). Named policies' entries survive: their KV
+            # namespaces are untouched by a default flip (r19).
+            self._evict_affinity_locked(None)
             if path:
                 self._last_weight_update = (path, version)
         return {"success": True, "version": version, "servers": results}
+
+    def update_policy_weights(self, policy: str, meta: Dict) -> Dict:
+        """Named-line weight push fan-out (r19): POST
+        /update_weights_from_disk with the policy handle to every
+        schedulable server — NO pause/continue (named pushes never
+        touch the default buffer, so they are zero-pause by
+        construction) and NO router-version bump (the staleness gate
+        tracks the default training line only). Evicts only this
+        line's affinities; a ``canary_fraction`` updates the router's
+        splitter so bare-name traffic starts splitting immediately."""
+        path = meta.get("path", "") or meta.get("model_path", "")
+        version = meta.get("version")
+        frac = float(meta.get("canary_fraction") or 0.0)
+        results = {}
+        targets = [a for a in self.addresses if self._schedulable(a)]
+        if not targets:
+            targets = list(self.addresses)
+        for addr in targets:
+            try:
+                results[addr] = self._post(
+                    addr, "/update_weights_from_disk",
+                    {
+                        "model_path": path, "policy": policy,
+                        "version": version, "canary_fraction": frac,
+                    },
+                    timeout=600,
+                )
+            except Exception as e:
+                # one dead server must not fail the fleet-wide push
+                logger.error(f"update_policy_weights {addr}: {e}")
+                results[addr] = {"success": False, "error": str(e)}
+                if self.fleet is not None:
+                    self.fleet.report_failure(addr)
+        pushed = next(
+            (
+                r.get("version") for r in results.values()
+                if r.get("success")
+            ),
+            version,
+        )
+        with self.lock:
+            self._evict_affinity_locked(policy)
+            sp = self._splits.get(policy)
+            if sp is not None and pushed is not None:
+                if frac > 0.0:
+                    sp.canary_version = int(pushed)
+                    sp.fraction = frac
+                else:
+                    sp.stable_version = int(pushed)
+                    sp.canary_version = None
+                    sp.fraction = 0.0
+        return {
+            "success": True, "policy": policy, "version": pushed,
+            "servers": results,
+        }
+
+    def policy_op(self, meta: Dict) -> Dict:
+        """Fan a registry lifecycle op (promote / retire / split) to
+        every schedulable server and mirror it into the router's
+        splitter state. Promote evicts nothing — the promoted
+        version's KV namespace survives on the servers."""
+        op = str(meta.get("op") or "")
+        name = str(meta.get("policy") or "")
+        results = {}
+        targets = [a for a in self.addresses if self._schedulable(a)]
+        if not targets:
+            targets = list(self.addresses)
+        for addr in targets:
+            try:
+                results[addr] = self._post(addr, "/policy", meta)
+            except Exception as e:
+                logger.error(f"policy_op {op} {addr}: {e}")
+                results[addr] = {"success": False, "error": str(e)}
+                if self.fleet is not None:
+                    self.fleet.report_failure(addr)
+        with self.lock:
+            sp = self._splits.get(name)
+            if op == "promote" and sp is not None:
+                sp.promote()
+            elif op == "split" and sp is not None:
+                sp.fraction = float(meta.get("canary_fraction") or 0.0)
+            elif op == "retire":
+                self._splits.pop(name, None)
+                self._evict_affinity_locked(name)
+        return {
+            "success": True, "op": op, "policy": name,
+            "servers": results,
+        }
 
     def resync_server(self, addr: str) -> None:
         """on_recover hook: a server re-entered rotation after being out
@@ -716,6 +887,12 @@ class RouterState:
                     else 0.0
                 ),
                 "qid_affinity_entries": len(self._qid_server),
+                "qid_affinity_evictions_default_total": (
+                    self.qid_evictions_default_total
+                ),
+                "qid_affinity_evictions_policy_total": (
+                    self.qid_evictions_policy_total
+                ),
                 "failovers_total": self.failovers_total,
                 "requests_migrated_total": self.requests_migrated_total,
                 "tracing_dropped_spans_total": float(self.tracer.dropped),
@@ -740,6 +917,16 @@ class RouterState:
                 # shipping surface (r16): present ONLY with --kv-ship —
                 # off keeps the metric namespace bit-identical
                 own["kv_ship_hints_total"] = self.kv_ship_hints_total
+            if self._splits:
+                # canary-split surface (r19): present ONLY with
+                # --policy-split configured
+                own["policy_splits"] = float(len(self._splits))
+                own["policy_stable_schedules_total"] = sum(
+                    sp.stable_total for sp in self._splits.values()
+                )
+                own["policy_canary_schedules_total"] = sum(
+                    sp.canary_total for sp in self._splits.values()
+                )
             if self.autoscaler is not None:
                 own.update(self.autoscaler.metrics())
         if self.fleet is not None:
@@ -892,6 +1079,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(self.state.finish())
             elif self.path == "/update_weights":
                 self._send_json(self.state.update_weights(payload))
+            elif self.path == "/policy":
+                # registry lifecycle fan-out (r19): promote / retire /
+                # split across the fleet + the router's own splitter
+                self._send_json(self.state.policy_op(payload))
             elif self.path == "/register":
                 self._send_json(self.state.register(str(payload["addr"])))
             elif self.path == "/deregister":
@@ -1065,6 +1256,12 @@ def main(argv=None):
         "(servers must run with --kv-ship too)",
     )
     p.add_argument(
+        "--policy-split", default="",
+        help="router-side canary splits, "
+        "name=STABLE[:CANARY:FRACTION][,name=...] — bare-name policy "
+        "handles resolve to exact versions at schedule time (r19)",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="record per-schedule route spans (drain via GET /trace)",
     )
@@ -1093,6 +1290,7 @@ def main(argv=None):
             bulk_weight=args.bulk_weight,
             inflight_ttl_s=args.inflight_ttl,
             kv_ship=args.kv_ship,
+            policy_split=args.policy_split,
         ),
     )
 

@@ -50,6 +50,7 @@ from areal_tpu.inference.engine import (
     AdmissionRejectedError,
     GenerationEngine,
 )
+from areal_tpu.inference.policies import UnknownPolicyError
 from areal_tpu.utils import chaos
 from areal_tpu.utils import logging as logging_util, names, network
 from areal_tpu.utils import name_resolve
@@ -306,6 +307,42 @@ _METRIC_HELP = {
         "shipping attempts dropped (version/geometry mismatch or an "
         "unreachable peer) — shipping soft-fails to a plain re-prefill"
     ),
+    # multi-policy serving plane (r19) — present only once a named
+    # policy is pushed (single-policy mode is a strict no-op)
+    "policy_lines": "named policy lines currently registered",
+    "policy_buffers_resident": "policy weight buffers resident in HBM",
+    "policy_buffers_host": (
+        "cold policy weight buffers demoted to host RAM by the LRU "
+        "evictor (reloaded on next request)"
+    ),
+    "policy_pinned_requests": (
+        "in-flight requests pinned to a named policy buffer"
+    ),
+    "policy_pushes_total": "weight pushes onto named policy lines",
+    "policy_promotes_total": "canary→stable promotions applied",
+    "policy_demotions_total": (
+        "policy buffers demoted HBM→host under residency pressure"
+    ),
+    "policy_reloads_total": (
+        "host-demoted policy buffers reloaded to HBM on demand"
+    ),
+    "policy_staging_bytes": (
+        "bytes staged in per-policy shadow buffers (chunked pushes)"
+    ),
+    "policy_cache_namespaces": (
+        "live per-(policy, version) KV cache namespaces"
+    ),
+    # per-policy labeled families (hand-rendered with {policy=...}
+    # labels in the /metrics assembly below, router-style)
+    "policy_stable_version": "stable weight version of a policy line",
+    "policy_canary_version": (
+        "canary weight version of a policy line (-1 = no canary)"
+    ),
+    "policy_canary_fraction": (
+        "fraction of a line's traffic routed to its canary version"
+    ),
+    "policy_requests_total": "requests served per policy line",
+    "policy_tokens_total": "completion tokens emitted per policy line",
 }
 
 # explicit metric-type registry for the engine surface: every name the
@@ -337,6 +374,9 @@ _ENGINE_COUNTERS = (
     "kv_ship_exports_total", "kv_ship_imports_total",
     "kv_ship_pages_out_total", "kv_ship_pages_in_total",
     "kv_ship_failures_total",
+    "policy_pushes_total", "policy_promotes_total",
+    "policy_demotions_total", "policy_reloads_total",
+    "policy_requests_total", "policy_tokens_total",
 )
 _ENGINE_HISTOGRAMS = (
     "queue_wait_seconds", "ttft_seconds", "request_latency_seconds",
@@ -363,6 +403,10 @@ _ENGINE_GAUGES = (
     "kv_tier_host_capacity_bytes", "kv_tier_pending_pages",
     "kv_tier_host_claim_hit_rate", "kv_tier_disk_pages",
     "kv_tier_disk_bytes",
+    "policy_lines", "policy_buffers_resident", "policy_buffers_host",
+    "policy_pinned_requests", "policy_staging_bytes",
+    "policy_cache_namespaces", "policy_stable_version",
+    "policy_canary_version", "policy_canary_fraction",
 )
 _METRIC_TYPES = {
     **{n: "counter" for n in _ENGINE_COUNTERS},
@@ -551,11 +595,39 @@ class _Handler(BaseHTTPRequestHandler):
                 if hasattr(eng, "latency_histograms")
                 else None
             )
-            body = render_prometheus(
+            text = render_prometheus(
                 eng.metrics(), prefix="areal_tpu_gen_",
                 help_text=_METRIC_HELP, histograms=hists,
-            ).encode()
-            self._send_text(body, "text/plain; version=0.0.4")
+            )
+            pols = getattr(eng, "_policies", None)
+            if pols is not None and pols.active:
+                # per-policy labeled families: hand-rendered after the
+                # scalar block (router-style) because render_prometheus
+                # only supports labels on histogram keys. TYPEs come
+                # from the module registry; base names are in
+                # _METRIC_HELP + the ARL003 extra_names declaration.
+                lines = [text.rstrip("\n")]
+                for name, st in sorted(eng.policy_status().items()):
+                    lab = f'{{policy="{name}"}}'
+                    cv = st["canary_version"]
+                    lines += [
+                        f'areal_tpu_gen_policy_stable_version{lab} '
+                        f'{st["stable_version"]}',
+                        f'areal_tpu_gen_policy_canary_version{lab} '
+                        f'{-1 if cv is None else cv}',
+                        f'areal_tpu_gen_policy_canary_fraction{lab} '
+                        f'{st["canary_fraction"]}',
+                        f'areal_tpu_gen_policy_requests_total{lab} '
+                        f'{st["requests_total"]}',
+                        f'areal_tpu_gen_policy_tokens_total{lab} '
+                        f'{st["tokens_total"]}',
+                    ]
+                text = "\n".join(lines) + "\n"
+            self._send_text(text.encode(), "text/plain; version=0.0.4")
+        elif url.path == "/policy":
+            # multi-policy status (r19): per-line versions, split,
+            # residency, pins — trace_report --policy reads this shape
+            self._send_json({"policies": eng.policy_status()})
         elif url.path == "/trace":
             # drains the engine's span buffer: a scraper polling /trace
             # assembles the full timeline without unbounded server memory
@@ -620,6 +692,18 @@ class _Handler(BaseHTTPRequestHandler):
                     self._ship_prefix(eng, ship_from, payload)
                 try:
                     result = eng.generate(payload)
+                except UnknownPolicyError as e:
+                    # typed 4xx: utils/http retries 5xx only, so a bad
+                    # handle fails fast instead of burning the budget
+                    self._send_json(
+                        {
+                            "error": str(e),
+                            "type": "unknown_policy",
+                            "policy": e.handle,
+                        },
+                        e.status,
+                    )
+                    return
                 except AdmissionRejectedError as e:
                     # load shed: typed 429 + Retry-After so utils/http
                     # treats it as backpressure, not failure
@@ -685,10 +769,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"status": "resumed"})
             elif self.path == "/update_weights_from_disk":
                 payload = self._read_json()
-                version = eng.update_weights_from_disk(
-                    payload["model_path"], payload.get("version")
-                )
-                self._send_json({"success": True, "model_version": version})
+                if payload.get("policy"):
+                    # named-line push (r19): zero-pause by construction
+                    # — no flip, the default line is untouched
+                    version = eng.update_policy_from_disk(
+                        payload["policy"], payload["model_path"],
+                        payload.get("version"),
+                        float(payload.get("canary_fraction") or 0.0),
+                    )
+                    self._send_json({
+                        "success": True, "policy": payload["policy"],
+                        "version": version,
+                    })
+                else:
+                    version = eng.update_weights_from_disk(
+                        payload["model_path"], payload.get("version")
+                    )
+                    self._send_json(
+                        {"success": True, "model_version": version}
+                    )
             elif self.path == "/update_weights_from_distributed":
                 # binary FFD chunk (reference sglang_remote.py:411 NCCL
                 # receive, host-staged over HTTP here)
@@ -696,8 +795,40 @@ class _Handler(BaseHTTPRequestHandler):
 
                 n = int(self.headers.get("Content-Length", 0))
                 header, arrays = decode_chunk(self.rfile.read(n))
-                out = eng.update_weights_chunk(header, arrays)
+                policy = header.pop("policy", None)
+                if policy:
+                    out = eng.update_policy_chunk(policy, header, arrays)
+                else:
+                    out = eng.update_weights_chunk(header, arrays)
                 self._send_json({"success": True, **out})
+            elif self.path == "/policy":
+                # registry lifecycle ops (r19): promote / retire /
+                # split. Unknown names fail typed 4xx below.
+                payload = self._read_json()
+                op = payload.get("op", "")
+                name = payload.get("policy", "")
+                if op == "promote":
+                    version = eng.promote_policy(name)
+                    self._send_json({
+                        "success": True, "policy": name,
+                        "stable_version": version,
+                    })
+                elif op == "retire":
+                    eng.retire_policy(name)
+                    self._send_json(
+                        {"success": True, "policy": name, "retired": True}
+                    )
+                elif op == "split":
+                    frac = float(payload.get("canary_fraction", 0.0))
+                    eng.set_policy_split(name, frac)
+                    self._send_json({
+                        "success": True, "policy": name,
+                        "canary_fraction": frac,
+                    })
+                else:
+                    self._send_json(
+                        {"error": f"unknown policy op {op!r}"}, 400
+                    )
             elif self.path == "/kv_export":
                 payload = self._read_json()
                 if not getattr(eng, "kv_ship_enabled", False):
@@ -726,6 +857,17 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._send_json({"error": f"unknown path {self.path}"}, 404)
+        except UnknownPolicyError as e:
+            # typed 4xx for every policy-plane endpoint: a bad handle
+            # is a caller bug, not a server fault — never retried
+            self._send_json(
+                {
+                    "error": str(e),
+                    "type": "unknown_policy",
+                    "policy": e.handle,
+                },
+                e.status,
+            )
         except Exception as e:  # surface engine errors as 500s
             logger.error(f"{self.path} failed: {e}")
             self._send_json({"error": str(e)}, 500)
@@ -1010,6 +1152,13 @@ def main(argv: Optional[list] = None):
         "staging is dropped (<= 0 disables the sweep)",
     )
     p.add_argument(
+        "--policy-max-resident", type=int,
+        default=d.policy.max_resident,
+        help="named policy weight buffers kept resident in HBM; colder "
+        "(unpinned) buffers LRU-demote to host RAM and reload on the "
+        "next request targeting them (<= 0 disables demotion)",
+    )
+    p.add_argument(
         "--router-addr", default="",
         help="router host:port to POST /register to at startup "
         "(dynamic fleet membership without shared name_resolve)",
@@ -1076,6 +1225,7 @@ def main(argv: Optional[list] = None):
     cfg.weights.streaming = not args.no_weight_streaming
     cfg.weights.flip_policy = args.weight_flip_policy
     cfg.weights.staging_ttl_s = args.weight_staging_ttl
+    cfg.policy.max_resident = args.policy_max_resident
     cfg.goodput.ready_quiet_s = args.ready_quiet
     cfg.goodput.ready_min_requests = args.ready_min_requests
     cfg.goodput.compile_events_path = args.compile_events

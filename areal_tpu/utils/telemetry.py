@@ -75,6 +75,13 @@ class RequestLineage:
     segments: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     failovers: int = 0
     migrations: int = 0
+    # named policy handle that served this request ("" = default line);
+    # resolved to an exact "name@vN" when a canary split applied (r19)
+    policy: str = ""
+    # client-measured submit→first-token latency; None when the request
+    # died before producing a token (trace_report --policy groups TTFT
+    # percentiles by the policy field above)
+    ttft_s: Optional[float] = None
 
     def add_segment(
         self, server: str, tokens: int, versions: Iterable[int]
@@ -116,6 +123,12 @@ class RequestLineage:
             "failovers": self.failovers,
             "migrations": self.migrations,
             "output_tokens": sum(s["tokens"] for s in self.segments),
+            **({"policy": self.policy} if self.policy else {}),
+            **(
+                {"ttft_s": round(self.ttft_s, 6)}
+                if self.ttft_s is not None
+                else {}
+            ),
         }
 
 

@@ -43,6 +43,7 @@ class MultiTurnWorkflow(RolloutWorkflow):
         # failures — the exact class of lie this plane removes. None
         # leaves bounding to the backend's internal timeouts.
         reward_timeout_s: Optional[float] = None,
+        policy: str = "",
     ):
         if gconfig.n_samples != 1:
             raise ValueError(
@@ -57,6 +58,10 @@ class MultiTurnWorkflow(RolloutWorkflow):
         self.max_turns = max_turns
         self.turn_discount = turn_discount
         self.feedback_text = feedback_text
+        # named policy handle (r19): "" rides the default line. The
+        # shared episode metadata keeps every turn on ONE resolved
+        # version — a canary must not swap weights mid-episode.
+        self.policy = policy
 
     def _tokenize_prompt(self, data: Dict[str, Any]) -> List[int]:
         if "input_ids" in data:
@@ -90,12 +95,18 @@ class MultiTurnWorkflow(RolloutWorkflow):
         # the server whose radix cache holds turn N-1's pages, so each
         # turn re-prefills only its new feedback/output suffix
         episode_id = unique_rid("ep")
+        # one metadata dict for the whole episode: the router writes a
+        # canary-resolved policy handle back into it, so later turns
+        # stay on the version that served turn 0 (r19)
+        episode_meta = {"qid": episode_id, "priority": "bulk"}
+        if self.policy:
+            episode_meta["policy"] = self.policy
         for turn in range(self.max_turns):
             req = ModelRequest(
                 rid=unique_rid(),
                 input_ids=tokens,
                 gconfig=self.gconfig.new(n_samples=1),
-                metadata={"qid": episode_id, "priority": "bulk"},
+                metadata=episode_meta,
             )
             resp = await engine.agenerate(req)
             tokens.extend(resp.output_tokens)

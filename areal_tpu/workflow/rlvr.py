@@ -38,6 +38,7 @@ class RLVRWorkflow(RolloutWorkflow):
         enable_thinking: bool = False,
         dump_dir: Optional[str] = None,
         priority: str = "bulk",
+        policy: str = "",
     ):
         self.reward_fn = AsyncRewardWrapper(reward_fn)
         self.gconfig = gconfig
@@ -49,6 +50,12 @@ class RLVRWorkflow(RolloutWorkflow):
         # workflow with priority="interactive" so admission control
         # protects their latency against bulk rollout pressure
         self.priority = priority
+        # named policy handle (r19): "" rides the default single-policy
+        # line; "actor" (or "actor@v13") pins the group's rollouts to
+        # that line. Siblings share one metadata dict, so a router-side
+        # canary resolution sticks for the WHOLE group — group-coherent
+        # versions keep sibling KV dedup intact across a canary split.
+        self.policy = policy
 
     def _tokenize_prompt(self, data: Dict[str, Any]) -> List[int]:
         if "input_ids" in data:
@@ -83,6 +90,7 @@ class RLVRWorkflow(RolloutWorkflow):
                 "qid": group_id,
                 "group_size": n,
                 "priority": self.priority,
+                **({"policy": self.policy} if self.policy else {}),
             },
         )
         resps = await asyncio.gather(

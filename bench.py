@@ -1018,6 +1018,309 @@ def _ttft_ab_phase() -> dict:
     }
 
 
+def _multipolicy_phase() -> dict:
+    """Multi-policy serving plane A/B (r19), measured. Two tiny-model
+    CPU server subprocesses: the `multipolicy` cell pushes a named
+    "actor" line (stable v1 + canary v2 at a 90/10 split) over the
+    `update_weights_from_distributed` wire format and drives >=200
+    policy-tagged requests through the split, then times a zero-pause
+    canary promote under continuing traffic; the `single` cell runs
+    the identical load on the default line only. The numbers of record
+    are per-policy tok/s, TTFT p95, observed canary-split accuracy vs
+    the configured 0.1 fraction, promote (flip) latency, and the
+    pause/flip counters — both of which must stay zero in the
+    multipolicy cell (named pushes never touch the default line)."""
+    import queue as _q
+    import struct as _struct
+    import subprocess
+    import threading
+    import urllib.request as _rq
+
+    import jax as _jax
+    import numpy as _np
+
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.utils import weight_transfer as wt
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "genserver_worker.py",
+    )
+    N_REQS = 200
+    CANARY_FRAC = 0.1
+    mcfg = tiny_config("qwen2")
+
+    def _leaves(seed):
+        params = _jax.device_get(
+            init_params(mcfg, _jax.random.PRNGKey(seed), dtype="float32")
+        )
+        return [(k, _np.asarray(v)) for k, v in wt.flatten_params(params)]
+
+    def _policy_chunks(policy, version, leaves, canary_fraction):
+        # encode_chunk's header schema is fixed, so the policy routing
+        # fields are spliced in here; the server pops header["policy"]
+        # and routes to update_policy_chunk (canary_fraction only
+        # matters on the completing chunk)
+        plan = wt.chunk_leaves(leaves, 64 * 1024)
+        bodies = []
+        for i, items in enumerate(plan):
+            header = {
+                "version": version,
+                "chunk_index": i,
+                "n_chunks": len(plan),
+                "policy": policy,
+                "params": [
+                    {
+                        "name": k,
+                        "dtype": str(a.dtype),
+                        "shape": list(a.shape),
+                        "nbytes": int(a.nbytes),
+                    }
+                    for k, a in items
+                ],
+            }
+            if i == len(plan) - 1 and canary_fraction:
+                header["canary_fraction"] = canary_fraction
+            hb = json.dumps(header).encode()
+            bodies.append(b"".join(
+                [_struct.pack(">Q", len(hb)), hb]
+                + [_np.ascontiguousarray(a).tobytes() for _, a in items]
+            ))
+        return bodies
+
+    def _p(vals, q):
+        vals = sorted(vals)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(q * (len(vals) - 1)))], 4)
+
+    def _post(addr, path, body, timeout=120, raw=False):
+        data = body if raw else json.dumps(body).encode()
+        req = _rq.Request(
+            f"http://{addr}{path}", data=data,
+            headers={
+                "Content-Type": (
+                    "application/octet-stream" if raw
+                    else "application/json"
+                )
+            },
+        )
+        with _rq.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _metric(addr, name):
+        with _rq.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for line in text.splitlines():
+            if line.startswith(f"areal_tpu_gen_{name} ") or (
+                line.startswith(f"areal_tpu_gen_{name}{{")
+            ):
+                try:
+                    return float(line.split()[-1])
+                except ValueError:
+                    return None
+        return None
+
+    def run_cell(multipolicy: bool) -> dict:
+        proc = subprocess.Popen(
+            [sys.executable, worker, "0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=dict(os.environ),
+        )
+        lines: "_q.Queue[str]" = _q.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True,
+        ).start()
+        try:
+            deadline = time.monotonic() + 240
+            port = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError("multipolicy worker died at startup")
+                try:
+                    line = lines.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                if line.startswith("PORT "):
+                    port = int(line.split()[1])
+                    break
+            if port is None:
+                raise RuntimeError("multipolicy worker reported no port")
+            addr = f"127.0.0.1:{port}"
+
+            handle = ""
+            if multipolicy:
+                # stable v1, then canary v2 at the 90/10 split
+                for body in _policy_chunks("actor", 1, _leaves(7), 0.0):
+                    _post(
+                        addr, "/update_weights_from_distributed", body,
+                        raw=True,
+                    )
+                for body in _policy_chunks(
+                    "actor", 2, _leaves(11), CANARY_FRAC
+                ):
+                    _post(
+                        addr, "/update_weights_from_distributed", body,
+                        raw=True,
+                    )
+                handle = "actor"
+
+            def _one(rng, n_new=8):
+                body = {
+                    "input_ids": rng.integers(1, 100, size=6).tolist(),
+                    "sampling_params": {
+                        "max_new_tokens": n_new, "greedy": True,
+                    },
+                }
+                if handle:
+                    body["policy"] = handle
+                return _post(addr, "/generate", body)
+
+            # warm: let the compile storm pass before the clock starts
+            warm_rng = _np.random.default_rng(3)
+            for _ in range(4):
+                _one(warm_rng)
+
+            results = []
+            results_lock = threading.Lock()
+            idx = [0]
+
+            def load_loop(seed):
+                rng = _np.random.default_rng(41 + seed)
+                while True:
+                    with results_lock:
+                        if idx[0] >= N_REQS:
+                            return
+                        idx[0] += 1
+                    try:
+                        out = _one(rng)
+                        with results_lock:
+                            results.append(out["meta_info"])
+                    except Exception:
+                        pass
+
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=load_loop, args=(i,), daemon=True)
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            window_s = time.monotonic() - t0
+
+            ttfts = [float(m["ttft"]) for m in results]
+            toks = sum(int(m["completion_tokens"]) for m in results)
+            cell = {
+                "multipolicy": multipolicy,
+                "requests": len(results),
+                "window_s": round(window_s, 3),
+                "tokens_per_sec": round(toks / window_s, 2)
+                if window_s > 0 else None,
+                "ttft_p50_s": _p(ttfts, 0.50),
+                "ttft_p95_s": _p(ttfts, 0.95),
+            }
+            if multipolicy:
+                versions = [int(m.get("policy_version", -1))
+                            for m in results]
+                canary = sum(1 for v in versions if v == 2)
+                stable = sum(1 for v in versions if v == 1)
+                observed = canary / len(versions) if versions else None
+                by_ver = {}
+                for m in results:
+                    v = int(m.get("policy_version", -1))
+                    by_ver.setdefault(v, [0, 0.0])
+                    by_ver[v][0] += int(m["completion_tokens"])
+                cell.update({
+                    "stable_requests": stable,
+                    "canary_requests": canary,
+                    "canary_fraction_configured": CANARY_FRAC,
+                    "canary_fraction_observed": round(observed, 4)
+                    if observed is not None else None,
+                    "canary_split_abs_error": round(
+                        abs(observed - CANARY_FRAC), 4
+                    ) if observed is not None else None,
+                    "per_version_tokens_per_sec": {
+                        f"v{v}": round(n[0] / window_s, 2)
+                        for v, n in sorted(by_ver.items())
+                    } if window_s > 0 else {},
+                })
+                # flip latency: promote the canary under continuing
+                # traffic, then confirm the new stable serves and the
+                # default line never paused or flipped
+                stop = threading.Event()
+
+                def tail_loop():
+                    rng = _np.random.default_rng(97)
+                    while not stop.is_set():
+                        try:
+                            _one(rng, n_new=4)
+                        except Exception:
+                            time.sleep(0.05)
+
+                tail = threading.Thread(target=tail_loop, daemon=True)
+                tail.start()
+                tp = time.monotonic()
+                out = _post(addr, "/policy", {
+                    "op": "promote", "policy": "actor",
+                })
+                cell["promote_s"] = round(time.monotonic() - tp, 4)
+                cell["promoted_stable_version"] = int(
+                    out.get("stable_version", -1)
+                )
+                post_rng = _np.random.default_rng(5)
+                post = _one(post_rng)
+                cell["post_promote_version"] = int(
+                    post["meta_info"].get("policy_version", -1)
+                )
+                stop.set()
+                tail.join(timeout=120)
+                cell["policy_promotes_total"] = _metric(
+                    addr, "policy_promotes_total"
+                )
+            # both cells: the default line must never have paused or
+            # flipped (named pushes bypass it by construction)
+            cell["paused"] = _metric(addr, "paused")
+            cell["weight_flips_total"] = _metric(
+                addr, "weight_flips_total"
+            )
+            return cell
+        finally:
+            if proc.poll() is None:
+                try:
+                    proc.stdin.close()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+    cells = {}
+    for name, multi in (("multipolicy", True), ("single", False)):
+        try:
+            cells[name] = run_cell(multi)
+        except Exception as e:  # per-cell graceful degradation
+            cells[name] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+    on = cells.get("multipolicy", {})
+    off = cells.get("single", {})
+    overhead = None
+    if (
+        isinstance(on.get("tokens_per_sec"), float)
+        and isinstance(off.get("tokens_per_sec"), float)
+        and off["tokens_per_sec"] > 0
+    ):
+        overhead = round(
+            1.0 - on["tokens_per_sec"] / off["tokens_per_sec"], 4
+        )
+    return {
+        "configs": cells,
+        "multipolicy_throughput_overhead_frac": overhead,
+    }
+
+
 def _env_resilience_phase() -> dict:
     """Kill-one-of-two ENV WORKERS under the chaos harness, measured.
     Two env-service subprocesses host the countdown tool env; a wave of
@@ -2290,6 +2593,24 @@ def main():
         emit_phase(
             "ttft_ab",
             {"configs": {}, "error": extra["ttft_ab_error"]},
+        )
+
+    # --- multi-policy serving A/B sub-phase (r19): one server carries
+    # a named "actor" line (stable + canary at 90/10) pushed over the
+    # chunked wire format while a second cell runs the identical load
+    # single-policy — per-policy tok/s, TTFT p95, observed canary-split
+    # accuracy, and promote (flip) latency under continuing traffic
+    # with the pause/flip counters pinned at zero. Same
+    # graceful-degradation rule as the other auxiliary phases ---
+    try:
+        multipolicy = _multipolicy_phase()
+        extra["multipolicy"] = multipolicy
+        emit_phase("multipolicy", multipolicy)
+    except Exception as e:
+        extra["multipolicy_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "multipolicy",
+            {"configs": {}, "error": extra["multipolicy_error"]},
         )
 
     # --- env-worker-kill resilience sub-phase: two env-service worker

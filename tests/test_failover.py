@@ -768,3 +768,222 @@ def test_autoscaler_drain_live_server_zero_loss_token_exact(
         assert fm["fleet_healthy_servers"] == 1.0, fm
     finally:
         client.destroy()
+
+
+# ==========================================================================
+# Multi-policy pin lifecycle across failover (r19)
+# ==========================================================================
+@pytest.fixture()
+def policy_servers():
+    """(engines, addrs): TWO in-process engines, each serving the SAME
+    named policy line ``actor`` (seed-7 weights, distinct from the
+    seed-0 default line) behind a real HTTP shell. In-process so the
+    test can audit each server's policy buffer ACCOUNT (pins) directly
+    — the satellite invariant is about accounting, not process death."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.api.cli_args import JaxGenConfig
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.inference.server import serve
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.utils import weight_transfer as wt
+
+    cfg = tiny_config("qwen2")
+    actor_params = jax.device_get(
+        init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    )
+    engines, shells, addrs = [], [], []
+    for _ in range(2):
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+            ),
+            model_config=cfg, params=params,
+        ).start()
+        # push the named line through the real chunked wire format
+        leaves = [
+            (k, np.asarray(v)) for k, v in wt.flatten_params(actor_params)
+        ]
+        plan = wt.chunk_leaves(leaves, 1 << 30)
+        for i, items in enumerate(plan):
+            header, arrays = wt.decode_chunk(
+                wt.encode_chunk(1, i, len(plan), items)
+            )
+            out = eng.update_policy_chunk("actor", header, arrays)
+        assert out["complete"] and out["policy"] == "actor"
+        httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+        engines.append(eng)
+        shells.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    yield engines, addrs
+    for httpd in shells:
+        httpd.shutdown()
+    for eng in engines:
+        eng.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_policy_pins_released_across_drain_failover_and_abort(
+    policy_servers,
+):
+    """Pin-lifecycle regression (r19): a named-policy request failing
+    over mid-decode must release its pin on the dead server's buffer
+    account — after the wave migrates off the drained victim, NEITHER
+    server holds a pinned policy buffer, and a hard mid-decode abort
+    (the failover/preemption finish path) releases its pin too. A leak
+    here would make the victim's buffer permanently unretirable."""
+    from areal_tpu.api.cli_args import (
+        FleetConfig,
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.remote import RemoteInferenceEngine
+
+    (victim_eng, survivor_eng), (victim_addr, survivor_addr) = (
+        policy_servers
+    )
+    MAX_NEW_POL = 16
+    client = RemoteInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="polpins", trial_name="t0",
+            consumer_batch_size=4, max_concurrent_rollouts=8,
+            request_timeout=60, request_retries=2, setup_timeout=120,
+            schedule_policy="round_robin",
+            # small chunks: the drain lands between chunks and later
+            # chunks suffix-resume on the survivor
+            new_tokens_per_chunk=4,
+            fleet=FleetConfig(
+                probe_interval_s=0.3, probe_timeout_s=2.0,
+                dead_threshold=2, halfopen_interval_s=60.0,
+            ),
+        )
+    ).initialize(addrs=[victim_addr, survivor_addr])
+
+    try:
+        async def wave():
+            reqs = [
+                ModelRequest(
+                    rid=f"pp{i}",
+                    input_ids=p,
+                    gconfig=GenerationHyperparameters(
+                        n_samples=1, max_new_tokens=MAX_NEW_POL,
+                        greedy=True,
+                    ),
+                    metadata={"policy": "actor"},
+                )
+                for i, p in enumerate(PROMPTS)
+            ]
+            tasks = [
+                asyncio.ensure_future(client.agenerate(r)) for r in reqs
+            ]
+            # drain the victim once BOTH servers hold live policy work
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (
+                    victim_eng.policy_status()["actor"]["requests_total"]
+                    and survivor_eng.policy_status()["actor"][
+                        "requests_total"
+                    ]
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert victim_eng.policy_status()["actor"]["requests_total"], (
+                "victim never took policy traffic"
+            )
+            req = urllib.request.Request(
+                f"http://{victim_addr}/drain", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.loads(r.read())["status"] == "draining"
+            return await asyncio.gather(*tasks)
+
+        results = asyncio.run(wave())
+
+        # zero lost rollouts, token-exact vs a dedicated seed-7 engine
+        assert len(results) == len(PROMPTS)
+        import jax.numpy as jnp
+
+        from areal_tpu.api.cli_args import JaxGenConfig
+        from areal_tpu.inference.engine import GenerationEngine
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.models.transformer import init_params
+
+        cfg = tiny_config("qwen2")
+        ref = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", max_num_seqs=4, max_model_len=64,
+                prefill_chunk=16,
+            ),
+            model_config=cfg,
+            params=init_params(
+                cfg, jax.random.PRNGKey(7), dtype=jnp.float32
+            ),
+        ).start()
+        try:
+            for prompt, out in zip(PROMPTS, results):
+                expect = ref.generate(
+                    {
+                        "input_ids": prompt,
+                        "sampling_params": {
+                            "max_new_tokens": MAX_NEW_POL, "greedy": True
+                        },
+                    }
+                )
+                assert out.output_tokens == expect["output_ids"], (
+                    f"prompt {prompt}: migrated policy stream diverged"
+                )
+        finally:
+            ref.stop()
+
+        # THE satellite invariant: no pinned-buffer leak on either
+        # account after the failover — every migrated chunk released
+        # its pin at finish, on the drained victim included
+        for eng in (victim_eng, survivor_eng):
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if eng.metrics()["policy_pinned_requests"] == 0.0:
+                    break
+                time.sleep(0.05)
+            assert eng.metrics()["policy_pinned_requests"] == 0.0
+            assert eng.policy_status()["actor"]["pinned_requests"] == 0
+        # ...which is exactly what keeps the line retirable
+        victim_eng.retire_policy("actor")
+        assert victim_eng.policy_status() == {}
+
+        # hard mid-decode abort on the survivor (the preempt/failover
+        # finish path): the pin must drop with the abort, and the line
+        # must keep serving afterwards
+        fut = survivor_eng.submit({
+            "rid": "abort-me", "input_ids": [3, 1, 4],
+            "policy": "actor",
+            "sampling_params": {"max_new_tokens": 40, "greedy": True},
+        })
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if survivor_eng.metrics()["policy_pinned_requests"] == 1.0:
+                break
+            time.sleep(0.01)
+        assert survivor_eng.metrics()["policy_pinned_requests"] == 1.0
+        survivor_eng.pause()
+        out = fut.result(timeout=60)
+        assert out["meta_info"]["finish_reason"]["type"] == "abort"
+        assert survivor_eng.metrics()["policy_pinned_requests"] == 0.0
+        survivor_eng.continue_generation()
+        alive = survivor_eng.generate(
+            {
+                "rid": "after-abort", "input_ids": [3, 1, 4],
+                "policy": "actor",
+                "sampling_params": {"max_new_tokens": 4, "greedy": True},
+            },
+            timeout=60,
+        )
+        assert alive["meta_info"]["policy"] == "actor"
+    finally:
+        client.destroy()

@@ -1,0 +1,568 @@
+"""Multi-policy serving plane (r19): named policy handles, per-policy
+KV namespaces, canary/A-B weight rollout on one fleet.
+
+The acceptance story: ONE engine serves two named policy lines
+concurrently and each line's greedy stream is BIT-IDENTICAL to a
+dedicated single-policy engine holding the same weights (per-policy KV
+namespacing — no cross-line cache poisoning, no cohort mixups). Named
+pushes never touch the default line's double buffer, so a canary push +
+promote emits ZERO pause spans while the other line is undisturbed. An
+unknown handle is a typed 400 (the client's mistake — utils/http.py's
+5xx-only retry policy must never burn its budget on it), and with no
+named policy registered the whole plane is a strict no-op: zero new
+metric keys, zero new result keys.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import JaxGenConfig, TracingConfig
+from areal_tpu.inference.engine import GenerationEngine
+from areal_tpu.inference.policies import (
+    CanarySplitter,
+    PolicyRegistry,
+    UnknownPolicyError,
+    parse_handle,
+    parse_split_spec,
+)
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+from areal_tpu.utils import weight_transfer as wt
+
+
+MODEL_CFG = tiny_config("qwen2")
+
+
+@pytest.fixture(scope="module")
+def param_sets():
+    p0 = init_params(MODEL_CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    p1 = init_params(MODEL_CFG, jax.random.PRNGKey(7), dtype=jnp.float32)
+    return jax.device_get(p0), jax.device_get(p1)
+
+
+def _gen_cfg(**kw) -> JaxGenConfig:
+    base = dict(
+        dtype="float32", max_num_seqs=4, max_model_len=2048,
+        prefill_chunk=16, decode_chunk=4, num_pages=48, page_size=64,
+        tracing=TracingConfig(enabled=True),
+    )
+    base.update(kw)
+    return JaxGenConfig(**base)
+
+
+def _greedy(eng, rid, ids, n, policy="", timeout=300):
+    payload = {
+        "rid": rid,
+        "input_ids": list(ids),
+        "sampling_params": {"max_new_tokens": n, "greedy": True},
+    }
+    if policy:
+        payload["policy"] = policy
+    return eng.generate(payload, timeout=timeout)
+
+
+def _push_policy_chunks(
+    eng, name, params, version, canary_fraction=0.0, chunk_bytes=64 * 1024
+):
+    """Stream a named-line push through the real FFD wire format."""
+    leaves = [(k, np.asarray(v)) for k, v in wt.flatten_params(params)]
+    plan = wt.chunk_leaves(leaves, chunk_bytes)
+    n = len(plan)
+    out = None
+    for i, items in enumerate(plan):
+        body = wt.encode_chunk(version, i, n, items)
+        header, arrays = wt.decode_chunk(body)
+        if canary_fraction and i == n - 1:
+            header["canary_fraction"] = canary_fraction
+        out = eng.update_policy_chunk(name, header, arrays)
+    return out, n
+
+
+def _wait_decoding(eng, deadline_s=60.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        reqs = list(eng._active.values())
+        if reqs and any(len(r.output_ids) > 0 for r in reqs):
+            return
+        time.sleep(0.01)
+    raise AssertionError("request never started decoding")
+
+
+# ---------------------------------------------------------------------------
+# Handle grammar + typed error contract (pure functions)
+# ---------------------------------------------------------------------------
+class TestHandleGrammar:
+    def test_bare_name_is_split_selector(self):
+        assert parse_handle("actor") == ("actor", None)
+
+    def test_explicit_selectors(self):
+        assert parse_handle("actor@stable") == ("actor", "stable")
+        assert parse_handle("actor@canary") == ("actor", "canary")
+        assert parse_handle("actor@v12") == ("actor", 12)
+
+    @pytest.mark.parametrize(
+        "bad", ["", "@v1", "actor@", "actor@v", "actor@twelve", "actor@V3"]
+    )
+    def test_grammar_errors_are_typed_400(self, bad):
+        with pytest.raises(UnknownPolicyError) as ei:
+            parse_handle(bad)
+        assert ei.value.status == 400
+        assert ei.value.handle == bad
+
+    def test_error_is_never_a_retryable_5xx(self):
+        # utils/http.py retries 5xx only; the whole point of the typed
+        # error is that a bad handle fails FAST
+        assert UnknownPolicyError.status < 500
+
+
+# ---------------------------------------------------------------------------
+# Registry lifecycle (no engine, no jax)
+# ---------------------------------------------------------------------------
+class TestRegistryLifecycle:
+    def test_push_registers_and_versions(self):
+        reg = PolicyRegistry()
+        assert not reg.active
+        assert reg.push("actor", {"w": 1}) == 1
+        assert reg.active
+        assert reg.push("actor", {"w": 2}) == 2  # auto-increment
+        assert reg.push("opponent", {"w": 9}) == 1  # per-line versions
+        assert sorted(reg.names()) == ["actor", "opponent"]
+
+    def test_version_collision_rejected(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1}, version=5)
+        with pytest.raises(ValueError, match="already serves"):
+            reg.push("actor", {"w": 2}, version=5)
+
+    def test_resolve_selectors(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        reg.push("actor", {"w": 2}, canary_fraction=0.5)
+        assert reg.resolve("actor@stable") == ("actor", 1)
+        assert reg.resolve("actor@canary") == ("actor", 2)
+        assert reg.resolve("actor@v1") == ("actor", 1)
+        with pytest.raises(UnknownPolicyError):
+            reg.resolve("actor@v99")
+        with pytest.raises(UnknownPolicyError):
+            reg.resolve("ghost")
+
+    def test_canary_split_is_deterministic_and_accurate(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        reg.push("actor", {"w": 2}, canary_fraction=0.1)
+        picks = [reg.resolve("actor")[1] for _ in range(200)]
+        canary = picks.count(2)
+        # error-accumulator split: exact up to fp drift, and the ISSUE's
+        # ±3%-over-200-requests acceptance band with margin to spare
+        assert canary in (19, 20)
+        assert abs(canary / 200 - 0.1) <= 0.03
+
+    def test_superseding_push_queues_old_namespace(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        reg.push("actor", {"w": 2})
+        assert ("actor", 1) in reg.drain_retired()
+        assert reg.resolve("actor") == ("actor", 2)
+
+    def test_promote_and_no_canary_errors(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        with pytest.raises(UnknownPolicyError):
+            reg.promote("actor")
+        with pytest.raises(UnknownPolicyError):
+            reg.set_split("actor", 0.2)
+        reg.push("actor", {"w": 2}, canary_fraction=0.25)
+        assert reg.promote("actor") == 2
+        # old stable retired; promoted version's namespace SURVIVES
+        retired = reg.drain_retired()
+        assert ("actor", 1) in retired
+        assert ("actor", 2) not in retired
+        assert reg.resolve("actor") == ("actor", 2)
+        assert reg.promotes_total == 1
+
+    def test_retire_refused_while_pinned(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        reg.retain("actor", 1)
+        with pytest.raises(RuntimeError, match="pinned"):
+            reg.retire("actor")
+        reg.release("actor", 1)
+        reg.retire("actor")
+        assert not reg.active
+        assert ("actor", 1) in reg.drain_retired()
+        with pytest.raises(UnknownPolicyError):
+            reg.resolve("actor")
+
+    def test_release_of_superseded_last_pin_drops_buffer(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        reg.retain("actor", 1)
+        reg.push("actor", {"w": 2})  # supersede while pinned: buffer stays
+        assert reg.params_for("actor", 1) == {"w": 1}
+        assert reg.pinned_requests() == 1
+        reg.release("actor", 1)
+        assert reg.pinned_requests() == 0
+        with pytest.raises(UnknownPolicyError):
+            reg.params_for("actor", 1)
+
+    def test_effective_version_requeues_to_current_stable(self):
+        reg = PolicyRegistry()
+        reg.push("actor", {"w": 1})
+        assert reg.effective_version("actor", 1) == 1
+        reg.push("actor", {"w": 2})
+        # the version a queued request resolved died → current stable
+        assert reg.effective_version("actor", 1) == 2
+        assert reg.is_live("actor", 2)
+        assert not reg.is_live("actor", 1)
+
+
+# ---------------------------------------------------------------------------
+# LRU demotion to host RAM (fake to_host/to_device, fake clock)
+# ---------------------------------------------------------------------------
+class TestLRUDemotion:
+    def _reg(self, max_resident=1):
+        moves = {"demote": 0, "reload": 0}
+
+        def to_host(params):
+            moves["demote"] += 1
+            return ("host", params)
+
+        def to_device(host):
+            moves["reload"] += 1
+            return host[1]
+
+        clk = [0.0]
+        reg = PolicyRegistry(
+            to_host=to_host, to_device=to_device,
+            max_resident=max_resident,
+            clock=lambda: clk.__setitem__(0, clk[0] + 1.0) or clk[0],
+        )
+        return reg, moves
+
+    def test_cold_line_demotes_and_reloads(self):
+        reg, moves = self._reg(max_resident=1)
+        reg.push("actor", {"w": "a"})
+        reg.push("opponent", {"w": "b"})  # over budget → actor demotes
+        assert moves["demote"] == 1
+        assert reg.demotions_total == 1
+        m = reg.metrics()
+        assert m["policy_buffers_host"] == 1.0
+        assert m["policy_buffers_resident"] == 1.0
+        # next request on the demoted line reloads it (and demotes the
+        # now-coldest other line)
+        assert reg.params_for("actor", 1) == {"w": "a"}
+        assert moves["reload"] == 1
+        assert reg.reloads_total == 1
+        assert reg.metrics()["policy_buffers_host"] == 1.0
+
+    def test_pins_block_demotion(self):
+        reg, moves = self._reg(max_resident=1)
+        reg.push("actor", {"w": "a"})
+        reg.retain("actor", 1)
+        reg.push("opponent", {"w": "b"})
+        reg.push("trainer", {"w": "c"})
+        # actor is pinned: over budget, but only UNPINNED buffers demote
+        line = reg._lines["actor"]
+        assert 1 in line.buffers
+        assert 1 not in line.host_buffers
+        reg.release("actor", 1)
+        reg.push("judge", {"w": "d"})  # now it is demotable
+        assert 1 in reg._lines["actor"].host_buffers
+
+    def test_zero_max_resident_disables_demotion(self):
+        reg, moves = self._reg(max_resident=0)
+        for i, name in enumerate(["a", "b", "c", "d"]):
+            reg.push(name, {"w": i})
+        assert moves["demote"] == 0
+        assert reg.metrics()["policy_buffers_resident"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Router-side splitter + --policy-split grammar
+# ---------------------------------------------------------------------------
+class TestSplitSpec:
+    def test_parse_spec(self):
+        splits = parse_split_spec("actor=12:13:0.1,opponent=7")
+        assert set(splits) == {"actor", "opponent"}
+        sp = splits["actor"]
+        assert (sp.stable_version, sp.canary_version, sp.fraction) == (
+            12, 13, 0.1
+        )
+        assert splits["opponent"].canary_version is None
+
+    @pytest.mark.parametrize(
+        "bad", ["actor", "actor=x", "actor=1:2", "actor=1:2:1.5", "=3"]
+    )
+    def test_bad_spec_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_split_spec(bad)
+
+    def test_splitter_error_accumulator_and_promote(self):
+        sp = CanarySplitter("actor", 4, canary_version=5, fraction=0.25)
+        picks = [sp.pick() for _ in range(8)]
+        assert picks.count("actor@v5") == 2
+        assert sp.stable_total == 6 and sp.canary_total == 2
+        sp.promote()
+        assert (sp.stable_version, sp.canary_version) == (5, None)
+        assert sp.pick() == "actor@v5"
+        with pytest.raises(ValueError):
+            sp.promote()
+
+
+# ---------------------------------------------------------------------------
+# Engine: single-policy strict no-op
+# ---------------------------------------------------------------------------
+def test_single_policy_mode_is_strict_noop(param_sets):
+    p0, _ = param_sets
+    eng = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    try:
+        out = _greedy(eng, "plain", [1, 2, 3], 8)
+        assert not eng._policies.active
+        assert eng.policy_status() == {}
+        # zero new metric keys and zero new result keys until a named
+        # policy registers — the default path is bit-for-bit the r13
+        # single-policy engine
+        m = eng.metrics()
+        assert not any(k.startswith("policy_") for k in m), m
+        assert "policy" not in out["meta_info"]
+        assert "policy_version" not in out["meta_info"]
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Engine: two named lines, bit-identical to dedicated engines
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_policy_streams_match_dedicated_engines(param_sets):
+    p0, p1 = param_sets
+    prompt = [11, 7, 3, 5]
+    ref0 = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    ref1 = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p1
+    ).start()
+    try:
+        want0 = _greedy(ref0, "ref0", prompt, 48)["output_ids"]
+        want1 = _greedy(ref1, "ref1", prompt, 48)["output_ids"]
+        assert want0 != want1, "param sets must disagree for the test"
+    finally:
+        ref0.stop()
+        ref1.stop()
+
+    eng = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    try:
+        out, n_chunks = _push_policy_chunks(eng, "actor", p1, version=1)
+        assert out == {"version": 1, "complete": True, "policy": "actor"}
+        assert n_chunks >= 3, "pick chunk_bytes small enough to stream"
+        # default line untouched: no flip, no version bump, no pause
+        m = eng.metrics()
+        assert eng.model_version == 0
+        assert m["weight_flips_total"] == 0.0
+        assert m["paused"] == 0.0
+        assert m["policy_lines"] == 1.0
+        assert m["policy_buffers_resident"] == 1.0
+        assert m["policy_pushes_total"] == 1.0
+
+        # both lines CONCURRENTLY, same prompt: per-(policy, version) KV
+        # namespaces mean neither stream can reuse the other's pages
+        futs = []
+        for i in range(2):
+            futs.append(eng.submit({
+                "rid": f"d{i}", "input_ids": list(prompt),
+                "sampling_params": {"max_new_tokens": 48, "greedy": True},
+            }))
+            futs.append(eng.submit({
+                "rid": f"a{i}", "input_ids": list(prompt),
+                "policy": "actor",
+                "sampling_params": {"max_new_tokens": 48, "greedy": True},
+            }))
+        results = [f.result(timeout=300) for f in futs]
+        for i in range(2):
+            assert results[2 * i]["output_ids"] == want0
+            assert results[2 * i + 1]["output_ids"] == want1
+        named = results[1]
+        assert named["meta_info"]["policy"] == "actor"
+        assert named["meta_info"]["policy_version"] == 1
+        # version fence: named tokens stamp the LINE's version
+        assert set(named["output_versions"]) == {1}
+        assert "policy" not in results[0]["meta_info"]
+
+        # per-policy accounting reached the status surface
+        st = eng.policy_status()["actor"]
+        assert st["requests_total"] == 2
+        assert st["tokens_total"] == 96
+        assert st["pinned_requests"] == 0
+
+        # unknown handle → typed 400 on the caller thread, decode alive
+        with pytest.raises(UnknownPolicyError) as ei:
+            _greedy(eng, "ghost-req", prompt, 4, policy="ghost")
+        assert ei.value.status == 400
+        with pytest.raises(UnknownPolicyError):
+            _greedy(eng, "dead-sel", prompt, 4, policy="actor@v99")
+        assert _greedy(eng, "alive", [9], 4)["output_ids"]
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_policy_pin_blocks_retire_until_drain(param_sets):
+    p0, p1 = param_sets
+    eng = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    try:
+        _push_policy_chunks(eng, "actor", p1, version=1)
+        fut = eng.submit({
+            "rid": "long", "input_ids": [5, 6, 7], "policy": "actor",
+            "sampling_params": {"max_new_tokens": 200, "greedy": True},
+        })
+        _wait_decoding(eng)
+        assert eng.metrics()["policy_pinned_requests"] == 1.0
+        with pytest.raises(RuntimeError, match="pinned"):
+            eng.retire_policy("actor")
+        fut.result(timeout=300)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if eng.metrics()["policy_pinned_requests"] == 0.0:
+                break
+            time.sleep(0.05)
+        assert eng.metrics()["policy_pinned_requests"] == 0.0
+        eng.retire_policy("actor")
+        assert eng.policy_status() == {}
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Engine: canary split + zero-pause promote, other line undisturbed
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_canary_split_and_zero_pause_promote(param_sets):
+    p0, p1 = param_sets
+    prompt = [2, 4, 6]
+    eng = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    try:
+        _push_policy_chunks(eng, "actor", p1, version=1)
+        _push_policy_chunks(eng, "opponent", p1, version=1)
+        # stage p0 as actor's canary at a 50/50 split
+        out, _ = _push_policy_chunks(
+            eng, "actor", p0, version=2, canary_fraction=0.5
+        )
+        assert out["version"] == 2
+        st = eng.policy_status()["actor"]
+        assert st["stable_version"] == 1
+        assert st["canary_version"] == 2
+        assert st["canary_fraction"] == 0.5
+
+        # deterministic error-accumulator split: picks 2,4,6,8 hit canary
+        results = [
+            _greedy(eng, f"s{i}", prompt, 8, policy="actor")
+            for i in range(8)
+        ]
+        versions = [r["meta_info"]["policy_version"] for r in results]
+        assert versions.count(2) == 4
+        assert versions == [1, 2, 1, 2, 1, 2, 1, 2]
+
+        opp_before = _greedy(eng, "ob", prompt, 16, policy="opponent")
+        assert eng.promote_policy("actor") == 2
+        m = eng.metrics()
+        # promote is registry state only: no flip, no pause span, and
+        # the OTHER line keeps serving identically
+        assert m["paused"] == 0.0
+        assert m["weight_flips_total"] == 0.0
+        assert m["policy_promotes_total"] == 1.0
+        st = eng.policy_status()["actor"]
+        assert st["stable_version"] == 2
+        assert st["canary_version"] is None
+        after = _greedy(eng, "post", prompt, 8, policy="actor")
+        assert after["meta_info"]["policy_version"] == 2
+        opp_after = _greedy(eng, "oa", prompt, 16, policy="opponent")
+        assert opp_after["output_ids"] == opp_before["output_ids"]
+        assert eng.policy_status()["opponent"]["stable_version"] == 1
+        # zero pause spans across the whole canary lifecycle
+        names = [s.name for s in eng.tracer.snapshot()]
+        assert "pause_window" not in names
+        assert "weight_update_pause" not in names
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Server HTTP surface: typed 400 + labeled per-policy /metrics
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_server_typed_400_and_policy_metrics(param_sets):
+    from areal_tpu.inference.server import serve
+
+    p0, p1 = param_sets
+    eng = GenerationEngine(
+        _gen_cfg(), model_config=MODEL_CFG, params=p0
+    ).start()
+    httpd = serve(eng, host="127.0.0.1", port=0, background=True)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+
+    def post(path, payload, timeout=60):
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://{addr}{path}", timeout=30
+        ) as r:
+            return r.read().decode()
+
+    try:
+        _push_policy_chunks(eng, "actor", p1, version=1)
+        # unknown handle over HTTP: status 400, typed body, NOT a 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/generate", {
+                "rid": "g", "input_ids": [1, 2], "policy": "ghost",
+                "sampling_params": {"max_new_tokens": 2, "greedy": True},
+            })
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read())
+        assert body["type"] == "unknown_policy"
+        assert body["policy"] == "ghost"
+
+        out = post("/generate", {
+            "rid": "ok", "input_ids": [1, 2], "policy": "actor",
+            "sampling_params": {"max_new_tokens": 4, "greedy": True},
+        }, timeout=300)
+        assert out["meta_info"]["policy"] == "actor"
+
+        # /policy status + lifecycle ops over HTTP
+        st = json.loads(get("/policy"))["policies"]
+        assert st["actor"]["stable_version"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/policy", {"op": "promote", "name": "actor"})
+        assert ei.value.code == 400  # no canary staged → typed 4xx
+
+        # labeled per-policy families on /metrics (hand-rendered)
+        text = get("/metrics")
+        assert 'areal_tpu_gen_policy_stable_version{policy="actor"} 1' in text
+        assert 'areal_tpu_gen_policy_requests_total{policy="actor"} 1' in text
+        assert "areal_tpu_gen_policy_lines 1" in text
+    finally:
+        httpd.shutdown()
+        eng.stop()

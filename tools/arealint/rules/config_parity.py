@@ -76,6 +76,8 @@ _SERVER_ALIASES: Dict[Tuple[str, str], Optional[str]] = {
     ("WeightTransferConfig", "streaming"): "no-weight-streaming",
     ("WeightTransferConfig", "flip_policy"): "weight-flip-policy",
     ("WeightTransferConfig", "staging_ttl_s"): "weight-staging-ttl",
+    # multi-policy serving plane (r19)
+    ("PolicyConfig", "max_resident"): "policy-max-resident",
     # cold-start elimination (r14)
     ("PrecompileConfig", "mode"): "precompile",
     ("PrecompileConfig", "replay_path"): "precompile-replay",
@@ -88,7 +90,7 @@ _SERVER_ALIASES: Dict[Tuple[str, str], Optional[str]] = {
 # sub-configs of JaxGenConfig whose fields ride the same server CLI
 _SUBCONFIGS = (
     "SpecConfig", "TracingConfig", "GoodputConfig",
-    "WeightTransferConfig", "PrecompileConfig",
+    "WeightTransferConfig", "PrecompileConfig", "PolicyConfig",
 )
 
 # flags the server declares that no config field maps to (launcher- or

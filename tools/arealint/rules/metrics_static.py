@@ -86,6 +86,13 @@ SURFACES = [
             # engine's _hists dict init
             "queue_wait_seconds", "ttft_seconds",
             "request_latency_seconds",
+            # multi-policy plane (r19): per-line families hand-rendered
+            # with {policy="..."} labels by the server's /metrics
+            # handler (render_prometheus cannot label scalar dicts) —
+            # documented-dynamic, one series per named line
+            "policy_stable_version", "policy_canary_version",
+            "policy_canary_fraction", "policy_requests_total",
+            "policy_tokens_total",
         ],
     ),
     Surface(

@@ -1017,6 +1017,188 @@ def format_lineage(ln: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def _parse_policy_metrics(text: str) -> Dict[str, Any]:
+    """Pull the multi-policy serving plane out of a Prometheus
+    ``/metrics`` snapshot: the per-policy labeled families the server
+    hand-renders (``policy_stable_version{policy="actor"} 12``) plus
+    the unlabeled policy-plane aggregates, engine (``areal_tpu_gen_``)
+    and router (``areal_tpu_router_``) prefixes both accepted. Returns
+    empty maps for non-snapshot input."""
+    per: Dict[str, Dict[str, float]] = {}
+    agg: Dict[str, float] = {}
+    labeled = (
+        "policy_stable_version", "policy_canary_version",
+        "policy_canary_fraction", "policy_requests_total",
+        "policy_tokens_total",
+    )
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        name = parts[0]
+        for prefix in ("areal_tpu_gen_", "areal_tpu_router_"):
+            if name.startswith(prefix):
+                name = name[len(prefix):]
+                break
+        try:
+            value = float(parts[1])
+        except ValueError:
+            continue
+        base, _, label = name.partition("{")
+        if label and base in labeled:
+            # {policy="actor"} → actor
+            pol = label.split('"')[1] if '"' in label else ""
+            if pol:
+                per.setdefault(pol, {})[base] = value
+        elif not label and (
+            base.startswith("policy_")
+            or base.startswith("qid_affinity_evictions_")
+        ):
+            agg[base] = value
+    return {"per_policy": per, "aggregates": agg}
+
+
+def load_policy(path: str) -> Dict[str, Any]:
+    """Load ``--policy`` input: a ``/metrics`` snapshot (per-policy
+    labeled families) or a lineage-ledger JSONL whose request records
+    carry the resolved ``policy`` handle. Either kind works; the report
+    renders whichever is present."""
+    with open(path) as f:
+        text = f.read()
+    metrics = _parse_policy_metrics(text)
+    records: List[Dict[str, Any]] = []
+    if not (metrics["per_policy"] or metrics["aggregates"]):
+        try:
+            records = load_lineage(path)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            records = []
+    return {"metrics": metrics, "ledger": records}
+
+
+def policy_summary(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-policy serving table: registry state (stable/canary versions
+    + split fraction) from a /metrics snapshot, and request/TTFT/
+    staleness aggregates from the lineage ledger — including the
+    OBSERVED per-version request split, the ground truth a canary
+    rollout checks its configured fraction against."""
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def row(name: str) -> Dict[str, Any]:
+        return rows.setdefault(
+            name,
+            {
+                "policy": name,
+                "stable_version": None,
+                "canary_version": None,
+                "canary_fraction": None,
+                "requests": 0,
+                "output_tokens": 0,
+                "migrations": 0,
+                "failovers": 0,
+                "ttft_p50_s": None,
+                "ttft_p95_s": None,
+                "staleness_p50": None,
+                "staleness_max": None,
+                "version_requests": {},
+            },
+        )
+
+    for name, fam in sorted(data["metrics"]["per_policy"].items()):
+        r = row(name)
+        if "policy_stable_version" in fam:
+            r["stable_version"] = int(fam["policy_stable_version"])
+        cv = fam.get("policy_canary_version")
+        if cv is not None and cv >= 0:
+            r["canary_version"] = int(cv)
+        if "policy_canary_fraction" in fam:
+            r["canary_fraction"] = fam["policy_canary_fraction"]
+        if "policy_requests_total" in fam:
+            r["requests"] = int(fam["policy_requests_total"])
+        if "policy_tokens_total" in fam:
+            r["output_tokens"] = int(fam["policy_tokens_total"])
+
+    ttfts: Dict[str, List[float]] = {}
+    stales: Dict[str, List[int]] = {}
+    for rec in data["ledger"]:
+        st = rec.get("staleness_max")
+        for rq in rec.get("requests", []):
+            handle = str(rq.get("policy") or "")
+            name = handle.split("@", 1)[0] or "<default>"
+            r = row(name)
+            r["requests"] += 1
+            r["output_tokens"] += int(rq.get("output_tokens", 0))
+            r["migrations"] += int(rq.get("migrations", 0))
+            r["failovers"] += int(rq.get("failovers", 0))
+            if "@v" in handle:
+                v = handle.rsplit("@v", 1)[1]
+                vr = r["version_requests"]
+                vr[v] = vr.get(v, 0) + 1
+            if rq.get("ttft_s") is not None:
+                ttfts.setdefault(name, []).append(float(rq["ttft_s"]))
+            if st is not None:
+                stales.setdefault(name, []).append(int(st))
+    for name, vals in ttfts.items():
+        vals.sort()
+        rows[name]["ttft_p50_s"] = round(_percentile(vals, 0.50), 4)
+        rows[name]["ttft_p95_s"] = round(_percentile(vals, 0.95), 4)
+    for name, vals in stales.items():
+        vals.sort()
+        rows[name]["staleness_p50"] = _percentile(vals, 0.50)
+        rows[name]["staleness_max"] = vals[-1]
+    for r in rows.values():
+        total = sum(r["version_requests"].values())
+        r["split_observed"] = {
+            v: round(n / total, 4)
+            for v, n in sorted(r["version_requests"].items())
+        } if total else {}
+    return {
+        "policies": [rows[k] for k in sorted(rows)],
+        "aggregates": data["metrics"]["aggregates"],
+    }
+
+
+def format_policy(po: Dict[str, Any]) -> str:
+    out = [
+        f"{'policy':<14}{'stable':>7}{'canary':>7}{'frac':>6}"
+        f"{'req':>7}{'tokens':>9}{'mig':>4}{'ttft p50/p95':>14}"
+        f"{'stale p50/max':>15}",
+    ]
+    for r in po["policies"]:
+        def fmt(v, nd=2):
+            return "-" if v is None else (
+                f"{v:.{nd}f}" if isinstance(v, float) else str(v)
+            )
+        ttft = (
+            f"{fmt(r['ttft_p50_s'])}/{fmt(r['ttft_p95_s'])}"
+            if r["ttft_p50_s"] is not None else "-"
+        )
+        stale = (
+            f"{fmt(r['staleness_p50'])}/{fmt(r['staleness_max'])}"
+            if r["staleness_p50"] is not None else "-"
+        )
+        out.append(
+            f"{r['policy'][:13]:<14}{fmt(r['stable_version']):>7}"
+            f"{fmt(r['canary_version']):>7}"
+            f"{fmt(r['canary_fraction']):>6}"
+            f"{r['requests']:>7}{r['output_tokens']:>9}"
+            f"{r['migrations']:>4}{ttft:>14}{stale:>15}"
+        )
+        if r.get("split_observed"):
+            split = "  ".join(
+                f"v{v}: {frac:.1%}"
+                for v, frac in r["split_observed"].items()
+            )
+            out.append(f"    observed split   {split}")
+    if po["aggregates"]:
+        out.append("")
+        for k in sorted(po["aggregates"]):
+            out.append(f"{k:<38}{po['aggregates'][k]:>10g}")
+    return "\n".join(out)
+
+
 def fleet_summary(manifest: Dict[str, Any]) -> Dict[str, Any]:
     rollup = manifest.get("rollup", {})
     anomalies = manifest.get("anomalies", {})
@@ -1516,6 +1698,14 @@ def main(argv=None) -> int:
         "attempt/migration/staleness table; exit 1 when it is empty",
     )
     p.add_argument(
+        "--policy", action="store_true",
+        help="per-policy serving table (multi-policy plane): registry "
+        "state + request/token counts from a /metrics snapshot's "
+        "labeled policy families, and/or request/TTFT/staleness "
+        "aggregates with the OBSERVED canary split from a lineage "
+        "ledger JSONL; exit 1 when the input carries neither",
+    )
+    p.add_argument(
         "--goodput", action="store_true",
         help="treat the input as a goodput JSONL stream (ledger "
         "snapshots + compile events — utils/goodput.py) and print the "
@@ -1646,6 +1836,22 @@ def main(argv=None) -> int:
         if not gp["roles"] and not gp["shapes"]:
             print(
                 "no goodput snapshots or compile events in file",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+    if args.policy:
+        po = policy_summary(load_policy(args.trace))
+        if args.json:
+            print(json.dumps(po, indent=2))
+        else:
+            print(format_policy(po))
+        if not po["policies"] and not po["aggregates"]:
+            print(
+                "no per-policy metrics or policy-tagged lineage "
+                "records in file (pass a /metrics snapshot from a "
+                "multi-policy server, or a ledger whose requests "
+                "carry a policy handle)",
                 file=sys.stderr,
             )
             return 1
