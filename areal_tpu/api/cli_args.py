@@ -1075,6 +1075,44 @@ class LauncherConfig:
     trainer_processes: int = 1
 
 
+@dataclasses.dataclass
+class SelfPlayConfig:
+    """Self-play episode plane (workflow/selfplay.py): multi-agent
+    episodes over one shared transcript, shipped as the countdown
+    proposer/solver workload. Off by default — with ``enabled=False``
+    the engine and workflow paths are a strict no-op. Every field here
+    is machine-checked against workflow/selfplay.py by arealint ARL002
+    (a field the workflow never reads is a silent default)."""
+
+    enabled: bool = False
+    # named policy handles (r19) for the two sides; "" rides the default
+    # line. Different handles play different checkpoints on one engine
+    # (e.g. "proposer@stable" vs "solver@canary").
+    proposer_policy: str = ""
+    solver_policy: str = ""
+    # which sides export training rows; an untrained side is a frozen
+    # opponent contributing only loss-masked context tokens
+    train_proposer: bool = True
+    train_solver: bool = True
+    # traffic class for UNTRAINED (opponent) sides: interactive gives
+    # opponent turns the bounded TTFT of PR 10/15 inside bulk rollouts
+    # (the opponent is on the episode's critical path); trained sides
+    # always ride bulk
+    opponent_priority: str = "interactive"
+    # proposer reward mapping: "banded" (difficulty band of the accepted
+    # instance) or "zero_sum" (1 - solver reward)
+    reward_mode: str = "banded"
+    # reward discount across an agent's own turns (export_completions)
+    turn_discount: float = 0.9
+    # per-side turn budgets within one episode
+    max_propose_rounds: int = 3
+    max_solver_rounds: int = 4
+    # proposer instance-schema bounds (env/selfplay.py grader families)
+    min_numbers: int = 3
+    max_numbers: int = 4
+    max_target: int = 1000
+
+
 # --------------------------------------------------------------------------
 # Experiments
 # --------------------------------------------------------------------------
@@ -1125,6 +1163,10 @@ class GRPOConfig(BaseExperimentConfig):
     )
     actor: PPOActorConfig = dataclasses.field(default_factory=PPOActorConfig)
     ref: Optional[PPOActorConfig] = None
+    # self-play episode plane (workflow/selfplay.py): off = strict no-op
+    selfplay: SelfPlayConfig = dataclasses.field(
+        default_factory=SelfPlayConfig
+    )
 
 
 # --------------------------------------------------------------------------

@@ -265,12 +265,9 @@ class _ChatCompletions:
             input_ids=list(input_ids),
             gconfig=gconfig,
             rid=f"chatcmpl-{uuid.uuid4().hex}",
-            metadata={
-                "qid": c.session_id,
-                "priority": c.priority,
-                # named policy handle (r19): "" rides the default line
-                **({"policy": c.policy} if c.policy else {}),
-            },
+            # the client-lifetime dict, not a fresh one: router-resolved
+            # canary handles written back into it stick for the session
+            metadata=c._metadata,
         )
         resp = await c.engine.agenerate(req)
         text = c.tokenizer.decode(resp.output_tokens)
@@ -330,6 +327,8 @@ class ArealOpenAI:
         session_id: Optional[str] = None,
         priority: str = "interactive",
         policy: str = "",
+        agent: str = "",
+        role: str = "",
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -353,6 +352,22 @@ class ArealOpenAI:
         from areal_tpu.api.io_struct import unique_rid
 
         self.session_id = session_id or unique_rid("sess")
+        # self-play episode plane: which agent of a multi-agent episode
+        # this client speaks for, and that agent's role — stamped into
+        # request metadata so lineage records split per side
+        self.agent = agent
+        self.role = role
+        # ONE metadata dict for the client's lifetime (the rlvr/
+        # multi_turn stamping contract, r19): the router writes a
+        # canary-resolved policy handle back into it, so every later
+        # turn of the session stays on the version that served turn 0
+        self._metadata: Dict[str, Any] = {
+            "qid": self.session_id,
+            "priority": self.priority,
+            **({"policy": self.policy} if self.policy else {}),
+            **({"agent": self.agent} if self.agent else {}),
+            **({"role": self.role} if self.role else {}),
+        }
         self._cache: Dict[str, CompletionWithTokenLogpReward] = {}
         self.chat = _Chat(self)
 

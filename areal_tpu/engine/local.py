@@ -102,6 +102,13 @@ class LocalSyncInferenceEngine(InferenceEngine):
         lineage = _telemetry.RequestLineage(
             rid=req.rid,
             attempt=episode.attempt if episode is not None else 0,
+            # same stamps as the remote engine so lineage records read
+            # identically across deployment modes: the policy handle is
+            # recorded as stamped (a single local engine has no router
+            # to resolve canaries), plus the self-play agent/role
+            policy=str(req.metadata.get("policy") or ""),
+            agent=str(req.metadata.get("agent") or ""),
+            role=str(req.metadata.get("role") or ""),
         )
         if episode is not None:
             self.engine.tracer.bind_trace(req.rid, episode.trace_id)
@@ -114,10 +121,21 @@ class LocalSyncInferenceEngine(InferenceEngine):
                     {"mm": req.mm}
                     if getattr(req, "mm", None) is not None else {}
                 )
+                # traffic-plane class rides into the in-process engine
+                # too: self-play opponent turns stamp "interactive" and
+                # get the bounded-TTFT scheduling the remote path has.
+                # The policy handle is NOT forwarded — a single local
+                # engine has no policy registry, and an unregistered
+                # name would 400 at submit (the remote path resolves
+                # handles in the router instead).
+                priority = str(req.metadata.get("priority") or "bulk")
+                if priority not in ("interactive", "bulk"):
+                    priority = "bulk"
                 fut = self.engine.submit(
                     {
                         "rid": req.rid,
                         "input_ids": list(req.input_ids) + accumulated,
+                        "priority": priority,
                         **payload_extra,
                         "sampling_params": {
                             "max_new_tokens": gconfig.max_new_tokens

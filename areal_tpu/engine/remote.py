@@ -667,6 +667,10 @@ class RemoteInferenceEngine(InferenceEngine):
         lineage = telemetry.RequestLineage(
             rid=req.rid,
             attempt=episode.attempt if episode is not None else 0,
+            # self-play stamps: which side of a multi-agent episode this
+            # request belongs to (workflow/selfplay.py); "" elsewhere
+            agent=str(req.metadata.get("agent") or ""),
+            role=str(req.metadata.get("role") or ""),
         )
         routed = False  # this rid ever held a router schedule (ledger)
         try:

@@ -1,0 +1,372 @@
+"""Proposer side of the countdown self-play pair.
+
+The solver side already exists (env/countdown.py: a tool-calling episode
+graded by ``countdown_score``). This module adds the other half of the
+first self-play workload (ROADMAP item 4): a **proposer** environment in
+which the model AUTHORS a countdown instance — a numbers/target pair —
+through a grader-validated schema, and the validated instance is then
+handed to the solver's episode by the self-play workflow
+(workflow/selfplay.py).
+
+Grader-family validation (the style of reward/grader.py): every rejected
+proposal names a FAMILY (``count``/``range``/``integer``/``target``/
+``unsolvable``/``parse``) so tests pin agreement vectors per family and
+the metrics plane can count invalid proposals without string-matching
+free text.
+
+Everything here is a pure function of the call log — no RNG, no clock —
+so ``ProposerEnv`` is ``replay_safe`` under the env service's journaled
+replay (ARCHITECTURE.md §13): a worker death mid-episode replays to a
+bit-identical state.
+
+Instance text formats (the toy tokenizer has no JSON punctuation, so the
+compact form is first-class, not a fallback):
+
+- compact: ``"3 5 2 = 21"`` — whitespace-separated numbers, ``=``, target
+- JSON:    ``{"numbers": [3, 5, 2], "target": 21}``
+"""
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+# Bounds mirror the solver generator (countdown.sample_instance): numbers
+# in 1..19, 3-4 of them. The proposer is graded against the same contract
+# the solver was trained on.
+NUMBER_MIN = 1
+NUMBER_MAX = 19
+DEFAULT_MIN_NUMBERS = 3
+DEFAULT_MAX_NUMBERS = 4
+DEFAULT_MAX_TARGET = 1000
+
+
+def parse_instance(text: str) -> Tuple[List[int], int]:
+    """Parse an instance from either accepted format; raises ValueError
+    (family ``parse``) on anything else. Numbers/target must be integers
+    — the countdown pool is integer by contract."""
+    text = text.strip()
+    if not text:
+        raise ValueError("empty instance")
+    if text.startswith("{"):
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            raise ValueError(f"bad JSON: {e}") from None
+        if not isinstance(obj, dict):
+            raise ValueError("JSON instance must be an object")
+        numbers, target = obj.get("numbers"), obj.get("target")
+        if not isinstance(numbers, list):
+            raise ValueError("JSON instance needs a 'numbers' list")
+        if isinstance(target, bool) or not isinstance(target, (int, float)):
+            raise ValueError("JSON instance needs a numeric 'target'")
+    else:
+        left, sep, right = text.partition("=")
+        if not sep:
+            raise ValueError(
+                "compact instance must look like '3 5 2 = 21'"
+            )
+        numbers = left.split()
+        target = right.strip()
+        if not numbers or not target:
+            raise ValueError("compact instance missing numbers or target")
+
+    def _as_int(v: Any, what: str) -> int:
+        if isinstance(v, bool):
+            raise ValueError(f"{what} must be an integer, got {v!r}")
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{what} must be an integer, got {v!r}"
+            ) from None
+        if f != int(f):
+            raise ValueError(f"{what} must be an integer, got {v!r}")
+        return int(f)
+
+    nums = [_as_int(n, "number") for n in numbers]
+    return nums, _as_int(target, "target")
+
+
+def instance_solvable(numbers: List[int], target: int) -> bool:
+    """Whether the target is reachable with + - * / using each number at
+    most once (subsets allowed — the solver's scoring rule). Exhaustive
+    pairwise-combine search; fine for the contract's <= 4 numbers."""
+    tol = 1e-6
+
+    def rec(vals: List[float]) -> bool:
+        if any(abs(v - target) < tol for v in vals):
+            return True
+        n = len(vals)
+        for i in range(n):
+            for j in range(n):
+                if i == j:
+                    continue
+                a, b = vals[i], vals[j]
+                rest = [vals[k] for k in range(n) if k not in (i, j)]
+                cands = [a + b, a - b, a * b]
+                if abs(b) > tol:
+                    cands.append(a / b)
+                for c in cands:
+                    if rec(rest + [c]):
+                        return True
+        return False
+
+    return rec([float(x) for x in numbers])
+
+
+def validate_instance(
+    numbers: List[int],
+    target: int,
+    min_numbers: int = DEFAULT_MIN_NUMBERS,
+    max_numbers: int = DEFAULT_MAX_NUMBERS,
+    max_target: int = DEFAULT_MAX_TARGET,
+    require_solvable: bool = True,
+) -> Tuple[bool, str, str]:
+    """(ok, family, detail). Families: ``count`` (wrong number count),
+    ``range`` (a number outside [NUMBER_MIN, NUMBER_MAX]), ``target``
+    (|target| above max_target), ``unsolvable`` (no expression reaches
+    the target), ``ok``."""
+    if not (min_numbers <= len(numbers) <= max_numbers):
+        return (
+            False,
+            "count",
+            f"need {min_numbers}-{max_numbers} numbers, got {len(numbers)}",
+        )
+    for n in numbers:
+        if not (NUMBER_MIN <= n <= NUMBER_MAX):
+            return (
+                False,
+                "range",
+                f"number {n} outside [{NUMBER_MIN}, {NUMBER_MAX}]",
+            )
+    if abs(target) > max_target:
+        return False, "target", f"|{target}| exceeds {max_target}"
+    if require_solvable and not instance_solvable(numbers, target):
+        return (
+            False,
+            "unsolvable",
+            f"no expression over {numbers} reaches {target}",
+        )
+    return True, "ok", "valid instance"
+
+
+def difficulty_band(numbers: List[int], target: int) -> int:
+    """Deterministic difficulty band 0..3 of a VALID instance — the
+    proposer's graded outcome. Pure arithmetic of the instance (no RNG,
+    no solver rollout) so banding is bit-stable under replay: more
+    numbers and larger/negative targets mean more combination depth."""
+    band = 0
+    if len(numbers) >= 4:
+        band += 1
+    if abs(target) > 50:
+        band += 1
+    if abs(target) > 200 or target < 0:
+        band += 1
+    return min(band, 3)
+
+
+def proposer_reward(
+    valid: bool,
+    band: int,
+    solver_reward: float,
+    mode: str = "banded",
+) -> float:
+    """Map a proposal's outcome to the proposer's scalar reward.
+
+    - ``banded``: invalid -> 0.0; valid -> (1 + band) / 4 in {0.25, 0.5,
+      0.75, 1.0} — harder (higher-band) instances earn more, independent
+      of the solver's luck.
+    - ``zero_sum``: invalid -> 0.0; valid -> 1.0 - solver_reward — the
+      adversarial mapping (proposer wins what the solver loses).
+    """
+    if not valid:
+        return 0.0
+    if mode == "banded":
+        return (1.0 + min(max(int(band), 0), 3)) / 4.0
+    if mode == "zero_sum":
+        return 1.0 - float(solver_reward)
+    raise ValueError(f"unknown proposer reward mode {mode!r}")
+
+
+PROPOSER_TOOL_SCHEMAS: List[Dict[str, Any]] = [
+    {
+        "type": "function",
+        "function": {
+            "name": "check_instance",
+            "description": (
+                "Validate a candidate countdown instance without "
+                "committing it; returns the grader verdict and the "
+                "difficulty band."
+            ),
+            "parameters": {
+                "type": "object",
+                "properties": {"instance": {"type": "string"}},
+                "required": ["instance"],
+            },
+        },
+    },
+    {
+        "type": "function",
+        "function": {
+            "name": "propose_instance",
+            "description": (
+                "Commit the final countdown instance ('3 5 2 = 21' or "
+                "JSON {numbers, target}). A valid instance ends the "
+                "episode; an invalid one is rejected with the reason."
+            ),
+            "parameters": {
+                "type": "object",
+                "properties": {"instance": {"type": "string"}},
+                "required": ["instance"],
+            },
+        },
+    },
+]
+
+
+@dataclasses.dataclass
+class ProposerEnv:
+    """Tool-style env (the protocol AgenticToolWorkflow speaks) in which
+    the model proposes one countdown instance. The episode ends when a
+    valid instance is committed, or after ``max_attempts`` invalid
+    ``propose_instance`` calls (deterministic budget — the env, not the
+    workflow, owns episode termination so replay needs no client state).
+
+    The committed instance travels in the FINAL OBSERVATION as JSON
+    (``accepted {"numbers": ..., "target": ..., "band": ...}``): under
+    the env service's journaled replay the observation is the one channel
+    that is bit-reproduced, so the workflow parses the instance from
+    there rather than from private env attributes."""
+
+    min_numbers: int = DEFAULT_MIN_NUMBERS
+    max_numbers: int = DEFAULT_MAX_NUMBERS
+    max_target: int = DEFAULT_MAX_TARGET
+    require_solvable: bool = True
+    max_attempts: int = 3
+    attempts: int = 0
+    instance: Optional[Tuple[List[int], int]] = None
+    band: int = 0
+    reward: float = 0.0
+    detail: str = "no proposal"
+    done: bool = False
+    info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def tools(self) -> List[Dict[str, Any]]:
+        return PROPOSER_TOOL_SCHEMAS
+
+    def prompt(self) -> str:
+        return (
+            f"Propose a countdown instance: {self.min_numbers}-"
+            f"{self.max_numbers} numbers in [{NUMBER_MIN}, {NUMBER_MAX}] "
+            f"and an integer target (|target| <= {self.max_target}) "
+            "reachable from them with + - * / using each number at most "
+            "once. Harder instances score higher. Check candidates with "
+            "check_instance; commit with propose_instance as "
+            "'3 5 2 = 21'."
+        )
+
+    def _grade(self, text: str) -> Tuple[bool, str, str, Any]:
+        try:
+            numbers, target = parse_instance(text)
+        except ValueError as e:
+            return False, "parse", str(e), None
+        ok, family, detail = validate_instance(
+            numbers,
+            target,
+            min_numbers=self.min_numbers,
+            max_numbers=self.max_numbers,
+            max_target=self.max_target,
+            require_solvable=self.require_solvable,
+        )
+        return ok, family, detail, (numbers, target)
+
+    def call(self, name: str, arguments: str) -> str:
+        try:
+            args = json.loads(arguments) if arguments else {}
+        except ValueError:
+            return "error: arguments are not valid JSON"
+        text = str(args.get("instance", ""))
+        if name == "check_instance":
+            ok, family, detail, inst = self._grade(text)
+            if ok:
+                numbers, target = inst
+                return f"valid (band {difficulty_band(numbers, target)})"
+            return f"invalid [{family}]: {detail}"
+        if name == "propose_instance":
+            ok, family, detail, inst = self._grade(text)
+            if ok:
+                numbers, target = inst
+                self.instance = (numbers, target)
+                self.band = difficulty_band(numbers, target)
+                self.reward = 1.0
+                self.detail = f"accepted (band {self.band})"
+                self.done = True
+                self.info = {
+                    "selfplay": {"valid": True, "band": self.band}
+                }
+                return "accepted " + json.dumps(
+                    {
+                        "numbers": numbers,
+                        "target": target,
+                        "band": self.band,
+                    }
+                )
+            self.attempts += 1
+            if self.attempts >= self.max_attempts:
+                self.reward = 0.0
+                self.detail = f"rejected [{family}]: {detail}"
+                self.done = True
+                self.info = {
+                    "selfplay": {"valid": False, "band": -1}
+                }
+            return f"rejected [{family}]: {detail}"
+        return f"error: unknown tool {name!r}"
+
+
+def build_side_env(kwargs: Dict[str, Any]):
+    """One factory for BOTH sides of a countdown self-play episode,
+    keyed by ``side``: the self-play workflow (and the env service's
+    ``selfplay_env`` hosting factory) opens a proposer session and later
+    a solver session carrying the accepted instance — one code path
+    whether the envs run in-process or behind the env service."""
+    side = str(kwargs.get("side") or "solver")
+    if side == "proposer":
+        return ProposerEnv(
+            min_numbers=int(kwargs.get("min_numbers", DEFAULT_MIN_NUMBERS)),
+            max_numbers=int(kwargs.get("max_numbers", DEFAULT_MAX_NUMBERS)),
+            max_target=int(kwargs.get("max_target", DEFAULT_MAX_TARGET)),
+            require_solvable=bool(kwargs.get("require_solvable", True)),
+            max_attempts=int(kwargs.get("max_attempts", 3)),
+        )
+    if side == "solver":
+        from areal_tpu.env.countdown import CountdownEnv
+
+        return CountdownEnv(
+            numbers=[int(x) for x in kwargs["numbers"]],
+            target=int(kwargs["target"]),
+        )
+    raise ValueError(f"unknown self-play side {side!r}")
+
+
+_ACCEPTED_PREFIX = "accepted "
+
+
+def parse_accepted_observation(
+    text: str,
+) -> Optional[Tuple[List[int], int, int]]:
+    """(numbers, target, band) from a ``propose_instance`` acceptance
+    observation, or None for any other tool output. The workflow's only
+    way to read the committed instance — see ProposerEnv docstring."""
+    text = text.strip()
+    # the workflow may see the observation wrapped for a template-less
+    # tokenizer: "propose_instance -> accepted {...}"
+    idx = text.find(_ACCEPTED_PREFIX)
+    if idx < 0:
+        return None
+    try:
+        obj = json.loads(text[idx + len(_ACCEPTED_PREFIX):].split("\n")[0])
+        numbers = [int(x) for x in obj["numbers"]]
+        return numbers, int(obj["target"]), int(obj["band"])
+    except (ValueError, KeyError, TypeError):
+        return None

@@ -178,7 +178,12 @@ class ToolEnvAdapter(Env):
         )
         done = bool(self._env.done)
         reward = float(getattr(self._env, "reward", 0.0)) if done else 0.0
-        info = {"detail": str(getattr(self._env, "detail", ""))}
+        # structured env-authored info (e.g. the self-play proposer's
+        # {"selfplay": {...}} grading summary) rides alongside the
+        # canonical detail string; "detail" stays adapter-owned
+        extra = getattr(self._env, "info", None)
+        info = dict(extra) if isinstance(extra, dict) else {}
+        info["detail"] = str(getattr(self._env, "detail", ""))
         return result, reward, done, info
 
     async def aclose(self):
@@ -195,6 +200,28 @@ def countdown_env() -> ToolEnvAdapter:
             numbers=[int(x) for x in kw["numbers"]], target=int(kw["target"])
         )
     )
+
+
+def proposer_env() -> ToolEnvAdapter:
+    """Factory for serving the countdown PROPOSER side (env/selfplay.py)
+    as a remote tool env; reset kwargs override the instance-schema
+    bounds ({"min_numbers", "max_numbers", "max_target", ...})."""
+    from areal_tpu.env import selfplay
+
+    return ToolEnvAdapter(
+        lambda kw: selfplay.build_side_env({**kw, "side": "proposer"})
+    )
+
+
+def selfplay_env() -> ToolEnvAdapter:
+    """Factory for serving BOTH sides of a countdown self-play episode
+    from one worker pool, keyed by the reset kwarg ``side``:
+    "proposer" -> ProposerEnv (schema bounds from the other kwargs),
+    "solver" -> CountdownEnv ({"numbers", "target"}). One pool serving
+    both sessions keeps the episode's replay journals co-located."""
+    from areal_tpu.env import selfplay
+
+    return ToolEnvAdapter(selfplay.build_side_env)
 
 
 def math_code_env() -> Env:
@@ -375,6 +402,17 @@ _METRIC_HELP = {
     "rejected_draining_total": "resets refused while draining (503)",
     "rejected_capacity_total": "resets refused at max_sessions (429)",
     "sessions_expired_total": "idle sessions reaped by the TTL sweeper",
+    "selfplay_proposals_total": (
+        "self-play proposer instances graded (settled propose_instance "
+        "calls)"
+    ),
+    "selfplay_valid_proposals_total": (
+        "proposals the instance grader accepted"
+    ),
+    "selfplay_invalid_proposals_total": (
+        "proposals rejected by the instance grader (episode budget "
+        "exhausted)"
+    ),
     "draining": "1 while this worker is draining",
     "step_latency_ewma_s": "EWMA of env step execution latency",
     "trace_spans": "spans currently buffered (drained by GET /trace)",
@@ -626,6 +664,18 @@ class _EnvHandler(BaseHTTPRequestHandler):
                 else 0.9 * st.step_latency_ewma_s + 0.1 * dt
             )
         st.bump("steps_total")
+        # self-play workload counters: the proposer env stamps a grading
+        # summary into info when a proposal settles — counters only ever
+        # appear on workers actually serving proposer sessions (strict
+        # metric no-op for every other env)
+        sp = info.get("selfplay") if isinstance(info, dict) else None
+        if isinstance(sp, dict):
+            st.bump("selfplay_proposals_total")
+            st.bump(
+                "selfplay_valid_proposals_total"
+                if sp.get("valid")
+                else "selfplay_invalid_proposals_total"
+            )
         self._send_json(resp)
 
     def _do_close(self, payload: dict) -> None:

@@ -78,6 +78,12 @@ class RequestLineage:
     # named policy handle that served this request ("" = default line);
     # resolved to an exact "name@vN" when a canary split applied (r19)
     policy: str = ""
+    # self-play episode plane: which agent of a multi-agent episode
+    # issued this request, and that agent's role ("proposer"/"solver"/
+    # ...). Both sides of an episode share the trace id; agent/role are
+    # the per-side split key (trace_report --lineage per-agent rows)
+    agent: str = ""
+    role: str = ""
     # client-measured submit→first-token latency; None when the request
     # died before producing a token (trace_report --policy groups TTFT
     # percentiles by the policy field above)
@@ -124,6 +130,8 @@ class RequestLineage:
             "migrations": self.migrations,
             "output_tokens": sum(s["tokens"] for s in self.segments),
             **({"policy": self.policy} if self.policy else {}),
+            **({"agent": self.agent} if self.agent else {}),
+            **({"role": self.role} if self.role else {}),
             **(
                 {"ttft_s": round(self.ttft_s, 6)}
                 if self.ttft_s is not None

@@ -55,6 +55,60 @@ def tool_error_observation(
     return json.dumps({"error": err})
 
 
+async def bounded_tool_call(
+    env, name: str, arguments: str, tool_timeout_s: Optional[float]
+):
+    """One bounded tool execution — ``(observation, is_error)``. Shared
+    by the agentic and self-play workflows. Local sync envs run on a
+    worker thread (so a slow tool cannot block the rollout loop's other
+    episodes) under ``tool_timeout_s``; remote envs are bounded by
+    their OWN retry/failover budget instead. Failures become error
+    observations EXCEPT the env-service-plane errors that mean the
+    episode itself is lost — those must reach the retry/quarantine
+    machinery, not the model."""
+    acall = getattr(env, "acall", None)
+    try:
+        if acall is not None:
+            # remote sessions already carry their own bound: per-
+            # attempt timeout x retries x failover hops
+            # (EnvServiceConfig). Racing an outer wait_for against
+            # that budget would cancel the call mid-retry or mid-
+            # replay — BEFORE the plane's hung-worker recovery runs
+            # — feeding the model a spurious timeout while the
+            # session stays pointed at the wedged worker. The call
+            # is bounded; let it finish or fail typed.
+            out = await acall(name, arguments)
+        elif tool_timeout_s:
+            out = await asyncio.wait_for(
+                asyncio.to_thread(env.call, name, arguments),
+                tool_timeout_s,
+            )
+        else:
+            out = await asyncio.to_thread(env.call, name, arguments)
+        return str(out), False
+    except asyncio.TimeoutError:
+        logger.warning(
+            f"tool {name} timed out after {tool_timeout_s}s; "
+            f"feeding the timeout back as an observation"
+        )
+        return tool_error_observation(
+            name, "ToolTimeout",
+            "tool call did not return within the budget",
+            timeout_s=tool_timeout_s,
+        ), True
+    except (EnvServiceError, asyncio.CancelledError):
+        # worker death / fleet-down / shutdown: episode-fatal
+        raise
+    except Exception as e:
+        logger.warning(
+            f"tool {name} raised {type(e).__name__}: {e}; feeding the "
+            f"error back as an observation"
+        )
+        return tool_error_observation(
+            name, type(e).__name__, str(e)
+        ), True
+
+
 class AgenticToolWorkflow(RolloutWorkflow):
     def __init__(
         self,
@@ -66,6 +120,7 @@ class AgenticToolWorkflow(RolloutWorkflow):
         tool_parser=hermes_tool_parser,
         system_prompt: Optional[str] = None,
         tool_timeout_s: Optional[float] = 30.0,
+        policy: str = "",
     ):
         if gconfig.n_samples != 1:
             raise ValueError(
@@ -82,56 +137,16 @@ class AgenticToolWorkflow(RolloutWorkflow):
         # per-call bound on tool execution (None/0 = unbounded, the old
         # behavior — one hung tool call stalls the episode forever)
         self.tool_timeout_s = tool_timeout_s
+        # named policy handle (r19): the same stamping contract rlvr/
+        # multi_turn got — "" rides the default line, and the client's
+        # session-lifetime metadata keeps every turn of an episode on
+        # one canary-resolved version
+        self.policy = policy
 
     async def _call_tool(self, env, name: str, arguments: str):
-        """One bounded tool execution. Local sync envs run on a worker
-        thread (so a slow tool cannot block the rollout loop's other
-        episodes) under ``tool_timeout_s``; remote envs are bounded by
-        their OWN retry/failover budget instead. Failures become error
-        observations EXCEPT the env-service-plane errors that mean the
-        episode itself is lost — those must reach the retry/quarantine
-        machinery, not the model."""
-        acall = getattr(env, "acall", None)
-        try:
-            if acall is not None:
-                # remote sessions already carry their own bound: per-
-                # attempt timeout x retries x failover hops
-                # (EnvServiceConfig). Racing an outer wait_for against
-                # that budget would cancel the call mid-retry or mid-
-                # replay — BEFORE the plane's hung-worker recovery runs
-                # — feeding the model a spurious timeout while the
-                # session stays pointed at the wedged worker. The call
-                # is bounded; let it finish or fail typed.
-                out = await acall(name, arguments)
-            elif self.tool_timeout_s:
-                out = await asyncio.wait_for(
-                    asyncio.to_thread(env.call, name, arguments),
-                    self.tool_timeout_s,
-                )
-            else:
-                out = await asyncio.to_thread(env.call, name, arguments)
-            return str(out), False
-        except asyncio.TimeoutError:
-            logger.warning(
-                f"tool {name} timed out after {self.tool_timeout_s}s; "
-                f"feeding the timeout back as an observation"
-            )
-            return tool_error_observation(
-                name, "ToolTimeout",
-                "tool call did not return within the budget",
-                timeout_s=self.tool_timeout_s,
-            ), True
-        except (EnvServiceError, asyncio.CancelledError):
-            # worker death / fleet-down / shutdown: episode-fatal
-            raise
-        except Exception as e:
-            logger.warning(
-                f"tool {name} raised {type(e).__name__}: {e}; feeding the "
-                f"error back as an observation"
-            )
-            return tool_error_observation(
-                name, type(e).__name__, str(e)
-            ), True
+        return await bounded_tool_call(
+            env, name, arguments, self.tool_timeout_s
+        )
 
     async def arun_episode(
         self, engine, data: Dict[str, Any]
@@ -164,6 +179,7 @@ class AgenticToolWorkflow(RolloutWorkflow):
             # OpenAI-shaped client (live sessions keep its interactive
             # default)
             priority="bulk",
+            policy=self.policy,
         )
         messages: List[Dict[str, str]] = []
         if self.system_prompt:

@@ -1,0 +1,444 @@
+"""Self-play episode plane: multi-agent episodes over one shared
+transcript.
+
+N named agents — each bound to its own policy handle (r19 multi-policy
+serving: ``proposer@stable`` vs ``solver@canary``, or two snapshots of
+one line for frozen-opponent play) — alternate turns inside a SINGLE
+episode. The structural decisions, and what each one buys:
+
+- **One shared transcript, one episode session id.** Every agent's
+  client stamps the same ``qid`` (the episode id), so every turn of
+  either side claims the radix-cached shared history; per-policy KV
+  namespaces (§21) keep the two sides' caches honest. Turn N re-prefills
+  only its new suffix.
+- **One ArealOpenAI client per agent.** Each client caches only its own
+  completions, so per-agent credit assignment falls out of the existing
+  export machinery: ``export_completions`` per trained agent, opponent
+  turns appearing only as loss-masked context tokens inside the shared
+  transcript.
+- **Per-agent traffic class.** Trained sides ride ``bulk`` like every
+  training rollout; a frozen opponent's turns can ride ``interactive``
+  so they get the bounded TTFT of PR 10/15 inside bulk saturation — the
+  opponent is on the episode's critical path.
+- **Per-agent lineage.** Every request carries ``agent``/``role``
+  metadata; the engines stamp them into ``RequestLineage`` so one
+  episode's ledger record splits per side (``trace_report --lineage``)
+  while both sides share the episode trace id.
+
+The shipped scenario is countdown proposer/solver
+(:class:`CountdownSelfPlayWorkflow`): the proposer authors a
+numbers/target instance through the grader-validated schema
+(env/selfplay.py), the solver plays the existing countdown tool episode
+on it; the proposer is rewarded by difficulty band (or zero-sum), the
+solver by the existing binary reward. Both env sessions run through the
+same ``env_factory`` — in-process tool envs or the PR 8 env service
+(replay-safe multi-session journaling: an env-worker kill mid-episode
+replays both sessions deterministically).
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import unique_rid
+from areal_tpu.api.openai_client import ArealOpenAI, hermes_tool_parser
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.env.selfplay import (
+    parse_accepted_observation,
+    proposer_reward,
+)
+from areal_tpu.utils import data as data_utils
+from areal_tpu.utils import logging as logging_util
+from areal_tpu.workflow.agentic import bounded_tool_call
+
+logger = logging_util.getLogger("SelfPlayWorkflow")
+
+
+@dataclasses.dataclass
+class AgentSpec:
+    """One side of a multi-agent episode."""
+
+    name: str
+    role: str = ""
+    # named policy handle (r19): "" rides the default line; two specs
+    # with different handles play different checkpoints on one engine
+    policy: str = ""
+    # traffic class for this side's turns: trained sides are bulk
+    # (shed-able rollout traffic); frozen opponents default interactive
+    # in make_countdown_selfplay_workflow so their turns get bounded
+    # TTFT inside bulk saturation
+    priority: str = "bulk"
+    # trained sides export training rows; untrained sides contribute
+    # only loss-masked context tokens to the shared transcript
+    trained: bool = True
+    # per-side turn budget within one episode phase
+    max_rounds: int = 4
+    # per-side tool-call parser (None = the workflow default): sides
+    # speaking different call conventions need different string surgery
+    tool_parser: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class _PhaseResult:
+    """What one agent's phase leaves behind for reward/export."""
+
+    last_id: Optional[str] = None
+    last_observation: str = ""
+    calls_per_turn: List[int] = dataclasses.field(default_factory=list)
+    errors_per_turn: List[int] = dataclasses.field(default_factory=list)
+
+
+class SelfPlayWorkflow(RolloutWorkflow):
+    """Base driver: per-agent clients over one shared transcript.
+
+    Subclasses own the episode SCRIPT (which agent moves when, how
+    rewards map); this class owns the mechanics every script shares —
+    client construction with the episode-scoped session id and per-agent
+    stamps, the bounded agentic turn loop over the shared message list,
+    and trained-agent row export with per-row agent attribution."""
+
+    def __init__(
+        self,
+        env_factory: Callable[[Dict[str, Any]], Any],
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        agents: List[AgentSpec],
+        turn_discount: float = 0.9,
+        tool_parser=hermes_tool_parser,
+        system_prompt: Optional[str] = None,
+        tool_timeout_s: Optional[float] = 30.0,
+    ):
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "self-play episodes are single-trajectory; group sampling "
+                "happens at the prompt level"
+            )
+        if not agents:
+            raise ValueError("self-play needs at least one agent")
+        names = [a.name for a in agents]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate agent names: {names}")
+        if not any(a.trained for a in agents):
+            raise ValueError(
+                "self-play with zero trained agents produces no rows"
+            )
+        self.env_factory = env_factory
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.agents = list(agents)
+        self.turn_discount = turn_discount
+        self.tool_parser = tool_parser
+        self.system_prompt = system_prompt
+        self.tool_timeout_s = tool_timeout_s
+
+    # -- mechanics ------------------------------------------------------
+    def _make_clients(
+        self, engine, episode_id: str
+    ) -> Dict[str, ArealOpenAI]:
+        """One client per agent, ALL bound to the episode session id:
+        shared-history KV reuse needs every side's turns steering to the
+        one server whose radix cache holds the shared transcript."""
+        return {
+            spec.name: ArealOpenAI(
+                engine,
+                self.tokenizer,
+                gconfig=self.gconfig,
+                tool_parser=spec.tool_parser or self.tool_parser,
+                session_id=episode_id,
+                priority=spec.priority,
+                policy=spec.policy,
+                agent=spec.name,
+                role=spec.role,
+            )
+            for spec in self.agents
+        }
+
+    async def _agent_phase(
+        self,
+        client: ArealOpenAI,
+        spec: AgentSpec,
+        env,
+        messages: List[Dict[str, str]],
+    ) -> _PhaseResult:
+        """Run ONE agent's turns against ONE env over the SHARED
+        transcript until the env reports done or the side's round budget
+        runs out. The loop is the agentic episode loop (tool messages,
+        error observations, template-less wrapping) — self-play composes
+        it per side instead of reinventing it."""
+        res = _PhaseResult()
+        for _ in range(spec.max_rounds):
+            resp = await client.chat.completions.create(
+                messages=messages, tools=env.tools, tool_choice="auto"
+            )
+            res.last_id = resp.id
+            choice = resp.choices[0]
+            messages.append(
+                {"role": "assistant", "content": choice.message.content}
+            )
+            res.calls_per_turn.append(0)
+            res.errors_per_turn.append(0)
+            if choice.finish_reason != "tool_calls":
+                break
+            for tc in choice.message.tool_calls:
+                if env.done:
+                    # a committing call ends the phase; a trailing call
+                    # in the same completion must not overwrite it
+                    break
+                result, is_error = await bounded_tool_call(
+                    env, tc.function.name, tc.function.arguments,
+                    self.tool_timeout_s,
+                )
+                res.calls_per_turn[-1] += 1
+                if is_error:
+                    res.errors_per_turn[-1] += 1
+                content = f"{tc.function.name} -> {result}"
+                if not is_error:
+                    res.last_observation = content
+                if not getattr(self.tokenizer, "chat_template", None):
+                    content = (
+                        f"<tool_response>\n{content}\n</tool_response>"
+                    )
+                messages.append(
+                    {
+                        "role": "tool",
+                        "tool_call_id": tc.id,
+                        "name": tc.function.name,
+                        "content": content,
+                    }
+                )
+            if env.done:
+                break
+        return res
+
+    async def _open_env(self, data: Dict[str, Any]):
+        env = self.env_factory(data)
+        astart = getattr(env, "astart", None)
+        if astart is not None:
+            await astart()
+        return env
+
+    @staticmethod
+    async def _close_env(env) -> None:
+        aclose = getattr(env, "aclose", None)
+        if aclose is not None:
+            try:
+                await aclose()
+            except Exception as e:  # cleanup must not mask the result
+                logger.warning(f"env aclose failed: {e}")
+
+    def _export_rows(
+        self, clients: Dict[str, ArealOpenAI],
+        results: Dict[str, _PhaseResult],
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """One batch with one row per trained-agent completion, plus the
+        per-row attribution the trainer splits on: ``agent_idx`` (index
+        into the workflow's agent list), tool_calls, tool_errors."""
+        rows: List[Dict[str, np.ndarray]] = []
+        agent_idx: List[int] = []
+        tool_calls: List[int] = []
+        tool_errors: List[int] = []
+        for idx, spec in enumerate(self.agents):
+            if not spec.trained:
+                continue
+            res = results.get(spec.name)
+            if res is None or res.last_id is None:
+                continue
+            exported = clients[spec.name].export_completions(
+                self.turn_discount
+            )
+            for turn, c in enumerate(exported.values()):
+                rows.append(c.to_training_row())
+                agent_idx.append(idx)
+                tool_calls.append(
+                    res.calls_per_turn[turn]
+                    if turn < len(res.calls_per_turn) else 0
+                )
+                tool_errors.append(
+                    res.errors_per_turn[turn]
+                    if turn < len(res.errors_per_turn) else 0
+                )
+        if not rows:
+            return None
+        batch = data_utils.concat_padded_tensors(rows)
+        batch["agent_idx"] = np.asarray(agent_idx, np.int32)
+        batch["tool_calls"] = np.asarray(tool_calls, np.int32)
+        batch["tool_errors"] = np.asarray(tool_errors, np.int32)
+        return batch
+
+
+class CountdownSelfPlayWorkflow(SelfPlayWorkflow):
+    """Countdown proposer/solver: the first measured self-play workload.
+
+    Episode script: (1) the PROPOSER authors a numbers/target instance
+    through the grader-validated schema (``propose_instance``); (2) the
+    SOLVER plays the existing countdown tool episode on the accepted
+    instance over the SAME transcript; (3) rewards map per role — solver
+    keeps the binary countdown reward, the proposer earns
+    ``proposer_reward`` (difficulty-banded or zero-sum).
+
+    The committed instance is read from the proposer's final tool
+    OBSERVATION (the one channel journaled replay bit-reproduces), never
+    from env internals. If the proposer never lands a valid instance,
+    the episode falls back to the dataset's own ``numbers``/``target``
+    (the solver still trains) and the proposer's reward is 0.
+    """
+
+    def __init__(
+        self,
+        env_factory: Callable[[Dict[str, Any]], Any],
+        gconfig: GenerationHyperparameters,
+        tokenizer,
+        proposer: Optional[AgentSpec] = None,
+        solver: Optional[AgentSpec] = None,
+        reward_mode: str = "banded",
+        turn_discount: float = 0.9,
+        tool_parser=hermes_tool_parser,
+        system_prompt: Optional[str] = None,
+        tool_timeout_s: Optional[float] = 30.0,
+        proposer_env_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        proposer = proposer or AgentSpec(
+            name="proposer", role="proposer", max_rounds=3
+        )
+        solver = solver or AgentSpec(name="solver", role="solver")
+        if reward_mode not in ("banded", "zero_sum"):
+            raise ValueError(
+                f"unknown self-play reward mode {reward_mode!r}"
+            )
+        super().__init__(
+            env_factory,
+            gconfig,
+            tokenizer,
+            agents=[proposer, solver],
+            turn_discount=turn_discount,
+            tool_parser=tool_parser,
+            system_prompt=system_prompt,
+            tool_timeout_s=tool_timeout_s,
+        )
+        self.proposer = proposer
+        self.solver = solver
+        self.reward_mode = reward_mode
+        # schema bounds forwarded into the proposer env's reset kwargs
+        # (SelfPlayConfig.min_numbers/max_numbers/max_target)
+        self.proposer_env_kwargs = dict(proposer_env_kwargs or {})
+
+    async def arun_episode(
+        self, engine, data: Dict[str, Any]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        episode_id = unique_rid("sp")
+        clients = self._make_clients(engine, episode_id)
+        messages: List[Dict[str, str]] = []
+        if self.system_prompt:
+            messages.append(
+                {"role": "system", "content": self.system_prompt}
+            )
+        results: Dict[str, _PhaseResult] = {}
+
+        # -- phase 1: proposer authors the instance ---------------------
+        penv = await self._open_env(
+            {**data, **self.proposer_env_kwargs, "side": "proposer"}
+        )
+        try:
+            messages.append({"role": "user", "content": penv.prompt()})
+            p_res = await self._agent_phase(
+                clients[self.proposer.name], self.proposer, penv, messages
+            )
+        finally:
+            await self._close_env(penv)
+        results[self.proposer.name] = p_res
+        accepted = parse_accepted_observation(p_res.last_observation)
+        if accepted is not None:
+            numbers, target, band = accepted
+            valid = True
+        else:
+            band = -1
+            valid = False
+            if "numbers" not in data or "target" not in data:
+                # no valid proposal and no dataset fallback: nothing for
+                # the solver to play — drop the episode
+                logger.warning(
+                    "proposer failed and data carries no fallback "
+                    "instance; dropping episode"
+                )
+                return None
+            numbers = [int(x) for x in data["numbers"]]
+            target = int(data["target"])
+
+        # -- phase 2: solver plays the instance -------------------------
+        senv = await self._open_env(
+            {**data, "side": "solver", "numbers": numbers,
+             "target": target}
+        )
+        try:
+            messages.append({"role": "user", "content": senv.prompt()})
+            s_res = await self._agent_phase(
+                clients[self.solver.name], self.solver, senv, messages
+            )
+        finally:
+            await self._close_env(senv)
+        results[self.solver.name] = s_res
+        solver_rew = float(getattr(senv, "reward", 0.0))
+
+        # -- phase 3: per-role reward mapping ---------------------------
+        if s_res.last_id is not None:
+            clients[self.solver.name].set_reward(s_res.last_id, solver_rew)
+        if p_res.last_id is not None:
+            clients[self.proposer.name].set_reward(
+                p_res.last_id,
+                proposer_reward(valid, band, solver_rew, self.reward_mode),
+            )
+        return self._export_rows(clients, results)
+
+
+def make_countdown_selfplay_workflow(
+    config,
+    env_factory: Callable[[Dict[str, Any]], Any],
+    gconfig: GenerationHyperparameters,
+    tokenizer,
+    tool_parser=hermes_tool_parser,
+    system_prompt: Optional[str] = None,
+    tool_timeout_s: Optional[float] = 30.0,
+) -> Optional[CountdownSelfPlayWorkflow]:
+    """Build the countdown self-play workflow from an experiment config
+    carrying a ``selfplay`` section (cli_args.SelfPlayConfig). Returns
+    None when self-play is off — the caller falls back to its
+    single-agent workflow and NOTHING else changes (the strict-no-op
+    contract)."""
+    sp = config.selfplay
+    if not sp.enabled:
+        return None
+    proposer = AgentSpec(
+        name="proposer",
+        role="proposer",
+        policy=sp.proposer_policy,
+        trained=sp.train_proposer,
+        priority="bulk" if sp.train_proposer else sp.opponent_priority,
+        max_rounds=sp.max_propose_rounds,
+    )
+    solver = AgentSpec(
+        name="solver",
+        role="solver",
+        policy=sp.solver_policy,
+        trained=sp.train_solver,
+        priority="bulk" if sp.train_solver else sp.opponent_priority,
+        max_rounds=sp.max_solver_rounds,
+    )
+    return CountdownSelfPlayWorkflow(
+        env_factory,
+        gconfig,
+        tokenizer,
+        proposer=proposer,
+        solver=solver,
+        reward_mode=sp.reward_mode,
+        turn_discount=sp.turn_discount,
+        tool_parser=tool_parser,
+        system_prompt=system_prompt,
+        tool_timeout_s=tool_timeout_s,
+        proposer_env_kwargs={
+            "min_numbers": sp.min_numbers,
+            "max_numbers": sp.max_numbers,
+            "max_target": sp.max_target,
+        },
+    )

@@ -1433,6 +1433,401 @@ def _env_resilience_phase() -> dict:
                     proc.kill()
 
 
+def _selfplay_phase() -> dict:
+    """Self-play countdown episodes, measured (r20). Two cells on one
+    tiny-model in-process engine: proposer/solver episodes where every
+    turn shares ONE transcript — the radix cell measures the
+    shared-prefix cached-token fraction the episode plane earns for
+    free, the affinity-off control (prefix_reuse_min=0) re-prefills
+    every turn from scratch. The frozen solver side rides the
+    INTERACTIVE class (the opponent-turn contract), so the engine's
+    native per-class ttft_seconds histograms give opponent-turn TTFT
+    p95 vs bulk directly; per-side policy/version attribution comes out
+    of the lineage records the episode stamps. A third cell kills an
+    env worker mid-episode (deterministic, on the committing
+    propose_instance /step) and checks the episode replays onto the
+    survivor BIT-IDENTICAL — zero lost episodes."""
+    import asyncio
+    import subprocess
+    import urllib.request as _rq
+
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import (
+        EnvServiceConfig,
+        GenerationHyperparameters,
+        JaxGenConfig,
+    )
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.env.countdown import sample_instance
+    from areal_tpu.env.selfplay import build_side_env
+    from areal_tpu.env.service import make_remote_tool_env_factory
+    from areal_tpu.inference.engine import GenerationEngine
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.workflow.selfplay import (
+        AgentSpec,
+        CountdownSelfPlayWorkflow,
+    )
+    from examples.countdown_agent import ToyToolTokenizer, toy_tool_parser
+    from examples.countdown_selfplay import toy_proposer_parser
+    from tools.trace_report import lineage_summary
+
+    tok = ToyToolTokenizer()
+    cfg = tiny_config("qwen2")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    n_episodes = 12
+
+    class _Adapter:
+        """Engine adapter speaking the ArealOpenAI surface: forwards the
+        traffic class each side's client stamps and collects per-request
+        lineage records grouped by episode qid (what the remote path's
+        ledger would hold)."""
+
+        def __init__(self, eng):
+            self._eng = eng
+            self.by_qid = {}
+
+        def get_version(self):
+            return 0
+
+        async def agenerate(self, req):
+            md = req.metadata or {}
+            fut = self._eng.submit(
+                {
+                    "input_ids": list(req.input_ids),
+                    "priority": str(md.get("priority") or "bulk"),
+                    "sampling_params": {
+                        "max_new_tokens": req.gconfig.max_new_tokens,
+                        "temperature": 1.0,
+                    },
+                }
+            )
+            r = await asyncio.wrap_future(fut)
+            rq = {
+                "rid": req.rid,
+                "weight_versions": sorted(set(r["output_versions"])) or [0],
+            }
+            if md.get("agent"):
+                rq.update(
+                    agent=str(md["agent"]),
+                    role=str(md.get("role") or ""),
+                    policy=str(md.get("policy") or ""),
+                )
+            self.by_qid.setdefault(str(md.get("qid")), []).append(rq)
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=r["output_ids"],
+                output_logprobs=r["output_logprobs"],
+                output_versions=r["output_versions"],
+                stop_reason="stop",
+            )
+
+    def _episode_workflow():
+        # the acceptance shape: trained proposer on bulk, frozen solver
+        # opponent on interactive, distinct per-side policy handles
+        return CountdownSelfPlayWorkflow(
+            env_factory=build_side_env,
+            gconfig=GenerationHyperparameters(
+                n_samples=1, max_new_tokens=16
+            ),
+            tokenizer=tok,
+            proposer=AgentSpec(
+                name="proposer", role="proposer",
+                policy="proposer@stable", priority="bulk",
+                trained=True, max_rounds=2,
+                tool_parser=toy_proposer_parser,
+            ),
+            solver=AgentSpec(
+                name="solver", role="solver", policy="solver@canary",
+                priority="interactive", trained=False, max_rounds=2,
+                tool_parser=toy_tool_parser,
+            ),
+            turn_discount=0.5,
+        )
+
+    def _cell(prefix_reuse_min: int) -> dict:
+        eng = GenerationEngine(
+            JaxGenConfig(
+                dtype="float32", page_size=16, max_num_seqs=8,
+                max_model_len=256, num_pages=64, prefill_chunk=16,
+                admit_wave=4, admit_hold_s=0.0,
+                prefix_reuse_min=prefix_reuse_min,
+            ),
+            model_config=cfg,
+            params=params,
+        ).start()
+        try:
+            from areal_tpu.utils.tracing import Histogram
+
+            adapter = _Adapter(eng)
+            wf = _episode_workflow()
+            rng = np.random.default_rng(0)
+            items = []
+            for _ in range(n_episodes + 2):
+                inst = sample_instance(rng)
+                items.append(
+                    {"numbers": inst.numbers, "target": inst.target}
+                )
+
+            async def _run(batch_items, adp):
+                return await asyncio.gather(
+                    *[wf.arun_episode(adp, it) for it in batch_items],
+                    return_exceptions=True,
+                )
+
+            # warmup: two discarded episodes absorb the XLA compile
+            # storm so the measured window's TTFT reads scheduling,
+            # not compilation; counters are diffed across the window
+            asyncio.run(_run(items[:2], _Adapter(eng)))
+            pre = {
+                k: (list(h.counts), h.count)
+                for k, h in eng.latency_histograms().items()
+            }
+            m0 = eng.metrics()
+
+            t0 = time.perf_counter()
+            out = asyncio.run(_run(items[2:], adapter))
+            wall = time.perf_counter() - t0
+            done = [
+                b for b in out
+                if not isinstance(b, Exception) and b is not None
+            ]
+            m = eng.metrics()
+            ttft = {}
+            for cls in ("interactive", "bulk"):
+                key = f'ttft_seconds{{sched_class="{cls}"}}'
+                h = eng.latency_histograms().get(key)
+                if h is None:
+                    continue
+                c0, n0 = pre.get(key, ([0] * len(h.counts), 0))
+                d = Histogram(h.bounds)
+                d.counts = [a - b for a, b in zip(h.counts, c0)]
+                d.count = h.count - n0
+                if d.count:
+                    ttft[cls] = {
+                        "p50_ms": round(d.quantile(0.5) * 1e3, 2),
+                        "p95_ms": round(d.quantile(0.95) * 1e3, 2),
+                        "turns": d.count,
+                    }
+            records = [
+                {
+                    "uid": qid, "status": "consumed", "attempts": 1,
+                    "consumed_step": 0, "requests": reqs,
+                }
+                for qid, reqs in adapter.by_qid.items()
+            ]
+            return {
+                "episodes": n_episodes,
+                "episodes_completed": len(done),
+                "episodes_per_s": round(len(done) / wall, 3),
+                "wall_s": round(wall, 3),
+                "rows_exported": int(
+                    sum(b["input_ids"].shape[0] for b in done)
+                ),
+                # measured-window fraction; the affinity-off control
+                # keeps same-wave sibling dedup (identical proposer
+                # openers admitted together share pages with the cache
+                # OFF), so the radix-vs-control delta isolates what the
+                # prefix cache itself earns across turns
+                "cached_token_fraction": round(
+                    (
+                        m["total_cached_prompt_tokens"]
+                        - m0["total_cached_prompt_tokens"]
+                    )
+                    / max(
+                        1,
+                        m["total_prompt_tokens"]
+                        - m0["total_prompt_tokens"],
+                    ),
+                    4,
+                ),
+                "prompt_tokens": m["total_prompt_tokens"]
+                - m0["total_prompt_tokens"],
+                "cached_prompt_tokens": m["total_cached_prompt_tokens"]
+                - m0["total_cached_prompt_tokens"],
+                "ttft": ttft,
+                "per_agent": lineage_summary(records)["agents"],
+            }
+        finally:
+            eng.stop()
+
+    def _env_kill_cell() -> dict:
+        def spawn():
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "areal_tpu.env.service",
+                    "--env", "areal_tpu.env.service:selfplay_env",
+                    "--port", "0", "--enable-chaos",
+                ],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+            )
+            line = proc.stdout.readline()
+            if not line.startswith("PORT "):
+                proc.kill()
+                raise RuntimeError(f"no port from env worker: {line!r}")
+            return proc, f"127.0.0.1:{int(line.split()[1])}"
+
+        class _Scripted:
+            """Deterministic transcript: proposer checks then commits
+            '3 5 2 = 21'; solver cracks it — what makes the chaos run
+            comparable bit-for-bit against the uninterrupted one."""
+
+            def __init__(self):
+                self.outs = [
+                    "<call>3 5 2 = 21</call>",
+                    "<submit>3 5 2 = 21</submit>",
+                    "<call>3*7</call>",
+                    "<submit>3*(5+2)</submit>",
+                ]
+
+            def get_version(self):
+                return 0
+
+            async def agenerate(self, req):
+                out = tok.encode(self.outs.pop(0))
+                return ModelResponse(
+                    input_tokens=list(req.input_ids),
+                    output_tokens=out,
+                    output_logprobs=[-0.3] * len(out),
+                    output_versions=[0] * len(out),
+                    stop_reason="stop",
+                )
+
+        ecfg = EnvServiceConfig(
+            call_retries=2, call_timeout_s=10, reset_timeout_s=10,
+            retry_delay_s=0.05,
+        )
+
+        def episode(addrs, capture):
+            inner = make_remote_tool_env_factory(
+                addrs=addrs, config=ecfg,
+                reset_keys=["side", "numbers", "target", "min_numbers",
+                            "max_numbers", "max_target"],
+            )
+
+            def factory(data):
+                env = inner(data)
+                capture.append(env)
+                return env
+
+            wf = CountdownSelfPlayWorkflow(
+                env_factory=factory,
+                gconfig=GenerationHyperparameters(
+                    n_samples=1, max_new_tokens=16
+                ),
+                tokenizer=tok,
+                proposer=AgentSpec(
+                    name="proposer", role="proposer", max_rounds=3,
+                    tool_parser=toy_proposer_parser,
+                ),
+                solver=AgentSpec(
+                    name="solver", role="solver", max_rounds=4,
+                    tool_parser=toy_tool_parser,
+                ),
+                turn_discount=0.5,
+                tool_timeout_s=15.0,
+            )
+            return asyncio.run(
+                wf.arun_episode(
+                    _Scripted(), {"numbers": [1, 1, 1], "target": 9}
+                )
+            )
+
+        procs = []
+        try:
+            vproc, victim = spawn()
+            procs.append(vproc)
+            sproc, survivor = spawn()
+            procs.append(sproc)
+            base_envs = []
+            baseline = episode([survivor], base_envs)
+            # arm the deterministic kill: the victim dies on its 2nd
+            # /step — the COMMITTING propose_instance call of the
+            # proposer session that round-robin stripes onto it
+            req = _rq.Request(
+                f"http://{victim}/chaos",
+                data=json.dumps({
+                    "spec": "kill:side=server,match=/step,start=1"
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with _rq.urlopen(req, timeout=10) as r:
+                r.read()
+            n_chaos, completed, replays, failovers = 3, 0, 0, 0
+            bit_identical = True
+            for _ in range(n_chaos):
+                envs = []
+                batch = episode([victim, survivor], envs)
+                if batch is None:
+                    bit_identical = False
+                    continue
+                completed += 1
+                replays += sum(e.stats["replays"] for e in envs)
+                failovers += sum(e.stats["failovers"] for e in envs)
+                if baseline is None or set(batch) != set(baseline) or any(
+                    not np.array_equal(batch[k], baseline[k])
+                    for k in baseline
+                ):
+                    bit_identical = False
+            return {
+                "episodes": n_chaos,
+                "episodes_lost": n_chaos - completed,
+                "replays": int(replays),
+                "failovers": int(failovers),
+                "bit_identical_to_uninterrupted": bool(
+                    bit_identical and baseline is not None
+                ),
+                "worker_killed": vproc.poll() is not None
+                or vproc.wait(timeout=10) is not None,
+            }
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    try:
+                        proc.stdin.close()
+                        proc.wait(timeout=10)
+                    except Exception:
+                        proc.kill()
+
+    radix = _cell(prefix_reuse_min=4)
+    control = _cell(prefix_reuse_min=0)
+    env_kill = _env_kill_cell()
+    summary = {
+        "cached_token_fraction": radix["cached_token_fraction"],
+        "cached_token_fraction_control": control["cached_token_fraction"],
+        "episodes_per_s": radix["episodes_per_s"],
+        "episodes_lost_under_kill": env_kill["episodes_lost"],
+    }
+    it, bk = radix["ttft"].get("interactive"), radix["ttft"].get("bulk")
+    if it and bk:
+        summary["opponent_ttft_p95_ms"] = it["p95_ms"]
+        summary["bulk_ttft_p95_ms"] = bk["p95_ms"]
+        summary["opponent_ttft_below_bulk"] = it["p95_ms"] < bk["p95_ms"]
+    return {
+        "configs": {
+            "radix": radix,
+            "affinity_off": control,
+            "env_kill": env_kill,
+        },
+        "summary": summary,
+        "workload": {
+            "n_episodes": n_episodes,
+            "sides": {
+                "proposer": "bulk, trained, proposer@stable",
+                "solver": "interactive, frozen opponent, solver@canary",
+            },
+            "max_new_tokens": 16,
+            "page_size": 16,
+            "num_pages": 64,
+            "max_num_seqs": 8,
+            "dtype": "float32",
+        },
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -2634,6 +3029,24 @@ def main():
             },
         )
 
+    # --- self-play episode sub-phase (r20): countdown proposer/solver
+    # episodes on one engine — shared-prefix cached-token fraction vs
+    # the affinity-off control, frozen-opponent (interactive) TTFT p95
+    # vs bulk, episodes/s, per-side policy attribution from lineage,
+    # and a deterministic mid-episode env-worker kill that must lose
+    # zero episodes and replay bit-identical. Same graceful-degradation
+    # rule as the other auxiliary phases ---
+    try:
+        selfplay = _selfplay_phase()
+        extra["selfplay"] = selfplay
+        emit_phase("selfplay", selfplay)
+    except Exception as e:
+        extra["selfplay_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        emit_phase(
+            "selfplay",
+            {"configs": {}, "error": extra["selfplay_error"]},
+        )
+
     unit = (
         "tokens/s (Qwen2-0.5B shape, 2k-token gens, async overlapped "
         "rollout+logp+update+weight-push, 1 chip)"
@@ -2703,8 +3116,19 @@ def _kv_tiers_standalone(tiny: bool) -> None:
     print(json.dumps(payload, indent=2, default=str))
 
 
+def _selfplay_standalone() -> None:
+    """Run ONLY the self-play phase (``python bench.py
+    --selfplay-only``) — tiny-model CPU-feasible by construction, so
+    there is no ``--tiny`` split; emits BENCH_<round>_selfplay.json."""
+    payload = _selfplay_phase()
+    emit_phase("selfplay", payload)
+    print(json.dumps(payload, indent=2, default=str))
+
+
 if __name__ == "__main__":
     if "--kv-tiers-only" in sys.argv:
         _kv_tiers_standalone(tiny="--tiny" in sys.argv)
+    elif "--selfplay-only" in sys.argv:
+        _selfplay_standalone()
     else:
         main()

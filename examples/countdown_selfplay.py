@@ -1,0 +1,223 @@
+"""Self-play countdown: proposer/solver episodes that train through PPO.
+
+The first multi-agent workload (workflow/selfplay.py): inside ONE
+episode the PROPOSER authors a numbers/target instance through the
+grader-validated schema (env/selfplay.py — ``propose_instance`` as
+``'3 5 2 = 21'``), then the SOLVER plays the classic countdown tool
+episode on it over the SAME transcript. The proposer earns the
+difficulty band of its accepted instance (or zero-sum vs the solver);
+the solver keeps the binary countdown reward. Each side's completions
+export as that side's training rows (``agent_idx`` splits the batch),
+with the other side's turns visible only as loss-masked context.
+
+Self-contained like examples/countdown_agent.py (no network egress):
+the same toy word-level tokenizer — whose compact instance format
+``3 5 2 = 21`` needs no JSON punctuation — and a small random-init
+qwen2-shaped model. With real checkpoints, bind each AgentSpec to a
+policy handle (``proposer@stable`` vs ``solver@canary``) on a
+multi-policy server (r19) instead.
+
+Run:  python examples/countdown_selfplay.py [--steps 3]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import uuid
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.api.openai_client import ToolCall, ToolCallFunction
+from examples.countdown_agent import ToyToolTokenizer, toy_tool_parser
+
+
+def toy_proposer_parser(text):
+    """Proposer-side convention over the same toy vocabulary: an
+    instance between <call>...</call> is checked (diagnostic), between
+    <submit>...</submit> it is proposed (commits the episode)."""
+    calls = []
+    for marker, name in (
+        ("call", "check_instance"),
+        ("submit", "propose_instance"),
+    ):
+        for m in re.finditer(
+            rf"<{marker}>(.*?)(?:</{marker}>|$)", text, re.DOTALL
+        ):
+            calls.append(
+                ToolCall(
+                    id=f"call_{uuid.uuid4().hex[:8]}",
+                    function=ToolCallFunction(
+                        name=name,
+                        arguments=json.dumps({"instance": m.group(1)}),
+                    ),
+                )
+            )
+    return calls
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--episodes-per-step", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=48)
+    p.add_argument(
+        "--reward-mode", choices=("banded", "zero_sum"), default="banded"
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxGenConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        ParallelismConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import (
+        FinetuneSpec,
+        WeightUpdateMeta,
+        WeightUpdateMethod,
+    )
+    from areal_tpu.engine.local import LocalSyncInferenceEngine
+    from areal_tpu.engine.ppo.actor import PPOActor
+    from areal_tpu.engine.spmd_engine import SPMDTrainEngine
+    from areal_tpu.env.countdown import sample_instance
+    from areal_tpu.env.selfplay import build_side_env
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.workflow.selfplay import (
+        AgentSpec,
+        CountdownSelfPlayWorkflow,
+    )
+
+    tok = ToyToolTokenizer()
+    model_cfg = ModelConfig(
+        vocab_size=32,
+        hidden_size=128,
+        intermediate_size=384,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        max_position_embeddings=1024,
+        rope_theta=1e4,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        attention_bias=True,
+        family="qwen2",
+    )
+    assert tok.vocab_size <= model_cfg.vocab_size
+    pcfg = PPOActorConfig(
+        dtype="float32",
+        param_dtype="float32",
+        gradient_checkpointing=False,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=32768),
+        optimizer=OptimizerConfig(
+            lr=1e-5, warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+        ),
+        parallel=ParallelismConfig(),
+        group_size=1,  # self-play episodes yield variable rows per side
+        ppo_n_minibatches=1,
+        group_reward_norm=False,
+        recompute_logprob=True,
+        use_decoupled_loss=True,
+        temperature=1.0,
+    )
+    engine = SPMDTrainEngine(pcfg)
+    engine.initialize(
+        ft_spec=FinetuneSpec(1, 1000, args.episodes_per_step),
+        model_config=model_cfg,
+        seed=0,
+    )
+    actor = PPOActor(pcfg, engine)
+
+    rollout = LocalSyncInferenceEngine(
+        InferenceEngineConfig(
+            experiment_name="countdown", trial_name="selfplay",
+            consumer_batch_size=args.episodes_per_step,
+        ),
+        JaxGenConfig(
+            dtype="float32",
+            max_num_seqs=16,
+            max_model_len=1024,
+            page_size=16,
+            prefill_chunk=64,
+            decode_chunk=8,
+            admit_wave=8,
+            kv_bucket=128,
+        ),
+        model_config=model_cfg,
+        params=jax.device_get(engine.params),
+    )
+    rollout.initialize(train_engine=engine)
+
+    gconfig = GenerationHyperparameters(
+        n_samples=1,
+        max_new_tokens=args.max_new_tokens,
+        temperature=1.0,
+        stop_token_ids=[tok.eos_token_id],
+    )
+    workflow = CountdownSelfPlayWorkflow(
+        env_factory=build_side_env,
+        gconfig=gconfig,
+        tokenizer=tok,
+        proposer=AgentSpec(
+            name="proposer", role="proposer", max_rounds=3,
+            tool_parser=toy_proposer_parser,
+        ),
+        solver=AgentSpec(
+            name="solver", role="solver", max_rounds=3,
+            tool_parser=toy_tool_parser,
+        ),
+        reward_mode=args.reward_mode,
+        turn_discount=0.9,
+    )
+
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        t0 = time.time()
+        items = []
+        for _ in range(args.episodes_per_step):
+            # the dataset instance is the FALLBACK the solver plays when
+            # the proposer fails to land a valid instance (proposer
+            # reward 0) — a random policy fails often, so every episode
+            # still trains the solver side
+            env = sample_instance(rng)
+            items.append({"numbers": env.numbers, "target": env.target})
+        batch = rollout.rollout_batch(items, workflow)
+        tool_calls = batch.pop("tool_calls", np.zeros(1))
+        tool_errors = batch.pop("tool_errors", np.zeros(1))
+        agent_idx = batch.pop("agent_idx", np.zeros(1, np.int32))
+        adv = actor.compute_advantages(dict(batch))
+        stats = actor.ppo_update(adv)
+        rollout.pause()
+        v = engine.get_version() + 1
+        rollout.update_weights(
+            WeightUpdateMeta(type=WeightUpdateMethod.DEVICE, model_version=v)
+        ).result(timeout=600)
+        engine.set_version(v)
+        rollout.resume()
+        n_prop = int(np.sum(agent_idx == 0))
+        n_solv = int(np.sum(agent_idx == 1))
+        print(
+            f"[selfplay] step {step}: rows={batch['input_ids'].shape[0]} "
+            f"(proposer {n_prop} / solver {n_solv}) "
+            f"tool_calls/turn={float(np.mean(tool_calls)):.2f} "
+            f"tool_errors/turn={float(np.mean(tool_errors)):.2f} "
+            f"reward_mean={float(np.mean(batch['rewards'])):.3f} "
+            f"loss={stats[0]['loss']:.4f} ({time.time()-t0:.1f}s)",
+            flush=True,
+        )
+    rollout.destroy()
+
+
+if __name__ == "__main__":
+    main()

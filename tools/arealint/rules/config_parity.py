@@ -23,6 +23,12 @@ makes the whole plumbing chain a machine-checked join:
    ``inference/router.py``) must have a flag in the router's ``main()``
    — the subprocess router must be configurable to what the in-process
    router already honors.
+5. **selfplay**: every scalar field of ``SelfPlayConfig`` must be READ
+   by ``workflow/selfplay.py`` (``*.selfplay.<field>`` attribute
+   accesses, or through a local ``sp = cfg.selfplay`` alias). The
+   self-play plane is trainer-side (no server CLI), so the failure mode
+   inverts: a field the workflow never reads is dead config — operators
+   set it and nothing changes, silently.
 """
 
 import ast
@@ -35,6 +41,7 @@ RULE_ID = "ARL002"
 CLI_ARGS = "areal_tpu/api/cli_args.py"
 SERVER = "areal_tpu/inference/server.py"
 ROUTER = "areal_tpu/inference/router.py"
+SELFPLAY_WF = "areal_tpu/workflow/selfplay.py"
 LAUNCHERS = (
     "areal_tpu/launcher/local.py",
     "areal_tpu/launcher/ray.py",
@@ -123,6 +130,11 @@ _ROUTER_ALIASES: Dict[str, Optional[str]] = {
     "down_consecutive": None,
     "cooldown_s": None,
 }
+
+# SelfPlayConfig fields the workflow module is NOT required to read
+# (every exemption must say why); currently none — the whole config is
+# workflow-consumed by design.
+_SELFPLAY_EXEMPT: Set[str] = set()
 
 
 def _kebab(field: str) -> str:
@@ -452,6 +464,60 @@ def check(project: core.Project, files: List[str]) -> List[core.Violation]:
                         symbol="main",
                     )
                 )
+
+    # (5): SelfPlayConfig ↔ workflow read-parity (dead-field detection)
+    selfplay_wf = project.module(SELFPLAY_WF)
+    selfplay_fields = _dataclass_scalar_fields(cli, "SelfPlayConfig")
+    if selfplay_wf is not None and selfplay_fields:
+        aliases = set()
+        for node in ast.walk(selfplay_wf.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "selfplay"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        reads: Set[str] = set()
+        field_names = {f for f, _ in selfplay_fields}
+        for node in ast.walk(selfplay_wf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in field_names
+                and (
+                    (
+                        isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "selfplay"
+                    )
+                    or (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id in aliases
+                    )
+                )
+            ):
+                reads.add(node.attr)
+        for field, line in selfplay_fields:
+            if field in _SELFPLAY_EXEMPT or field in reads:
+                continue
+            out.append(
+                core.Violation(
+                    rule=RULE_ID,
+                    path=CLI_ARGS,
+                    line=line,
+                    message=(
+                        f"SelfPlayConfig.{field} is never read by "
+                        f"{SELFPLAY_WF}: dead config — operators set "
+                        f"it and nothing changes"
+                    ),
+                    hint=(
+                        "consume the field in workflow/selfplay.py or "
+                        "list it in config_parity._SELFPLAY_EXEMPT "
+                        "with a reason"
+                    ),
+                    symbol="SelfPlayConfig",
+                )
+            )
     return out
 
 
@@ -465,6 +531,6 @@ core.register_rule(
         ),
         check=check,
         paths=(),  # pure cross-module join, no per-file walk
-        anchors=(CLI_ARGS, SERVER, ROUTER) + LAUNCHERS,
+        anchors=(CLI_ARGS, SERVER, ROUTER, SELFPLAY_WF) + LAUNCHERS,
     )
 )

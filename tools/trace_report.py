@@ -938,11 +938,40 @@ def lineage_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     staleness-at-consumption distribution."""
     rows = []
     staleness: List[int] = []
+    # self-play episode plane: requests stamped with agent/role split
+    # one episode's story per side (policy handle + versions + turns)
+    agents: Dict[str, Dict[str, Any]] = {}
     for r in records:
         rewards = r.get("rewards") or []
         st = r.get("staleness_max")
         if st is not None:
             staleness.append(int(st))
+        seen_agents = set()
+        for rq in r.get("requests", []):
+            agent = str(rq.get("agent", ""))
+            if not agent:
+                continue
+            a = agents.setdefault(
+                agent,
+                {
+                    "agent": agent,
+                    "role": str(rq.get("role", "")),
+                    "turns": 0,
+                    "episodes": 0,
+                    "policies": set(),
+                    "versions": set(),
+                },
+            )
+            a["turns"] += 1
+            pol = str(rq.get("policy", ""))
+            if pol:
+                a["policies"].add(pol)
+            a["versions"].update(
+                int(v) for v in rq.get("weight_versions", [])
+            )
+            if agent not in seen_agents:
+                a["episodes"] += 1
+                seen_agents.add(agent)
         rows.append(
             {
                 "uid": str(r.get("uid", "?")),
@@ -984,6 +1013,17 @@ def lineage_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "staleness_p50": _percentile(staleness, 0.50),
         "staleness_max": staleness[-1] if staleness else 0,
         "rows": rows,
+        "agents": [
+            {
+                "agent": a["agent"],
+                "role": a["role"],
+                "turns": a["turns"],
+                "episodes": a["episodes"],
+                "policies": sorted(a["policies"]),
+                "versions": sorted(a["versions"]),
+            }
+            for _, a in sorted(agents.items())
+        ],
     }
 
 
@@ -1014,6 +1054,21 @@ def format_lineage(ln: Dict[str, Any]) -> str:
             f"{r['consumed_step'] if r['consumed_step'] is not None else '-':>6}"
             f"{r['reward_mean'] if r['reward_mean'] is not None else '-':>8}"
         )
+    if ln.get("agents"):
+        out += [
+            "",
+            "per-agent (self-play episodes):",
+            f"{'agent':<12}{'role':<12}{'policy':<20}{'vers':<12}"
+            f"{'turns':>6}{'eps':>5}",
+        ]
+        for a in ln["agents"]:
+            pol = ",".join(a["policies"]) or "-"
+            vers = ",".join(str(v) for v in a["versions"]) or "-"
+            out.append(
+                f"{a['agent'][:11]:<12}{a['role'][:11]:<12}"
+                f"{pol[:19]:<20}{vers[:11]:<12}"
+                f"{a['turns']:>6}{a['episodes']:>5}"
+            )
     return "\n".join(out)
 
 
